@@ -1,0 +1,15 @@
+"""svd_jacobi_tpu — a TPU-native one-sided block-Jacobi SVD framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capabilities of
+acastellanos95/SVD-Jacobi-MPI-CUDA (an MPI+OpenMP+CUDA one-sided Jacobi SVD,
+see SURVEY.md): dense SVD via tournament-ordered block-Jacobi sweeps, batched
+on the MXU, sharded over TPU meshes with ICI collectives, with a
+LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
+"""
+
+from .config import SVDConfig
+from .solver import SVDResult, svd
+
+__version__ = "0.1.0"
+
+__all__ = ["svd", "SVDConfig", "SVDResult", "__version__"]

@@ -1,0 +1,59 @@
+"""Solver configuration.
+
+TPU-native replacement for the reference's scattered compile-time constants
+(reference: lib/global.cuh:9 TOLERANCE, lib/JacobiMethods.cu:234 maxIterations,
+lib/JacobiMethods.cu:200 threadsPerBlock, main.cu:1431 36-thread pin) — one
+dataclass surfaced through every public entry point and the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SVDConfig:
+    """Static configuration for the one-sided block-Jacobi SVD solver.
+
+    Attributes:
+      block_size: width ``b`` of a column block. Columns are padded to
+        ``2k*b`` and grouped into ``2k`` blocks; each sweep runs ``2k-1``
+        tournament rounds of ``k`` disjoint block pairs. ``None`` picks a
+        TPU-friendly width automatically (multiple of 128 when n is large).
+      max_sweeps: hard cap on Jacobi sweeps. The reference hard-codes a single
+        sweep and ignores its own convergence estimate
+        (lib/JacobiMethods.cu:234,462); we instead iterate to convergence.
+      tol: convergence threshold on the scaled coupling
+        ``max_{i<j} |a_i . a_j| / (|a_i| |a_j|)`` over every column pair met
+        in a sweep (the dgesvj criterion; numerically-null columns are
+        deflated from the statistic). ``None`` -> ``sqrt(m) * eps`` of the
+        input dtype, the roundoff floor of an m-term dot product.
+      gram_dtype: dtype in which Gram matrices / rotations are *computed*
+        (storage dtype is taken from the input array). E.g. keep A in
+        bfloat16 but accumulate Gram products in float32.
+      matmul_precision: JAX precision for the Gram/update matmuls
+        ("highest" | "high" | "default"). On TPU "default" f32 matmuls go
+        through bf16 passes; "highest" keeps full f32.
+    """
+
+    block_size: Optional[int] = None
+    max_sweeps: int = 32
+    tol: Optional[float] = None
+    pair_solver: str = "auto"  # "auto" | "qr-svd" (stable) | "gram-eigh" (fast)
+    gram_dtype: Optional[str] = None
+    matmul_precision: str = "highest"
+
+    def pick_block_size(self, n: int) -> int:
+        if self.block_size is not None:
+            if self.block_size < 1:
+                raise ValueError(f"block_size must be >= 1, got {self.block_size}")
+            return self.block_size
+        # TPU-friendly default: lane-aligned 128-wide blocks once n is big
+        # enough; otherwise roughly n/8 so there is parallelism across pairs.
+        if n >= 2048:
+            return 128
+        b = 1
+        while b * 16 <= n and b < 128:
+            b *= 2
+        return b

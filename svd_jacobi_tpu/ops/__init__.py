@@ -1,0 +1,3 @@
+"""Numerical kernels: batched block orthogonalization, Schur rotations."""
+
+from . import blockwise  # noqa: F401

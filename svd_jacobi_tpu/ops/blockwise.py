@@ -1,0 +1,289 @@
+"""Batched block-pair orthogonalization — the MXU-facing numerical core.
+
+TPU-native replacement for the reference's per-pair hot loop
+(reference: lib/JacobiMethods.cu:437-604 "local pair solver"): the reference
+computes Gram scalars with a host dot-product loop (lib/JacobiMethods.cu:450-459),
+a scalar Schur rotation (lib/JacobiMethods.cu:466-478), and then ships two
+columns to the GPU and back per rotation (8 memcpys + 2 launches,
+lib/JacobiMethods.cu:479-510). Here one round processes *all* k block pairs at
+once, resident on device:
+
+  X   = [A_I | A_J]               (k, m, 2b)   concat of the paired blocks
+  G   = X^T X                     (k, 2b, 2b)  batched matmul -> MXU
+  Q   = eigvecs(G) desc.          (k, 2b, 2b)  batched eigh
+  X'  = X Q,  V' = V Q                         batched matmuls -> MXU
+
+Post-multiplying by the eigenvectors of the Gram matrix makes the 2b columns
+of each pair exactly orthogonal (one-sided block Jacobi with an exact
+subproblem solve); ordering eigenvalues descending embeds de-Rijk-style norm
+sorting, which accelerates convergence. The generalization from the
+reference's b = 1 Givens rotation (lib/JacobiMethods.cu:1483-1491) to b >= 128
+blocks is what turns this memory-bound scalar update into MXU matmuls
+(SURVEY.md section 7, "hard parts": block-Jacobi formulation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _precision(name: str) -> jax.lax.Precision:
+    return {
+        "highest": jax.lax.Precision.HIGHEST,
+        "high": jax.lax.Precision.HIGH,
+        "default": jax.lax.Precision.DEFAULT,
+    }[name]
+
+
+def pair_gram(x: jax.Array, gram_dtype, precision: str) -> jax.Array:
+    """Batched Gram matrices G = X^T X for X of shape (k, m, 2b)."""
+    xg = x.astype(gram_dtype)
+    return jnp.einsum(
+        "kmi,kmj->kij", xg, xg,
+        precision=_precision(precision),
+        preferred_element_type=gram_dtype,
+    )
+
+
+def off_diag_stats(g: jax.Array, b: int) -> Tuple[jax.Array, jax.Array]:
+    """(max_rel, off2): convergence statistics from a round's Gram matrices.
+
+    ``max_rel`` is the dgesvj-style scaled coupling ``max_{i<j} |g_ij| /
+    sqrt(g_ii g_jj)`` over every column pair inside each 2b-wide Gram matrix
+    — the cosine of the angle between columns, so it bounds the orthogonality
+    of U columns independently of conditioning (a globally normalized
+    off-norm does not: tiny-sigma columns can stay far from orthogonal while
+    the global norm looks converged). ``off2`` is the plain squared F-norm of
+    the coupling blocks, kept as a diagnostic.
+
+    This is the criterion the reference computes per pair as
+    ``convergence_value = |alpha|/sqrt(beta*gamma)`` and then discards
+    (lib/JacobiMethods.cu:462,547; dead because maxIterations = 1,
+    lib/JacobiMethods.cu:234) — here it actually drives the sweep loop.
+    """
+    acc = jnp.float32 if g.dtype in (jnp.bfloat16, jnp.float16) else g.dtype
+    g = g.astype(acc)
+    off2 = jnp.sum(jnp.square(g[:, :b, b:]))
+    d2 = jnp.diagonal(g, axis1=-2, axis2=-1)                # (k, 2b)
+    d = jnp.sqrt(jnp.maximum(d2, jnp.finfo(acc).tiny))
+    c = jnp.abs(g) / (d[:, :, None] * d[:, None, :])
+    n2 = g.shape[-1]
+    c = c * (1.0 - jnp.eye(n2, dtype=acc))[None]
+    # Deflation (dgesvj-style): columns whose norm is at the roundoff floor
+    # relative to the largest column are numerically null — their directions
+    # are noise and their couplings can never converge. Exclude them from
+    # the statistic (they still get rotated; sigma ~ 0 comes out fine).
+    eps = jnp.finfo(g.dtype).eps
+    null_thresh = jnp.max(d2) * (n2 * eps) ** 2
+    live = d2 > null_thresh                                  # (k, 2b)
+    pair_live = live[:, :, None] & live[:, None, :]
+    max_rel = jnp.max(jnp.where(pair_live, c, jnp.zeros_like(c)))
+    return max_rel, off2
+
+
+def _nearest_identity_order(q: jax.Array) -> jax.Array:
+    """Permute/sign eigenvector columns so Q is as close to I as possible.
+
+    eigh orders columns by eigenvalue, which gives Q a permutation component
+    even when G is nearly diagonal. A rotation with a permutation component
+    moves column *contents* between tournament slots, which lets strongly
+    coupled columns chase each other around the ring and never meet — the
+    sweep stalls (observed: off-norm frozen while per-pair coupling -> 0).
+    Reordering each column to the slot of its dominant entry (and fixing the
+    sign) makes Q -> I as G -> diagonal: every rotation is then a small-angle
+    rotation, the classical convergence condition for cyclic Jacobi — the
+    block generalization of the reference's always-small-angle Rutishauser
+    t = sgn(tau)/(|tau| + sqrt(1+tau^2)) choice (lib/JacobiMethods.cu:466-478).
+    """
+    dom = jnp.argmax(jnp.abs(q), axis=-2)                      # (k, 2b)
+    perm = jnp.argsort(dom, axis=-1)                           # (k, 2b)
+    q = jnp.take_along_axis(q, perm[:, None, :], axis=-1)
+    dom_p = jnp.take_along_axis(dom, perm, axis=-1)
+    lead = jnp.take_along_axis(q, dom_p[:, None, :], axis=-2)  # (k, 1, 2b)
+    signs = jnp.sign(lead)
+    return q * jnp.where(signs == 0, jnp.ones_like(signs), signs)
+
+
+def _rotate_cols(top: jax.Array, bot: jax.Array):
+    """Tournament rotation on the *last* axis (column pairs of a panel)."""
+    if top.shape[-1] == 1:
+        return top, bot
+    new_top = jnp.concatenate([top[..., :1], bot[..., :1], top[..., 1:-1]], axis=-1)
+    new_bot = jnp.concatenate([bot[..., 1:], top[..., -1:]], axis=-1)
+    return new_top, new_bot
+
+
+def givens_cleanup_sweep(p: jax.Array, dmax2: jax.Array):
+    """One scalar one-sided Jacobi sweep over the columns of each panel.
+
+    ``p``: (k, n2, n2) batch of small panels (the rotated R factors). Runs a
+    full tournament of n2-1 rounds of scalar Givens rotations, with (c, s)
+    from the Rutishauser/Golub-Van-Loan formula the reference uses
+    (tau = (gamma-beta)/(2 alpha), t = sgn(tau)/(|tau|+sqrt(1+tau^2));
+    lib/JacobiMethods.cu:466-478, lib/Utils.cu:130-165). Returns
+    ``(p', q, max_rel)`` where ``q`` is the accumulated orthogonal transform
+    (p' = p @ q) and ``max_rel`` the largest scaled coupling seen (deflated
+    columns masked via ``dmax2``, the global max squared column norm).
+
+    Why this exists: XLA's TPU svd/eigh converge to an *absolute* tolerance
+    (relative to sigma_max), so couplings between small-norm columns are
+    left unresolved — the block rotation comes back as exact identity while
+    scaled couplings sit at 1e-2, and the sweep loop spins. Scalar rotations
+    computed directly from (alpha, beta, gamma) are accurate at *any* scale
+    (the reason sgesvj delivers high relative accuracy); one such sweep after
+    the block solve restores sgesvj-grade convergence on TPU.
+    """
+    k, n2, _ = p.shape
+    if n2 < 2:
+        return p, jnp.broadcast_to(jnp.eye(n2, dtype=p.dtype), p.shape), jnp.zeros((), jnp.float32)
+    b2 = n2 // 2
+    eps = jnp.finfo(p.dtype).eps
+    tiny = jnp.finfo(p.dtype).tiny
+    null_thresh = dmax2.astype(p.dtype) * (n2 * eps) ** 2
+
+    eye = jnp.broadcast_to(jnp.eye(n2, dtype=p.dtype), (k, n2, n2))
+
+    def body(carry, _):
+        ptop, pbot, qtop, qbot, max_rel = carry
+        alpha = jnp.sum(ptop * pbot, axis=1)                  # (k, b2)
+        beta = jnp.sum(ptop * ptop, axis=1)
+        gamma = jnp.sum(pbot * pbot, axis=1)
+        denom = jnp.sqrt(jnp.maximum(beta, tiny)) * jnp.sqrt(jnp.maximum(gamma, tiny))
+        rel = jnp.abs(alpha) / jnp.maximum(denom, tiny)
+        live = (beta > null_thresh) & (gamma > null_thresh)
+        max_rel = jnp.maximum(
+            max_rel, jnp.max(jnp.where(live, rel, 0.0)).astype(jnp.float32))
+        # Rutishauser small-angle rotation; skip numerically-null couplings.
+        safe_a = jnp.where(jnp.abs(alpha) > tiny, alpha, jnp.ones_like(alpha))
+        tau = (gamma - beta) / (2.0 * safe_a)
+        sgn = jnp.where(tau >= 0, 1.0, -1.0).astype(p.dtype)
+        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+        c = 1.0 / jnp.sqrt(1.0 + t * t)
+        s = t * c
+        rot = jnp.abs(alpha) > tiny
+        c = jnp.where(rot, c, jnp.ones_like(c))[:, None, :]
+        s = jnp.where(rot, s, jnp.zeros_like(s))[:, None, :]
+        ptop, pbot = c * ptop - s * pbot, s * ptop + c * pbot
+        qtop, qbot = c * qtop - s * qbot, s * qtop + c * qbot
+        ptop, pbot = _rotate_cols(ptop, pbot)
+        qtop, qbot = _rotate_cols(qtop, qbot)
+        return (ptop, pbot, qtop, qbot, max_rel), None
+
+    init = (p[..., :b2], p[..., b2:], eye[..., :b2], eye[..., b2:],
+            jnp.zeros((), jnp.float32))
+    (ptop, pbot, qtop, qbot, max_rel), _ = jax.lax.scan(body, init, None, length=n2 - 1)
+    # A full tournament cycle returns the layout to the initial order.
+    return (jnp.concatenate([ptop, pbot], axis=-1),
+            jnp.concatenate([qtop, qbot], axis=-1), max_rel)
+
+
+def _newton_schulz_polish(q: jax.Array, precision) -> jax.Array:
+    """One Newton-Schulz step q <- q(3I - q^T q)/2: restores orthogonality of
+    an almost-orthogonal q to the dtype floor (TPU svd/eigh return rotations
+    that are only ~1e-5/1e-6 orthogonal in f32; applying hundreds of them
+    would erode U/V)."""
+    n2 = q.shape[-1]
+    g = jnp.einsum("kij,kil->kjl", q, q, precision=precision,
+                   preferred_element_type=q.dtype)
+    return jnp.einsum("kij,kjl->kil", q,
+                      1.5 * jnp.eye(n2, dtype=q.dtype) - 0.5 * g,
+                      precision=precision, preferred_element_type=q.dtype)
+
+
+def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_name,
+                              with_v, method):
+    b = top.shape[-1]
+    gram_dtype = jnp.dtype(gram_dtype_name)
+    x = jnp.concatenate([top, bot], axis=-1)  # (k, m, 2b)
+    prec = _precision(precision)
+    if method == "gram-eigh":
+        # Fast path: Gram + eigh. Squares the condition number — fine in f64
+        # or for well-conditioned inputs; stalls in f32 when cond(A)^2
+        # approaches 1/eps.
+        g = pair_gram(x, gram_dtype, precision)
+        max_rel, off2 = off_diag_stats(g, b)
+        _, q = jnp.linalg.eigh(g)
+        q = _nearest_identity_order(q).astype(gram_dtype)
+        q = _newton_schulz_polish(q, prec)
+    elif method == "qr-svd":
+        # Stable path: R = qr(X).R is a backward-stable small image of the
+        # pair (conditioning enters linearly, not squared); the rotation is
+        # the right singular factor of R. This is the block analogue of why
+        # scalar sgesvj stays accurate in f32 where Gram-based methods fail.
+        r = jnp.linalg.qr(x.astype(gram_dtype), mode="r")  # (k, 2b, 2b)
+        g = jnp.einsum("kij,kil->kjl", r, r, precision=prec,
+                       preferred_element_type=gram_dtype)
+        max_rel, off2 = off_diag_stats(g, b)
+        _, _, vt = jnp.linalg.svd(r)
+        q = _nearest_identity_order(vt.mT).astype(gram_dtype)
+        q = _newton_schulz_polish(q, prec)
+        # Scalar cleanup: XLA's svd on TPU resolves couplings only to an
+        # absolute (sigma_max-relative) tolerance; one scale-independent
+        # Givens sweep on the rotated panel finishes the job (see
+        # givens_cleanup_sweep). Without it the TPU sweep loop stalls with
+        # block rotations that come back as exact identity.
+        r2 = jnp.einsum("kij,kjl->kil", r, q, precision=prec,
+                        preferred_element_type=gram_dtype)
+        dmax2 = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
+        _, q2, _ = givens_cleanup_sweep(r2, dmax2)
+        q = jnp.einsum("kij,kjl->kil", q, q2, precision=prec,
+                       preferred_element_type=gram_dtype)
+    else:
+        raise ValueError(f"unknown pair solver method: {method!r}")
+    prec = _precision(precision)
+    xn = jnp.einsum("kmi,kij->kmj", x.astype(gram_dtype), q, precision=prec,
+                    preferred_element_type=gram_dtype).astype(top.dtype)
+    new_top, new_bot = xn[..., :b], xn[..., b:]
+    if with_v:
+        v = jnp.concatenate([vtop, vbot], axis=-1)
+        vn = jnp.einsum("kmi,kij->kmj", v.astype(gram_dtype), q, precision=prec,
+                        preferred_element_type=gram_dtype).astype(vtop.dtype)
+        new_vtop, new_vbot = vn[..., :b], vn[..., b:]
+    else:
+        new_vtop, new_vbot = vtop, vbot
+    return new_top, new_bot, new_vtop, new_vbot, max_rel, off2
+
+
+def orthogonalize_pairs(
+    top: jax.Array,
+    bot: jax.Array,
+    vtop: Optional[jax.Array],
+    vbot: Optional[jax.Array],
+    *,
+    precision: str = "highest",
+    gram_dtype=None,
+    method: str = "qr-svd",
+) -> Tuple[jax.Array, jax.Array, Optional[jax.Array], Optional[jax.Array], jax.Array, jax.Array]:
+    """Orthogonalize each (top[i], bot[i]) block pair; update V alongside.
+
+    Args:
+      top, bot: (k, m, b) stacks of paired column blocks of A.
+      vtop, vbot: (k, n, b) stacks of the matching V blocks, or None when the
+        caller does not accumulate V (NoVec paths).
+
+    Returns:
+      (top', bot', vtop', vbot', max_rel, off2) — convergence statistics
+      measured on this round's Gram matrices *before* rotation (see
+      `off_diag_stats`).
+    """
+    if gram_dtype is None:
+        gram_dtype = jnp.promote_types(top.dtype, jnp.float32)
+    with_v = vtop is not None
+    if not with_v:
+        # Placeholders keep a single jitted signature; zero-size arrays cost
+        # nothing and the with_v=False branch never touches them.
+        vtop = jnp.zeros((top.shape[0], 0, top.shape[2]), top.dtype)
+        vbot = vtop
+    new_top, new_bot, new_vtop, new_vbot, max_rel, off2 = _orthogonalize_pairs_impl(
+        top, bot, vtop, vbot,
+        precision=precision,
+        gram_dtype_name=jnp.dtype(gram_dtype).name,
+        with_v=with_v,
+        method=method,
+    )
+    if not with_v:
+        new_vtop = new_vbot = None
+    return new_top, new_bot, new_vtop, new_vbot, max_rel, off2

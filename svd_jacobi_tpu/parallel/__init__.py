@@ -1,0 +1,3 @@
+"""Parallelism: tournament schedule, device meshes, sharded sweeps."""
+
+from . import schedule  # noqa: F401

@@ -1,0 +1,1 @@
+"""Utilities: matrix generation, validation oracles, reporting, checkpoints."""

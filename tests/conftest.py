@@ -1,0 +1,28 @@
+"""Test backend: 8 virtual CPU devices, fp64 enabled.
+
+SURVEY.md section 4: the reference could only test multi-node on the real
+cluster; we exercise all mesh/ppermute logic on a virtual 8-device CPU
+backend (`--xla_force_host_platform_device_count=8`) so the full distributed
+path runs in CI with no TPU attached.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+# The axon TPU plugin registers itself via sitecustomize and ignores
+# JAX_PLATFORMS from the environment; force CPU through the config API.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    assert len(jax.devices()) == 8
+    return jax.devices()

@@ -1,0 +1,60 @@
+"""Property tests for the tournament schedule (SURVEY.md section 7 step 1:
+"every pair exactly once per sweep" carries the proof obligation for both the
+single-device scan and the ppermute ring)."""
+
+import itertools
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from svd_jacobi_tpu.parallel import schedule as sched
+
+
+@pytest.mark.parametrize("nblocks", [2, 4, 6, 8, 16, 30, 64])
+def test_every_pair_exactly_once(nblocks):
+    table = sched.schedule(nblocks)
+    assert table.shape == (sched.num_rounds(nblocks), nblocks // 2, 2)
+    seen = [tuple(sorted(p)) for rnd in table for p in rnd]
+    expect = list(itertools.combinations(range(nblocks), 2))
+    assert sorted(seen) == sorted(expect)
+    assert len(seen) == len(set(seen))
+
+
+@pytest.mark.parametrize("nblocks", [2, 4, 8, 12])
+def test_rounds_are_disjoint(nblocks):
+    for rnd in sched.schedule(nblocks):
+        flat = rnd.ravel().tolist()
+        assert sorted(flat) == list(range(nblocks))
+
+
+@pytest.mark.parametrize("nblocks", [4, 8, 10])
+def test_rotation_returns_to_start(nblocks):
+    """The rotation is a (2k-1)-cycle on non-fixed slots: after 2k-1 steps the
+    layout returns to the initial assignment (so sweeps compose cleanly)."""
+    k = nblocks // 2
+    top, bot = np.arange(k), np.arange(k, 2 * k)
+    t, b = top.copy(), bot.copy()
+    for _ in range(sched.num_rounds(nblocks)):
+        t, b = sched.rotate_indices(t, b)
+    np.testing.assert_array_equal(t, top)
+    np.testing.assert_array_equal(b, bot)
+
+
+def test_rotate_blocks_matches_rotate_indices():
+    k = 5
+    top_i, bot_i = np.arange(k), np.arange(k, 2 * k)
+    top_d = jnp.arange(k, dtype=jnp.float32)[:, None, None] * jnp.ones((1, 3, 2))
+    bot_d = jnp.arange(k, 2 * k, dtype=jnp.float32)[:, None, None] * jnp.ones((1, 3, 2))
+    for _ in range(3):
+        top_i, bot_i = sched.rotate_indices(top_i, bot_i)
+        top_d, bot_d = sched.rotate_blocks(top_d, bot_d)
+    np.testing.assert_array_equal(np.asarray(top_d[:, 0, 0]), top_i)
+    np.testing.assert_array_equal(np.asarray(bot_d[:, 0, 0]), bot_i)
+
+
+def test_single_pair_identity():
+    top, bot = np.array([0]), np.array([1])
+    t, b = sched.rotate_indices(top, bot)
+    np.testing.assert_array_equal(t, top)
+    np.testing.assert_array_equal(b, bot)

@@ -1,0 +1,133 @@
+"""Single-device solver vs the numpy/LAPACK oracle (SURVEY.md section 4:
+sigma oracle + residual + the orthogonality checks the reference lacks)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from svd_jacobi_tpu import SVDConfig, svd
+from svd_jacobi_tpu.utils import matgen, validation
+
+
+def _check(a, result, sigma_tol, res_tol, orth_tol=None):
+    # orth_tol defaults: the solver's off-norm floor is ~2000*eps (f64) /
+    # ~1000*eps (f32); U/V orthogonality errors scale with n * floor.
+    if orth_tol is None:
+        orth_tol = 1e-10 if result.s.dtype == np.float64 else 5e-3
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    rep = validation.validate(a, result, s_ref=s_ref)
+    assert float(rep.sigma_err) < sigma_tol, rep.as_dict()
+    if rep.residual_rel is not None:
+        assert float(rep.residual_rel) < res_tol, rep.as_dict()
+        assert float(rep.u_orth) < orth_tol, rep.as_dict()
+        assert float(rep.v_orth) < orth_tol, rep.as_dict()
+    # descending order
+    s = np.asarray(result.s)
+    assert np.all(np.diff(s) <= 1e-30 + 1e-6 * s[0])
+
+
+@pytest.mark.parametrize("n,b", [(8, 1), (16, 2), (32, 4), (64, 8), (96, 16)])
+def test_square_f64(n, b):
+    a = matgen.random_dense(n, n, dtype=jnp.float64, seed=n)
+    r = svd(a, config=SVDConfig(block_size=b))
+    assert int(r.sweeps) < 32
+    _check(a, r, sigma_tol=1e-12, res_tol=1e-13)
+
+
+def test_square_f32():
+    a = matgen.random_dense(48, 48, dtype=jnp.float32, seed=3)
+    r = svd(a, config=SVDConfig(block_size=8))
+    _check(a, r, sigma_tol=1e-5, res_tol=1e-5)
+
+
+@pytest.mark.parametrize("m,n", [(40, 24), (65, 33), (128, 16)])
+def test_tall_skinny(m, n):
+    a = matgen.random_dense(m, n, dtype=jnp.float64, seed=m + n)
+    r = svd(a, config=SVDConfig(block_size=4))
+    _check(a, r, sigma_tol=1e-12, res_tol=1e-13)
+
+
+def test_wide_via_transpose():
+    a = matgen.random_dense(20, 50, dtype=jnp.float64, seed=7)
+    r = svd(a, config=SVDConfig(block_size=4))
+    s_ref = np.linalg.svd(np.asarray(a), compute_uv=False)
+    np.testing.assert_allclose(np.asarray(r.s), s_ref, rtol=1e-10, atol=1e-12)
+    assert r.u.shape == (20, 20) and r.v.shape == (50, 20)
+    rep = validation.validate(a, r)
+    assert float(rep.residual_rel) < 1e-13
+
+
+def test_odd_n_padding():
+    a = matgen.random_dense(31, 29, dtype=jnp.float64, seed=11)
+    r = svd(a, config=SVDConfig(block_size=4))
+    _check(a, r, sigma_tol=1e-12, res_tol=1e-13)
+
+
+def test_upper_triangular_reference_input():
+    """The reference's benchmark input: seeded upper-triangular (main.cu:1558).
+
+    Random triangular matrices are numerically singular (cond ~ 1e17 here):
+    U columns for numerically-null sigmas are noise by construction (same as
+    one-sided Jacobi everywhere, incl. the reference's U = A*inv(Sigma),
+    lib/JacobiMethods.cu:1156-1173), so orthogonality is only checked on the
+    numerically live columns.
+    """
+    n = 64
+    a = matgen.random_upper_triangular(n, dtype=jnp.float64)
+    r = svd(a, config=SVDConfig(block_size=8))
+    assert int(r.sweeps) < 20
+    s_ref = np.linalg.svd(np.asarray(a), compute_uv=False)
+    rep = validation.validate(a, r, s_ref=s_ref)
+    assert float(rep.sigma_err) < 1e-12
+    assert float(rep.residual_rel) < 1e-13
+    assert float(rep.v_orth) < 1e-10
+    s = np.asarray(r.s)
+    live = s > s[0] * n * np.finfo(np.float64).eps * 10
+    u_live = np.asarray(r.u)[:, live]
+    assert np.abs(u_live.T @ u_live - np.eye(live.sum())).max() < 1e-9
+
+
+def test_known_spectrum():
+    s_true = np.geomspace(1.0, 1e-4, 24)
+    a = matgen.with_known_spectrum(48, 24, s_true, dtype=jnp.float64)
+    r = svd(a, config=SVDConfig(block_size=4))
+    np.testing.assert_allclose(np.asarray(r.s), s_true, rtol=1e-10, atol=1e-12)
+
+
+def test_novec_options():
+    a = matgen.random_dense(24, 24, dtype=jnp.float64, seed=5)
+    r = svd(a, compute_u=False, compute_v=False, config=SVDConfig(block_size=4))
+    assert r.u is None and r.v is None
+    s_ref = np.linalg.svd(np.asarray(a), compute_uv=False)
+    np.testing.assert_allclose(np.asarray(r.s), s_ref, rtol=1e-10, atol=1e-12)
+    r2 = svd(a, compute_u=True, compute_v=False, config=SVDConfig(block_size=4))
+    assert r2.u is not None and r2.v is None
+
+
+def test_full_matrices():
+    a = matgen.random_dense(40, 12, dtype=jnp.float64, seed=9)
+    r = svd(a, full_matrices=True, config=SVDConfig(block_size=4))
+    assert r.u.shape == (40, 40)
+    rep = validation.validate(a, type(r)(u=r.u[:, :12], s=r.s, v=r.v,
+                                         sweeps=r.sweeps, off_rel=r.off_rel))
+    assert float(rep.residual_rel) < 1e-13
+    assert float(validation.orthogonality_error(r.u)) < 1e-12
+
+
+def test_rank_deficient():
+    a = matgen.with_known_spectrum(30, 20, np.r_[np.ones(10), np.zeros(10)],
+                                   dtype=jnp.float64)
+    r = svd(a, config=SVDConfig(block_size=4))
+    s = np.asarray(r.s)
+    np.testing.assert_allclose(s[:10], 1.0, rtol=1e-10)
+    assert np.all(s[10:] < 1e-10)
+    rep = validation.validate(a, r)
+    assert float(rep.residual_rel) < 1e-12
+
+
+def test_tiny_and_degenerate():
+    for m, n in [(1, 1), (2, 1), (3, 2), (2, 3)]:
+        a = matgen.random_dense(m, n, dtype=jnp.float64, seed=m * 10 + n)
+        r = svd(a)
+        s_ref = np.linalg.svd(np.asarray(a), compute_uv=False)
+        np.testing.assert_allclose(np.asarray(r.s), s_ref, rtol=1e-10, atol=1e-12)
