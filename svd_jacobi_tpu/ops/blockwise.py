@@ -48,7 +48,8 @@ def pair_gram(x: jax.Array, gram_dtype, precision: str) -> jax.Array:
     )
 
 
-def off_diag_stats(g: jax.Array, b: int) -> Tuple[jax.Array, jax.Array]:
+def off_diag_stats(g: jax.Array, b: int,
+                   dmax2: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
     """(max_rel, off2): convergence statistics from a round's Gram matrices.
 
     ``max_rel`` is the dgesvj-style scaled coupling ``max_{i<j} |g_ij| /
@@ -77,7 +78,14 @@ def off_diag_stats(g: jax.Array, b: int) -> Tuple[jax.Array, jax.Array]:
     # are noise and their couplings can never converge. Exclude them from
     # the statistic (they still get rotated; sigma ~ 0 comes out fine).
     eps = jnp.finfo(g.dtype).eps
-    null_thresh = jnp.max(d2) * (n2 * eps) ** 2
+    if dmax2 is None:
+        dmax2 = jnp.max(d2)
+    # ``dmax2`` must be the GLOBAL max squared column norm. Under sharding a
+    # device's local batch can momentarily hold only numerically-null
+    # (padding/deflated) columns; a batch-local max would then declare them
+    # live relative to each other and their mutual cosines (~O(1) noise)
+    # would stall the convergence statistic. Callers on a mesh pmax it.
+    null_thresh = dmax2.astype(d2.dtype) * (n2 * eps) ** 2
     live = d2 > null_thresh                                  # (k, 2b)
     pair_live = live[:, :, None] & live[:, None, :]
     max_rel = jnp.max(jnp.where(pair_live, c, jnp.zeros_like(c)))
@@ -194,7 +202,7 @@ def _newton_schulz_polish(q: jax.Array, precision) -> jax.Array:
 
 
 def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_name,
-                              with_v, method):
+                              with_v, method, dmax2=None):
     b = top.shape[-1]
     gram_dtype = jnp.dtype(gram_dtype_name)
     x = jnp.concatenate([top, bot], axis=-1)  # (k, m, 2b)
@@ -204,7 +212,7 @@ def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_nam
         # or for well-conditioned inputs; stalls in f32 when cond(A)^2
         # approaches 1/eps.
         g = pair_gram(x, gram_dtype, precision)
-        max_rel, off2 = off_diag_stats(g, b)
+        max_rel, off2 = off_diag_stats(g, b, dmax2)
         _, q = jnp.linalg.eigh(g)
         q = _nearest_identity_order(q).astype(gram_dtype)
         q = _newton_schulz_polish(q, prec)
@@ -216,7 +224,7 @@ def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_nam
         r = jnp.linalg.qr(x.astype(gram_dtype), mode="r")  # (k, 2b, 2b)
         g = jnp.einsum("kij,kil->kjl", r, r, precision=prec,
                        preferred_element_type=gram_dtype)
-        max_rel, off2 = off_diag_stats(g, b)
+        max_rel, off2 = off_diag_stats(g, b, dmax2)
         _, _, vt = jnp.linalg.svd(r)
         q = _nearest_identity_order(vt.mT).astype(gram_dtype)
         q = _newton_schulz_polish(q, prec)
@@ -227,8 +235,9 @@ def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_nam
         # block rotations that come back as exact identity.
         r2 = jnp.einsum("kij,kjl->kil", r, q, precision=prec,
                         preferred_element_type=gram_dtype)
-        dmax2 = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
-        _, q2, _ = givens_cleanup_sweep(r2, dmax2)
+        if dmax2 is None:
+            dmax2 = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
+        _, q2, _ = givens_cleanup_sweep(r2, dmax2.astype(gram_dtype))
         q = jnp.einsum("kij,kjl->kil", q, q2, precision=prec,
                        preferred_element_type=gram_dtype)
     else:
@@ -256,6 +265,7 @@ def orthogonalize_pairs(
     precision: str = "highest",
     gram_dtype=None,
     method: str = "qr-svd",
+    dmax2: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, Optional[jax.Array], Optional[jax.Array], jax.Array, jax.Array]:
     """Orthogonalize each (top[i], bot[i]) block pair; update V alongside.
 
@@ -263,6 +273,9 @@ def orthogonalize_pairs(
       top, bot: (k, m, b) stacks of paired column blocks of A.
       vtop, vbot: (k, n, b) stacks of the matching V blocks, or None when the
         caller does not accumulate V (NoVec paths).
+      dmax2: GLOBAL max squared column norm, for the deflation gates. On a
+        mesh this must be pmax'd across devices (see off_diag_stats); None
+        falls back to the batch-local max (single-device semantics).
 
     Returns:
       (top', bot', vtop', vbot', max_rel, off2) — convergence statistics
@@ -283,6 +296,7 @@ def orthogonalize_pairs(
         gram_dtype_name=jnp.dtype(gram_dtype).name,
         with_v=with_v,
         method=method,
+        dmax2=dmax2,
     )
     if not with_v:
         new_vtop = new_vbot = None

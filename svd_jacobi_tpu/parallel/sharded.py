@@ -1,0 +1,254 @@
+"""Distributed one-sided block-Jacobi SVD over a TPU device mesh.
+
+TPU-native replacement for the reference's MPI distribution engine and
+distributed solver (reference: `omp_mpi_cuda_dgesvd_local_matrices`,
+lib/JacobiMethods.cu:191-1175, and its root-centric scatter/gather transport,
+lib/JacobiMethods.cu:334-432 distribute, 606-688 gather, 694 barrier). The
+reference moves every column through rank 0 with blocking MPI_Send/MPI_Recv
+four times per round; here the matrix is *persistently* sharded column-block
+over a 1D mesh and never leaves the devices:
+
+  * each device owns a contiguous slab of pair slots (``k_loc`` "top" and
+    ``k_loc`` "bot" column blocks of A, and the matching V blocks);
+  * a round orthogonalizes every local block pair — batched matmuls on the
+    MXU (ops/blockwise.py);
+  * the tournament rotation moves exactly ONE block to each neighbor —
+    two `lax.ppermute` hops on the ICI ring per round, the minimum possible
+    communication (vs. the reference's O(n) columns through root per round);
+  * convergence is a `lax.pmax` over the mesh of the per-device scaled
+    coupling, driving a `lax.while_loop` over sweeps — replacing the
+    reference's discarded convergence estimate + hard-coded single sweep
+    (lib/JacobiMethods.cu:234, 462) and its per-round MPI_Barrier (the
+    collectives are the synchronization).
+
+The ring schedule is the circle method of parallel/schedule.py restricted to
+shards: position top[0] (device 0) is the fixed player; every other slot
+cycles ``bot[0] -> top[1] -> ... -> top[k-1] -> bot[k-1] -> ... -> bot[0]``.
+The property tests in tests/test_schedule.py prove every block pair meets
+exactly once per sweep; tests/test_sharded.py proves the sharded traversal
+is equivalent to the single-device one.
+
+Multi-host: build the mesh from `jax.devices()` after
+`jax.distributed.initialize()` — the same code runs over ICI within a host
+and DCN across hosts; `utils.matgen.sharded_random` generates inputs directly
+into the sharding so no host ever materializes the full matrix.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SVDConfig
+from ..ops import blockwise
+from . import schedule as sched
+from .. import solver as _single
+
+AXIS = "blocks"
+
+
+def make_mesh(devices=None, axis_name: str = AXIS) -> Mesh:
+    """1D mesh over all (or the given) devices.
+
+    Replaces the reference's process bootstrap (MPI_Init/rank/size,
+    main.cu:1427-1442): mesh construction is the only topology setup needed;
+    on multi-host, call `jax.distributed.initialize()` first.
+    """
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def _ring_exchange(top, bot, *, axis_name: str, n_devices: int):
+    """One tournament rotation of block stacks sharded over ``axis_name``.
+
+    Local view: ``top``/``bot`` are (k_loc, m, b) with k_loc >= 2. Globally
+    this implements exactly `schedule.rotate_blocks`:
+      new_top = [top[0], bot[0], top[1:-1]]   (slot 0 fixed, top shifts right)
+      new_bot = [bot[1:], top[-1]]            (bot shifts left)
+    The only non-local moves are one block to each neighbor:
+      * ``top[-1]`` rides right  (device d -> d+1), entering the neighbor's
+        top stream;
+      * ``bot[0]`` rides left    (device d -> d-1), entering the neighbor's
+        bot stream;
+    which become two `lax.ppermute` hops over the ICI ring — the TPU-native
+    form of the reference's per-round column transport
+    (lib/JacobiMethods.cu:334-432, 606-688).
+    """
+    if n_devices == 1:
+        return sched.rotate_blocks(top, bot)  # has the k == 1 fixed point
+
+    right = [(d, d + 1) for d in range(n_devices - 1)]
+    left = [(d, d - 1) for d in range(1, n_devices)]
+    t_in = lax.ppermute(top[-1:], axis_name, right)   # from left neighbor
+    b_in = lax.ppermute(bot[:1], axis_name, left)     # from right neighbor
+
+    d = lax.axis_index(axis_name)
+    # Device 0: slot 0 is the fixed player; bot[0] enters top locally.
+    top_first = jnp.concatenate([top[:1], bot[:1], top[1:-1]], axis=0)
+    top_rest = jnp.concatenate([t_in, top[:-1]], axis=0)
+    new_top = jnp.where(d == 0, top_first, top_rest)
+    # Last device: top[-1] enters bot locally (end of the ring).
+    bot_last = jnp.concatenate([bot[1:], top[-1:]], axis=0)
+    bot_rest = jnp.concatenate([bot[1:], b_in], axis=0)
+    new_bot = jnp.where(d == n_devices - 1, bot_last, bot_rest)
+    return new_top, new_bot
+
+
+def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
+                    tol, max_sweeps, precision, gram_dtype_name, method,
+                    with_v):
+    """Body run under shard_map: while_loop(sweeps) of scan(rounds)."""
+    gram_dtype = jnp.dtype(gram_dtype_name)
+
+    def round_body(carry, _, *, dmax2):
+        top, bot, vtop, vbot, max_rel = carry
+        top, bot, nvt, nvb, rel, _ = blockwise.orthogonalize_pairs(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            precision=precision, gram_dtype=gram_dtype, method=method,
+            dmax2=dmax2)
+        if with_v:
+            vtop, vbot = nvt, nvb
+        top, bot = _ring_exchange(top, bot, axis_name=axis_name,
+                                  n_devices=n_devices)
+        if with_v:
+            vtop, vbot = _ring_exchange(vtop, vbot, axis_name=axis_name,
+                                        n_devices=n_devices)
+        max_rel = jnp.maximum(max_rel, rel.astype(jnp.float32))
+        return (top, bot, vtop, vbot, max_rel), None
+
+    def sweep(top, bot, vtop, vbot):
+        # Global max squared column norm for the deflation gates: column
+        # norms drift only slowly across a sweep (they converge to the
+        # sigmas), so one pmax per sweep is enough.
+        acc = jnp.promote_types(top.dtype, jnp.float32)
+        local_d2 = jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
+                               jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
+        dmax2 = lax.pmax(local_d2, axis_name)
+        init = (top, bot, vtop, vbot, jnp.zeros((), jnp.float32))
+        (top, bot, vtop, vbot, local_rel), _ = lax.scan(
+            partial(round_body, dmax2=dmax2), init, None, length=n_rounds)
+        # Global convergence statistic: pmax over the mesh — the TPU-native
+        # form of the reduction the reference never does (its per-pair
+        # convergence_value is computed and discarded, lib/JacobiMethods.cu:462).
+        off_rel = lax.pmax(local_rel, axis_name)
+        return top, bot, vtop, vbot, off_rel
+
+    def cond(state):
+        _, _, _, _, off_rel, prev_off, sweeps = state
+        return _single._should_continue(off_rel, prev_off, sweeps,
+                                        tol=tol, max_sweeps=max_sweeps)
+
+    def body(state):
+        top, bot, vtop, vbot, prev_off, _, sweeps = state
+        top, bot, vtop, vbot, off_rel = sweep(top, bot, vtop, vbot)
+        return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
+
+    inf = jnp.float32(jnp.inf)
+    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
+    top, bot, vtop, vbot, off_rel, _, sweeps = lax.while_loop(cond, body, state)
+    return top, bot, vtop, vbot, off_rel, sweeps
+
+
+def svd(
+    a,
+    *,
+    mesh: Optional[Mesh] = None,
+    compute_u: bool = True,
+    compute_v: bool = True,
+    full_matrices: bool = False,
+    config: Optional[SVDConfig] = None,
+) -> _single.SVDResult:
+    """Distributed one-sided block-Jacobi SVD: ``a = u @ diag(s) @ v.T``.
+
+    Drop-in distributed form of `svd_jacobi_tpu.svd` (same result contract);
+    public API surface mirrors the reference's distributed entry point
+    `omp_mpi_cuda_dgesvd_local_matrices` (lib/JacobiMethods.cuh:44-52) with
+    jobu/jobv expressed as compute_u/compute_v (see lapack.gesvd for the
+    SVD_OPTIONS-shaped surface).
+
+    Args:
+      a: (m, n) real matrix. May be an already-sharded jax.Array (e.g. from
+        `utils.matgen.sharded_random`) or a host array to be distributed.
+      mesh: 1D device mesh; defaults to all local devices.
+    """
+    if config is None:
+        config = SVDConfig()
+    a = jnp.asarray(a)
+    if a.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+    m, n = a.shape
+    if m < n:
+        r = svd(a.T, mesh=mesh, compute_u=compute_v, compute_v=compute_u,
+                full_matrices=full_matrices, config=config)
+        return _single.SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
+                                 off_rel=r.off_rel)
+
+    if mesh is None:
+        mesh = make_mesh()
+    (axis_name,) = mesh.axis_names
+    n_devices = mesh.size
+    b, k = _single._plan(n, n_devices, config)
+    n_pad = 2 * k * b
+    tol, gram_dtype_name, method = _single._resolve_options(a, config)
+
+    u, s, v, sweeps, off_rel = _svd_sharded_jit(
+        a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
+        n_devices=n_devices, compute_u=compute_u, compute_v=compute_v,
+        full_u=full_matrices, tol=tol, max_sweeps=int(config.max_sweeps),
+        precision=config.matmul_precision,
+        gram_dtype_name=gram_dtype_name, method=method)
+    return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+
+
+@partial(jax.jit, static_argnames=(
+    "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
+    "compute_v", "full_u", "tol", "max_sweeps", "precision",
+    "gram_dtype_name", "method"))
+def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
+                     compute_u, compute_v, full_u, tol, max_sweeps, precision,
+                     gram_dtype_name, method):
+    m = a.shape[0]
+    dtype = a.dtype
+    k = nblocks // 2
+    block_spec = P(axis_name, None, None)  # shard the pair-slot axis
+
+    top, bot = _single._blockify(a, n_pad, nblocks)
+    if compute_v:
+        veye = jnp.eye(n_pad, dtype=dtype)
+        vtop, vbot = _single._blockify(veye, n_pad, nblocks)
+    else:
+        # Zero-size placeholders keep one traced signature (cf. solver.py).
+        vtop = vbot = jnp.zeros((k, 0, top.shape[2]), dtype)
+
+    top = lax.with_sharding_constraint(top, NamedSharding(mesh, block_spec))
+    bot = lax.with_sharding_constraint(bot, NamedSharding(mesh, block_spec))
+    vtop = lax.with_sharding_constraint(vtop, NamedSharding(mesh, block_spec))
+    vbot = lax.with_sharding_constraint(vbot, NamedSharding(mesh, block_spec))
+
+    jacobi = jax.shard_map(
+        partial(_sharded_jacobi, axis_name=axis_name, n_devices=n_devices,
+                n_rounds=sched.num_rounds(nblocks), tol=tol, max_sweeps=max_sweeps,
+                precision=precision, gram_dtype_name=gram_dtype_name,
+                method=method, with_v=compute_v),
+        mesh=mesh,
+        in_specs=(block_spec,) * 4,
+        out_specs=(block_spec,) * 4 + (P(), P()),
+        # The loop carries mix replicated constants (V = I, counters) with
+        # device-varying data; skip the static variance check rather than
+        # sprinkling pcasts through code shared with the single-device path.
+        check_vma=False,
+    )
+    top, bot, vtop, vbot, off_rel, sweeps = jacobi(top, bot, vtop, vbot)
+
+    a_work = _single._deblockify(top, bot)
+    v_work = _single._deblockify(vtop, vbot)[:n, :] if compute_v else None
+    u, s, v = _single._postprocess(a_work, v_work, n, compute_u=compute_u,
+                                   full_u=full_u, dtype=dtype)
+    return u, s, v, sweeps, off_rel

@@ -53,6 +53,50 @@ def _default_tol(m: int, n: int, dtype) -> float:
     return float(np.sqrt(m) * eps)
 
 
+def _plan(n: int, n_devices: int, config: SVDConfig):
+    """Choose block width ``b`` and pair count ``k`` (columns pad to 2*k*b).
+
+    On a multi-device mesh each device must hold k/P >= 2 pair slots (the
+    ring exchange splices one incoming block per stream), and blocks are
+    shrunk — even user-specified ones — so the padded width 2*k*b stays
+    within ~2x of n instead of ballooning with the device count.
+    """
+    b = config.pick_block_size(n)
+    b = min(b, max(1, (n + 1) // 2))
+    if n_devices > 1:
+        b = min(b, max(1, -(-n // (4 * n_devices))))
+    k = max(1, -(-n // (2 * b)))
+    if n_devices > 1:
+        k = max(k, 2 * n_devices)
+        k = -(-k // n_devices) * n_devices  # round up to multiple of P
+    return b, k
+
+
+def _resolve_options(a, config: SVDConfig):
+    """Shared option resolution for the single-device and sharded entry
+    points: tolerance, Gram dtype, and pair-solver method."""
+    m, n = a.shape
+    tol = config.tol if config.tol is not None else _default_tol(m, n, a.dtype)
+    gram_dtype = config.gram_dtype or jnp.promote_types(a.dtype, jnp.float32).name
+    method = config.pair_solver
+    if method == "auto":
+        method = "qr-svd"
+    if method not in ("qr-svd", "gram-eigh"):
+        raise ValueError(f"unknown pair solver method: {method!r}")
+    return float(tol), jnp.dtype(gram_dtype).name, method
+
+
+def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps):
+    """Sweep-loop predicate shared by both solvers: continue while above tol,
+    under the sweep cap, and not stalled (in the quadratic endgame —
+    off < 1e-4, one clean sweep from the floor — a sweep that fails to
+    shrink the coupling 4x means the dtype's roundoff floor is reached)."""
+    stalled = jnp.logical_and(off_rel < 1e-4, off_rel > 0.25 * prev_off)
+    return jnp.logical_and(
+        sweeps < max_sweeps,
+        jnp.logical_and(off_rel > tol, jnp.logical_not(stalled)))
+
+
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
     """(m, n) -> top/bot stacks (k, m, b), zero-padding columns to n_pad."""
     m, n = a.shape
@@ -104,10 +148,7 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
                     gram_dtype, method):
     """while_loop over sweeps until the scaled coupling drops below tol.
 
-    Also stops on *stall*: once in the quadratic endgame (off < 1e-4, where
-    one more clean sweep would reach the roundoff floor), a sweep that fails
-    to shrink the coupling by at least 4x means the floor of the working
-    dtype has been reached and further sweeps are wasted FLOPs.
+    Also stops on *stall* — see `_should_continue`.
     """
     with_v = vtop is not None
     k = top.shape[0]
@@ -116,10 +157,8 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
 
     def cond(state):
         _, _, _, _, off_rel, prev_off, sweeps = state
-        stalled = jnp.logical_and(off_rel < 1e-4, off_rel > 0.25 * prev_off)
-        return jnp.logical_and(sweeps < max_sweeps,
-                               jnp.logical_and(off_rel > tol,
-                                               jnp.logical_not(stalled)))
+        return _should_continue(off_rel, prev_off, sweeps,
+                                tol=tol, max_sweeps=max_sweeps)
 
     def body(state):
         top, bot, vtop, vbot, prev_off, _, sweeps = state
@@ -225,20 +264,14 @@ def svd(
                 full_matrices=full_matrices, config=config)
         return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps, off_rel=r.off_rel)
 
-    b = config.pick_block_size(n)
-    b = min(b, max(1, (n + 1) // 2))
-    k = max(1, -(-n // (2 * b)))  # ceil(n / 2b)
+    b, k = _plan(n, 1, config)
     n_pad = 2 * k * b
-    tol = config.tol if config.tol is not None else _default_tol(m, n, a.dtype)
-    gram_dtype = config.gram_dtype or jnp.promote_types(a.dtype, jnp.float32).name
-    method = config.pair_solver
-    if method == "auto":
-        method = "qr-svd"
+    tol, gram_dtype_name, method = _resolve_options(a, config)
 
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
     u, s, v, sweeps, off_rel = _svd_padded(
         a_pad, n=n, compute_u=compute_u, compute_v=compute_v,
-        full_u=full_matrices, nblocks=2 * k, tol=float(tol),
+        full_u=full_matrices, nblocks=2 * k, tol=tol,
         max_sweeps=int(config.max_sweeps), precision=config.matmul_precision,
-        gram_dtype_name=jnp.dtype(gram_dtype).name, method=method)
+        gram_dtype_name=gram_dtype_name, method=method)
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
