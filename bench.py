@@ -1,0 +1,80 @@
+"""Benchmark driver: one-sided block-Jacobi SVD on the attached accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+The reference publishes no numbers (SURVEY.md section 6), so the baseline is
+self-generated on the same chip: `jnp.linalg.svd` (XLA's built-in SVD) on the
+identical input — `vs_baseline` is our speedup over it (>1 means faster).
+`value` is nominal GFLOP/s using the classic 12*n^3 full-SVD flop count
+(4mn^2 + 8n^3 at m = n), so runs at different sizes stay comparable.
+
+Usage: python bench.py [N] [dtype]   (defaults: 2048, float32)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _force(tree):
+    """Force device execution without timing the host transfer: reduce every
+    output to one scalar on device and materialize only that (block_until_ready
+    over the axon TPU tunnel does not reliably synchronize, and a full-factor
+    device->host copy through the tunnel would dominate the measurement)."""
+    import jax
+    import jax.numpy as jnp
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    return float(np.asarray(sum(jnp.sum(x) for x in leaves)))
+
+
+def _time(f, *args, reps: int = 2) -> float:
+    """Best-of-reps device wall time."""
+    _force(f(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    dtype_name = sys.argv[2] if len(sys.argv) > 2 else "float32"
+
+    import jax
+    import jax.numpy as jnp
+    import svd_jacobi_tpu as sj
+    from svd_jacobi_tpu.utils import matgen, validation
+
+    dtype = jnp.dtype(dtype_name)
+    a = matgen.random_dense(n, n, dtype=dtype)
+
+    t_ours = _time(lambda x: tuple(sj.svd(x)[:3]), a)
+    t_xla = _time(lambda x: jnp.linalg.svd(x, compute_uv=True), a)
+
+    r = sj.svd(a)
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    sigma_err = float(validation.sigma_error(r.s, s_ref))
+
+    flops = 12.0 * n**3  # nominal full-SVD flop count (4mn^2 + 8n^3, m = n)
+    print(json.dumps({
+        "metric": f"svd_{n}x{n}_{dtype_name}_gflops",
+        "value": round(flops / t_ours / 1e9, 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(t_xla / t_ours, 3),
+        "time_s": round(t_ours, 4),
+        "baseline_time_s": round(t_xla, 4),
+        "baseline": "jnp.linalg.svd same chip",
+        "sweeps": int(r.sweeps),
+        "sigma_err": sigma_err,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,9 +40,19 @@ class SVDConfig:
     block_size: Optional[int] = None
     max_sweeps: int = 32
     tol: Optional[float] = None
-    pair_solver: str = "auto"  # "auto" | "qr-svd" (stable) | "gram-eigh" (fast)
+    # "auto": gram-eigh for f32/bf16 (fast, LAPACK-dgesvd-class absolute
+    # accuracy), qr-svd for f64 (gesvj-class high relative accuracy).
+    pair_solver: str = "auto"  # "auto" | "qr-svd" (accurate) | "gram-eigh" (fast)
+    # Convergence criterion: "rel" = dgesvj scaled coupling (relative
+    # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2
+    # (LAPACK-dgesvd class). "auto" follows the pair solver.
+    criterion: str = "auto"  # "auto" | "rel" | "abs"
     gram_dtype: Optional[str] = None
     matmul_precision: str = "highest"
+    # Stop when an endgame sweep fails to keep shrinking the coupling
+    # (roundoff floor reached; thresholds per criterion, see
+    # solver._should_continue). Disable to run until tol or max_sweeps.
+    stall_detection: bool = True
 
     def pick_block_size(self, n: int) -> int:
         if self.block_size is not None:

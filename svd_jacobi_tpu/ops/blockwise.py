@@ -49,43 +49,57 @@ def pair_gram(x: jax.Array, gram_dtype, precision: str) -> jax.Array:
 
 
 def off_diag_stats(g: jax.Array, b: int,
-                   dmax2: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """(max_rel, off2): convergence statistics from a round's Gram matrices.
+                   dmax2: Optional[jax.Array] = None,
+                   criterion: str = "rel") -> Tuple[jax.Array, jax.Array]:
+    """(stat, off2): convergence statistics from a round's Gram matrices.
 
-    ``max_rel`` is the dgesvj-style scaled coupling ``max_{i<j} |g_ij| /
-    sqrt(g_ii g_jj)`` over every column pair inside each 2b-wide Gram matrix
-    — the cosine of the angle between columns, so it bounds the orthogonality
-    of U columns independently of conditioning (a globally normalized
-    off-norm does not: tiny-sigma columns can stay far from orthogonal while
-    the global norm looks converged). ``off2`` is the plain squared F-norm of
-    the coupling blocks, kept as a diagnostic.
+    Two criteria (``criterion``):
 
-    This is the criterion the reference computes per pair as
+    * ``"rel"`` — the dgesvj-style scaled coupling ``max_{i<j} |g_ij| /
+      sqrt(g_ii g_jj)`` over every column pair inside each 2b-wide Gram
+      matrix — the cosine of the angle between columns, so it bounds the
+      orthogonality of U columns independently of conditioning. Columns at
+      the roundoff floor relative to the largest column are deflated from
+      the statistic (their directions are noise and can never converge).
+      Drives the high-relative-accuracy ("qr-svd") path.
+    * ``"abs"`` — ``max_{i<j} |g_ij| / dmax2``: couplings scaled by the
+      GLOBAL max squared column norm (~sigma_max^2). This is the LAPACK
+      dgesvd / XLA-svd accuracy class (|sigma - sigma_true| <~ eps *
+      sigma_max): cheap to converge because an eigh-quality rotation always
+      reaches it — no scalar cleanup sweeps needed. Default for the fast
+      ("gram-eigh") path.
+
+    ``off2`` is the plain squared F-norm of the coupling blocks (diagnostic).
+
+    The "rel" statistic is what the reference computes per pair as
     ``convergence_value = |alpha|/sqrt(beta*gamma)`` and then discards
     (lib/JacobiMethods.cu:462,547; dead because maxIterations = 1,
     lib/JacobiMethods.cu:234) — here it actually drives the sweep loop.
+
+    ``dmax2`` must be the GLOBAL max squared column norm. Under sharding a
+    device's local batch can momentarily hold only numerically-null
+    (padding/deflated) columns; a batch-local max would then declare them
+    live relative to each other and their mutual cosines (~O(1) noise)
+    would stall the convergence statistic. Callers on a mesh pmax it.
     """
     acc = jnp.float32 if g.dtype in (jnp.bfloat16, jnp.float16) else g.dtype
     g = g.astype(acc)
     off2 = jnp.sum(jnp.square(g[:, :b, b:]))
     d2 = jnp.diagonal(g, axis1=-2, axis2=-1)                # (k, 2b)
-    d = jnp.sqrt(jnp.maximum(d2, jnp.finfo(acc).tiny))
-    c = jnp.abs(g) / (d[:, :, None] * d[:, None, :])
     n2 = g.shape[-1]
-    c = c * (1.0 - jnp.eye(n2, dtype=acc))[None]
-    # Deflation (dgesvj-style): columns whose norm is at the roundoff floor
-    # relative to the largest column are numerically null — their directions
-    # are noise and their couplings can never converge. Exclude them from
-    # the statistic (they still get rotated; sigma ~ 0 comes out fine).
     eps = jnp.finfo(g.dtype).eps
     if dmax2 is None:
         dmax2 = jnp.max(d2)
-    # ``dmax2`` must be the GLOBAL max squared column norm. Under sharding a
-    # device's local batch can momentarily hold only numerically-null
-    # (padding/deflated) columns; a batch-local max would then declare them
-    # live relative to each other and their mutual cosines (~O(1) noise)
-    # would stall the convergence statistic. Callers on a mesh pmax it.
-    null_thresh = dmax2.astype(d2.dtype) * (n2 * eps) ** 2
+    dmax2 = dmax2.astype(acc)
+    no_diag = (1.0 - jnp.eye(n2, dtype=acc))[None]
+    if criterion == "abs":
+        c = jnp.abs(g) / jnp.maximum(dmax2, jnp.finfo(acc).tiny)
+        stat = jnp.max(c * no_diag)
+        return stat, off2
+    d = jnp.sqrt(jnp.maximum(d2, jnp.finfo(acc).tiny))
+    c = jnp.abs(g) / (d[:, :, None] * d[:, None, :])
+    c = c * no_diag
+    null_thresh = dmax2 * (n2 * eps) ** 2
     live = d2 > null_thresh                                  # (k, 2b)
     pair_live = live[:, :, None] & live[:, None, :]
     max_rel = jnp.max(jnp.where(pair_live, c, jnp.zeros_like(c)))
@@ -202,17 +216,19 @@ def _newton_schulz_polish(q: jax.Array, precision) -> jax.Array:
 
 
 def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_name,
-                              with_v, method, dmax2=None):
+                              with_v, method, dmax2=None, criterion="rel"):
     b = top.shape[-1]
     gram_dtype = jnp.dtype(gram_dtype_name)
     x = jnp.concatenate([top, bot], axis=-1)  # (k, m, 2b)
     prec = _precision(precision)
     if method == "gram-eigh":
-        # Fast path: Gram + eigh. Squares the condition number — fine in f64
-        # or for well-conditioned inputs; stalls in f32 when cond(A)^2
-        # approaches 1/eps.
+        # Fast path: Gram + eigh — MXU matmuls + one batched eigh, no QR, no
+        # scalar cleanup. Squares the condition number, so it delivers
+        # absolute (LAPACK-dgesvd-class) accuracy and should run with
+        # criterion="abs"; under the "rel" criterion it stalls once couplings
+        # of small-norm columns hit the eigh's absolute-accuracy floor.
         g = pair_gram(x, gram_dtype, precision)
-        max_rel, off2 = off_diag_stats(g, b, dmax2)
+        max_rel, off2 = off_diag_stats(g, b, dmax2, criterion)
         _, q = jnp.linalg.eigh(g)
         q = _nearest_identity_order(q).astype(gram_dtype)
         q = _newton_schulz_polish(q, prec)
@@ -224,7 +240,7 @@ def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_nam
         r = jnp.linalg.qr(x.astype(gram_dtype), mode="r")  # (k, 2b, 2b)
         g = jnp.einsum("kij,kil->kjl", r, r, precision=prec,
                        preferred_element_type=gram_dtype)
-        max_rel, off2 = off_diag_stats(g, b, dmax2)
+        max_rel, off2 = off_diag_stats(g, b, dmax2, criterion)
         _, _, vt = jnp.linalg.svd(r)
         q = _nearest_identity_order(vt.mT).astype(gram_dtype)
         q = _newton_schulz_polish(q, prec)
@@ -266,6 +282,7 @@ def orthogonalize_pairs(
     gram_dtype=None,
     method: str = "qr-svd",
     dmax2: Optional[jax.Array] = None,
+    criterion: str = "rel",
 ) -> Tuple[jax.Array, jax.Array, Optional[jax.Array], Optional[jax.Array], jax.Array, jax.Array]:
     """Orthogonalize each (top[i], bot[i]) block pair; update V alongside.
 
@@ -297,6 +314,7 @@ def orthogonalize_pairs(
         with_v=with_v,
         method=method,
         dmax2=dmax2,
+        criterion=criterion,
     )
     if not with_v:
         new_vtop = new_vbot = None

@@ -103,16 +103,16 @@ def _ring_exchange(top, bot, *, axis_name: str, n_devices: int):
 
 def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
                     tol, max_sweeps, precision, gram_dtype_name, method,
-                    with_v):
+                    criterion, with_v, stall_detection=True):
     """Body run under shard_map: while_loop(sweeps) of scan(rounds)."""
     gram_dtype = jnp.dtype(gram_dtype_name)
 
-    def round_body(carry, _, *, dmax2):
+    def round_body(carry, _, *, dmax2, mth, crit):
         top, bot, vtop, vbot, max_rel = carry
         top, bot, nvt, nvb, rel, _ = blockwise.orthogonalize_pairs(
             top, bot, vtop if with_v else None, vbot if with_v else None,
-            precision=precision, gram_dtype=gram_dtype, method=method,
-            dmax2=dmax2)
+            precision=precision, gram_dtype=gram_dtype, method=mth,
+            criterion=crit, dmax2=dmax2)
         if with_v:
             vtop, vbot = nvt, nvb
         top, bot = _ring_exchange(top, bot, axis_name=axis_name,
@@ -123,7 +123,7 @@ def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
         max_rel = jnp.maximum(max_rel, rel.astype(jnp.float32))
         return (top, bot, vtop, vbot, max_rel), None
 
-    def sweep(top, bot, vtop, vbot):
+    def sweep(top, bot, vtop, vbot, mth, crit):
         # Global max squared column norm for the deflation gates: column
         # norms drift only slowly across a sweep (they converge to the
         # sigmas), so one pmax per sweep is enough.
@@ -133,26 +133,43 @@ def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
         dmax2 = lax.pmax(local_d2, axis_name)
         init = (top, bot, vtop, vbot, jnp.zeros((), jnp.float32))
         (top, bot, vtop, vbot, local_rel), _ = lax.scan(
-            partial(round_body, dmax2=dmax2), init, None, length=n_rounds)
+            partial(round_body, dmax2=dmax2, mth=mth, crit=crit),
+            init, None, length=n_rounds)
         # Global convergence statistic: pmax over the mesh — the TPU-native
         # form of the reduction the reference never does (its per-pair
         # convergence_value is computed and discarded, lib/JacobiMethods.cu:462).
         off_rel = lax.pmax(local_rel, axis_name)
         return top, bot, vtop, vbot, off_rel
 
-    def cond(state):
-        _, _, _, _, off_rel, prev_off, sweeps = state
-        return _single._should_continue(off_rel, prev_off, sweeps,
-                                        tol=tol, max_sweeps=max_sweeps)
+    def iterate(top, bot, vtop, vbot, mth, crit, t, budget):
+        def cond(state):
+            _, _, _, _, off_rel, prev_off, sweeps = state
+            return _single._should_continue(off_rel, prev_off, sweeps,
+                                            tol=t, max_sweeps=budget,
+                                            stall_detection=stall_detection,
+                                            criterion=crit)
 
-    def body(state):
-        top, bot, vtop, vbot, prev_off, _, sweeps = state
-        top, bot, vtop, vbot, off_rel = sweep(top, bot, vtop, vbot)
-        return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
+        def body(state):
+            top, bot, vtop, vbot, prev_off, _, sweeps = state
+            top, bot, vtop, vbot, off_rel = sweep(top, bot, vtop, vbot,
+                                                  mth, crit)
+            return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
 
-    inf = jnp.float32(jnp.inf)
-    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
-    top, bot, vtop, vbot, off_rel, _, sweeps = lax.while_loop(cond, body, state)
+        inf = jnp.float32(jnp.inf)
+        state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
+        return lax.while_loop(cond, body, state)
+
+    if method == "hybrid":
+        # See solver._svd_padded: abs-converged bulk phase, then a short
+        # relative-criterion polish phase for U orthogonality.
+        top, bot, vtop, vbot, _, _, s1 = iterate(
+            top, bot, vtop, vbot, "gram-eigh", "abs",
+            _single._abs_phase_tol(top.dtype), max_sweeps)
+        top, bot, vtop, vbot, off_rel, _, s2 = iterate(
+            top, bot, vtop, vbot, "qr-svd", criterion, tol, max_sweeps - s1)
+        return top, bot, vtop, vbot, off_rel, s1 + s2
+    top, bot, vtop, vbot, off_rel, _, sweeps = iterate(
+        top, bot, vtop, vbot, method, criterion, tol, max_sweeps)
     return top, bot, vtop, vbot, off_rel, sweeps
 
 
@@ -196,24 +213,26 @@ def svd(
     n_devices = mesh.size
     b, k = _single._plan(n, n_devices, config)
     n_pad = 2 * k * b
-    tol, gram_dtype_name, method = _single._resolve_options(a, config)
+    tol, gram_dtype_name, method, criterion = _single._resolve_options(
+        a, config, compute_uv=compute_u)
 
     u, s, v, sweeps, off_rel = _svd_sharded_jit(
         a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
         n_devices=n_devices, compute_u=compute_u, compute_v=compute_v,
         full_u=full_matrices, tol=tol, max_sweeps=int(config.max_sweeps),
         precision=config.matmul_precision,
-        gram_dtype_name=gram_dtype_name, method=method)
+        gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
+        stall_detection=bool(config.stall_detection))
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
 @partial(jax.jit, static_argnames=(
     "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
     "compute_v", "full_u", "tol", "max_sweeps", "precision",
-    "gram_dtype_name", "method"))
+    "gram_dtype_name", "method", "criterion", "stall_detection"))
 def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      compute_u, compute_v, full_u, tol, max_sweeps, precision,
-                     gram_dtype_name, method):
+                     gram_dtype_name, method, criterion, stall_detection=True):
     m = a.shape[0]
     dtype = a.dtype
     k = nblocks // 2
@@ -236,7 +255,8 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
         partial(_sharded_jacobi, axis_name=axis_name, n_devices=n_devices,
                 n_rounds=sched.num_rounds(nblocks), tol=tol, max_sweeps=max_sweeps,
                 precision=precision, gram_dtype_name=gram_dtype_name,
-                method=method, with_v=compute_v),
+                method=method, criterion=criterion, with_v=compute_v,
+                stall_detection=stall_detection),
         mesh=mesh,
         in_specs=(block_spec,) * 4,
         out_specs=(block_spec,) * 4 + (P(), P()),

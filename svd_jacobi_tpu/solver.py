@@ -46,11 +46,21 @@ class SVDResult(NamedTuple):
     off_rel: jax.Array
 
 
-def _default_tol(m: int, n: int, dtype) -> float:
-    # dgesvj-style threshold for the scaled coupling |a_i.a_j|/(|a_i||a_j|):
-    # the roundoff floor of an m-term f32/f64 dot product is ~sqrt(m)*eps.
+def _default_tol(m: int, n: int, dtype, criterion: str = "rel") -> float:
+    # "rel": dgesvj-style threshold for the scaled coupling
+    # |a_i.a_j|/(|a_i||a_j|) — the roundoff floor of an m-term dot product
+    # is ~sqrt(m)*eps. "abs": couplings are measured against sigma_max^2,
+    # whose floor sits near 8*eps on the gram-eigh path (measured).
     eps = float(jnp.finfo(dtype).eps)
+    if criterion == "abs":
+        return 8.0 * eps
     return float(np.sqrt(m) * eps)
+
+
+def _abs_phase_tol(dtype) -> float:
+    """Phase-1 (bulk) tolerance for the hybrid method — shared by the
+    single-device and sharded solvers so they cannot drift."""
+    return _default_tol(1, 1, dtype, "abs")
 
 
 def _plan(n: int, n_devices: int, config: SVDConfig):
@@ -72,29 +82,62 @@ def _plan(n: int, n_devices: int, config: SVDConfig):
     return b, k
 
 
-def _resolve_options(a, config: SVDConfig):
+def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     """Shared option resolution for the single-device and sharded entry
-    points: tolerance, Gram dtype, and pair-solver method."""
+    points: tolerance, Gram dtype, pair-solver method, and convergence
+    criterion.
+
+    "auto" picks qr-svd (gesvj-class relative accuracy) for f64 and "hybrid"
+    for f32/bf16 when singular vectors are wanted: cheap all-matmul
+    gram-eigh/abs sweeps do the bulk of the work, then qr-svd/rel sweeps
+    polish — needed because one-sided Jacobi reads U off the rotated
+    columns, so U orthogonality REQUIRES relative convergence (under "abs"
+    alone, couplings between small-sigma columns stay O(1) and U is not
+    orthogonal). With compute_uv=False there is no U to protect and auto
+    stays on the fast gram-eigh/abs path.
+    """
     m, n = a.shape
-    tol = config.tol if config.tol is not None else _default_tol(m, n, a.dtype)
-    gram_dtype = config.gram_dtype or jnp.promote_types(a.dtype, jnp.float32).name
     method = config.pair_solver
     if method == "auto":
-        method = "qr-svd"
-    if method not in ("qr-svd", "gram-eigh"):
+        if a.dtype == jnp.float64:
+            method = "qr-svd"
+        else:
+            method = "hybrid" if compute_uv else "gram-eigh"
+    if method not in ("qr-svd", "gram-eigh", "hybrid"):
         raise ValueError(f"unknown pair solver method: {method!r}")
-    return float(tol), jnp.dtype(gram_dtype).name, method
+    criterion = config.criterion
+    if criterion == "auto":
+        criterion = "abs" if method == "gram-eigh" else "rel"
+    if criterion not in ("rel", "abs"):
+        raise ValueError(f"unknown convergence criterion: {criterion!r}")
+    # For "hybrid", tol/criterion describe the FINAL (polish) phase; the abs
+    # phase always runs with the abs default tolerance.
+    tol = (config.tol if config.tol is not None
+           else _default_tol(m, n, a.dtype, criterion))
+    gram_dtype = config.gram_dtype or jnp.promote_types(a.dtype, jnp.float32).name
+    return float(tol), jnp.dtype(gram_dtype).name, method, criterion
 
 
-def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps):
+def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
+                     stall_detection=True, criterion="rel"):
     """Sweep-loop predicate shared by both solvers: continue while above tol,
-    under the sweep cap, and not stalled (in the quadratic endgame —
-    off < 1e-4, one clean sweep from the floor — a sweep that fails to
-    shrink the coupling 4x means the dtype's roundoff floor is reached)."""
-    stalled = jnp.logical_and(off_rel < 1e-4, off_rel > 0.25 * prev_off)
-    return jnp.logical_and(
-        sweeps < max_sweeps,
-        jnp.logical_and(off_rel > tol, jnp.logical_not(stalled)))
+    under the sweep cap, and not stalled (in the endgame — off < 1e-4, close
+    to the floor — a sweep that fails to keep shrinking the coupling means
+    the dtype's roundoff floor is reached). The gate/shrink thresholds
+    differ per criterion — see the inline comments; the constants are
+    measured, not derived (a mistuned threshold cost 100x sigma error)."""
+    go = jnp.logical_and(sweeps < max_sweeps, off_rel > tol)
+    if stall_detection:
+        if criterion == "rel":
+            gate, shrink = 1e-4, 0.25
+        else:
+            # Gate near the floor (tol is set just above it) and use a
+            # gentler shrink test: the abs path contracts only ~2-4x per
+            # sweep mid-range, so a 4x test there misfires sweeps early.
+            gate, shrink = 4.0 * tol, 0.75
+        stalled = jnp.logical_and(off_rel < gate, off_rel > shrink * prev_off)
+        go = jnp.logical_and(go, jnp.logical_not(stalled))
+    return go
 
 
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
@@ -115,7 +158,8 @@ def _deblockify(top: jax.Array, bot: jax.Array) -> jax.Array:
     return blocks.transpose(1, 0, 2).reshape(m, nblocks * b)
 
 
-def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd"):
+def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd",
+           criterion="rel", dmax2=None):
     """One full sweep: 2k-1 tournament rounds via lax.scan."""
     k = top.shape[0]
     n_rounds = sched.num_rounds(2 * k)
@@ -125,7 +169,8 @@ def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd"):
         top, bot, vtop, vbot, max_rel = carry
         top, bot, vtop, vbot, rel, _ = blockwise.orthogonalize_pairs(
             top, bot, vtop if with_v else None, vbot if with_v else None,
-            precision=precision, gram_dtype=gram_dtype, method=method)
+            precision=precision, gram_dtype=gram_dtype, method=method,
+            criterion=criterion, dmax2=dmax2)
         if not with_v:
             vtop, vbot = carry[2], carry[3]
         top, bot = sched.rotate_blocks(top, bot)
@@ -145,7 +190,7 @@ def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd"):
 
 
 def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
-                    gram_dtype, method):
+                    gram_dtype, method, criterion, stall_detection=True):
     """while_loop over sweeps until the scaled coupling drops below tol.
 
     Also stops on *stall* — see `_should_continue`.
@@ -158,13 +203,19 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
     def cond(state):
         _, _, _, _, off_rel, prev_off, sweeps = state
         return _should_continue(off_rel, prev_off, sweeps,
-                                tol=tol, max_sweeps=max_sweeps)
+                                tol=tol, max_sweeps=max_sweeps,
+                                stall_detection=stall_detection,
+                                criterion=criterion)
 
     def body(state):
         top, bot, vtop, vbot, prev_off, _, sweeps = state
+        acc = jnp.promote_types(top.dtype, jnp.float32)
+        dmax2 = jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
+                            jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
         top, bot, vtop, vbot, off_rel = _sweep(
             top, bot, vtop if with_v else None, vbot if with_v else None,
-            precision=precision, gram_dtype=gram_dtype, method=method)
+            precision=precision, gram_dtype=gram_dtype, method=method,
+            criterion=criterion, dmax2=dmax2)
         if not with_v:
             vtop, vbot = state[2], state[3]
         return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
@@ -210,9 +261,10 @@ def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
 
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "tol", "max_sweeps",
-    "precision", "gram_dtype_name", "method"))
+    "precision", "gram_dtype_name", "method", "criterion", "stall_detection"))
 def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
-                max_sweeps, precision, gram_dtype_name, method):
+                max_sweeps, precision, gram_dtype_name, method, criterion,
+                stall_detection=True):
     m, n_pad = a.shape
     dtype = a.dtype
     gram_dtype = jnp.dtype(gram_dtype_name)
@@ -222,9 +274,28 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
         vtop, vbot = _blockify(veye, n_pad, nblocks)
     else:
         vtop = vbot = None
-    top, bot, vtop, vbot, off_rel, sweeps = _jacobi_iterate(
-        top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
-        precision=precision, gram_dtype=gram_dtype, method=method)
+    if method == "hybrid":
+        # Phase 1: all-matmul gram-eigh sweeps to absolute (sigma_max-scaled)
+        # convergence; phase 2: qr-svd sweeps to the relative criterion,
+        # restoring U orthogonality / small-sigma relative accuracy. The
+        # phase-2 loop starts from near-converged state, so it typically
+        # adds only 1-3 sweeps.
+        top, bot, vtop, vbot, _, s1 = _jacobi_iterate(
+            top, bot, vtop, vbot, tol=_abs_phase_tol(dtype),
+            max_sweeps=max_sweeps,
+            precision=precision, gram_dtype=gram_dtype, method="gram-eigh",
+            criterion="abs", stall_detection=stall_detection)
+        # max_sweeps stays a TOTAL budget across both phases.
+        top, bot, vtop, vbot, off_rel, s2 = _jacobi_iterate(
+            top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps - s1,
+            precision=precision, gram_dtype=gram_dtype, method="qr-svd",
+            criterion=criterion, stall_detection=stall_detection)
+        sweeps = s1 + s2
+    else:
+        top, bot, vtop, vbot, off_rel, sweeps = _jacobi_iterate(
+            top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
+            precision=precision, gram_dtype=gram_dtype, method=method,
+            criterion=criterion, stall_detection=stall_detection)
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if compute_v else None
     u, s, v = _postprocess(a_work, v_work, n, compute_u=compute_u,
@@ -266,12 +337,14 @@ def svd(
 
     b, k = _plan(n, 1, config)
     n_pad = 2 * k * b
-    tol, gram_dtype_name, method = _resolve_options(a, config)
+    tol, gram_dtype_name, method, criterion = _resolve_options(
+        a, config, compute_uv=compute_u)
 
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
     u, s, v, sweeps, off_rel = _svd_padded(
         a_pad, n=n, compute_u=compute_u, compute_v=compute_v,
         full_u=full_matrices, nblocks=2 * k, tol=tol,
         max_sweeps=int(config.max_sweeps), precision=config.matmul_precision,
-        gram_dtype_name=gram_dtype_name, method=method)
+        gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
+        stall_detection=bool(config.stall_detection))
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
