@@ -21,14 +21,8 @@ import numpy as np
 
 
 def _force(tree):
-    """Force device execution without timing the host transfer: reduce every
-    output to one scalar on device and materialize only that (block_until_ready
-    over the axon TPU tunnel does not reliably synchronize, and a full-factor
-    device->host copy through the tunnel would dominate the measurement)."""
-    import jax
-    import jax.numpy as jnp
-    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
-    return float(np.asarray(sum(jnp.sum(x) for x in leaves)))
+    from svd_jacobi_tpu.utils._exec import force
+    return force(tree)
 
 
 def _time(f, *args, reps: int = 2) -> float:

@@ -1,0 +1,104 @@
+"""LAPACK-gesvd-shaped API surface.
+
+Mirrors the reference's public solver contract
+(reference: `SVD_OPTIONS {AllVec, SomeVec, NoVec}` and the dgesvd-style
+signatures of `omp_mpi_cuda_dgesvd_local_matrices` /
+`cuda_dgesvd_kernel`, lib/JacobiMethods.cuh:25-62), so a user of the
+reference can switch with the same vocabulary:
+
+    u, s, vt = gesvd(SVD_OPTIONS.SomeVec, SVD_OPTIONS.SomeVec, a)
+
+Differences from the reference, by design:
+  * returns ``v^T`` like LAPACK dgesvd proper (the reference returns V
+    untransposed); `svd_jacobi_tpu.svd` returns V untransposed for parity
+    with the reference's convention.
+  * works for any m, n (the reference documents m >= n and in practice only
+    square, SURVEY.md quirks #4/#7).
+  * AllVec returns full square U (m, m) / Vt (n, n); SomeVec the economy
+    factors — matching LAPACK jobu='A'/'S'. The reference treats AllVec ==
+    SomeVec (its SomeVec branch is commented out, lib/JacobiMethods.cu:1165).
+  * layout: arrays are row-major jax arrays; the reference's col-major
+    MATRIX_LAYOUT enum (lib/Utils.cuh:18-21) is unnecessary — pass `a.T`
+    for a col-major buffer.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+
+from .config import SVDConfig
+from .solver import SVDResult, svd
+
+
+class SVD_OPTIONS(enum.Enum):
+    """Job options for U/V computation (lib/JacobiMethods.cuh:25-29)."""
+
+    AllVec = "all"    # full square factor
+    SomeVec = "some"  # economy factor (min(m, n) columns)
+    NoVec = "none"    # do not compute
+
+
+def gesvd(
+    jobu: SVD_OPTIONS,
+    jobv: SVD_OPTIONS,
+    a,
+    *,
+    config: Optional[SVDConfig] = None,
+    mesh=None,
+) -> Tuple[Optional[jax.Array], jax.Array, Optional[jax.Array]]:
+    """Compute ``a = u @ diag(s) @ vt`` (note: returns v TRANSPOSED).
+
+    Args:
+      jobu/jobv: SVD_OPTIONS for the left/right factors.
+      a: (m, n) real matrix.
+      config: solver configuration.
+      mesh: optional `jax.sharding.Mesh` — routes to the distributed solver
+        (the reference's `omp_mpi_cuda_dgesvd_local_matrices` equivalent);
+        None runs single-device (`cuda_dgesvd_kernel` equivalent).
+
+    Returns:
+      (u, s, vt); u/vt are None under NoVec. s is descending, length
+      min(m, n). AllVec: u is (m, m), vt is (n, n); SomeVec: u is
+      (m, min(m, n)), vt is (min(m, n), n).
+    """
+    if not isinstance(jobu, SVD_OPTIONS) or not isinstance(jobv, SVD_OPTIONS):
+        raise TypeError("jobu/jobv must be SVD_OPTIONS members")
+    full = (jobu == SVD_OPTIONS.AllVec) or (jobv == SVD_OPTIONS.AllVec)
+    r = _solve(a, jobu != SVD_OPTIONS.NoVec, jobv != SVD_OPTIONS.NoVec,
+               full, config, mesh)
+    u, s, v = r.u, r.s, r.v
+    vt = None
+    if v is not None:
+        # full_matrices in the solver completes U; AllVec for V needs the
+        # square V, which the solver returns as (n, min) unless n <= m and
+        # full was requested via the transpose path. Complete here if short.
+        if jobv == SVD_OPTIONS.AllVec and v.shape[1] < v.shape[0]:
+            v = _complete_basis(v)
+        vt = v.T
+    if u is not None and jobu != SVD_OPTIONS.AllVec and u.shape[1] > s.shape[0]:
+        u = u[:, : s.shape[0]]
+    return u, s, vt
+
+
+def _solve(a, compute_u, compute_v, full, config, mesh) -> SVDResult:
+    if mesh is not None:
+        from .parallel import sharded
+        return sharded.svd(a, mesh=mesh, compute_u=compute_u,
+                           compute_v=compute_v, full_matrices=full,
+                           config=config)
+    return svd(a, compute_u=compute_u, compute_v=compute_v,
+               full_matrices=full, config=config)
+
+
+def _complete_basis(q: jax.Array) -> jax.Array:
+    """Extend an (n, r) orthonormal set to an (n, n) orthonormal basis."""
+    import jax.numpy as jnp
+    n, r = q.shape
+    qq, rr = jnp.linalg.qr(q, mode="complete")
+    signs = jnp.sign(jnp.diagonal(rr))
+    signs = jnp.where(signs == 0, 1.0, signs)
+    qq = qq.at[:, :r].multiply(signs[None, :])
+    return qq

@@ -127,10 +127,7 @@ def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
         # Global max squared column norm for the deflation gates: column
         # norms drift only slowly across a sweep (they converge to the
         # sigmas), so one pmax per sweep is enough.
-        acc = jnp.promote_types(top.dtype, jnp.float32)
-        local_d2 = jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
-                               jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
-        dmax2 = lax.pmax(local_d2, axis_name)
+        dmax2 = lax.pmax(_single._global_dmax2(top, bot), axis_name)
         init = (top, bot, vtop, vbot, jnp.zeros((), jnp.float32))
         (top, bot, vtop, vbot, local_rel), _ = lax.scan(
             partial(round_body, dmax2=dmax2, mth=mth, crit=crit),
@@ -162,11 +159,13 @@ def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
     if method == "hybrid":
         # See solver._svd_padded: abs-converged bulk phase, then a short
         # relative-criterion polish phase for U orthogonality.
-        top, bot, vtop, vbot, _, _, s1 = iterate(
+        top, bot, vtop, vbot, off1, _, s1 = iterate(
             top, bot, vtop, vbot, "gram-eigh", "abs",
             _single._abs_phase_tol(top.dtype), max_sweeps)
-        top, bot, vtop, vbot, off_rel, _, s2 = iterate(
+        top, bot, vtop, vbot, off2, _, s2 = iterate(
             top, bot, vtop, vbot, "qr-svd", criterion, tol, max_sweeps - s1)
+        # Zero-iteration polish leaves its init off = inf; see solver.py.
+        off_rel = jnp.where(s2 > 0, off2, off1)
         return top, bot, vtop, vbot, off_rel, s1 + s2
     top, bot, vtop, vbot, off_rel, _, sweeps = iterate(
         top, bot, vtop, vbot, method, criterion, tol, max_sweeps)

@@ -140,6 +140,14 @@ def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
     return go
 
 
+def _global_dmax2(top, bot):
+    """Max squared column norm over both stacks (the GLOBAL deflation scale;
+    mesh callers additionally pmax this across devices)."""
+    acc = jnp.promote_types(top.dtype, jnp.float32)
+    return jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
+                       jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
+
+
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
     """(m, n) -> top/bot stacks (k, m, b), zero-padding columns to n_pad."""
     m, n = a.shape
@@ -209,9 +217,7 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
 
     def body(state):
         top, bot, vtop, vbot, prev_off, _, sweeps = state
-        acc = jnp.promote_types(top.dtype, jnp.float32)
-        dmax2 = jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
-                            jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
+        dmax2 = _global_dmax2(top, bot)
         top, bot, vtop, vbot, off_rel = _sweep(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             precision=precision, gram_dtype=gram_dtype, method=method,
@@ -280,16 +286,19 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
         # restoring U orthogonality / small-sigma relative accuracy. The
         # phase-2 loop starts from near-converged state, so it typically
         # adds only 1-3 sweeps.
-        top, bot, vtop, vbot, _, s1 = _jacobi_iterate(
+        top, bot, vtop, vbot, off1, s1 = _jacobi_iterate(
             top, bot, vtop, vbot, tol=_abs_phase_tol(dtype),
             max_sweeps=max_sweeps,
             precision=precision, gram_dtype=gram_dtype, method="gram-eigh",
             criterion="abs", stall_detection=stall_detection)
         # max_sweeps stays a TOTAL budget across both phases.
-        top, bot, vtop, vbot, off_rel, s2 = _jacobi_iterate(
+        top, bot, vtop, vbot, off2, s2 = _jacobi_iterate(
             top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps - s1,
             precision=precision, gram_dtype=gram_dtype, method="qr-svd",
             criterion=criterion, stall_detection=stall_detection)
+        # A zero-iteration polish (bulk ate the budget) leaves its init
+        # off = inf; report the bulk statistic instead.
+        off_rel = jnp.where(s2 > 0, off2, off1)
         sweeps = s1 + s2
     else:
         top, bot, vtop, vbot, off_rel, sweeps = _jacobi_iterate(
@@ -348,3 +357,152 @@ def svd(
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
         stall_detection=bool(config.stall_detection))
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+
+
+# ---------------------------------------------------------------------------
+# Host-controlled sweep stepping — powers checkpoint/resume and per-sweep
+# observability (utils/checkpoint.py, utils/profiling.py). The fused `svd`
+# entry point runs its whole while_loop inside one jit; this API instead
+# exposes one jitted sweep per call so the host can snapshot state at sweep
+# boundaries (the reference has no checkpointing at all — SURVEY.md section 5)
+# and record per-sweep metrics.
+
+
+class SweepState(NamedTuple):
+    """Device state between sweeps. ``vtop``/``vbot`` are zero-width when V
+    is not accumulated."""
+
+    top: jax.Array
+    bot: jax.Array
+    vtop: jax.Array
+    vbot: jax.Array
+    off_rel: jax.Array
+    sweeps: jax.Array
+
+
+class SweepStepper:
+    """Run the solve one sweep at a time under host control.
+
+    Usage:
+        st = SweepStepper(a, config=cfg)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)      # one jitted sweep
+        result = st.finish(state)
+
+    Matches `svd()` semantics for m >= n (callers transpose wide inputs);
+    the hybrid method's phase switch happens on host via `should_continue` /
+    `step` consulting the current off-norm.
+    """
+
+    def __init__(self, a, *, compute_u: bool = True, compute_v: bool = True,
+                 full_matrices: bool = False, config: SVDConfig | None = None):
+        if config is None:
+            config = SVDConfig()
+        a = jnp.asarray(a)
+        if a.ndim != 2:
+            raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+        m, n = a.shape
+        if m < n:
+            raise ValueError("SweepStepper requires m >= n; pass a.T and "
+                             "swap u/v (as svd() does)")
+        self.a, self.m, self.n = a, m, n
+        self.compute_u, self.compute_v = compute_u, compute_v
+        self.full_matrices = full_matrices
+        self.config = config
+        b, k = _plan(n, 1, config)
+        self.nblocks, self.n_pad = 2 * k, 2 * k * b
+        (self.tol, self.gram_dtype_name, self.method,
+         self.criterion) = _resolve_options(a, config, compute_uv=compute_u)
+        self.abs_tol = _abs_phase_tol(a.dtype)
+        self._prev_off = float("inf")
+        # Hybrid runs as two host-visible stages: "bulk" (gram-eigh/abs)
+        # then "polish" (qr-svd/rel). Non-hybrid methods have one stage.
+        self._stage = "bulk" if self.method == "hybrid" else "single"
+        self._just_switched = False
+
+    def init(self) -> SweepState:
+        top, bot = _blockify(self.a, self.n_pad, self.nblocks)
+        k = self.nblocks // 2
+        if self.compute_v:
+            vtop, vbot = _blockify(jnp.eye(self.n_pad, dtype=self.a.dtype),
+                                   self.n_pad, self.nblocks)
+        else:
+            vtop = vbot = jnp.zeros((k, 0, top.shape[2]), self.a.dtype)
+        return SweepState(top, bot, vtop, vbot,
+                          jnp.float32(jnp.inf), jnp.int32(0))
+
+    def _phase(self):
+        """(method, criterion, tol) for the next sweep, per current stage."""
+        if self._stage == "bulk":
+            return "gram-eigh", "abs", self.abs_tol
+        if self._stage == "polish":
+            return "qr-svd", self.criterion, self.tol
+        return self.method, self.criterion, self.tol
+
+    def step(self, state: SweepState) -> SweepState:
+        method, criterion, _ = self._phase()
+        if self._just_switched:
+            # First sweep of the polish stage: the pre-sweep off_rel is on
+            # the abs scale — do not use it as the stall comparator.
+            self._prev_off = float("inf")
+            self._just_switched = False
+        else:
+            self._prev_off = float(state.off_rel)
+        top, bot, vtop, vbot, off = _sweep_step_jit(
+            state.top, state.bot, state.vtop, state.vbot,
+            with_v=self.compute_v, precision=self.config.matmul_precision,
+            gram_dtype_name=self.gram_dtype_name, method=method,
+            criterion=criterion)
+        return SweepState(top, bot, vtop, vbot, off, state.sweeps + 1)
+
+    def should_continue(self, state: SweepState) -> bool:
+        if int(state.sweeps) == 0:
+            return True
+        if int(state.sweeps) >= self.config.max_sweeps:
+            return False
+        _, criterion, tol = self._phase()
+        go = bool(_should_continue(
+            float(state.off_rel), self._prev_off, int(state.sweeps),
+            tol=tol, max_sweeps=self.config.max_sweeps,
+            stall_detection=self.config.stall_detection, criterion=criterion))
+        if not go and self._stage == "bulk":
+            # End of the bulk stage (abs-converged or stalled) — switch to
+            # the polish stage instead of terminating; its off-norm scale
+            # is different, so reset the stall comparator.
+            self._stage = "polish"
+            self._prev_off = float("inf")
+            self._just_switched = True
+            return True
+        return go
+
+    def finish(self, state: SweepState) -> SVDResult:
+        u, s, v = _finish_jit(
+            state.top, state.bot, state.vtop, state.vbot, n=self.n,
+            compute_u=self.compute_u, compute_v=self.compute_v,
+            full_u=self.full_matrices)
+        return SVDResult(u=u, s=s, v=(v if self.compute_v else None),
+                         sweeps=state.sweeps, off_rel=state.off_rel)
+
+
+@partial(jax.jit, static_argnames=("with_v", "precision", "gram_dtype_name",
+                                   "method", "criterion"))
+def _sweep_step_jit(top, bot, vtop, vbot, *, with_v, precision,
+                    gram_dtype_name, method, criterion):
+    dmax2 = _global_dmax2(top, bot)
+    top, bot, nvt, nvb, off = _sweep(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        precision=precision, gram_dtype=jnp.dtype(gram_dtype_name),
+        method=method, criterion=criterion, dmax2=dmax2)
+    if with_v:
+        vtop, vbot = nvt, nvb
+    return top, bot, vtop, vbot, off
+
+
+@partial(jax.jit, static_argnames=("n", "compute_u", "compute_v", "full_u"))
+def _finish_jit(top, bot, vtop, vbot, *, n, compute_u, compute_v, full_u):
+    a_work = _deblockify(top, bot)
+    v_work = _deblockify(vtop, vbot)[:n, :] if compute_v else None
+    u, s, v = _postprocess(a_work, v_work, n, compute_u=compute_u,
+                           full_u=full_u, dtype=top.dtype)
+    return u, s, v

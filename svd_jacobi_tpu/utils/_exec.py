@@ -1,0 +1,19 @@
+"""Force device execution without timing/paying host transfers.
+
+`jax.block_until_ready` does not reliably synchronize through the axon TPU
+tunnel, and a full device->host copy of large factors through the tunnel
+would dominate any measurement — so every timing path (bench.py, cli.py,
+utils/profiling.py) reduces outputs to ONE scalar on device and materializes
+only that.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def force(tree) -> float:
+    import jax
+    import jax.numpy as jnp
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    return float(np.asarray(sum(jnp.sum(x) for x in leaves)))

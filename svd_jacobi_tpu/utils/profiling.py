@@ -1,0 +1,102 @@
+"""Observability: per-sweep structured metrics and profiler tracing.
+
+The reference's only instrumentation is a wall-clock bracket around the
+solver call plus stdout prints mirrored to a report file (reference:
+`omp_get_wtime` at main.cu:1586,1610; report at main.cu:1667-1669). Here:
+
+  * `trace(dir)` — context manager around `jax.profiler` for XLA-level
+    traces viewable in TensorBoard/Perfetto;
+  * `instrumented_svd(a, ...)` — runs the solve sweep-by-sweep (SweepStepper)
+    and records per-sweep off-norm, stage, and wall time, returning
+    (result, SweepLog); `SweepLog.to_json()` is the structured successor of
+    the reference's free-text report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from ..config import SVDConfig
+from ..solver import SVDResult, SweepStepper
+
+
+class SweepRecord(NamedTuple):
+    sweep: int
+    stage: str          # "bulk" | "polish" | "single"
+    method: str
+    off_norm: float     # convergence statistic AFTER this sweep
+    time_s: float
+
+
+class SweepLog(NamedTuple):
+    records: List[SweepRecord]
+    total_time_s: float
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "total_time_s": self.total_time_s,
+            "sweeps": [r._asdict() for r in self.records],
+        }, indent=2)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """XLA profiler trace of the enclosed block (TensorBoard-viewable)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def _sync(x) -> float:
+    from ._exec import force
+    return force(x)
+
+
+def instrumented_svd(
+    a,
+    *,
+    compute_u: bool = True,
+    compute_v: bool = True,
+    full_matrices: bool = False,
+    config: Optional[SVDConfig] = None,
+):
+    """-> (SVDResult, SweepLog): the solve with per-sweep metrics.
+
+    Runs one jitted sweep per host step, so each record's wall time is the
+    real device time of that sweep (first sweep of each stage includes its
+    compilation)."""
+    import jax.numpy as jnp
+    a = jnp.asarray(a)
+    if a.ndim == 2 and a.shape[0] < a.shape[1]:
+        r, log = instrumented_svd(a.T, compute_u=compute_v,
+                                  compute_v=compute_u,
+                                  full_matrices=full_matrices, config=config)
+        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
+                         off_rel=r.off_rel), log
+    stepper = SweepStepper(a, compute_u=compute_u, compute_v=compute_v,
+                           full_matrices=full_matrices, config=config)
+    state = stepper.init()
+    records: List[SweepRecord] = []
+    t_all = time.perf_counter()
+    while stepper.should_continue(state):
+        method, _, _ = stepper._phase()
+        stage = stepper._stage
+        t0 = time.perf_counter()
+        state = stepper.step(state)
+        _sync(state.off_rel)
+        records.append(SweepRecord(
+            sweep=int(state.sweeps), stage=stage, method=method,
+            off_norm=float(state.off_rel), time_s=time.perf_counter() - t0))
+    result = stepper.finish(state)
+    _sync(result.s)
+    log = SweepLog(records=records,
+                   total_time_s=time.perf_counter() - t_all)
+    return result, log

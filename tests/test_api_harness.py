@@ -1,0 +1,194 @@
+"""LAPACK surface, CLI driver, checkpoint/resume, stepper, profiling
+(SURVEY.md C9 public API, C13/C14 harness, section 5 aux subsystems)."""
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from svd_jacobi_tpu import SVDConfig, svd
+from svd_jacobi_tpu.lapack import SVD_OPTIONS, gesvd
+from svd_jacobi_tpu.solver import SweepStepper
+from svd_jacobi_tpu.utils import checkpoint, matgen, profiling, validation
+
+
+CFG = SVDConfig(block_size=4)
+
+
+def _ref(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+class TestGesvd:
+    def test_somevec(self):
+        a = matgen.random_dense(24, 16, dtype=jnp.float64, seed=1)
+        u, s, vt = gesvd(SVD_OPTIONS.SomeVec, SVD_OPTIONS.SomeVec, a, config=CFG)
+        assert u.shape == (24, 16) and vt.shape == (16, 16)
+        np.testing.assert_allclose(np.asarray(u * s[None, :] @ vt),
+                                   np.asarray(a), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(s), _ref(a), rtol=1e-10, atol=1e-12)
+
+    def test_novec(self):
+        a = matgen.random_dense(16, 16, dtype=jnp.float64, seed=2)
+        u, s, vt = gesvd(SVD_OPTIONS.NoVec, SVD_OPTIONS.NoVec, a, config=CFG)
+        assert u is None and vt is None
+        np.testing.assert_allclose(np.asarray(s), _ref(a), rtol=1e-10, atol=1e-12)
+
+    def test_allvec_tall(self):
+        a = matgen.random_dense(20, 8, dtype=jnp.float64, seed=3)
+        u, s, vt = gesvd(SVD_OPTIONS.AllVec, SVD_OPTIONS.AllVec, a, config=CFG)
+        assert u.shape == (20, 20) and vt.shape == (8, 8)
+        assert float(validation.orthogonality_error(u)) < 1e-12
+        np.testing.assert_allclose(np.asarray(u[:, :8] * s[None, :] @ vt),
+                                   np.asarray(a), atol=1e-12)
+
+    def test_allvec_wide(self):
+        a = matgen.random_dense(8, 20, dtype=jnp.float64, seed=4)
+        u, s, vt = gesvd(SVD_OPTIONS.AllVec, SVD_OPTIONS.AllVec, a, config=CFG)
+        assert u.shape == (8, 8) and vt.shape == (20, 20)
+        assert float(validation.orthogonality_error(vt.T)) < 1e-11
+        np.testing.assert_allclose(np.asarray(u * s[None, :] @ vt[:8]),
+                                   np.asarray(a), atol=1e-12)
+
+    def test_mixed_jobs(self):
+        a = matgen.random_dense(12, 12, dtype=jnp.float64, seed=5)
+        u, s, vt = gesvd(SVD_OPTIONS.SomeVec, SVD_OPTIONS.NoVec, a, config=CFG)
+        assert u is not None and vt is None
+
+    def test_type_errors(self):
+        a = jnp.zeros((4, 4))
+        with pytest.raises(TypeError):
+            gesvd("AllVec", SVD_OPTIONS.NoVec, a)
+
+
+class TestStepperAndCheckpoint:
+    def test_stepper_matches_svd(self):
+        a = matgen.random_dense(32, 32, dtype=jnp.float64, seed=6)
+        r_fused = svd(a, config=CFG)
+        st = SweepStepper(a, config=CFG)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        r = st.finish(state)
+        np.testing.assert_allclose(np.asarray(r.s), np.asarray(r_fused.s),
+                                   rtol=1e-10, atol=1e-13)
+        rep = validation.validate(a, r)
+        assert float(rep.residual_rel) < 1e-13
+
+    def test_stepper_hybrid_stages(self):
+        a = matgen.random_dense(32, 32, dtype=jnp.float32, seed=7)
+        cfg = SVDConfig(block_size=4, pair_solver="hybrid")
+        r, log = profiling.instrumented_svd(a, config=cfg)
+        stages = [rec.stage for rec in log.records]
+        assert "bulk" in stages and "polish" in stages
+        assert stages == sorted(stages, key=["bulk", "polish"].index)
+        rep = validation.validate(a, r, s_ref=_ref(a))
+        assert float(rep.sigma_err) < 1e-5
+        assert float(rep.u_orth) < 5e-3
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        a = matgen.random_dense(32, 32, dtype=jnp.float64, seed=8)
+        path = tmp_path / "ck.npz"
+        r = checkpoint.svd_checkpointed(a, path=path, config=CFG)
+        assert not path.exists()  # removed on success
+        np.testing.assert_allclose(np.asarray(r.s), _ref(a),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_checkpoint_resume(self, tmp_path):
+        a = matgen.random_dense(32, 32, dtype=jnp.float64, seed=9)
+        path = tmp_path / "ck.npz"
+        # Interrupt after 2 sweeps, snapshotting each sweep.
+        st = SweepStepper(a, config=CFG)
+        state = st.init()
+        for _ in range(2):
+            state = st.step(state)
+        checkpoint.save_state(path, st, state)
+        # Resume to completion.
+        r = checkpoint.svd_checkpointed(a, path=path, config=CFG, keep=True)
+        assert int(r.sweeps) > 2
+        np.testing.assert_allclose(np.asarray(r.s), _ref(a),
+                                   rtol=1e-10, atol=1e-12)
+        rep = validation.validate(a, r)
+        assert float(rep.residual_rel) < 1e-13
+
+    def test_checkpoint_mismatch_rejected(self, tmp_path):
+        a = matgen.random_dense(32, 32, dtype=jnp.float64, seed=10)
+        path = tmp_path / "ck.npz"
+        st = SweepStepper(a, config=CFG)
+        checkpoint.save_state(path, st, st.init())
+        b = matgen.random_dense(40, 40, dtype=jnp.float64, seed=10)
+        with pytest.raises(ValueError, match="does not match"):
+            checkpoint.svd_checkpointed(b, path=path, config=CFG)
+
+    def test_checkpoint_wide_input(self, tmp_path):
+        a = matgen.random_dense(16, 40, dtype=jnp.float64, seed=11)
+        r = checkpoint.svd_checkpointed(a, path=tmp_path / "w.npz", config=CFG)
+        np.testing.assert_allclose(np.asarray(r.s), _ref(a),
+                                   rtol=1e-10, atol=1e-12)
+        assert r.u.shape == (16, 16) and r.v.shape == (40, 16)
+
+
+class TestCli:
+    def test_cli_runs_and_reports(self, tmp_path, capsys):
+        from svd_jacobi_tpu import cli
+        rc = cli.main(["64", "--dtype", "float64", "--selftest-n", "32",
+                       "--oracle", "--report-dir", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        solve = json.loads(out)
+        assert solve["residual_rel"] < 1e-12
+        assert solve["sigma_err"] < 1e-12
+        reports = list(tmp_path.glob("report-dimension-64-*.json"))
+        assert len(reports) == 1
+        rep = json.loads(reports[0].read_text())
+        assert rep["self_test"]["ok"]
+        assert rep["solve"]["sweeps"] >= 1
+
+    def test_cli_distributed(self, tmp_path, eight_devices):
+        from svd_jacobi_tpu import cli
+        rc = cli.main(["48", "--dtype", "float64", "--distributed",
+                       "--no-selftest", "--matrix", "dense",
+                       "--report-dir", str(tmp_path)])
+        assert rc == 0
+
+    def test_cli_rejects_rect_triangular(self, tmp_path):
+        from svd_jacobi_tpu import cli
+        rc = cli.main(["32", "16", "--no-selftest",
+                       "--report-dir", str(tmp_path)])
+        assert rc == 2
+
+
+def test_profiling_log_json():
+    a = matgen.random_dense(24, 24, dtype=jnp.float64, seed=12)
+    r, log = profiling.instrumented_svd(a, config=CFG)
+    d = json.loads(log.to_json())
+    assert d["total_time_s"] > 0
+    assert len(d["sweeps"]) == int(r.sweeps)
+    assert all(rec["off_norm"] >= 0 for rec in d["sweeps"])
+
+
+def test_live_orth_bf16_deflates():
+    """Regression: bfloat16 eps (numpy kind 'V') must not fall back to an
+    f64-scale threshold — null columns of a rank-deficient bf16 input must
+    be deflated from the live-orthogonality metric."""
+    s_true = np.r_[np.ones(8), np.zeros(8)]
+    a = matgen.with_known_spectrum(24, 16, s_true,
+                                   dtype=jnp.float32).astype(jnp.bfloat16)
+    r = svd(a, config=SVDConfig(block_size=4))
+    err = float(validation.live_orthogonality_error(r.u, r.s))
+    assert err < 0.1, err
+
+
+def test_stepper_polish_actually_polishes():
+    """Regression: the first polish sweep must not be stall-compared against
+    the bulk phase's abs-scale off-norm (which spuriously terminated the
+    polish phase with U unorthogonalized)."""
+    a = matgen.with_known_spectrum(
+        64, 64, np.geomspace(1, 1e-5, 64), dtype=jnp.float32)
+    cfg = SVDConfig(block_size=8, pair_solver="hybrid")
+    r, log = profiling.instrumented_svd(a, config=cfg)
+    n_polish = sum(1 for rec in log.records if rec.stage == "polish")
+    assert n_polish >= 1
+    assert float(validation.live_orthogonality_error(r.u, r.s)) < 5e-3
