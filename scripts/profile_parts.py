@@ -1,0 +1,87 @@
+"""Profile the building blocks of the solver on the attached accelerator.
+
+Times each candidate primitive so perf decisions are measured, not guessed.
+Usage: python scripts/profile_parts.py [N]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+
+
+def _scalarize(f):
+    def g(*args):
+        out = f(*args)
+        leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+    return g
+
+
+def t(name, f, *args, reps=3):
+    f_j = jax.jit(_scalarize(f))
+    float(np.asarray(f_j(*args)))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(np.asarray(f_j(*args)))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:48s} {best*1e3:10.2f} ms")
+    return best
+
+
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (N, N), jnp.float32)
+
+print(f"== N={N} f32 on {jax.devices()[0]} ==")
+t("jnp.linalg.svd", lambda x: jnp.linalg.svd(x), a)
+t("jnp.linalg.svd novec", lambda x: jnp.linalg.svd(x, compute_uv=False), a)
+t("jnp.linalg.eigh", lambda x: jnp.linalg.eigh(x @ x.T), a)
+t("gram n^3 highest", lambda x: jnp.einsum("mi,mj->ij", x, x,
+                                           precision=jax.lax.Precision.HIGHEST), a)
+t("gram n^3 default", lambda x: jnp.einsum("mi,mj->ij", x, x,
+                                           precision=jax.lax.Precision.DEFAULT), a)
+t("matmul n^3 highest", lambda x: x @ x, a)
+t("qr full", lambda x: jnp.linalg.qr(x), a)
+
+# batched small-panel ops at b=128 (2b=256), k=N/256 panels
+b2 = 256
+k = max(1, N // b2)
+panels = jax.random.normal(key, (k, b2, b2), jnp.float32)
+tall = jax.random.normal(key, (k, N, b2), jnp.float32)
+t(f"batched eigh ({k},{b2},{b2})", lambda p: jnp.linalg.eigh(p @ p.transpose(0, 2, 1)), panels)
+t(f"batched svd  ({k},{b2},{b2})", lambda p: jnp.linalg.svd(p), panels)
+t(f"batched qr-r ({k},{N},{b2})", lambda p: jnp.linalg.qr(p, mode="r"), tall)
+t(f"batched mm   ({k},{N},{b2})@({k},{b2},{b2})",
+  lambda x, q: jnp.einsum("kmi,kij->kmj", x, q,
+                          precision=jax.lax.Precision.HIGHEST), tall, panels)
+
+# the sequential givens cleanup scan
+sys.path.insert(0, "/root/repo")
+from svd_jacobi_tpu.ops import blockwise
+t(f"givens_cleanup_sweep ({k},{b2},{b2})",
+  lambda p: blockwise.givens_cleanup_sweep(p, jnp.float32(1.0))[0], panels)
+
+# one full sweep, each method
+from svd_jacobi_tpu import solver
+top = jax.random.normal(key, (k // 2 if k >= 2 else 1, N, b2), jnp.float32)
+kk = top.shape[0]
+bot = jax.random.normal(key, (kk, N, b2), jnp.float32)
+vtop = jax.random.normal(key, (kk, N, b2), jnp.float32)
+vbot = jax.random.normal(key, (kk, N, b2), jnp.float32)
+
+for method, crit in [("gram-eigh", "abs"), ("qr-svd", "rel")]:
+    t(f"one sweep {method} (k={kk}, b={b2})",
+      lambda tp, bt, vt, vb: solver._sweep(
+          tp, bt, vt, vb, precision="highest", gram_dtype=jnp.float32,
+          method=method, criterion=crit, dmax2=jnp.float32(N))[0],
+      top, bot, vtop, vbot)
+
+# end-to-end current solver
+import svd_jacobi_tpu as sj
+r = sj.svd(a)
+print("sweeps:", int(r.sweeps), "off_rel:", float(r.off_rel))
+t("sj.svd end-to-end", lambda x: tuple(sj.svd(x)[:3]), a, reps=2)

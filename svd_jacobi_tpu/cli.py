@@ -114,6 +114,11 @@ def main(argv=None) -> int:
 
     m = args.m if args.m is not None else args.n
     n = args.n
+    if args.matrix == "triangular" and m != n:
+        # Reject the invalid combination up front, before the warm-up
+        # self-test spends a full solve.
+        log("triangular input requires m == n; use --matrix dense")
+        return 2
     dtype = jnp.dtype(args.dtype)
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
                           tol=args.tol, pair_solver=args.pair_solver)
@@ -142,9 +147,6 @@ def main(argv=None) -> int:
         report["self_test"] = _self_test(args, config, log)
 
     if args.matrix == "triangular":
-        if m != n:
-            log("triangular input requires m == n; use --matrix dense")
-            return 2
         a = matgen.random_upper_triangular(n, seed=args.seed, dtype=dtype)
     else:
         a = matgen.random_dense(m, n, seed=args.seed, dtype=dtype)

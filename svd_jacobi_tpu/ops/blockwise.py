@@ -138,7 +138,22 @@ def _rotate_cols(top: jax.Array, bot: jax.Array):
     return new_top, new_bot
 
 
-def givens_cleanup_sweep(p: jax.Array, dmax2: jax.Array):
+def _maybe_pvary(x, axis_name):
+    """Mark a replicated loop-carry init as device-varying under shard_map.
+
+    shard_map's variance checking (check_vma) requires scan carries to keep a
+    consistent varying-axes type; inits built from constants (identity
+    blocks, zero accumulators) start replicated and must be explicitly
+    `pvary`'d onto the mesh axis. Outside shard_map (axis_name None) this is
+    the identity.
+    """
+    if axis_name is None:
+        return x
+    return jax.lax.pcast(x, (axis_name,), to="varying")
+
+
+def givens_cleanup_sweep(p: jax.Array, dmax2: jax.Array,
+                         axis_name: Optional[str] = None):
     """One scalar one-sided Jacobi sweep over the columns of each panel.
 
     ``p``: (k, n2, n2) batch of small panels (the rotated R factors). Runs a
@@ -194,8 +209,10 @@ def givens_cleanup_sweep(p: jax.Array, dmax2: jax.Array):
         qtop, qbot = _rotate_cols(qtop, qbot)
         return (ptop, pbot, qtop, qbot, max_rel), None
 
-    init = (p[..., :b2], p[..., b2:], eye[..., :b2], eye[..., b2:],
-            jnp.zeros((), jnp.float32))
+    init = (p[..., :b2], p[..., b2:],
+            _maybe_pvary(eye[..., :b2], axis_name),
+            _maybe_pvary(eye[..., b2:], axis_name),
+            _maybe_pvary(jnp.zeros((), jnp.float32), axis_name))
     (ptop, pbot, qtop, qbot, max_rel), _ = jax.lax.scan(body, init, None, length=n2 - 1)
     # A full tournament cycle returns the layout to the initial order.
     return (jnp.concatenate([ptop, pbot], axis=-1),
@@ -216,7 +233,8 @@ def _newton_schulz_polish(q: jax.Array, precision) -> jax.Array:
 
 
 def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_name,
-                              with_v, method, dmax2=None, criterion="rel"):
+                              with_v, method, dmax2=None, criterion="rel",
+                              axis_name=None):
     b = top.shape[-1]
     gram_dtype = jnp.dtype(gram_dtype_name)
     x = jnp.concatenate([top, bot], axis=-1)  # (k, m, 2b)
@@ -253,7 +271,8 @@ def _orthogonalize_pairs_impl(top, bot, vtop, vbot, *, precision, gram_dtype_nam
                         preferred_element_type=gram_dtype)
         if dmax2 is None:
             dmax2 = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
-        _, q2, _ = givens_cleanup_sweep(r2, dmax2.astype(gram_dtype))
+        _, q2, _ = givens_cleanup_sweep(r2, dmax2.astype(gram_dtype),
+                                        axis_name=axis_name)
         q = jnp.einsum("kij,kjl->kil", q, q2, precision=prec,
                        preferred_element_type=gram_dtype)
     else:
@@ -283,6 +302,7 @@ def orthogonalize_pairs(
     method: str = "qr-svd",
     dmax2: Optional[jax.Array] = None,
     criterion: str = "rel",
+    axis_name: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, Optional[jax.Array], Optional[jax.Array], jax.Array, jax.Array]:
     """Orthogonalize each (top[i], bot[i]) block pair; update V alongside.
 
@@ -293,6 +313,8 @@ def orthogonalize_pairs(
       dmax2: GLOBAL max squared column norm, for the deflation gates. On a
         mesh this must be pmax'd across devices (see off_diag_stats); None
         falls back to the batch-local max (single-device semantics).
+      axis_name: mesh axis when called inside shard_map, so internal loop
+        carries can be `pvary`'d for the variance checker; None otherwise.
 
     Returns:
       (top', bot', vtop', vbot', max_rel, off2) — convergence statistics
@@ -315,6 +337,7 @@ def orthogonalize_pairs(
         method=method,
         dmax2=dmax2,
         criterion=criterion,
+        axis_name=axis_name,
     )
     if not with_v:
         new_vtop = new_vbot = None

@@ -112,7 +112,7 @@ def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
         top, bot, nvt, nvb, rel, _ = blockwise.orthogonalize_pairs(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             precision=precision, gram_dtype=gram_dtype, method=mth,
-            criterion=crit, dmax2=dmax2)
+            criterion=crit, dmax2=dmax2, axis_name=axis_name)
         if with_v:
             vtop, vbot = nvt, nvb
         top, bot = _ring_exchange(top, bot, axis_name=axis_name,
@@ -128,7 +128,9 @@ def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
         # norms drift only slowly across a sweep (they converge to the
         # sigmas), so one pmax per sweep is enough.
         dmax2 = lax.pmax(_single._global_dmax2(top, bot), axis_name)
-        init = (top, bot, vtop, vbot, jnp.zeros((), jnp.float32))
+        init = (top, bot, vtop, vbot,
+                lax.pcast(jnp.zeros((), jnp.float32), (axis_name,),
+                          to="varying"))
         (top, bot, vtop, vbot, local_rel), _ = lax.scan(
             partial(round_body, dmax2=dmax2, mth=mth, crit=crit),
             init, None, length=n_rounds)
@@ -259,10 +261,6 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
         mesh=mesh,
         in_specs=(block_spec,) * 4,
         out_specs=(block_spec,) * 4 + (P(), P()),
-        # The loop carries mix replicated constants (V = I, counters) with
-        # device-varying data; skip the static variance check rather than
-        # sprinkling pcasts through code shared with the single-device path.
-        check_vma=False,
     )
     top, bot, vtop, vbot, off_rel, sweeps = jacobi(top, bot, vtop, vbot)
 

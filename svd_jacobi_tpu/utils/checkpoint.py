@@ -15,6 +15,7 @@ Usage:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import tempfile
@@ -27,7 +28,15 @@ import numpy as np
 from ..config import SVDConfig
 from ..solver import SVDResult, SweepState, SweepStepper
 
-_FORMAT = 1
+_FORMAT = 2
+
+
+def _input_digest(a) -> str:
+    """Content hash of the input matrix, so a stale checkpoint from a
+    *different* matrix with the same layout (common when a parameter sweep
+    reuses one path) is rejected instead of silently yielding the wrong
+    factors."""
+    return hashlib.sha256(np.ascontiguousarray(np.asarray(a)).tobytes()).hexdigest()
 
 
 def _fingerprint(stepper: SweepStepper) -> dict:
@@ -36,6 +45,7 @@ def _fingerprint(stepper: SweepStepper) -> dict:
         "m": stepper.m, "n": stepper.n, "n_pad": stepper.n_pad,
         "nblocks": stepper.nblocks,
         "dtype": str(stepper.a.dtype),
+        "input_sha256": _input_digest(stepper.a),
         "compute_u": stepper.compute_u, "compute_v": stepper.compute_v,
         "full_matrices": stepper.full_matrices,
         "config": dataclasses.asdict(stepper.config),
@@ -56,6 +66,11 @@ def save_state(path, stepper: SweepStepper, state: SweepState) -> None:
                      vtop=np.asarray(state.vtop), vbot=np.asarray(state.vbot),
                      off_rel=np.asarray(state.off_rel),
                      sweeps=np.asarray(state.sweeps))
+            # Flush to stable storage BEFORE the rename: without the fsync a
+            # crash can leave an empty/truncated file under the final name —
+            # the exact loss checkpointing exists to prevent.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
