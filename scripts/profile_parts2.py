@@ -1,45 +1,43 @@
-"""Profile primitives with in-jit repetition so tunnel RTT cancels.
+"""Profile primitives by K queued dispatches + one readback (RTT amortized).
 
-For each op f we time scan-of-R-applications minus scan-of-1, divided by
-R-1 — the per-application device time free of dispatch/readback overhead.
-Usage: python scripts/profile_parts2.py [N] [R]
+Per-op device time = (t_K - t_1) / (K - 1) where t_j times j dispatches of
+the same jitted function followed by a single scalar readback.
+Usage: python scripts/profile_parts2.py [N] [K]
 """
 import sys
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-R = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 8
 
 
-def _loop(f, reps, *args):
-    def body(i, acc):
-        # Perturb input per iteration so XLA cannot CSE the calls.
-        bumped = tuple(a + jnp.float32(i) * jnp.finfo(jnp.float32).tiny
-                       for a in args)
-        out = f(*bumped)
+def _scalarize(f):
+    def g(*args):
+        out = f(*args)
         leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
-        return acc + sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
-    return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+    return g
 
 
-def t(name, f, *args, reps=R):
-    f1 = jax.jit(partial(_loop, f, 1))
-    fR = jax.jit(partial(_loop, f, reps))
-    float(np.asarray(f1(*args)))
-    float(np.asarray(fR(*args)))
-    t1 = tR = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter(); float(np.asarray(f1(*args)))
-        t1 = min(t1, time.perf_counter() - t0)
-        t0 = time.perf_counter(); float(np.asarray(fR(*args)))
-        tR = min(tR, time.perf_counter() - t0)
-    per = (tR - t1) / (reps - 1)
-    print(f"{name:52s} {per*1e3:10.2f} ms/call")
+def t(name, f, *args, reps=K):
+    g = jax.jit(_scalarize(f))
+    float(np.asarray(g(*args)))  # compile + warm
+
+    def run(j):
+        t0 = time.perf_counter()
+        for _ in range(j - 1):
+            g(*args)
+        float(np.asarray(g(*args)))
+        return time.perf_counter() - t0
+
+    t1 = min(run(1) for _ in range(2))
+    tK = min(run(reps) for _ in range(2))
+    per = (tK - t1) / (reps - 1)
+    print(f"{name:52s} {per*1e3:10.2f} ms/call", flush=True)
     return per
 
 
@@ -47,14 +45,13 @@ key = jax.random.PRNGKey(0)
 a = jax.random.normal(key, (N, N), jnp.float32)
 HI = jax.lax.Precision.HIGHEST
 
-print(f"== N={N} f32 on {jax.devices()[0]}, R={R} ==")
+print(f"== N={N} f32 on {jax.devices()[0]}, K={K} ==", flush=True)
+t("matmul highest", lambda x: jnp.matmul(x, x, precision=HI), a)
+t("matmul default", lambda x: jnp.matmul(x, x), a)
+t("gram n^3 highest", lambda x: jnp.einsum("mi,mj->ij", x, x, precision=HI), a)
 t("jnp.linalg.svd", lambda x: jnp.linalg.svd(x), a)
 t("jnp.linalg.svd novec", lambda x: jnp.linalg.svd(x, compute_uv=False), a)
 t("jnp.linalg.eigh(sym)", lambda x: jnp.linalg.eigh(x + x.T), a)
-t("gram n^3 highest", lambda x: jnp.einsum("mi,mj->ij", x, x, precision=HI), a)
-t("gram n^3 default", lambda x: jnp.einsum("mi,mj->ij", x, x), a)
-t("matmul highest", lambda x: jnp.matmul(x, x, precision=HI), a)
-t("matmul default", lambda x: jnp.matmul(x, x), a)
 t("qr full", lambda x: jnp.linalg.qr(x), a)
 t("qr r-only", lambda x: jnp.linalg.qr(x, mode="r"), a)
 
@@ -66,10 +63,9 @@ t(f"batched eigh ({k},{b2},{b2})",
   lambda p: jnp.linalg.eigh(p + p.transpose(0, 2, 1)), panels)
 t(f"batched svd  ({k},{b2},{b2})", lambda p: jnp.linalg.svd(p), panels)
 t(f"batched qr-r ({k},{N},{b2})", lambda p: jnp.linalg.qr(p, mode="r"), tall)
-t(f"batched mm   ({k},{N},{b2})@...",
-  lambda x: jnp.einsum("kmi,kij->kmj", x[:, :b2 * (N // b2)].reshape(k, N // b2 * b2, b2)[:, :N],
-                       jnp.einsum("kmi,kmj->kij", x, x, precision=HI),
-                       precision=HI), tall)
+t(f"batched update mm ({k},{N},{b2})",
+  lambda x, q: jnp.einsum("kmi,kij->kmj", x, q, precision=HI), tall,
+  jax.random.normal(key, (k, b2, b2), jnp.float32))
 
 sys.path.insert(0, "/root/repo")
 from svd_jacobi_tpu.ops import blockwise
@@ -83,13 +79,13 @@ bot = jax.random.normal(key, (kk, N, b2), jnp.float32)
 vt = jax.random.normal(key, (kk, N, b2), jnp.float32)
 vb = jax.random.normal(key, (kk, N, b2), jnp.float32)
 for method, crit in [("gram-eigh", "abs"), ("qr-svd", "rel")]:
-    t(f"one ROUND {method}",
+    t(f"one ROUND {method} noV",
       lambda tp, bt: blockwise.orthogonalize_pairs(
           tp, bt, None, None, precision="highest", gram_dtype=jnp.float32,
           method=method, criterion=crit, dmax2=jnp.float32(N))[0],
-      top, bot, reps=R)
-    t(f"one SWEEP {method} (k={kk}, 2b={b2})",
+      top, bot)
+    t(f"one SWEEP {method}+V (k={kk}, 2b={b2})",
       lambda tp, bt, v1, v2: solver._sweep(
           tp, bt, v1, v2, precision="highest", gram_dtype=jnp.float32,
           method=method, criterion=crit, dmax2=jnp.float32(N))[0],
-      top, bot, vt, vb, reps=3)
+      top, bot, vt, vb, reps=4)

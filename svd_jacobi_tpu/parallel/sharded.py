@@ -101,11 +101,40 @@ def _ring_exchange(top, bot, *, axis_name: str, n_devices: int):
     return new_top, new_bot
 
 
-def _sharded_jacobi(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
+def _identity_blocks(k: int, n_pad: int, dtype, *, axis_name, local_shape):
+    """Per-shard construction of this device's blocks of V = I.
+
+    Device d owns pair slots [d*k_loc, (d+1)*k_loc); its top blocks are the
+    global column blocks of the same index and its bot blocks are offset by
+    ``k``. Building the identity blocks from iota *inside* shard_map means no
+    device ever materializes the full replicated n_pad x n_pad identity the
+    way a naive `jnp.eye` init would (at 65536^2 f32 that is 16 GB).
+    """
+    k_loc, _, b = local_shape
+    d = lax.axis_index(axis_name)
+    shape = (k_loc, n_pad, b)
+    rows = lax.broadcasted_iota(jnp.int32, shape, 1)
+    cols = lax.broadcasted_iota(jnp.int32, shape, 2)
+    blk = lax.broadcasted_iota(jnp.int32, shape, 0) + d * k_loc
+    vtop = (rows == blk * b + cols).astype(dtype)
+    vbot = (rows == (blk + k) * b + cols).astype(dtype)
+    return vtop, vbot
+
+
+def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                     tol, max_sweeps, precision, gram_dtype_name, method,
-                    criterion, with_v, stall_detection=True):
+                    criterion, with_v, n_pad, nblocks, stall_detection=True):
     """Body run under shard_map: while_loop(sweeps) of scan(rounds)."""
     gram_dtype = jnp.dtype(gram_dtype_name)
+    if with_v:
+        vtop, vbot = _identity_blocks(nblocks // 2, n_pad, top.dtype,
+                                      axis_name=axis_name,
+                                      local_shape=top.shape)
+    else:
+        # Zero-width placeholders keep one traced signature (cf. solver.py).
+        vtop = vbot = lax.pcast(
+            jnp.zeros((top.shape[0], 0, top.shape[2]), top.dtype),
+            (axis_name,), to="varying")
 
     def round_body(carry, _, *, dmax2, mth, crit):
         top, bot, vtop, vbot, max_rel = carry
@@ -236,33 +265,24 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      gram_dtype_name, method, criterion, stall_detection=True):
     m = a.shape[0]
     dtype = a.dtype
-    k = nblocks // 2
     block_spec = P(axis_name, None, None)  # shard the pair-slot axis
 
     top, bot = _single._blockify(a, n_pad, nblocks)
-    if compute_v:
-        veye = jnp.eye(n_pad, dtype=dtype)
-        vtop, vbot = _single._blockify(veye, n_pad, nblocks)
-    else:
-        # Zero-size placeholders keep one traced signature (cf. solver.py).
-        vtop = vbot = jnp.zeros((k, 0, top.shape[2]), dtype)
-
     top = lax.with_sharding_constraint(top, NamedSharding(mesh, block_spec))
     bot = lax.with_sharding_constraint(bot, NamedSharding(mesh, block_spec))
-    vtop = lax.with_sharding_constraint(vtop, NamedSharding(mesh, block_spec))
-    vbot = lax.with_sharding_constraint(vbot, NamedSharding(mesh, block_spec))
 
     jacobi = jax.shard_map(
         partial(_sharded_jacobi, axis_name=axis_name, n_devices=n_devices,
                 n_rounds=sched.num_rounds(nblocks), tol=tol, max_sweeps=max_sweeps,
                 precision=precision, gram_dtype_name=gram_dtype_name,
                 method=method, criterion=criterion, with_v=compute_v,
+                n_pad=n_pad, nblocks=nblocks,
                 stall_detection=stall_detection),
         mesh=mesh,
-        in_specs=(block_spec,) * 4,
+        in_specs=(block_spec,) * 2,
         out_specs=(block_spec,) * 4 + (P(), P()),
     )
-    top, bot, vtop, vbot, off_rel, sweeps = jacobi(top, bot, vtop, vbot)
+    top, bot, vtop, vbot, off_rel, sweeps = jacobi(top, bot)
 
     a_work = _single._deblockify(top, bot)
     v_work = _single._deblockify(vtop, vbot)[:n, :] if compute_v else None
