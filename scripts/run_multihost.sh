@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Multi-host launch recipe — successor of the reference's SLURM scripts
+# (/root/reference/build/buildSVDMPICUDA.slurm, runSVDMPICUDA.slurm,
+# runSVDMPICUDAWithoutCMake.slurm: 2 nodes x 1 GPU, mpiexec --map-by
+# ppr:1:node, OMP_NUM_THREADS=36).
+#
+# On a Cloud TPU pod slice there is no mpiexec: every host runs the SAME
+# command, and jax.distributed.initialize() (called by
+# svd_jacobi_tpu.parallel.launch.initialize, which the CLI invokes under
+# --distributed) auto-discovers the coordinator from the TPU metadata:
+#
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all --command \
+#     "cd svd-jacobi-tpu && python -m svd_jacobi_tpu.cli 16384 --distributed"
+#
+# On SLURM clusters (CPU/GPU backends), one task per node, like the
+# reference's --tasks-per-node=1:
+#
+#   #SBATCH -N 2 --tasks-per-node=1
+#   srun python -m svd_jacobi_tpu.cli 16384 --distributed
+#
+# (jax.distributed.initialize auto-detects SLURM via SLURM_* env vars.)
+#
+# For a local smoke test of the multi-process path without any cluster,
+# emulate N virtual devices on CPU — this is what this script runs:
+
+set -euo pipefail
+N=${1:-1024}
+DEVICES=${2:-8}
+
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${DEVICES}" \
+JAX_PLATFORMS=cpu \
+python -m svd_jacobi_tpu.cli "${N}" --distributed --no-selftest "${@:3}"

@@ -104,7 +104,17 @@ def _self_test(args, config, log) -> dict:
 def main(argv=None) -> int:
     args = _parse_args(sys.argv[1:] if argv is None else argv)
 
+    import os
+
     import jax
+
+    # Some TPU attachment plugins register themselves unconditionally and
+    # ignore JAX_PLATFORMS from the environment; honor it through the config
+    # API so `JAX_PLATFORMS=cpu python -m svd_jacobi_tpu.cli ...` (e.g. the
+    # scripts/run_multihost.sh virtual-device smoke test) works everywhere.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import jax.numpy as jnp
     import svd_jacobi_tpu as sj
     from svd_jacobi_tpu.utils import matgen, validation
@@ -127,7 +137,12 @@ def main(argv=None) -> int:
 
     mesh = None
     if args.distributed:
-        from svd_jacobi_tpu.parallel import sharded
+        from svd_jacobi_tpu.parallel import launch, sharded
+        ctx = launch.initialize()  # multi-host bootstrap; no-op single-host
+        if ctx.process_count > 1:
+            log(f"process {ctx.process_index}/{ctx.process_count}, "
+                f"{ctx.local_device_count} local / "
+                f"{ctx.global_device_count} global devices")
         mesh = sharded.make_mesh()
         log(f"mesh: {mesh}")
 
@@ -146,7 +161,14 @@ def main(argv=None) -> int:
     if not args.no_selftest:
         report["self_test"] = _self_test(args, config, log)
 
-    if args.matrix == "triangular":
+    if mesh is not None:
+        # Generate directly into the mesh sharding: no host materializes the
+        # full matrix (replaces the reference's root-rank generation +
+        # scatter, main.cu:1548-1567).
+        from svd_jacobi_tpu.parallel import launch
+        a = launch.sharded_input(m, n, mesh, seed=args.seed, dtype=dtype,
+                                 kind=args.matrix)
+    elif args.matrix == "triangular":
         a = matgen.random_upper_triangular(n, seed=args.seed, dtype=dtype)
     else:
         a = matgen.random_dense(m, n, seed=args.seed, dtype=dtype)

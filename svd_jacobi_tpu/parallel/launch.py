@@ -1,0 +1,140 @@
+"""Multi-host bootstrap — the TPU-native successor of the reference's MPI
+process management and SLURM launch scripts.
+
+The reference bootstraps with `MPI_Init`/`MPI_Comm_rank`/`MPI_Comm_size`
+(reference: main.cu:1427-1442) and is launched by three SLURM scripts
+(build/buildSVDMPICUDA.slurm, build/runSVDMPICUDA.slurm,
+build/runSVDMPICUDAWithoutCMake.slurm: 2 nodes x 1 GPU, `mpiexec
+--map-by ppr:1:node`). On TPU the same roles are played by
+`jax.distributed.initialize()` (process bootstrap over DCN), a `Mesh` over
+`jax.devices()` (global device topology — ICI within a host, DCN across
+hosts), and host-sharded input generation (each process materializes only
+its addressable shards). See scripts/run_multihost.sh for the launch recipe
+replacing the SLURM files.
+
+Typical multi-host program:
+
+    from svd_jacobi_tpu.parallel import launch, sharded
+    ctx = launch.initialize()              # no-op on a single process
+    mesh = sharded.make_mesh()             # all devices across all hosts
+    a = launch.sharded_input(16384, 16384, mesh)
+    r = sharded.svd(a, mesh=mesh)
+
+On TPU pods the coordinator/process-id/process-count arguments are
+auto-detected from the TPU metadata; on CPU/GPU clusters (or for tests)
+pass them explicitly or via the standard JAX_* environment variables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+from ..utils import matgen
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedContext:
+    """What the reference read back from MPI_Comm_rank/size (main.cu:1441-1442)."""
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        """True on the process that owns coordination duties (reference:
+        ROOT_RANK, lib/global.cuh:11 — but unlike the reference's root, no
+        data funnels through it; it only prints/writes reports)."""
+        return self.process_index == 0
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> DistributedContext:
+    """Bootstrap multi-host JAX; safe to call on a single process.
+
+    Replaces `MPI_Init` (main.cu:1427). Auto-detects cluster parameters on
+    TPU pods / SLURM / Cloud TPU environments via JAX's cluster detection;
+    explicit arguments (or JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID env vars) override. When no cluster environment is
+    present and no arguments are given, this is a no-op single-process
+    context — the same code path then runs single-host, like the reference
+    run with `mpiexec -np 1`.
+    """
+    explicit = (coordinator_address is not None
+                or num_processes is not None
+                or bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
+                or bool(os.environ.get("JAX_NUM_PROCESSES")))
+    if explicit or _cluster_env_present():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
+        except RuntimeError as e:
+            # Benign double-init (library + app both bootstrapping), or a
+            # backend already started before an *auto-detected* (not
+            # explicitly requested) cluster env — e.g. a single-worker dev
+            # attachment that still advertises TPU metadata. Explicit
+            # requests always surface the error.
+            benign = ("already initialized" in str(e)
+                      or (not explicit
+                          and "must be called before" in str(e)))
+            if not benign:
+                raise
+    return DistributedContext(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def _cluster_env_present() -> bool:
+    """True when a known MULTI-process cluster environment advertises itself
+    (TPU pod metadata with >1 worker, SLURM with >1 node, Open MPI with >1
+    rank). Single-worker values — e.g. a dev attachment exporting
+    TPU_WORKER_HOSTNAMES=localhost — do not count."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return bool(
+        "," in hostnames
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        or (os.environ.get("SLURM_JOB_NUM_NODES")
+            and int(os.environ["SLURM_JOB_NUM_NODES"]) > 1)
+        or int(os.environ.get("OMPI_COMM_WORLD_SIZE", "1")) > 1
+    )
+
+
+def sharded_input(m: int, n: int, mesh, *, seed: int = matgen.DEFAULT_SEED,
+                  dtype=None, kind: str = "dense"):
+    """Generate the benchmark input directly into the solver's sharding.
+
+    Host-sharded replacement for the reference's root-rank generation +
+    scatter (main.cu:1548-1567): each process materializes only its
+    addressable column blocks, so no host ever holds the full matrix.
+    ``kind``: "dense" (uniform) or "triangular" (the reference's benchmark
+    input, upper-triangular — only valid square).
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if dtype is None:
+        dtype = jnp.float32
+    (axis_name,) = mesh.axis_names
+    sharding = NamedSharding(mesh, P(None, axis_name))  # column-block
+    if kind == "triangular":
+        if m != n:
+            raise ValueError("triangular input requires m == n")
+        return matgen.sharded_random(m, n, sharding, seed=seed, dtype=dtype,
+                                     triangular=True)
+    return matgen.sharded_random(m, n, sharding, seed=seed, dtype=dtype)

@@ -53,7 +53,7 @@ def with_known_spectrum(m: int, n: int, singular_values, *,
 
 
 def sharded_random(m: int, n: int, sharding, *, seed: int = DEFAULT_SEED,
-                   dtype=jnp.float32) -> jax.Array:
+                   dtype=jnp.float32, triangular: bool = False) -> jax.Array:
     """Generate a matrix directly into ``sharding`` (host-sharded on
     multi-host: each process only materializes its addressable shards).
 
@@ -63,6 +63,10 @@ def sharded_random(m: int, n: int, sharding, *, seed: int = DEFAULT_SEED,
     tile origin. Deterministic for a fixed (seed, sharding layout); note the
     values DO depend on the shard decomposition — use `random_dense` when
     bit-identical inputs across different mesh shapes are required.
+
+    ``triangular=True`` zeroes the strictly-lower part per tile, producing
+    the reference's upper-triangular benchmark input (main.cu:1558-1567)
+    without any host materializing the full matrix.
     """
     shape = (m, n)
 
@@ -72,6 +76,11 @@ def sharded_random(m: int, n: int, sharding, *, seed: int = DEFAULT_SEED,
         h = (index[0].stop or m) - row
         w = (index[1].stop or n) - col
         key = jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(seed), row), col)
-        return jax.random.uniform(key, (h, w), dtype=dtype)
+        t = jax.random.uniform(key, (h, w), dtype=dtype)
+        if triangular:
+            rows = row + jnp.arange(h)[:, None]
+            cols = col + jnp.arange(w)[None, :]
+            t = jnp.where(rows <= cols, t, jnp.zeros_like(t))
+        return t
 
     return jax.make_array_from_callback(shape, sharding, tile)
