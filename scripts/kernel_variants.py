@@ -1,0 +1,194 @@
+"""Scratch Pallas kernel variants for the round-3 perf push.
+
+Variant A: batched-k congruence tournament (current kernel's math, but all k
+panels processed inside ONE kernel body so per-step vector-op overhead is
+amortized over the whole batch).
+
+Variant B: one-sided tournament on the Cholesky factors R of the Gram panels
+(R^T R = G): rotations act on R's columns only (no row transform), alpha is a
+true dot product, beta/gamma are carried in closed form — roughly half the
+per-step passes of the congruence form.
+
+The winner is folded into svd_jacobi_tpu/ops/pallas_jacobi.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _shift_cols(top, bot):
+    """Circle-method tournament shift on the last axis (slot 0 fixed)."""
+    if top.shape[-1] == 1:
+        return top, bot
+    new_top = jnp.concatenate([top[..., :1], bot[..., :1], top[..., 1:-1]], axis=-1)
+    new_bot = jnp.concatenate([bot[..., 1:], top[..., -1:]], axis=-1)
+    return new_top, new_bot
+
+
+def _shift_rows(top, bot):
+    if top.shape[-2] == 1:
+        return top, bot
+    new_top = jnp.concatenate([top[..., :1, :], bot[..., :1, :], top[..., 1:-1, :]], axis=-2)
+    new_bot = jnp.concatenate([bot[..., 1:, :], top[..., -1:, :]], axis=-2)
+    return new_top, new_bot
+
+
+def _rutishauser(alpha, beta, gamma):
+    f32 = jnp.float32
+    tiny = jnp.finfo(f32).tiny
+    safe_a = jnp.where(jnp.abs(alpha) > tiny, alpha, jnp.ones_like(alpha))
+    tau = (gamma - beta) / (2.0 * safe_a)
+    sgn = jnp.where(tau >= 0, f32(1.0), f32(-1.0))
+    t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+    c = jax.lax.rsqrt(1.0 + t * t)
+    s = t * c
+    rot = jnp.abs(alpha) > tiny
+    c = jnp.where(rot, c, f32(1.0))
+    s = jnp.where(rot, s, f32(0.0))
+    return c, s
+
+
+# --------------------------------------------------------------------------
+# Variant A: batched congruence
+
+
+def _body_a(g, dmax2, *, n_steps):
+    k, n2, _ = g.shape
+    b2 = n2 // 2
+    f32 = jnp.float32
+    eps = jnp.finfo(f32).eps
+    tiny = jnp.finfo(f32).tiny
+    null_thresh = dmax2 * (n2 * eps) ** 2
+
+    q0 = jnp.broadcast_to(jnp.eye(n2, dtype=f32), (k, n2, n2))
+    diag_mask = (jax.lax.broadcasted_iota(jnp.int32, (b2, b2), 0)
+                 == jax.lax.broadcasted_iota(jnp.int32, (b2, b2), 1)).astype(f32)[None]
+
+    def step(_, carry):
+        g, q, max_rel = carry
+        alpha = jnp.sum(g[:, :b2, b2:] * diag_mask, axis=1)[:, None, :]  # (k,1,b2)
+        beta = jnp.sum(g[:, :b2, :b2] * diag_mask, axis=1)[:, None, :]
+        gamma = jnp.sum(g[:, b2:, b2:] * diag_mask, axis=1)[:, None, :]
+        denom = jnp.sqrt(jnp.maximum(beta, tiny)) * jnp.sqrt(jnp.maximum(gamma, tiny))
+        rel = jnp.abs(alpha) / jnp.maximum(denom, tiny)
+        live = (beta > null_thresh) & (gamma > null_thresh)
+        max_rel = jnp.maximum(max_rel, jnp.max(jnp.where(live, rel, 0.0)))
+        c, s = _rutishauser(alpha, beta, gamma)
+        g = jnp.concatenate(
+            [c * g[..., :b2] - s * g[..., b2:], s * g[..., :b2] + c * g[..., b2:]],
+            axis=-1)
+        cT, sT = c.transpose(0, 2, 1), s.transpose(0, 2, 1)
+        g = jnp.concatenate(
+            [cT * g[:, :b2] - sT * g[:, b2:], sT * g[:, :b2] + cT * g[:, b2:]],
+            axis=-2)
+        q = jnp.concatenate(
+            [c * q[..., :b2] - s * q[..., b2:], s * q[..., :b2] + c * q[..., b2:]],
+            axis=-1)
+        gt, gb = _shift_cols(g[..., :b2], g[..., b2:])
+        g = jnp.concatenate([gt, gb], axis=-1)
+        gt, gb = _shift_rows(g[:, :b2], g[:, b2:])
+        g = jnp.concatenate([gt, gb], axis=-2)
+        qt, qb = _shift_cols(q[..., :b2], q[..., b2:])
+        q = jnp.concatenate([qt, qb], axis=-1)
+        return g, q, max_rel
+
+    _, q, max_rel = jax.lax.fori_loop(0, n_steps, step, (g, q0, jnp.zeros((), f32)))
+    return q, max_rel
+
+
+def _kernel_a(g_ref, dmax2_ref, q_ref, stat_ref, *, n_steps):
+    q, max_rel = _body_a(g_ref[...], dmax2_ref[0], n_steps=n_steps)
+    q_ref[...] = q
+    stat_ref[0] = max_rel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rotations_a(g, dmax2, *, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, n2, _ = g.shape
+    kernel = functools.partial(_kernel_a, n_steps=max(n2 - 1, 1))
+    q, stat = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((k, n2, n2), jnp.float32),
+                   jax.ShapeDtypeStruct((1,), jnp.float32)],
+        interpret=interpret,
+    )(g.astype(jnp.float32), jnp.reshape(dmax2.astype(jnp.float32), (1,)))
+    return q, stat[0]
+
+
+# --------------------------------------------------------------------------
+# Variant B: one-sided on Cholesky factors
+
+
+def _body_b(r, dmax2, *, n_steps):
+    k, n2, _ = r.shape
+    b2 = n2 // 2
+    f32 = jnp.float32
+    eps = jnp.finfo(f32).eps
+    tiny = jnp.finfo(f32).tiny
+    null_thresh = dmax2 * (n2 * eps) ** 2
+
+    q0 = jnp.broadcast_to(jnp.eye(n2, dtype=f32), (k, n2, n2))
+    rt, rb = r[..., :b2], r[..., b2:]
+    qt, qb = q0[..., :b2], q0[..., b2:]
+    beta = jnp.sum(rt * rt, axis=-2)[:, None, :]    # (k,1,b2)
+    gamma = jnp.sum(rb * rb, axis=-2)[:, None, :]
+
+    def step(_, carry):
+        rt, rb, qt, qb, beta, gamma, max_rel = carry
+        alpha = jnp.sum(rt * rb, axis=-2)[:, None, :]
+        denom = jnp.sqrt(jnp.maximum(beta, tiny)) * jnp.sqrt(jnp.maximum(gamma, tiny))
+        rel = jnp.abs(alpha) / jnp.maximum(denom, tiny)
+        live = (beta > null_thresh) & (gamma > null_thresh)
+        max_rel = jnp.maximum(max_rel, jnp.max(jnp.where(live, rel, 0.0)))
+        c, s = _rutishauser(alpha, beta, gamma)
+        rt, rb = c * rt - s * rb, s * rt + c * rb
+        qt, qb = c * qt - s * qb, s * qt + c * qb
+        # Closed-form norm updates (alpha is the pre-rotation coupling).
+        cc, ss, cs2 = c * c, s * s, 2.0 * c * s
+        beta, gamma = (cc * beta - cs2 * alpha + ss * gamma,
+                       ss * beta + cs2 * alpha + cc * gamma)
+        rt, rb = _shift_cols(rt, rb)
+        qt, qb = _shift_cols(qt, qb)
+        beta, gamma = _shift_cols(beta, gamma)
+        return rt, rb, qt, qb, beta, gamma, max_rel
+
+    rt, rb, qt, qb, beta, gamma, max_rel = jax.lax.fori_loop(
+        0, n_steps, step, (rt, rb, qt, qb, beta, gamma, jnp.zeros((), f32)))
+    return jnp.concatenate([qt, qb], axis=-1), max_rel
+
+
+def _kernel_b(r_ref, dmax2_ref, q_ref, stat_ref, *, n_steps):
+    q, max_rel = _body_b(r_ref[...], dmax2_ref[0], n_steps=n_steps)
+    q_ref[...] = q
+    stat_ref[0] = max_rel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rotations_b(r, dmax2, *, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, n2, _ = r.shape
+    kernel = functools.partial(_kernel_b, n_steps=max(n2 - 1, 1))
+    q, stat = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((k, n2, n2), jnp.float32),
+                   jax.ShapeDtypeStruct((1,), jnp.float32)],
+        interpret=interpret,
+    )(r.astype(jnp.float32), jnp.reshape(dmax2.astype(jnp.float32), (1,)))
+    return q, stat[0]

@@ -1,0 +1,68 @@
+"""On-chip measurements driving the round-3 perf work (RTT-amortized).
+
+The axon tunnel costs ~140 ms per dispatch+readback, so per-op device time
+is measured as (t_K - t_1)/(K - 1) with K queued dispatches and one scalar
+readback (methodology of profile_parts2.py). Results + conclusions are
+recorded in PROFILE.md.
+
+Usage: python scripts/profile_r3.py [N] [K]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+
+def _scalarize(f):
+    def g(*args):
+        out = f(*args)
+        leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+    return g
+
+
+def t(name, f, *args, reps=K):
+    g = jax.jit(_scalarize(f))
+    float(np.asarray(g(*args)))  # compile + warm
+
+    def run(j):
+        t0 = time.perf_counter()
+        for _ in range(j - 1):
+            g(*args)
+        float(np.asarray(g(*args)))
+        return time.perf_counter() - t0
+
+    t1 = min(run(1) for _ in range(2))
+    tK = min(run(reps) for _ in range(2))
+    per = (tK - t1) / (reps - 1)
+    print(f"{name:56s} {per*1e3:10.3f} ms/call", flush=True)
+    return per
+
+
+key = jax.random.PRNGKey(0)
+print(f"== N={N} f32 on {jax.devices()[0]}, K={K} ==", flush=True)
+
+from svd_jacobi_tpu.ops import pallas_jacobi
+
+for b in (64, 128, 256):
+    n2 = 2 * b
+    k = max(1, N // n2)
+    x = jax.random.normal(key, (k, N, n2), jnp.float32)
+    g0 = jnp.einsum("kmi,kmj->kij", x, x, precision="highest")
+    dmax2 = jnp.max(jnp.diagonal(g0, axis1=-2, axis2=-1))
+    t(f"pallas rotations b={b} (k={k},{n2},{n2})",
+      lambda gg, dd: pallas_jacobi.rotations(gg, dd), g0, dmax2)
+
+HI = jax.lax.Precision.HIGHEST
+a = jax.random.normal(key, (N, N), jnp.float32)
+t("full matmul highest", lambda x: jnp.matmul(x, x, precision=HI), a)
+t("full matmul default", lambda x: jnp.matmul(x, x), a)
+t("jnp.linalg.svd", lambda x: jnp.linalg.svd(x), a, reps=3)
+t("qr reduced", lambda x: jnp.linalg.qr(x), a, reps=3)
+t("cholesky(A^TA+I)", lambda x: jnp.linalg.cholesky(
+    jnp.matmul(x.T, x, precision=HI) / N + 2 * jnp.eye(N)), a, reps=3)

@@ -1,0 +1,77 @@
+"""Part 2 of the round-3 on-chip measurements (see profile_r3.py)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+
+def _scalarize(f):
+    def g(*args):
+        out = f(*args)
+        leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+    return g
+
+
+def t(name, f, *args, reps=K):
+    g = jax.jit(_scalarize(f))
+    float(np.asarray(g(*args)))  # compile + warm
+
+    def run(j):
+        t0 = time.perf_counter()
+        for _ in range(j - 1):
+            g(*args)
+        float(np.asarray(g(*args)))
+        return time.perf_counter() - t0
+
+    t1 = min(run(1) for _ in range(2))
+    tK = min(run(reps) for _ in range(2))
+    per = (tK - t1) / (reps - 1)
+    print(f"{name:56s} {per*1e3:10.3f} ms/call", flush=True)
+    return per
+
+
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (N, N), jnp.float32)
+HI = jax.lax.Precision.HIGHEST
+print(f"== N={N} f32 on {jax.devices()[0]}, K={K} ==", flush=True)
+
+t("jnp.linalg.svd", lambda x: jnp.linalg.svd(x), a, reps=3)
+t("qr reduced", lambda x: jnp.linalg.qr(x), a, reps=3)
+t("qr r-only", lambda x: jnp.linalg.qr(x, mode="r"), a, reps=3)
+t("cholesky(A^TA/N+2I)", lambda x: jnp.linalg.cholesky(
+    jnp.matmul(x.T, x, precision=HI) / N + 2 * jnp.eye(N)), a, reps=3)
+
+from svd_jacobi_tpu.ops import blockwise
+from svd_jacobi_tpu import solver
+
+for b in (64, 128):
+    n2 = 2 * b
+    k = max(1, N // n2 // 2)
+    g0 = jax.random.normal(key, (2 * k, n2, n2), jnp.float32)
+    g0 = jnp.einsum("kij,kil->kjl", g0, g0, precision=HI) + 2 * jnp.eye(n2)
+    t(f"batched cholesky (2k={2*k},{n2},{n2})", jnp.linalg.cholesky, g0)
+    t(f"batched eigh     (2k={2*k},{n2},{n2})", jnp.linalg.eigh, g0, reps=3)
+    top = jax.random.normal(key, (k, N, b), jnp.float32)
+    bot = jax.random.normal(key, (k, N, b), jnp.float32)
+    t(f"batched qr-r     (k={k},{N},{n2})",
+      lambda tp, bt: jnp.linalg.qr(jnp.concatenate([tp, bt], -1), mode="r"),
+      top, bot, reps=3)
+    vt = jax.random.normal(key, (k, N, b), jnp.float32)
+    vb = jax.random.normal(key, (k, N, b), jnp.float32)
+    for method, crit in [("gram-eigh", "abs"), ("qr-svd", "rel")]:
+        t(f"one ROUND {method} b={b} +V",
+          lambda tp, bt, v1, v2, me=method, cr=crit: blockwise.orthogonalize_pairs(
+              tp, bt, v1, v2, precision="highest", gram_dtype=jnp.float32,
+              method=me, criterion=cr, dmax2=jnp.float32(N))[:4],
+          top, bot, vt, vb, reps=4)
+    t(f"one SWEEP gram-eigh b={b} +V",
+      lambda tp, bt, v1, v2: solver._sweep(
+          tp, bt, v1, v2, precision="highest", gram_dtype=jnp.float32,
+          method="gram-eigh", criterion="abs", dmax2=jnp.float32(N))[:4],
+      top, bot, vt, vb, reps=3)

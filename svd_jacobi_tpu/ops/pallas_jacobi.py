@@ -143,9 +143,13 @@ def _kernel_body(g, dmax2, *, n_steps: int):
 
 
 def _pallas_kernel(g_ref, dmax2_ref, q_ref, stat_ref, *, n_steps):
+    from jax.experimental import pallas as pl
+
     q, max_rel = _kernel_body(g_ref[0], dmax2_ref[0], n_steps=n_steps)
     q_ref[0] = q.astype(q_ref.dtype)
-    stat_ref[0] = max_rel
+    # Whole-array SMEM output: TPU grid steps run sequentially, each writes
+    # its own slot (rank-1 SMEM cannot be blocked per grid step).
+    stat_ref[pl.program_id(0)] = max_rel
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -167,7 +171,7 @@ def _rotations_call(g, dmax2, *, interpret: bool):
         out_specs=[
             pl.BlockSpec((1, n2, n2), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((k, n2, n2), jnp.float32),
