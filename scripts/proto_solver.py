@@ -1,0 +1,127 @@
+"""Prototype round-3 solver: cross+self Pallas kernels + optional QR precond.
+
+Sweep structure (all pairs exactly once per sweep):
+  1. self round: every width-b block self-orthogonalized by the full
+     tournament kernel (within-block pairs);
+  2. 2k-1 cross rounds: each [I | J] panel's b*b cross pairs annihilated by
+     the cross kernel (b cyclic steps), then the outer tournament rotates
+     block pairings.
+
+Convergence stat: dgesvj scaled coupling from each round's *fresh* Gram
+panel (covers within-block couplings too), plus the self-round kernel stat.
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+
+sys.path.insert(0, "scripts")
+
+import jax
+import jax.numpy as jnp
+
+import kernel_variants as kv
+from svd_jacobi_tpu.ops import blockwise, pallas_jacobi
+from svd_jacobi_tpu.parallel import schedule as sched
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def _einsum(a, b, spec):
+    return jnp.einsum(spec, a, b, precision=HI, preferred_element_type=jnp.float32)
+
+
+def _self_round(blocks, vblocks, dmax2, interpret):
+    g = _einsum(blocks, blocks, "kmi,kmj->kij")
+    q, rel = pallas_jacobi.rotations(g, dmax2, interpret=interpret)
+    blocks = _einsum(blocks, q, "kmi,kij->kmj")
+    if vblocks is not None:
+        vblocks = _einsum(vblocks, q, "kmi,kij->kmj")
+    return blocks, vblocks, rel
+
+
+def _cross_round(top, bot, vtop, vbot, dmax2, interpret):
+    b = top.shape[-1]
+    x = jnp.concatenate([top, bot], axis=-1)
+    g = _einsum(x, x, "kmi,kmj->kij")
+    stat, _ = blockwise.off_diag_stats(g, b, dmax2, "rel")
+    q, _ = kv.rotations_cross(g, dmax2, interpret=interpret)
+    xn = _einsum(x, q, "kmi,kij->kmj")
+    top, bot = xn[..., :b], xn[..., b:]
+    if vtop is not None:
+        v = jnp.concatenate([vtop, vbot], axis=-1)
+        vn = _einsum(v, q, "kmi,kij->kmj")
+        vtop, vbot = vn[..., :b], vn[..., b:]
+    return top, bot, vtop, vbot, stat
+
+
+def _sweep(top, bot, vtop, vbot, dmax2, interpret):
+    k, m, b = top.shape
+    with_v = vtop is not None
+    blocks = jnp.concatenate([top, bot], axis=0)
+    vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
+    blocks, vblocks, rel_self = _self_round(blocks, vblocks, dmax2, interpret)
+    top, bot = blocks[:k], blocks[k:]
+    if with_v:
+        vtop, vbot = vblocks[:k], vblocks[k:]
+
+    def body(carry, _):
+        top, bot, vtop, vbot, mx = carry
+        top, bot, vtop, vbot, stat = _cross_round(
+            top, bot, vtop, vbot, dmax2, interpret)
+        top, bot = sched.rotate_blocks(top, bot)
+        if with_v:
+            vtop, vbot = sched.rotate_blocks(vtop, vbot)
+        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
+
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
+    init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
+    (top, bot, vtop, vbot, off), _ = jax.lax.scan(
+        body, init, None, length=sched.num_rounds(2 * k))
+    return top, bot, (vtop if with_v else None), (vbot if with_v else None), off
+
+
+@partial(jax.jit, static_argnames=("nblocks", "tol", "max_sweeps", "compute_v",
+                                   "interpret", "precondition"))
+def proto_svd(a, *, nblocks, tol, max_sweeps, compute_v=True, interpret=False,
+              precondition=False):
+    from svd_jacobi_tpu import solver as slv
+
+    m, n = a.shape
+    q_pre = None
+    if precondition:
+        q_pre, a = jnp.linalg.qr(a)
+        m = n
+    top, bot = slv._blockify(a, n, nblocks)
+    if compute_v:
+        vtop, vbot = slv._blockify(jnp.eye(n, dtype=a.dtype), n, nblocks)
+    else:
+        vtop = vbot = None
+
+    def cond(state):
+        _, _, _, _, off, sweeps = state
+        return jnp.logical_and(sweeps < max_sweeps, off > tol)
+
+    def body(state):
+        top, bot, vtop, vbot, _, sweeps = state
+        dmax2 = slv._global_dmax2(top, bot)
+        top, bot, nvt, nvb, off = _sweep(top, bot,
+                                         vtop if compute_v else None,
+                                         vbot if compute_v else None,
+                                         dmax2, interpret)
+        if compute_v:
+            vtop, vbot = nvt, nvb
+        return (top, bot, vtop, vbot, off, sweeps + 1)
+
+    inf = jnp.float32(jnp.inf)
+    state = (top, bot, vtop, vbot, inf, jnp.int32(0))
+    top, bot, vtop, vbot, off, sweeps = jax.lax.while_loop(cond, body, state)
+    a_work = slv._deblockify(top, bot)
+    v_work = slv._deblockify(vtop, vbot)[:n, :] if compute_v else None
+    u, s, v = slv._postprocess(a_work, v_work, n, compute_u=True,
+                               full_u=False, dtype=a.dtype)
+    if q_pre is not None and u is not None:
+        u = jnp.matmul(q_pre, u, precision=HI)
+    return u, s, v, sweeps, off
