@@ -1,0 +1,122 @@
+"""Round-3 part 3: per-round einsum cost vs precision/dtype + kernel cost.
+
+Usage: python scripts/profile_r3c.py [N] [K]
+"""
+import sys
+import time
+
+sys.path.insert(0, "scripts")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+
+
+def _scalarize(f):
+    def g(*args):
+        out = f(*args)
+        leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+    return g
+
+
+def t(name, f, *args, reps=K, flops=None):
+    g = jax.jit(_scalarize(f))
+    float(np.asarray(g(*args)))
+
+    def run(j):
+        t0 = time.perf_counter()
+        for _ in range(j - 1):
+            g(*args)
+        float(np.asarray(g(*args)))
+        return time.perf_counter() - t0
+
+    t1 = min(run(1) for _ in range(3))
+    tK = min(run(reps) for _ in range(3))
+    per = (tK - t1) / (reps - 1)
+    extra = f"  {flops/per/1e12:8.2f} TF/s" if flops else ""
+    print(f"{name:52s} {per*1e3:9.3f} ms/call{extra}", flush=True)
+    return per
+
+
+key = jax.random.PRNGKey(0)
+print(f"== N={N} on {jax.devices()[0]}, K={K} ==", flush=True)
+
+b = 128
+n2 = 2 * b
+k = N // n2
+x = jax.random.normal(key, (k, N, n2), jnp.float32)
+q = jax.random.normal(key, (k, n2, n2), jnp.float32) * 0.1
+gf_gram = 2 * k * N * n2 * n2
+
+for prec in ("default", "high", "highest"):
+    p = dict(default=jax.lax.Precision.DEFAULT, high=jax.lax.Precision.HIGH,
+             highest=jax.lax.Precision.HIGHEST)[prec]
+    t(f"gram einsum f32 {prec} (k={k},{N},{n2})",
+      lambda xx, pp=p: jnp.einsum("kmi,kmj->kij", xx, xx, precision=pp,
+                                  preferred_element_type=jnp.float32),
+      x, flops=gf_gram)
+    t(f"apply einsum f32 {prec}",
+      lambda xx, qq, pp=p: jnp.einsum("kmi,kij->kmj", xx, qq, precision=pp,
+                                      preferred_element_type=jnp.float32),
+      x, q, flops=gf_gram)
+
+xb = x.astype(jnp.bfloat16)
+qb = q.astype(jnp.bfloat16)
+t("gram einsum bf16->f32", lambda xx: jnp.einsum(
+    "kmi,kmj->kij", xx, xx, preferred_element_type=jnp.float32), xb, flops=gf_gram)
+t("apply einsum bf16->f32", lambda xx, qq: jnp.einsum(
+    "kmi,kij->kmj", xx, qq, preferred_element_type=jnp.float32), xb, qb, flops=gf_gram)
+t("cast f32->bf16 (k,m,n2)", lambda xx: xx.astype(jnp.bfloat16), x)
+
+# Kernel costs at the shapes the solver uses.
+import kernel_variants as kv
+from svd_jacobi_tpu.ops import pallas_jacobi
+
+g0 = jnp.einsum("kmi,kmj->kij", x, x, precision="highest")
+dmax2 = jnp.max(jnp.diagonal(g0, axis1=-2, axis2=-1))
+t(f"cross kernel ({k},{n2},{n2}) {n2//2} steps",
+  lambda gg, dd: kv.rotations_cross(gg, dd), g0, dmax2)
+t(f"full tournament kernel ({k},{n2},{n2}) {n2-1} steps",
+  lambda gg, dd: pallas_jacobi.rotations(gg, dd), g0, dmax2)
+blocks = jax.random.normal(key, (2 * k, N, b), jnp.float32)
+gs = jnp.einsum("kmi,kmj->kij", blocks, blocks, precision="highest")
+t(f"self kernel ({2*k},{b},{b}) {b-1} steps",
+  lambda gg, dd: pallas_jacobi.rotations(gg, dd), gs, dmax2)
+
+# Fused round at two precisions (gram + kernel + apply X,V in one jit).
+v = jax.random.normal(key, (k, N, n2), jnp.float32)
+
+
+def round_f32(xx, vv, prec):
+    g = jnp.einsum("kmi,kmj->kij", xx, xx, precision=prec,
+                   preferred_element_type=jnp.float32)
+    d = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
+    qq, _ = kv.rotations_cross(g, d)
+    xn = jnp.einsum("kmi,kij->kmj", xx, qq, precision=prec,
+                    preferred_element_type=jnp.float32)
+    vn = jnp.einsum("kmi,kij->kmj", vv, qq, precision=prec,
+                    preferred_element_type=jnp.float32)
+    return xn, vn
+
+
+def round_bf16(xx, vv):
+    g = jnp.einsum("kmi,kmj->kij", xx.astype(jnp.bfloat16), xx.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    d = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
+    qq, _ = kv.rotations_cross(g, d)
+    qq16 = qq.astype(jnp.bfloat16)
+    xn = jnp.einsum("kmi,kij->kmj", xx.astype(jnp.bfloat16), qq16,
+                    preferred_element_type=jnp.float32)
+    vn = jnp.einsum("kmi,kij->kmj", vv.astype(jnp.bfloat16), qq16,
+                    preferred_element_type=jnp.float32)
+    return xn, vn
+
+
+t("ROUND f32 highest (gram+kernel+applyXV)",
+  lambda xx, vv: round_f32(xx, vv, jax.lax.Precision.HIGHEST), x, v)
+t("ROUND f32 default", lambda xx, vv: round_f32(xx, vv, jax.lax.Precision.DEFAULT), x, v)
+t("ROUND bf16-in f32-acc", round_bf16, x, v)

@@ -1,0 +1,156 @@
+"""Round-3 part 4: INTRA-JIT component costs via fori_loop(reps) in one jit.
+
+Each measurement jits a loop of `reps` iterations of one component and
+divides wall time by reps — per-dispatch tunnel overhead (~2-3 ms/call,
+see profile_r3c.py) amortizes to noise.
+
+Usage: python scripts/profile_r3d.py [N] [b] [reps]
+"""
+import functools
+import sys
+import time
+
+sys.path.insert(0, "scripts")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+R = int(sys.argv[3]) if len(sys.argv) > 3 else 50
+
+key = jax.random.PRNGKey(0)
+HI = jax.lax.Precision.HIGHEST
+DEF = jax.lax.Precision.DEFAULT
+
+
+def t(name, body, init, flops_per=None):
+    """body: carry -> carry; differential timing (4R vs R loops in one jit)
+    cancels the per-call dispatch+readback RTT of the tunnel."""
+    @functools.partial(jax.jit, static_argnames=("reps",))
+    def loop(c, reps):
+        c = jax.lax.fori_loop(0, reps, lambda i, cc: body(cc), c)
+        leaves = jax.tree_util.tree_leaves(c)
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+
+    def run(reps):
+        float(np.asarray(loop(init, reps)))  # compile+warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(np.asarray(loop(init, reps)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    per = (run(4 * R) - run(R)) / (3 * R)
+    extra = f"  {flops_per/per/1e12:8.2f} TF/s" if flops_per else ""
+    print(f"{name:56s} {per*1e3:9.3f} ms/iter{extra}", flush=True)
+    return per
+
+
+n2 = 2 * B
+k = max(1, N // n2)
+x = jax.random.normal(key, (k, N, n2), jnp.float32)
+v = jax.random.normal(key, (k, N, n2), jnp.float32)
+g0 = jnp.einsum("kmi,kmj->kij", x, x, precision=HI)
+dmax2 = jnp.max(jnp.diagonal(g0, axis1=-2, axis2=-1))
+gf = 2 * k * N * n2 * n2
+
+print(f"== N={N} b={B} reps={R} on {jax.devices()[0]} ==", flush=True)
+
+import kernel_variants as kv
+from svd_jacobi_tpu.ops import pallas_jacobi
+
+def _carry_x(xx):
+    g = jnp.einsum("kmi,kmj->kij", xx, xx, precision=HI,
+                   preferred_element_type=jnp.float32)
+    return xx + g[:, :1, :] * 1e-9
+
+
+t("gram f32 highest (carried)", _carry_x, x, flops_per=gf)
+
+
+def _carry_x_def(xx):
+    g = jnp.einsum("kmi,kmj->kij", xx, xx, precision=DEF,
+                   preferred_element_type=jnp.float32)
+    return xx + g[:, :1, :] * 1e-9
+
+
+t("gram f32 default (carried)", _carry_x_def, x, flops_per=gf)
+
+
+def _carry_x_bf(xx):
+    xb = xx.astype(jnp.bfloat16)
+    g = jnp.einsum("kmi,kmj->kij", xb, xb, preferred_element_type=jnp.float32)
+    return xx + g[:, :1, :] * 1e-9
+
+
+t("gram bf16->f32 (carried)", _carry_x_bf, x, flops_per=gf)
+
+
+def _apply(xx, prec):
+    q = g0 * 1e-4
+    return jnp.einsum("kmi,kij->kmj", xx, q, precision=prec,
+                      preferred_element_type=jnp.float32) * 0.99
+
+
+t("apply f32 highest (carried)", lambda xx: _apply(xx, HI), x, flops_per=gf)
+t("apply f32 default (carried)", lambda xx: _apply(xx, DEF), x, flops_per=gf)
+
+
+def _apply_bf(xx):
+    q = (g0 * 1e-4).astype(jnp.bfloat16)
+    return jnp.einsum("kmi,kij->kmj", xx.astype(jnp.bfloat16), q,
+                      preferred_element_type=jnp.float32) * 0.99
+
+
+t("apply bf16->f32 (carried)", _apply_bf, x, flops_per=gf)
+
+
+def _kernel_cross(gg):
+    q, _ = kv.rotations_cross(gg, dmax2)
+    return gg + q * 1e-9
+
+
+t(f"cross kernel {n2//2} steps (carried)", _kernel_cross, g0)
+
+
+def _kernel_full(gg):
+    q, _ = pallas_jacobi.rotations(gg, dmax2)
+    return gg + q * 1e-9
+
+
+t(f"full kernel {n2-1} steps (carried)", _kernel_full, g0)
+
+
+def _round(state, prec, bf16):
+    xx, vv = state
+    if bf16:
+        xb = xx.astype(jnp.bfloat16)
+        g = jnp.einsum("kmi,kmj->kij", xb, xb, preferred_element_type=jnp.float32)
+    else:
+        g = jnp.einsum("kmi,kmj->kij", xx, xx, precision=prec,
+                       preferred_element_type=jnp.float32)
+    d = jnp.max(jnp.diagonal(g, axis1=-2, axis2=-1))
+    q, _ = kv.rotations_cross(g, d)
+    if bf16:
+        qb = q.astype(jnp.bfloat16)
+        xn = jnp.einsum("kmi,kij->kmj", xx.astype(jnp.bfloat16), qb,
+                        preferred_element_type=jnp.float32)
+        vn = jnp.einsum("kmi,kij->kmj", vv.astype(jnp.bfloat16), qb,
+                        preferred_element_type=jnp.float32)
+    else:
+        xn = jnp.einsum("kmi,kij->kmj", xx, q, precision=prec,
+                        preferred_element_type=jnp.float32)
+        vn = jnp.einsum("kmi,kij->kmj", vv, q, precision=prec,
+                        preferred_element_type=jnp.float32)
+    return xn, vn
+
+
+t("ROUND f32 highest (carried)", lambda s: _round(s, HI, False), (x, v),
+  flops_per=3 * gf)
+t("ROUND f32 default (carried)", lambda s: _round(s, DEF, False), (x, v),
+  flops_per=3 * gf)
+t("ROUND bf16 (carried)", lambda s: _round(s, None, True), (x, v),
+  flops_per=3 * gf)

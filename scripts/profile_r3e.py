@@ -1,0 +1,83 @@
+"""Round-3 part 5: gridded-per-panel vs batched-in-body kernel cost.
+
+Differential intra-jit timing (see profile_r3d.py).
+Usage: python scripts/profile_r3e.py [N]
+"""
+import functools
+import sys
+import time
+
+sys.path.insert(0, "scripts")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+R = 30
+
+key = jax.random.PRNGKey(0)
+HI = jax.lax.Precision.HIGHEST
+
+
+def t(name, body, init):
+    @functools.partial(jax.jit, static_argnames=("reps",))
+    def loop(c, reps):
+        c = jax.lax.fori_loop(0, reps, lambda i, cc: body(cc), c)
+        leaves = jax.tree_util.tree_leaves(c)
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+
+    def run(reps):
+        float(np.asarray(loop(init, reps)))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(np.asarray(loop(init, reps)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    per = (run(4 * R) - run(R)) / (3 * R)
+    print(f"{name:56s} {per*1e3:9.3f} ms/iter", flush=True)
+    return per
+
+
+import kernel_variants as kv
+from svd_jacobi_tpu.ops import pallas_jacobi
+
+print(f"== N={N} on {jax.devices()[0]} ==", flush=True)
+
+for (k, n2) in [(8, 256), (16, 128), (32, 64)]:
+    x = jax.random.normal(key, (k, N, n2), jnp.float32)
+    g0 = jnp.einsum("kmi,kmj->kij", x, x, precision=HI)
+    dmax2 = jnp.max(jnp.diagonal(g0, axis1=-2, axis2=-1))
+
+    def _grid(gg, kk=k, nn=n2):
+        q, _ = pallas_jacobi.rotations(gg, dmax2)
+        return gg + q * 1e-9
+
+    def _batched(gg, kk=k, nn=n2):
+        q, _ = kv.rotations_a(gg, dmax2)
+        return gg + q * 1e-9
+
+    def _cross_grid(gg, kk=k, nn=n2):
+        q, _ = kv.rotations_cross(gg, dmax2)
+        return gg + q * 1e-9
+
+    t(f"full gridded  ({k},{n2},{n2}) {n2-1} steps", _grid, g0)
+    t(f"full batched  ({k},{n2},{n2}) {n2-1} steps", _batched, g0)
+    t(f"cross gridded ({k},{n2},{n2}) {n2//2} steps", _cross_grid, g0)
+
+
+from svd_jacobi_tpu.ops import pallas_jacobi2 as pj2
+
+for (k, n2) in [(8, 256), (16, 128), (32, 64), (64, 32), (128, 16)]:
+    x = jax.random.normal(key, (k, N, min(n2, 256)), jnp.float32)[:, :, :n2] \
+        if n2 <= 256 else None
+    xg = jax.random.normal(key, (k, 512, n2), jnp.float32)
+    g0 = jnp.einsum("kmi,kmj->kij", xg, xg, precision=HI)
+
+    def _v2(gg, kk=k, nn=n2):
+        q = pj2.cross_rotations(g0)
+        return gg + q * 1e-9
+
+    t(f"cross v2 ({k},{n2},{n2}) {n2//2} steps", _v2, g0)

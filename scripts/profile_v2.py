@@ -1,0 +1,60 @@
+"""Kernel v2 cost on chip. Usage: python scripts/profile_v2.py"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from svd_jacobi_tpu.ops import pallas_jacobi2 as pj2
+
+R = 30
+key = jax.random.PRNGKey(0)
+HI = jax.lax.Precision.HIGHEST
+
+
+def t(name, body, init):
+    @functools.partial(jax.jit, static_argnames=("reps",))
+    def loop(c, reps):
+        c = jax.lax.fori_loop(0, reps, lambda i, cc: body(cc), c)
+        leaves = jax.tree_util.tree_leaves(c)
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)
+
+    def run(reps):
+        float(np.asarray(loop(init, reps)))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(np.asarray(loop(init, reps)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    per = (run(4 * R) - run(R)) / (3 * R)
+    print(f"{name:56s} {per*1e3:9.3f} ms/iter", flush=True)
+    return per
+
+
+print(f"== on {jax.devices()[0]} ==", flush=True)
+
+for (k, n2) in [(8, 256), (16, 128), (32, 64), (64, 32), (128, 16)]:
+    xg = jax.random.normal(key, (k, 512, n2), jnp.float32)
+    g0 = jnp.einsum("kmi,kmj->kij", xg, xg, precision=HI)
+
+    def _v2(gg):
+        q = pj2.cross_rotations(gg)
+        return gg + q * 1e-9
+
+    t(f"cross v2 ({k},{n2},{n2}) {n2//2} steps", _v2, g0)
+
+from svd_jacobi_tpu.ops import pallas_blocks as pb
+
+for (k, n2) in [(8, 256), (16, 256), (16, 128), (32, 64)]:
+    xg = jax.random.normal(key, (k, 512, n2), jnp.float32)
+    g0 = jnp.einsum("kmi,kmj->kij", xg, xg, precision=HI)
+
+    def _v3(gg):
+        q = pb.cross_rotations(gg)
+        return gg + q * 1e-9
+
+    t(f"cross v3 4arr ({k},{n2},{n2}) {n2//2} steps", _v3, g0)

@@ -1,0 +1,155 @@
+"""Round-3 prototype C: kernel-v2 sweep with per-round threshold skip.
+
+Sweep = 1 self round (full tournament per block) + 2k-1 cross rounds
+(mod-b pairing across block pairs), each round gated by a fresh Gram
+coupling stat (rounds below the target tolerance are skipped via lax.cond).
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from svd_jacobi_tpu.ops import blockwise, pallas_jacobi2 as pj2
+from svd_jacobi_tpu.parallel import schedule as sched
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def _einsum(a, b, spec):
+    return jnp.einsum(spec, a, b, precision=HI, preferred_element_type=jnp.float32)
+
+
+def _polish(q):
+    """One Newton-Schulz step: restore Q orthogonality to the f32 floor."""
+    n2 = q.shape[-1]
+    g = _einsum(q, q, "kij,kil->kjl")
+    return _einsum(q, 1.5 * jnp.eye(n2, dtype=q.dtype) - 0.5 * g, "kij,kjl->kil")
+
+
+def _skip_stat(g):
+    """UNMASKED max scaled coupling — the round-skip gate. Unlike the
+    convergence stat it does NOT deflate small columns: a sub-noise-floor
+    column still deserves its rotations (they keep U orthogonal), it just
+    cannot be allowed to block loop termination. Exactly-zero (padding)
+    columns contribute 0/tiny = 0."""
+    acc = jnp.float32
+    g = g.astype(acc)
+    n2 = g.shape[-1]
+    d = jnp.sqrt(jnp.maximum(jnp.diagonal(g, axis1=-2, axis2=-1), 0.0))
+    denom = jnp.maximum(d[:, :, None] * d[:, None, :], jnp.finfo(acc).tiny)
+    c = jnp.abs(g) / denom
+    return jnp.max(c * (1.0 - jnp.eye(n2, dtype=acc))[None])
+
+
+def _self_round(blocks, vblocks, dmax2, rtol, interpret, polish, passes=1):
+    g = _einsum(blocks, blocks, "kmi,kmj->kij")
+    stat, _ = blockwise.off_diag_stats(g, g.shape[-1] // 2, dmax2, "rel")
+    skip = _skip_stat(g)
+
+    def do(args):
+        blocks, vblocks = args
+        q = pj2.self_rotations(g, interpret=interpret, passes=passes)
+        if polish:
+            q = _polish(q)
+        blocks = _einsum(blocks, q, "kmi,kij->kmj")
+        if vblocks is not None:
+            vblocks = _einsum(vblocks, q, "kmi,kij->kmj")
+        return blocks, vblocks
+
+    blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
+                                   (blocks, vblocks))
+    return blocks, vblocks, stat
+
+
+def _cross_round(top, bot, vtop, vbot, dmax2, rtol, interpret, polish, passes=1):
+    b = top.shape[-1]
+    x = jnp.concatenate([top, bot], axis=-1)
+    g = _einsum(x, x, "kmi,kmj->kij")
+    stat, _ = blockwise.off_diag_stats(g, b, dmax2, "rel")
+    skip = _skip_stat(g)
+
+    def do(args):
+        top, bot, vtop, vbot = args
+        q = pj2.cross_rotations(g, interpret=interpret, passes=passes)
+        if polish:
+            q = _polish(q)
+        xn = _einsum(jnp.concatenate([top, bot], axis=-1), q, "kmi,kij->kmj")
+        top, bot = xn[..., :b], xn[..., b:]
+        if vtop is not None:
+            vn = _einsum(jnp.concatenate([vtop, vbot], axis=-1), q, "kmi,kij->kmj")
+            vtop, vbot = vn[..., :b], vn[..., b:]
+        return top, bot, vtop, vbot
+
+    top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, lambda a: a,
+                                        (top, bot, vtop, vbot))
+    return top, bot, vtop, vbot, stat
+
+
+def _sweep(top, bot, vtop, vbot, dmax2, rtol, interpret, polish, passes=1):
+    k, m, b = top.shape
+    with_v = vtop is not None
+    blocks = jnp.concatenate([top, bot], axis=0)
+    vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
+    blocks, vblocks, rel_self = _self_round(blocks, vblocks, dmax2, rtol,
+                                            interpret, polish, passes)
+    top, bot = blocks[:k], blocks[k:]
+    if with_v:
+        vtop, vbot = vblocks[:k], vblocks[k:]
+
+    def body(carry, _):
+        top, bot, vtop, vbot, mx = carry
+        top, bot, vtop, vbot, stat = _cross_round(
+            top, bot, vtop, vbot, dmax2, rtol, interpret, polish, passes)
+        top, bot = sched.rotate_blocks(top, bot)
+        if with_v:
+            vtop, vbot = sched.rotate_blocks(vtop, vbot)
+        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
+
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
+    init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
+    (top, bot, vtop, vbot, off), _ = jax.lax.scan(
+        body, init, None, length=sched.num_rounds(2 * k))
+    return top, bot, (vtop if with_v else None), (vbot if with_v else None), off
+
+
+@partial(jax.jit, static_argnames=("nblocks", "tol", "max_sweeps", "compute_v",
+                                   "interpret", "polish"))
+def proto_svd(a, *, nblocks, tol, max_sweeps, compute_v=True, interpret=False,
+              polish=True):
+    from svd_jacobi_tpu import solver as slv
+
+    m, n = a.shape
+    top, bot = slv._blockify(a, n, nblocks)
+    if compute_v:
+        vtop, vbot = slv._blockify(jnp.eye(n, dtype=a.dtype), n, nblocks)
+    else:
+        vtop = vbot = None
+
+    def cond(state):
+        _, _, _, _, off, sweeps = state
+        return jnp.logical_and(sweeps < max_sweeps, off > tol)
+
+    def body(state):
+        top, bot, vtop, vbot, _, sweeps = state
+        dmax2 = slv._global_dmax2(top, bot)
+        top, bot, nvt, nvb, off = _sweep(top, bot,
+                                         vtop if compute_v else None,
+                                         vbot if compute_v else None,
+                                         dmax2, tol, interpret, polish)
+        if compute_v:
+            vtop, vbot = nvt, nvb
+        return (top, bot, vtop, vbot, off, sweeps + 1)
+
+    inf = jnp.float32(jnp.inf)
+    state = (top, bot, vtop, vbot, inf, jnp.int32(0))
+    top, bot, vtop, vbot, off, sweeps = jax.lax.while_loop(cond, body, state)
+    a_work = slv._deblockify(top, bot)
+    v_work = slv._deblockify(vtop, vbot)[:n, :] if compute_v else None
+    u, s, v = slv._postprocess(a_work, v_work, n, compute_u=True,
+                               full_u=False, dtype=a.dtype)
+    return u, s, v, sweeps, off

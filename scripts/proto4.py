@@ -1,0 +1,187 @@
+"""Round-3 prototype D: preconditioned kernel-v3 solver.
+
+Pipeline: norm-sort columns -> QR -> one-sided block Jacobi on L = R^T with
+the 4-array Pallas kernels -> U = Q1 @ V_L, V = P @ U_L.
+
+Parameterized for on-chip config search: block width, apply/gram precision.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from svd_jacobi_tpu.ops import blockwise, pallas_blocks as pb
+from svd_jacobi_tpu.parallel import schedule as sched
+
+HI = jax.lax.Precision.HIGHEST
+PREC = {"highest": jax.lax.Precision.HIGHEST, "high": jax.lax.Precision.HIGH,
+        "default": jax.lax.Precision.DEFAULT}
+
+
+def _einsum(a, b, spec, prec=HI):
+    if prec == "bf16":
+        return jnp.einsum(spec, a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum(spec, a, b, precision=prec,
+                      preferred_element_type=jnp.float32)
+
+
+def _polish(q):
+    n2 = q.shape[-1]
+    g = _einsum(q, q, "kij,kil->kjl")
+    return _einsum(q, 1.5 * jnp.eye(n2, dtype=q.dtype) - 0.5 * g, "kij,kjl->kil")
+
+
+def _stats(g, dmax2):
+    """(masked_rel, unmasked) max scaled coupling, one fused pass."""
+    f32 = jnp.float32
+    g = g.astype(f32)
+    n2 = g.shape[-1]
+    eps = jnp.finfo(f32).eps
+    d2 = jnp.diagonal(g, axis1=-2, axis2=-1)
+    inv = 1.0 / jnp.maximum(d2, jnp.finfo(f32).tiny)          # (k, n2) divs only
+    r2 = (g * g) * inv[:, :, None] * inv[:, None, :] * (1.0 - jnp.eye(n2, dtype=f32))[None]
+    unmasked = jnp.sqrt(jnp.max(r2))
+    null2 = dmax2.astype(f32) * (n2 * eps) ** 2
+    live = d2 > null2
+    pair = live[:, :, None] & live[:, None, :]
+    masked = jnp.sqrt(jnp.max(jnp.where(pair, r2, 0.0)))
+    return masked, unmasked
+
+
+def _self_round(blocks, vblocks, dmax2, rtol, interpret, polish, gprec):
+    g = _einsum(blocks, blocks, "kmi,kmj->kij",
+                "bf16" if gprec == "bf16" else PREC[gprec])
+    stat, skip = _stats(g, dmax2)
+
+    def do(args):
+        blocks, vblocks = args
+        q = pb.self_rotations(g, interpret=interpret, polish=polish)
+        blocks = _einsum(blocks, q, "kmi,kij->kmj")
+        if vblocks is not None:
+            vblocks = _einsum(vblocks, q, "kmi,kij->kmj")
+        return blocks, vblocks
+
+    blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
+                                   (blocks, vblocks))
+    return blocks, vblocks, stat
+
+
+def _cross_round(top, bot, vtop, vbot, dmax2, rtol, interpret, polish,
+                 gprec, aprec):
+    b = top.shape[-1]
+    x = jnp.concatenate([top, bot], axis=-1)
+    g = _einsum(x, x, "kmi,kmj->kij",
+                "bf16" if gprec == "bf16" else PREC[gprec])
+    stat, skip = _stats(g, dmax2)
+
+    def do(args):
+        top, bot, vtop, vbot = args
+        q = pb.cross_rotations(g, interpret=interpret, polish=polish)
+        xn = _einsum(jnp.concatenate([top, bot], axis=-1), q, "kmi,kij->kmj",
+                     PREC[aprec])
+        top, bot = xn[..., :b], xn[..., b:]
+        if vtop is not None:
+            vn = _einsum(jnp.concatenate([vtop, vbot], axis=-1), q,
+                         "kmi,kij->kmj", PREC[aprec])
+            vtop, vbot = vn[..., :b], vn[..., b:]
+        return top, bot, vtop, vbot
+
+    top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, lambda a: a,
+                                        (top, bot, vtop, vbot))
+    return top, bot, vtop, vbot, stat
+
+
+def _sweep(top, bot, vtop, vbot, dmax2, rtol, interpret, polish, gprec, aprec):
+    k, m, b = top.shape
+    with_v = vtop is not None
+    blocks = jnp.concatenate([top, bot], axis=0)
+    vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
+    blocks, vblocks, rel_self = _self_round(blocks, vblocks, dmax2, rtol,
+                                            interpret, polish, gprec)
+    top, bot = blocks[:k], blocks[k:]
+    if with_v:
+        vtop, vbot = vblocks[:k], vblocks[k:]
+
+    def body(carry, _):
+        top, bot, vtop, vbot, mx = carry
+        top, bot, vtop, vbot, stat = _cross_round(
+            top, bot, vtop, vbot, dmax2, rtol, interpret, polish, gprec, aprec)
+        top, bot = sched.rotate_blocks(top, bot)
+        if with_v:
+            vtop, vbot = sched.rotate_blocks(vtop, vbot)
+        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
+
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
+    init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
+    (top, bot, vtop, vbot, off), _ = jax.lax.scan(
+        body, init, None, length=sched.num_rounds(2 * k))
+    return top, bot, (vtop if with_v else None), (vbot if with_v else None), off
+
+
+@partial(jax.jit, static_argnames=("nblocks", "tol", "max_sweeps",
+                                   "interpret", "polish", "gprec", "aprec",
+                                   "precond"))
+def proto_svd(a, *, nblocks, tol, max_sweeps, interpret=False, polish=True,
+              gprec="highest", aprec="highest", precond=True):
+    from svd_jacobi_tpu import solver as slv
+
+    m, n = a.shape
+    q1 = None
+    order = None
+    if precond:
+        norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
+        order = jnp.argsort(-norms)
+        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1))
+        a = r.T  # L: Jacobi on the lower-triangular factor's columns
+        m = n
+
+    top, bot = slv._blockify(a, n, nblocks)
+    vtop, vbot = slv._blockify(jnp.eye(n, dtype=a.dtype), n, nblocks)
+
+    bulk_tol = 3e-2
+
+    def mk(gp, stop_tol, rtol):
+        def cond(state):
+            _, _, _, _, off, sweeps = state
+            return jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
+
+        def body(state):
+            top, bot, vtop, vbot, _, sweeps = state
+            dmax2 = slv._global_dmax2(top, bot)
+            top, bot, vtop, vbot, off = _sweep(top, bot, vtop, vbot,
+                                               dmax2, rtol, interpret, polish,
+                                               gp, aprec)
+            return (top, bot, vtop, vbot, off, sweeps + 1)
+        return cond, body
+
+    inf = jnp.float32(jnp.inf)
+    state = (top, bot, vtop, vbot, inf, jnp.int32(0))
+    if gprec == "auto":
+        # Phase A: bf16 Gram panels (angles/stats only see ~4e-3 noise,
+        # harmless above bulk_tol; the APPLY matmuls stay full f32 so no
+        # backward error enters X or V). Phase B: full-precision grams.
+        ca, ba = mk("bf16", bulk_tol, bulk_tol)
+        state = jax.lax.while_loop(ca, ba, state)
+        cb, bb = mk("highest", tol, tol)
+        top, bot, vtop, vbot, off, sweeps = jax.lax.while_loop(cb, bb, state)
+    else:
+        c1, b1 = mk(gprec, tol, tol)
+        top, bot, vtop, vbot, off, sweeps = jax.lax.while_loop(c1, b1, state)
+    a_work = slv._deblockify(top, bot)
+    v_work = slv._deblockify(vtop, vbot)[:n, :]
+    # One-sided Jacobi on L: L = U_L S V_L^T with U_L = normalized columns,
+    # V_L = accumulated rotations.
+    u_l, s, v_l = slv._postprocess(a_work, v_work, n, compute_u=True,
+                                   full_u=False, dtype=a.dtype)
+    if precond:
+        # A P = Q1 R = Q1 L^T = Q1 (V_L S U_L^T)^T ... A = U S V^T with
+        # U = Q1 V_L and V = P U_L (P = the sort permutation on columns).
+        u = jnp.matmul(q1, v_l, precision=HI)
+        v = jnp.zeros_like(u_l).at[order, :].set(u_l)
+        return u, s, v, sweeps, off
+    return u_l, s, v_l, sweeps, off

@@ -1,0 +1,55 @@
+"""Time proto_svd vs jnp.linalg.svd on the attached chip.
+
+Usage: python scripts/time_proto.py [N] [b] [precond(0/1)]
+"""
+import sys
+import time
+
+sys.path.insert(0, "scripts")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import proto3 as ps
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+PRE = bool(int(sys.argv[3])) if len(sys.argv) > 3 else False
+
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (N, N), jnp.float32)
+nblocks = max(2, N // B)
+tol = float(np.sqrt(N) * np.finfo(np.float32).eps)
+
+
+def _force(tree):
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    return float(np.asarray(sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)))
+
+
+def run(f, *args, reps=2):
+    out = f(*args)
+    _force(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+t_p, out = run(lambda x: ps.proto_svd(
+    x, nblocks=nblocks, tol=tol, max_sweeps=30), a)
+u, s, v, sweeps, off = out
+t_x, _ = run(lambda x: jnp.linalg.svd(x), a)
+
+s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+serr = float(np.max(np.abs(np.asarray(s, np.float64) - s_ref)) / s_ref[0])
+un = np.asarray(u, np.float64)
+uorth = float(np.linalg.norm(un.T @ un - np.eye(N)))
+res = float(np.linalg.norm(un @ np.diag(np.asarray(s, np.float64)) @ np.asarray(v, np.float64).T
+                           - np.asarray(a, np.float64)) / np.linalg.norm(np.asarray(a, np.float64)))
+print(f"N={N} b={B} pre={PRE}: proto {t_p:.4f}s ({int(sweeps)} sweeps, off {float(off):.2e}) "
+      f"xla {t_x:.4f}s speedup {t_x/t_p:.3f} serr {serr:.2e} uorth {uorth:.2e} res {res:.2e}",
+      flush=True)

@@ -1,0 +1,56 @@
+"""Config search for proto4 on chip. Usage: python scripts/time_proto4.py [N]"""
+import sys
+import time
+
+sys.path.insert(0, "scripts")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import proto4
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (N, N), jnp.float32)
+tol = float(np.sqrt(N) * np.finfo(np.float32).eps)
+an = np.asarray(a, np.float64)
+s_ref = np.linalg.svd(an, compute_uv=False)
+
+
+def _force(tree):
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    return float(np.asarray(sum(jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in leaves)))
+
+
+def run(f, *args, reps=2):
+    out = f(*args)
+    _force(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+t_x, _ = run(lambda x: jnp.linalg.svd(x), a)
+print(f"xla svd: {t_x:.4f}s", flush=True)
+
+for name, kw in [
+    ("b128 hi pre", dict(nblocks=N // 128)),
+    ("b128 hi nopolish", dict(nblocks=N // 128, polish=False)),
+    ("b128 auto pre", dict(nblocks=N // 128, gprec="auto")),
+]:
+    t_p, out = run(lambda x, kw=kw: proto4.proto_svd(
+        x, tol=tol, max_sweeps=30, **kw), a)
+    u, s, v, sweeps, off = out
+    un, sn, vn = (np.asarray(u, np.float64), np.asarray(s, np.float64),
+                  np.asarray(v, np.float64))
+    res = np.linalg.norm(un @ np.diag(sn) @ vn.T - an) / np.linalg.norm(an)
+    uo = np.max(np.abs(un.T @ un - np.eye(N)))
+    serr = np.max(np.abs(sn - s_ref)) / s_ref[0]
+    print(f"{name:18s} {t_p:.4f}s ({int(sweeps)} sw, off {float(off):.1e}) "
+          f"x{t_x/t_p:.3f} serr {serr:.1e} uorth {uo:.1e} res {res:.1e}",
+          flush=True)

@@ -40,9 +40,23 @@ class SVDConfig:
     block_size: Optional[int] = None
     max_sweeps: int = 32
     tol: Optional[float] = None
-    # "auto": gram-eigh for f32/bf16 (fast, LAPACK-dgesvd-class absolute
-    # accuracy), qr-svd for f64 (gesvj-class high relative accuracy).
-    pair_solver: str = "auto"  # "auto" | "qr-svd" (accurate) | "gram-eigh" (fast)
+    # "auto": the Pallas device-kernel path ("pallas") for f32/bf16 inputs
+    # that are large enough to block (the TPU fast path; runs under the
+    # Pallas interpreter on CPU), qr-svd for f64 (gesvj-class high relative
+    # accuracy) and for tiny inputs.
+    pair_solver: str = "auto"  # "auto" | "pallas" | "qr-svd" | "gram-eigh" | "hybrid"
+    # --- Pallas-path options (pair_solver="pallas") ---
+    # QR preconditioning: norm-sort columns, factor A P = Q1 R, run Jacobi
+    # on L = R^T (Drmac-style: graded triangular factors converge in ~25%
+    # fewer sweeps), then U = Q1 V_L, V = P U_L. "auto" = on for m >= n.
+    precondition: str = "auto"  # "auto" | "on" | "off"
+    # One in-kernel Newton-Schulz step on each accumulated rotation Q
+    # (restores orthogonality to the f32 floor; protects the residual over
+    # hundreds of applied rotations for ~5% kernel cost).
+    kernel_polish: bool = True
+    # bf16 Gram panels for the bulk phase (angles/stats only; applies stay
+    # f32). None = auto (on for n <= 2048, where the gram share is largest).
+    bulk_bf16: Optional[bool] = None
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2
     # (LAPACK-dgesvd class). "auto" follows the pair solver.

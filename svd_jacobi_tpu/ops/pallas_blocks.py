@@ -1,0 +1,364 @@
+"""Pallas TPU kernel v3: cross-pair tournament in 4-block-array layout.
+
+Same math as `pallas_jacobi2.cross_rotations` (cyclic mod-b pairing of the
+two column blocks of a panel, Rutishauser rotations, congruence on the Gram
+panel, accumulated Q) but the panel is carried as FOUR separate (kb, b, b)
+arrays
+
+    G = [[gxx, c ], [ct, gyy]]        q = [qx | qy]  (2b rows, b cols each)
+
+so every per-step operation is a FULL-ARRAY elementwise op or a full-array
+`pltpu.roll` — no sub-tile lane slicing and no concatenates inside the hot
+loop, which Mosaic lowers to masked merges (measured: the slice/concat form
+costs 3.8 us/step at b=128; this form is the replacement).
+
+Reference lineage: the per-pair rotation math is the TPU replacement for
+the reference CUDA kernel `jacobi_rotation` (lib/JacobiMethods.cu:1483-1491)
+generalized to all b pairs of a block pair per step (SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_TINY = 1e-30
+
+
+def _rutishauser(alpha, beta, gamma):
+    f32 = jnp.float32
+    safe_a = jnp.where(jnp.abs(alpha) > _TINY, alpha, jnp.ones_like(alpha))
+    tau = (gamma - beta) / (2.0 * safe_a)
+    sgn = jnp.where(tau >= 0, f32(1.0), f32(-1.0))
+    t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+    c = jax.lax.rsqrt(1.0 + t * t)
+    s = t * c
+    rot = jnp.abs(alpha) > _TINY
+    c = jnp.where(rot, c, f32(1.0))
+    s = jnp.where(rot, s, f32(0.0))
+    return c, s
+
+
+def _roll(x, shift, axis):
+    """Circular shift; pltpu.roll in compiled kernels, jnp.roll elsewhere."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return pltpu.roll(x, shift, axis)
+    except Exception:
+        return jnp.roll(x, shift, axis=axis)
+
+
+def _cross_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps):
+    """Run ``n_steps`` cyclic cross-rotation steps on the 4-block panels.
+
+    All six arrays are (kb, *, *); the aligned pairing couples column i of
+    X with aligned column i of Y, and the Y system (c's columns, ct's rows,
+    gyy's rows+cols, qy's columns, i.e. everything Y-indexed) rolls by -1
+    after each step.
+    """
+    f32 = jnp.float32
+    b = gxx.shape[-1]
+    dmask = (jax.lax.broadcasted_iota(jnp.int32, (b, b), 0)
+             == jax.lax.broadcasted_iota(jnp.int32, (b, b), 1)).astype(f32)[None]
+
+    def step(_, carry):
+        gxx, c, ct, gyy, qx, qy = carry
+        alpha = jnp.sum(c * dmask, axis=1)[:, None, :]     # (kb, 1, b)
+        beta = jnp.sum(gxx * dmask, axis=1)[:, None, :]
+        gamma = jnp.sum(gyy * dmask, axis=1)[:, None, :]
+        co_l, si_l = _rutishauser(alpha, beta, gamma)
+        # Sublane-shaped copies for the row mix. A transpose relayout beats
+        # re-deriving the angles from lane-axis reductions (measured 25%
+        # slower per step — lane reductions are long chains).
+        co_s = co_l.transpose(0, 2, 1)
+        si_s = si_l.transpose(0, 2, 1)
+
+        # Column mix (blocks pair with their horizontal neighbor) ...
+        gxx, c = co_l * gxx - si_l * c, si_l * gxx + co_l * c
+        ct, gyy = co_l * ct - si_l * gyy, si_l * ct + co_l * gyy
+        # ... then row mix (vertical neighbor) with the transposed angles.
+        gxx, ct = co_s * gxx - si_s * ct, si_s * gxx + co_s * ct
+        c, gyy = co_s * c - si_s * gyy, si_s * c + co_s * gyy
+        # Q columns (rows never move).
+        qx, qy = co_l * qx - si_l * qy, si_l * qx + co_l * qy
+
+        # Advance the pairing: everything Y-indexed rolls by -1.
+        c = _roll(c, -1, 2)
+        ct = _roll(ct, -1, 1)
+        gyy = _roll(_roll(gyy, -1, 1), -1, 2)
+        qy = _roll(qy, -1, 2)
+        return gxx, c, ct, gyy, qx, qy
+
+    # Unroll pairs of steps per loop iteration: shortens the per-iteration
+    # bookkeeping and gives Mosaic a longer straight-line region to schedule
+    # (the chain itself is sequential; the win is reduced loop overhead).
+    if n_steps % 2 == 0:
+        return jax.lax.fori_loop(
+            0, n_steps // 2, lambda i, cc: step(i, step(i, cc)),
+            (gxx, c, ct, gyy, qx, qy))
+    return jax.lax.fori_loop(0, n_steps, step, (gxx, c, ct, gyy, qx, qy))
+
+
+
+def _polish_blocks(qx, qy):
+    """One Newton-Schulz step on Q = [qx | qy] using in-kernel MXU matmuls:
+    Q <- Q (1.5 I - 0.5 Q^T Q). Restores the accumulated product's
+    orthogonality to the f32 floor without leaving VMEM (an XLA-level
+    polish costs ~2x the kernel itself in critical-path latency)."""
+    f32 = jnp.float32
+    b = qx.shape[-1]
+    mm = lambda a, bb, spec: jnp.einsum(
+        spec, a, bb, precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=f32)
+    gxx = mm(qx, qx, "kij,kil->kjl")
+    gxy = mm(qx, qy, "kij,kil->kjl")
+    gyy = mm(qy, qy, "kij,kil->kjl")
+    eye = jnp.eye(b, dtype=f32)[None]
+    mxx = 1.5 * eye - 0.5 * gxx
+    myy = 1.5 * eye - 0.5 * gyy
+    mxy = -0.5 * gxy
+    myx = -0.5 * gxy.transpose(0, 2, 1)
+    new_qx = mm(qx, mxx, "kij,kjl->kil") + mm(qy, myx, "kij,kjl->kil")
+    new_qy = mm(qx, mxy, "kij,kjl->kil") + mm(qy, myy, "kij,kjl->kil")
+    return new_qx, new_qy
+
+
+def _cross_kernel(gxx_ref, c_ref, ct_ref, gyy_ref, qx_ref, qy_ref, *, n_steps,
+                  polish):
+    f32 = jnp.float32
+    kb, b, _ = gxx_ref.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (2 * b, b), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (2 * b, b), 1)
+    qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (kb, 2 * b, b))
+    qy0 = jnp.broadcast_to((rows == cols + b).astype(f32)[None], (kb, 2 * b, b))
+    _, _, _, _, qx, qy = _cross_blocks_body(
+        gxx_ref[...].astype(f32), c_ref[...].astype(f32),
+        ct_ref[...].astype(f32), gyy_ref[...].astype(f32),
+        qx0, qy0, n_steps)
+    if polish:
+        qx, qy = _polish_blocks(qx, qy)
+    qx_ref[...] = qx
+    qy_ref[...] = qy
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_k", "passes",
+                                              "polish"))
+def _cross_call(gxx, c, ct, gyy, *, interpret: bool, block_k: int, passes: int,
+                polish: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, b, _ = gxx.shape
+    kernel = functools.partial(_cross_kernel, n_steps=passes * b,
+                               polish=polish)
+    spec_in = pl.BlockSpec((block_k, b, b), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    spec_out = pl.BlockSpec((block_k, 2 * b, b), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    f32 = jnp.float32
+    qx, qy = pl.pallas_call(
+        kernel,
+        grid=(k // block_k,),
+        in_specs=[spec_in] * 4,
+        out_specs=[spec_out] * 2,
+        out_shape=[jax.ShapeDtypeStruct((k, 2 * b, b), f32)] * 2,
+        interpret=interpret,
+    )(gxx.astype(f32), c.astype(f32), ct.astype(f32), gyy.astype(f32))
+    return qx, qy
+
+
+def supported(platform: str | None = None) -> bool:
+    if platform is None:
+        platform = jax.default_backend()
+    return platform in ("tpu", "axon")
+
+
+def _pick_block_k(k: int, b: int, factor: int = 24) -> int:
+    """Panels per grid step, bounded by scoped VMEM (~16 MB): a panel's
+    live set is 8*b^2 floats (4 G-quadrants + 2 Q halves) and Mosaic's
+    scheduling temporaries multiply that by ~3 (cross) / ~4 (self, which
+    has extra circle-move intermediates) — expressed as bytes-per-panel
+    b^2*4*factor against a 12 MB budget."""
+    budget_panels = max(1, (12 << 20) // (b * b * 4 * factor))
+    block_k = k
+    while block_k > budget_panels and block_k % 2 == 0:
+        block_k //= 2
+    return block_k
+
+
+def cross_rotations(g: jax.Array, *, interpret: bool | None = None,
+                    block_k: int | None = None, passes: int = 1,
+                    polish: bool = True) -> jax.Array:
+    """Drop-in equivalent of `pallas_jacobi2.cross_rotations` (same G in,
+    same Q out), 4-block-array layout inside."""
+    if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
+        raise ValueError(f"expected (k, n2, n2) panels with even n2, got {g.shape}")
+    k, n2, _ = g.shape
+    b = n2 // 2
+    if block_k is None:
+        block_k = _pick_block_k(k, b)
+    if interpret is None:
+        interpret = not supported()
+    gxx, c = g[:, :b, :b], g[:, :b, b:]
+    ct, gyy = g[:, b:, :b], g[:, b:, b:]
+    qx, qy = _cross_call(gxx, c, ct, gyy, interpret=bool(interpret),
+                         block_k=int(block_k), passes=int(passes),
+                         polish=bool(polish))
+    return jnp.concatenate([qx, qy], axis=2)
+
+
+# ---------------------------------------------------------------------------
+# Full tournament (self coverage) in the same 4-block-array layout: every
+# pair INSIDE each width-n2 panel exactly once via n2-1 circle-method steps.
+# The circle move (slot 0 fixed) is expressed as full-array rolls + masked
+# selects, so the hot loop stays free of sub-tile slicing.
+
+
+def _circle_masks(b2):
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, b2), 1)[None]
+    return ((lane == 0).astype(jnp.float32),
+            (lane == 1).astype(jnp.float32),
+            (lane == b2 - 1).astype(jnp.float32))
+
+
+def _colmove(x, y, m0, m1, mlast, axis):
+    """Circle-method slot move along ``axis``: X' = [x0, y0, x1..x_{b2-2}],
+    Y' = [y1..y_{b2-1}, x_{b2-1}]. Masks are lane-shaped; for axis=1 pass
+    their transposes."""
+    xr = _roll(x, 1, axis)
+    yr1 = _roll(y, 1, axis)
+    new_x = m0 * x + m1 * yr1 + (1.0 - m0 - m1) * xr
+    new_y = mlast * x + (1.0 - mlast) * _roll(y, -1, axis)
+    return new_x, new_y
+
+
+def _self_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps):
+    """n_steps circle-method tournament steps on the 4-block panels."""
+    f32 = jnp.float32
+    b2 = gxx.shape[-1]
+    dmask = (jax.lax.broadcasted_iota(jnp.int32, (b2, b2), 0)
+             == jax.lax.broadcasted_iota(jnp.int32, (b2, b2), 1)).astype(f32)[None]
+    m0, m1, mlast = _circle_masks(b2)
+    m0s, m1s, mlasts = (m.transpose(0, 2, 1) for m in (m0, m1, mlast))
+
+    def step(_, carry):
+        gxx, c, ct, gyy, qx, qy = carry
+        alpha = jnp.sum(c * dmask, axis=1)[:, None, :]
+        beta = jnp.sum(gxx * dmask, axis=1)[:, None, :]
+        gamma = jnp.sum(gyy * dmask, axis=1)[:, None, :]
+        co_l, si_l = _rutishauser(alpha, beta, gamma)
+        co_s = co_l.transpose(0, 2, 1)
+        si_s = si_l.transpose(0, 2, 1)
+
+        gxx, c = co_l * gxx - si_l * c, si_l * gxx + co_l * c
+        ct, gyy = co_l * ct - si_l * gyy, si_l * ct + co_l * gyy
+        gxx, ct = co_s * gxx - si_s * ct, si_s * gxx + co_s * ct
+        c, gyy = co_s * c - si_s * gyy, si_s * c + co_s * gyy
+        qx, qy = co_l * qx - si_l * qy, si_l * qx + co_l * qy
+
+        # Circle move: columns of both halves, then rows, then Q columns.
+        gxx, c = _colmove(gxx, c, m0, m1, mlast, 2)
+        ct, gyy = _colmove(ct, gyy, m0, m1, mlast, 2)
+        gxx, ct = _colmove(gxx, ct, m0s, m1s, mlasts, 1)
+        c, gyy = _colmove(c, gyy, m0s, m1s, mlasts, 1)
+        qx, qy = _colmove(qx, qy, m0, m1, mlast, 2)
+        return gxx, c, ct, gyy, qx, qy
+
+    return jax.lax.fori_loop(0, n_steps, step, (gxx, c, ct, gyy, qx, qy))
+
+
+def _self_kernel(gxx_ref, c_ref, ct_ref, gyy_ref, qx_ref, qy_ref, *, n_steps,
+                 polish):
+    f32 = jnp.float32
+    kb, b2, _ = gxx_ref.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (2 * b2, b2), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (2 * b2, b2), 1)
+    qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (kb, 2 * b2, b2))
+    qy0 = jnp.broadcast_to((rows == cols + b2).astype(f32)[None], (kb, 2 * b2, b2))
+    _, _, _, _, qx, qy = _self_blocks_body(
+        gxx_ref[...].astype(f32), c_ref[...].astype(f32),
+        ct_ref[...].astype(f32), gyy_ref[...].astype(f32), qx0, qy0, n_steps)
+    if polish:
+        qx, qy = _polish_blocks(qx, qy)
+    qx_ref[...] = qx
+    qy_ref[...] = qy
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_k", "passes",
+                                              "polish"))
+def _self_call(gxx, c, ct, gyy, *, interpret: bool, block_k: int, passes: int,
+               polish: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, b2, _ = gxx.shape
+    kernel = functools.partial(_self_kernel,
+                               n_steps=passes * max(2 * b2 - 1, 1),
+                               polish=polish)
+    spec_in = pl.BlockSpec((block_k, b2, b2), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    spec_out = pl.BlockSpec((block_k, 2 * b2, b2), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    f32 = jnp.float32
+    qx, qy = pl.pallas_call(
+        kernel,
+        grid=(k // block_k,),
+        in_specs=[spec_in] * 4,
+        out_specs=[spec_out] * 2,
+        out_shape=[jax.ShapeDtypeStruct((k, 2 * b2, b2), f32)] * 2,
+        interpret=interpret,
+    )(gxx.astype(f32), c.astype(f32), ct.astype(f32), gyy.astype(f32))
+    return qx, qy
+
+
+def self_rotations(g: jax.Array, *, interpret: bool | None = None,
+                   block_k: int | None = None, passes: int = 1,
+                   polish: bool = True) -> jax.Array:
+    """Annihilate EVERY pair inside each (n2, n2) Gram panel exactly once
+    (n2-1 circle-method steps); drop-in for `pallas_jacobi2.self_rotations`."""
+    if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
+        raise ValueError(f"expected (k, n2, n2) panels with even n2, got {g.shape}")
+    k, n2, _ = g.shape
+    b2 = n2 // 2
+    if block_k is None:
+        block_k = _pick_block_k(k, b2, factor=40)
+    if interpret is None:
+        interpret = not supported()
+    qx, qy = _self_call(g[:, :b2, :b2], g[:, :b2, b2:], g[:, b2:, :b2],
+                        g[:, b2:, b2:], interpret=bool(interpret),
+                        block_k=int(block_k), passes=int(passes),
+                        polish=bool(polish))
+    return jnp.concatenate([qx, qy], axis=2)
+
+
+def reference_self(g: jax.Array) -> jax.Array:
+    """Pure-jnp reference (no Pallas) for tests."""
+    k, n2, _ = g.shape
+    b2 = n2 // 2
+    f32 = jnp.float32
+    rows = jax.lax.broadcasted_iota(jnp.int32, (2 * b2, b2), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (2 * b2, b2), 1)
+    qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (k, 2 * b2, b2))
+    qy0 = jnp.broadcast_to((rows == cols + b2).astype(f32)[None], (k, 2 * b2, b2))
+    _, _, _, _, qx, qy = _self_blocks_body(
+        g[:, :b2, :b2].astype(f32), g[:, :b2, b2:].astype(f32),
+        g[:, b2:, :b2].astype(f32), g[:, b2:, b2:].astype(f32),
+        qx0, qy0, max(n2 - 1, 1))
+    return jnp.concatenate([qx, qy], axis=2)
+
+
+def reference_cross(g: jax.Array) -> jax.Array:
+    """Pure-jnp reference (no Pallas) for tests."""
+    k, n2, _ = g.shape
+    b = n2 // 2
+    f32 = jnp.float32
+    rows = jax.lax.broadcasted_iota(jnp.int32, (2 * b, b), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (2 * b, b), 1)
+    qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (k, 2 * b, b))
+    qy0 = jnp.broadcast_to((rows == cols + b).astype(f32)[None], (k, 2 * b, b))
+    _, _, _, _, qx, qy = _cross_blocks_body(
+        g[:, :b, :b].astype(f32), g[:, :b, b:].astype(f32),
+        g[:, b:, :b].astype(f32), g[:, b:, b:].astype(f32), qx0, qy0, b)
+    return jnp.concatenate([qx, qy], axis=2)

@@ -1,0 +1,334 @@
+"""Pallas TPU kernel v2: cross-pair Givens tournament on Gram panels.
+
+The framework's device kernel — TPU-native replacement for the reference's
+CUDA `jacobi_rotation` (reference: lib/JacobiMethods.cu:1483-1491, one pair
+per launch with 8 host<->device memcpys around it). One call processes ALL
+k panels of a round: for each [I | J] column-pair panel's Gram matrix
+``G = [X|Y]^T [X|Y]`` it annihilates every cross pair (x_i, y_j) exactly
+once — b cyclic steps of b disjoint scalar Givens rotations, pairing
+``(x_i, y_{(i+t) mod b})`` at step t — and returns the accumulated
+orthogonal transform Q (the caller applies Q to the tall panels and V on
+the MXU).
+
+Design notes (measured on TPU v5e, see PROFILE.md):
+
+* The per-step cost of this kernel family is LATENCY-bound — a sequential
+  dependency chain of small VPU ops — so the implementation minimizes
+  chain depth, not FLOPs:
+  - rotation angles come from the Rutishauser formula fed by the coupling
+    diagonal alpha (one masked-sum reduction) and CARRIED column norms
+    beta/gamma updated in closed form (no diagonal re-extraction);
+  - angles are computed twice, in lane shape (1, b) for column transforms
+    and sublane shape (b, 1) for row transforms — two short independent
+    chains instead of one chain plus a relayout transpose;
+  - the cyclic pairing moves ONLY the Y half (columns via a lane roll,
+    rows via a sublane roll, `pltpu.roll`), not the whole tournament
+    system; after b steps the layout is back in the original order, so Q
+    maps original slots to original slots.
+* No convergence statistic is computed in-kernel: the caller derives the
+  dgesvj-style scaled-coupling stat from the (already materialized) Gram
+  panel, which also lets it skip the whole round (`lax.cond`) when the
+  panel is already converged — the threshold-Jacobi work taper.
+* Within-block (self) pairs are covered by RECURSIVE HALVING with this
+  same kernel: a width-w block is two width-w/2 half-blocks -> cross-pair
+  the halves (w/2 steps), recurse. Total sequential rotation steps per
+  full sweep: (n/b - 1) outer rounds * b steps + sum_{l} b/2^l = n - 1,
+  the tournament-optimal count.
+
+The grid runs over chunks of the panel batch so arbitrarily large rounds
+stay within VMEM; panels inside a chunk are batched inside the kernel body
+(a serial grid over panels would multiply the latency chain by k —
+measured 2-3x slower at b <= 64).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+_TINY = 1e-30
+
+
+def _rutishauser(alpha, beta, gamma):
+    """Small-angle Givens (c, s) — the formula the reference inlines at
+    lib/JacobiMethods.cu:466-478; identity on numerically-null couplings."""
+    f32 = jnp.float32
+    safe_a = jnp.where(jnp.abs(alpha) > _TINY, alpha, jnp.ones_like(alpha))
+    tau = (gamma - beta) / (2.0 * safe_a)
+    sgn = jnp.where(tau >= 0, f32(1.0), f32(-1.0))
+    t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+    c = jax.lax.rsqrt(1.0 + t * t)
+    s = t * c
+    rot = jnp.abs(alpha) > _TINY
+    c = jnp.where(rot, c, f32(1.0))
+    s = jnp.where(rot, s, f32(0.0))
+    return c, s
+
+
+def _roll_m1(x, axis):
+    """Circular shift by -1 (element i takes element i+1) along ``axis``.
+
+    Uses pltpu.roll inside the compiled kernel (single lane/sublane rotate);
+    falls back to jnp.roll under the interpreter / outside Pallas.
+    """
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return pltpu.roll(x, -1, axis)
+    except Exception:
+        return jnp.roll(x, -1, axis=axis)
+
+
+def _cross_body(g, q, b, n_steps):
+    """Pure function: run ``n_steps`` cyclic cross-rotation steps on the
+    (kb, 2b, 2b) Gram panels ``g`` accumulating into ``q``. Returns (g, q).
+
+    Runs identically inside the Pallas kernel (compiled) and as the
+    reference implementation in tests.
+    """
+    f32 = jnp.float32
+    dmask = (jax.lax.broadcasted_iota(jnp.int32, (b, b), 0)
+             == jax.lax.broadcasted_iota(jnp.int32, (b, b), 1)).astype(f32)[None]
+
+    def step(_, carry):
+        g, q = carry
+        # Angle inputs re-derived from the congruence-updated panel each
+        # step: three independent masked-sum reductions (alpha from the
+        # aligned coupling diagonal, beta/gamma from the block diagonals).
+        # Mosaic cannot carry (kb,1,b) arrays across fori_loop iterations
+        # ("Not implemented: Sublane broadcast"), so closed-form carried
+        # norms are not an option here; the reductions run in parallel and
+        # add little to the step's latency chain.
+        alpha_l = jnp.sum(g[:, :b, b:] * dmask, axis=1)[:, None, :]
+        beta_l = jnp.sum(g[:, :b, :b] * dmask, axis=1)[:, None, :]
+        gamma_l = jnp.sum(g[:, b:, b:] * dmask, axis=1)[:, None, :]
+        c_l, s_l = _rutishauser(alpha_l, beta_l, gamma_l)
+        # Sublane-shaped copies for the row transform (Mosaic lowers this
+        # transpose; lane-broadcasting sublane-shaped reductions it does not).
+        c_s = c_l.transpose(0, 2, 1)
+        s_s = s_l.transpose(0, 2, 1)
+
+        # Congruence G <- J^T G J (columns then rows), Q <- Q J.
+        gx, gy = g[:, :, :b], g[:, :, b:]
+        g = jnp.concatenate([c_l * gx - s_l * gy, s_l * gx + c_l * gy], axis=2)
+        hx, hy = g[:, :b, :], g[:, b:, :]
+        g = jnp.concatenate([c_s * hx - s_s * hy, s_s * hx + c_s * hy], axis=1)
+        qx, qy = q[:, :, :b], q[:, :, b:]
+        q = jnp.concatenate([c_l * qx - s_l * qy, s_l * qx + c_l * qy], axis=2)
+
+        # Advance the cyclic pairing: only the Y half moves (columns via a
+        # lane roll, rows via a sublane roll); same for Q's Y columns and
+        # the carried gamma norms.
+        g = jnp.concatenate([g[:, :, :b], _roll_m1(g[:, :, b:], 2)], axis=2)
+        g = jnp.concatenate([g[:, :b, :], _roll_m1(g[:, b:, :], 1)], axis=1)
+        q = jnp.concatenate([q[:, :, :b], _roll_m1(q[:, :, b:], 2)], axis=2)
+
+        return g, q
+
+    g, q = jax.lax.fori_loop(0, n_steps, step, (g, q))
+    return g, q
+
+
+def _cross_kernel(g_ref, q_ref, *, b, n_steps):
+    f32 = jnp.float32
+    kb, n2, _ = g_ref.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n2, n2), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n2, n2), 1)
+    q0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (kb, n2, n2))
+    _, q = _cross_body(g_ref[...].astype(f32), q0, b, n_steps)
+    q_ref[...] = q
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_k", "passes"))
+def _cross_call(g, *, interpret: bool, block_k: int, passes: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, n2, _ = g.shape
+    b = n2 // 2
+    kernel = functools.partial(_cross_kernel, b=b, n_steps=passes * b)
+    if k % block_k:
+        raise ValueError(f"panel count {k} not divisible by block_k={block_k}")
+    q = pl.pallas_call(
+        kernel,
+        grid=(k // block_k,),
+        in_specs=[pl.BlockSpec((block_k, n2, n2), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block_k, n2, n2), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, n2, n2), jnp.float32),
+        interpret=interpret,
+    )(g.astype(jnp.float32))
+    return q
+
+
+def supported(platform: str | None = None) -> bool:
+    """True when the compiled Pallas TPU path can run on this backend."""
+    if platform is None:
+        platform = jax.default_backend()
+    return platform in ("tpu", "axon")
+
+
+def cross_rotations(g: jax.Array, *, interpret: bool | None = None,
+                    block_k: int | None = None, passes: int = 1) -> jax.Array:
+    """Annihilate every cross pair of each Gram panel once; return Q.
+
+    Args:
+      g: (k, 2b, 2b) symmetric Gram panels of [I | J] column-pair panels.
+      interpret: run under the Pallas interpreter (CPU testing); default
+        compiles on TPU backends and interprets elsewhere.
+      block_k: panels per grid step (VMEM chunking). Default: whole batch
+        up to 8 panels, then the largest divisor of k with <= 8 panels.
+
+    Returns:
+      q: (k, 2b, 2b) float32, the accumulated product of the b rotation
+      steps. Columns of the panel are made mutually orthogonal ACROSS the
+      two blocks only; within-block pairs are the recursion's job
+      (`self_rotations`).
+    """
+    if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
+        raise ValueError(f"expected (k, n2, n2) panels with even n2, got {g.shape}")
+    if block_k is None:
+        block_k = _pick_block_k(g.shape[0], g.shape[-1])
+    if interpret is None:
+        interpret = not supported()
+    return _cross_call(g, interpret=bool(interpret), block_k=int(block_k),
+                       passes=int(passes))
+
+
+def _pick_block_k(k: int, n2: int) -> int:
+    """Panels per grid step: as many as VMEM comfortably holds (the batched
+    body amortizes per-step latency over the chunk; a serial grid multiplies
+    it), budgeting ~8 MB for g + q + temporaries of ~6x panel size."""
+    budget_panels = max(1, (8 << 20) // (n2 * n2 * 4 * 6))
+    block_k = k
+    while block_k > budget_panels and block_k % 2 == 0:
+        block_k //= 2
+    return block_k
+
+
+def reference_cross(g: jax.Array) -> jax.Array:
+    """Pure-jnp reference for `cross_rotations` (tests/CPU oracle): same
+    body, no Pallas."""
+    k, n2, _ = g.shape
+    b = n2 // 2
+    q0 = jnp.broadcast_to(jnp.eye(n2, dtype=jnp.float32)[None], (k, n2, n2))
+    _, q = _cross_body(g.astype(jnp.float32), q0, b, b)
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Full tournament (self coverage): every pair INSIDE each panel exactly once.
+
+
+def _shift_cols(top, bot):
+    """Circle-method tournament shift on the last axis (slot 0 fixed)."""
+    if top.shape[-1] == 1:
+        return top, bot
+    new_top = jnp.concatenate([top[..., :1], bot[..., :1], top[..., 1:-1]], axis=-1)
+    new_bot = jnp.concatenate([bot[..., 1:], top[..., -1:]], axis=-1)
+    return new_top, new_bot
+
+
+def _shift_rows(top, bot):
+    if top.shape[-2] == 1:
+        return top, bot
+    new_top = jnp.concatenate(
+        [top[..., :1, :], bot[..., :1, :], top[..., 1:-1, :]], axis=-2)
+    new_bot = jnp.concatenate([bot[..., 1:, :], top[..., -1:, :]], axis=-2)
+    return new_top, new_bot
+
+
+def _self_body(g, q, b2, n_steps):
+    """n2-1 circle-method steps covering every pair inside each panel once.
+
+    Same trimmed structure as `_cross_body` (no in-kernel statistics), but
+    the pairing advances by moving ALL slots (the circle method with slot 0
+    fixed) because every pair of the n2 = 2*b2 columns must meet.
+    """
+    f32 = jnp.float32
+    dmask = (jax.lax.broadcasted_iota(jnp.int32, (b2, b2), 0)
+             == jax.lax.broadcasted_iota(jnp.int32, (b2, b2), 1)).astype(f32)[None]
+
+    def step(_, carry):
+        g, q = carry
+        alpha_l = jnp.sum(g[:, :b2, b2:] * dmask, axis=1)[:, None, :]
+        beta_l = jnp.sum(g[:, :b2, :b2] * dmask, axis=1)[:, None, :]
+        gamma_l = jnp.sum(g[:, b2:, b2:] * dmask, axis=1)[:, None, :]
+        c_l, s_l = _rutishauser(alpha_l, beta_l, gamma_l)
+        c_s = c_l.transpose(0, 2, 1)
+        s_s = s_l.transpose(0, 2, 1)
+
+        gx, gy = g[:, :, :b2], g[:, :, b2:]
+        g = jnp.concatenate([c_l * gx - s_l * gy, s_l * gx + c_l * gy], axis=2)
+        hx, hy = g[:, :b2, :], g[:, b2:, :]
+        g = jnp.concatenate([c_s * hx - s_s * hy, s_s * hx + c_s * hy], axis=1)
+        qx, qy = q[:, :, :b2], q[:, :, b2:]
+        q = jnp.concatenate([c_l * qx - s_l * qy, s_l * qx + c_l * qy], axis=2)
+
+        gt, gb = _shift_cols(g[:, :, :b2], g[:, :, b2:])
+        g = jnp.concatenate([gt, gb], axis=2)
+        gt, gb = _shift_rows(g[:, :b2, :], g[:, b2:, :])
+        g = jnp.concatenate([gt, gb], axis=1)
+        qt, qb = _shift_cols(q[:, :, :b2], q[:, :, b2:])
+        q = jnp.concatenate([qt, qb], axis=2)
+        return g, q
+
+    g, q = jax.lax.fori_loop(0, n_steps, step, (g, q))
+    return g, q
+
+
+def _self_kernel(g_ref, q_ref, *, b2, n_steps):
+    f32 = jnp.float32
+    kb, n2, _ = g_ref.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n2, n2), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n2, n2), 1)
+    q0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (kb, n2, n2))
+    _, q = _self_body(g_ref[...].astype(f32), q0, b2, n_steps)
+    q_ref[...] = q
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_k", "passes"))
+def _self_call(g, *, interpret: bool, block_k: int, passes: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, n2, _ = g.shape
+    kernel = functools.partial(_self_kernel, b2=n2 // 2,
+                               n_steps=passes * max(n2 - 1, 1))
+    q = pl.pallas_call(
+        kernel,
+        grid=(k // block_k,),
+        in_specs=[pl.BlockSpec((block_k, n2, n2), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block_k, n2, n2), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, n2, n2), jnp.float32),
+        interpret=interpret,
+    )(g.astype(jnp.float32))
+    return q
+
+
+def self_rotations(g: jax.Array, *, interpret: bool | None = None,
+                   block_k: int | None = None, passes: int = 1) -> jax.Array:
+    """Annihilate EVERY column pair inside each Gram panel exactly once
+    (full n2-1-step tournament); returns the accumulated Q like
+    `cross_rotations`. Used once per sweep on the per-block Grams."""
+    if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
+        raise ValueError(f"expected (k, n2, n2) panels with even n2, got {g.shape}")
+    if block_k is None:
+        block_k = _pick_block_k(g.shape[0], g.shape[-1])
+    if interpret is None:
+        interpret = not supported()
+    return _self_call(g, interpret=bool(interpret), block_k=int(block_k),
+                       passes=int(passes))
+
+
+def reference_self(g: jax.Array) -> jax.Array:
+    """Pure-jnp reference for `self_rotations`."""
+    k, n2, _ = g.shape
+    q0 = jnp.broadcast_to(jnp.eye(n2, dtype=jnp.float32)[None], (k, n2, n2))
+    _, q = _self_body(g.astype(jnp.float32), q0, n2 // 2, max(n2 - 1, 1))
+    return q

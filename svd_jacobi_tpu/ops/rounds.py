@@ -1,0 +1,212 @@
+"""Kernel-driven sweep machinery for the Pallas one-sided block-Jacobi path.
+
+This is the production TPU compute path (SVDConfig.pair_solver="pallas"):
+each tournament round forms the Gram panel of its block pairs on the MXU,
+hands it to the Pallas rotation kernel (`ops.pallas_blocks`), and applies
+the accumulated orthogonal transform back to the tall column panels (and V)
+on the MXU. The reference's equivalent hot loop ships two columns to the
+GPU per rotation with 8 memcpys around each kernel launch
+(lib/JacobiMethods.cu:479-510); here one kernel call rotates every pair of
+a round and the matrix never leaves the device.
+
+Design points (measured on TPU v5e — see PROFILE.md):
+
+* Round skipping (threshold Jacobi): each round's panel coupling is
+  measured on the freshly formed Gram panel; rounds whose UNMASKED
+  coupling is below the target tolerance are skipped via `lax.cond`,
+  which tapers late-sweep cost to the Gram + stat only. The skip gate
+  deliberately ignores the deflation mask: a sub-noise-floor column still
+  needs its rotations (they keep U orthogonal) even though it must not
+  block loop termination (that is the masked stat's job).
+* The convergence statistic is the dgesvj scaled coupling
+  ``max |g_ij| / sqrt(g_ii g_jj)`` with numerically-null columns deflated
+  (the quantity the reference computes per pair and discards,
+  lib/JacobiMethods.cu:462,234).
+* Optional bf16 Gram panels for the bulk phase: Gram errors only perturb
+  rotation ANGLES (the transforms stay exactly orthogonal) and the stat by
+  ~4e-3, harmless while the coupling is above ``BULK_TOL``; the apply
+  matmuls always run at full f32 precision so no backward error enters X
+  or V.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import pallas_blocks as pb
+from ..parallel import schedule as sched
+
+HI = jax.lax.Precision.HIGHEST
+
+# Coupling level above which bf16 Gram panels are safe (their ~4e-3 angle /
+# stat noise is well below the couplings being resolved).
+BULK_TOL = 3e-2
+
+
+def _einsum(a, b, spec, bf16=False):
+    if bf16:
+        return jnp.einsum(spec, a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum(spec, a, b, precision=HI,
+                      preferred_element_type=jnp.float32)
+
+
+def panel_stats(g: jax.Array, dmax2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(masked, unmasked) max scaled coupling of a Gram panel stack.
+
+    ``masked`` deflates columns whose squared norm is below
+    ``dmax2 * (n2*eps)^2`` (directions at the roundoff floor whose mutual
+    cosines are noise and can never converge) — it drives the sweep loop.
+    ``unmasked`` keeps them — it gates round skipping. Exactly-zero
+    (padding) columns contribute 0 to both.
+    """
+    f32 = jnp.float32
+    g = g.astype(f32)
+    n2 = g.shape[-1]
+    eps = jnp.finfo(f32).eps
+    d2 = jnp.diagonal(g, axis1=-2, axis2=-1)
+    inv = 1.0 / jnp.maximum(d2, jnp.finfo(f32).tiny)
+    r2 = (g * g) * inv[:, :, None] * inv[:, None, :]
+    r2 = r2 * (1.0 - jnp.eye(n2, dtype=f32))[None]
+    unmasked = jnp.sqrt(jnp.max(r2))
+    null2 = dmax2.astype(f32) * (n2 * eps) ** 2
+    live = d2 > null2
+    pair = live[:, :, None] & live[:, None, :]
+    masked = jnp.sqrt(jnp.max(jnp.where(pair, r2, 0.0)))
+    return masked, unmasked
+
+
+def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram):
+    """Annihilate every within-block pair once (full tournament kernel)."""
+    g = _einsum(blocks, blocks, "kmi,kmj->kij", bf16_gram)
+    stat, skip = panel_stats(g, dmax2)
+
+    def do(args):
+        blocks, vblocks = args
+        q = pb.self_rotations(g, interpret=interpret, polish=polish)
+        blocks = _einsum(blocks, q, "kmi,kij->kmj").astype(blocks.dtype)
+        if vblocks is not None:
+            vblocks = _einsum(vblocks, q, "kmi,kij->kmj").astype(vblocks.dtype)
+        return blocks, vblocks
+
+    blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
+                                   (blocks, vblocks))
+    return blocks, vblocks, stat
+
+
+def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
+                bf16_gram):
+    """Annihilate every cross pair of each (top[i], bot[i]) block pair."""
+    b = top.shape[-1]
+    x = jnp.concatenate([top, bot], axis=-1)
+    g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
+    stat, skip = panel_stats(g, dmax2)
+
+    def do(args):
+        top, bot, vtop, vbot = args
+        q = pb.cross_rotations(g, interpret=interpret, polish=polish)
+        xn = _einsum(jnp.concatenate([top, bot], axis=-1), q,
+                     "kmi,kij->kmj").astype(top.dtype)
+        top, bot = xn[..., :b], xn[..., b:]
+        if vtop is not None:
+            vn = _einsum(jnp.concatenate([vtop, vbot], axis=-1), q,
+                         "kmi,kij->kmj").astype(vtop.dtype)
+            vtop, vbot = vn[..., :b], vn[..., b:]
+        return top, bot, vtop, vbot
+
+    top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, lambda a: a,
+                                        (top, bot, vtop, vbot))
+    return top, bot, vtop, vbot, stat
+
+
+def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram):
+    """One full sweep: self round + 2k-1 cross tournament rounds.
+
+    Every pair of the n columns is annihilated exactly once: n-1 sequential
+    rotation steps in total, the tournament-optimal count. Returns the max
+    (deflation-masked) coupling observed across the sweep's fresh Gram
+    panels — measured BEFORE each round's rotations.
+    """
+    k, m, b = top.shape
+    with_v = vtop is not None
+    blocks = jnp.concatenate([top, bot], axis=0)
+    vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
+    blocks, vblocks, rel_self = self_round(
+        blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
+        bf16_gram=bf16_gram)
+    top, bot = blocks[:k], blocks[k:]
+    if with_v:
+        vtop, vbot = vblocks[:k], vblocks[k:]
+
+    def body(carry, _):
+        top, bot, vtop, vbot, mx = carry
+        top, bot, vtop, vbot, stat = cross_round(
+            top, bot, vtop, vbot, dmax2, rtol, interpret=interpret,
+            polish=polish, bf16_gram=bf16_gram)
+        top, bot = sched.rotate_blocks(top, bot)
+        if with_v:
+            vtop, vbot = sched.rotate_blocks(vtop, vbot)
+        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
+
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
+    init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
+    (top, bot, vtop, vbot, off), _ = jax.lax.scan(
+        body, init, None, length=sched.num_rounds(2 * k))
+    return top, bot, (vtop if with_v else None), (vbot if with_v else None), off
+
+
+def _global_dmax2(top, bot):
+    acc = jnp.promote_types(top.dtype, jnp.float32)
+    return jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
+                       jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
+
+
+def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
+            bulk_bf16):
+    """Sweep until the masked coupling drops below ``tol``.
+
+    Two phases when ``bulk_bf16``: bf16-Gram sweeps down to BULK_TOL, then
+    full-precision sweeps to ``tol``. ``max_sweeps`` is a TOTAL budget.
+    """
+    with_v = vtop is not None
+    k = top.shape[0]
+    if vtop is None:
+        vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
+
+    def phase(state, stop_tol, rtol, bf16_gram):
+        def cond(st):
+            _, _, _, _, off, sweeps = st
+            return jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
+
+        def body(st):
+            top, bot, vtop, vbot, _, sweeps = st
+            dmax2 = _global_dmax2(top, bot)
+            top, bot, nvt, nvb, off = sweep(
+                top, bot, vtop if with_v else None, vbot if with_v else None,
+                dmax2, rtol, interpret=interpret, polish=polish,
+                bf16_gram=bf16_gram)
+            if not with_v:
+                nvt, nvb = st[2], st[3]
+            return (top, bot, nvt, nvb, off, sweeps + 1)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    inf = jnp.float32(jnp.inf)
+    state = (top, bot, vtop, vbot, inf, jnp.int32(0))
+    bulk_off = inf
+    bulk_sweeps = jnp.int32(0)
+    if bulk_bf16:
+        state = phase(state, jnp.float32(BULK_TOL), BULK_TOL, True)
+        bulk_off, bulk_sweeps = state[4], state[5]
+        # Reset the off carry so the full-precision phase re-measures.
+        state = (state[0], state[1], state[2], state[3], inf, state[5])
+    top, bot, vtop, vbot, off, sweeps = phase(state, tol, tol, False)
+    # If the bulk phase consumed the whole budget, report its statistic
+    # rather than the untouched inf carry (cf. solver._svd_padded hybrid).
+    off = jnp.where(sweeps > bulk_sweeps, off, bulk_off)
+    return (top, bot, (vtop if with_v else None), (vbot if with_v else None),
+            off, sweeps)

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import SVDConfig
-from .ops import blockwise
+from .ops import blockwise, rounds
+from .ops import pallas_blocks as pb
 from .parallel import schedule as sched
 
 
@@ -101,13 +102,25 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     if method == "auto":
         if a.dtype == jnp.float64:
             method = "qr-svd"
+        elif min(m, n) >= 64:
+            # The Pallas device-kernel path (TPU fast path; interpreter on
+            # CPU backends).
+            method = "pallas"
         else:
             method = "hybrid" if compute_uv else "gram-eigh"
-    if method not in ("qr-svd", "gram-eigh", "hybrid"):
+    if method == "pallas" and a.dtype == jnp.float64:
+        raise ValueError("pair_solver='pallas' computes rotations in float32; "
+                         "use 'qr-svd' (the auto choice) for float64 inputs")
+    if method not in ("pallas", "qr-svd", "gram-eigh", "hybrid"):
         raise ValueError(f"unknown pair solver method: {method!r}")
     criterion = config.criterion
     if criterion == "auto":
         criterion = "abs" if method == "gram-eigh" else "rel"
+    if method == "pallas":
+        # The kernel path measures only the rel (dgesvj scaled-coupling)
+        # statistic; an abs-scale tolerance would be compared against the
+        # wrong quantity and could never be reached.
+        criterion = "rel"
     if criterion not in ("rel", "abs"):
         raise ValueError(f"unknown convergence criterion: {criterion!r}")
     # For "hybrid", tol/criterion describe the FINAL (polish) phase; the abs
@@ -140,12 +153,10 @@ def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
     return go
 
 
-def _global_dmax2(top, bot):
-    """Max squared column norm over both stacks (the GLOBAL deflation scale;
-    mesh callers additionally pmax this across devices)."""
-    acc = jnp.promote_types(top.dtype, jnp.float32)
-    return jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
-                       jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
+# Max squared column norm over both stacks (the GLOBAL deflation scale; mesh
+# callers additionally pmax this across devices). One definition, shared with
+# the kernel-path sweep machinery.
+_global_dmax2 = rounds._global_dmax2
 
 
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
@@ -232,6 +243,18 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
     return top, bot, (vtop if with_v else None), (vbot if with_v else None), off_rel, sweeps
 
 
+def _complete_orthonormal(u, n, dtype):
+    """Complete an economy (m, n) orthonormal factor to (m, m): QR of the
+    economy factor gives a basis whose leading columns equal u up to column
+    signs (R is diagonal +-1 for orthonormal input); fix the signs."""
+    acc = jnp.promote_types(dtype, jnp.float32)
+    q, r = jnp.linalg.qr(u.astype(acc), mode="complete")
+    signs = jnp.sign(jnp.diagonal(r))
+    signs = jnp.where(signs == 0, 1.0, signs)
+    q = q.at[:, :n].multiply(signs[None, :])
+    return q.astype(dtype)
+
+
 def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
     """sigma = column norms; sort descending; U = A_work * diag(1/sigma).
 
@@ -254,14 +277,7 @@ def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
         u = (a_sorted.astype(acc) / safe[None, :]).astype(dtype)
         u = jnp.where(s[None, :] > 0, u, jnp.zeros_like(u))
         if full_u and m > n:
-            # Complete U to m x m: QR of the economy factor gives an
-            # orthonormal basis whose leading columns equal U up to column
-            # signs (R is diagonal +-1 for orthonormal input); fix the signs.
-            q, r = jnp.linalg.qr(u.astype(acc), mode="complete")
-            signs = jnp.sign(jnp.diagonal(r))
-            signs = jnp.where(signs == 0, 1.0, signs)
-            q = q.at[:, :n].multiply(signs[None, :])
-            u = q.astype(dtype)
+            u = _complete_orthonormal(u, n, dtype)
     return u, s.astype(dtype), v
 
 
@@ -312,6 +328,66 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
     return u, s, v, sweeps, off_rel
 
 
+@partial(jax.jit, static_argnames=(
+    "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
+    "max_sweeps", "precondition", "polish", "bulk_bf16", "interpret"))
+def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
+                max_sweeps, precondition, polish, bulk_bf16, interpret):
+    """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
+
+    With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
+    columns, factor A P = Q1 R, and run one-sided Jacobi on L = R^T — graded
+    triangular factors converge in measurably fewer sweeps (15 -> 11 at
+    2048^2 f32 on v5e) and the tail couplings collapse so the round-skip
+    taper bites. Bookkeeping: L = U_L S V_L^T gives
+    A = (Q1 V_L) S (P U_L)^T, so the ROTATION product becomes U and the
+    normalized COLUMNS become V — the accumulation is only needed when U is
+    wanted, and V comes free.
+    """
+    m = a.shape[0]
+    dtype = a.dtype
+    if precondition:
+        norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
+        order = jnp.argsort(-norms)
+        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1))
+        work = r.T.astype(dtype)         # L: lower-triangular, (n, n)
+        accumulate = compute_u           # rotations -> U
+        want_cols = compute_v            # normalized columns -> V
+    else:
+        work = a
+        accumulate = compute_v
+        want_cols = compute_u
+
+    top, bot = _blockify(work, n_pad, nblocks)
+    if accumulate:
+        vtop, vbot = _blockify(jnp.eye(n_pad, dtype=dtype), n_pad, nblocks)
+    else:
+        vtop = vbot = None
+
+    top, bot, vtop, vbot, off_rel, sweeps = rounds.iterate(
+        top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
+        interpret=interpret, polish=polish, bulk_bf16=bulk_bf16)
+
+    a_work = _deblockify(top, bot)
+    v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
+    cols, s, rot = _postprocess(a_work, v_work, n, compute_u=want_cols,
+                                full_u=False, dtype=dtype)
+    if precondition:
+        u = v = None
+        if compute_u:
+            u = jnp.matmul(q1, rot, precision=jax.lax.Precision.HIGHEST
+                           ).astype(dtype)
+            if full_u and m > n:
+                u = _complete_orthonormal(u, n, dtype)
+        if compute_v:
+            v = jnp.zeros_like(cols).at[order, :].set(cols)
+        return u, s, v, sweeps, off_rel
+    u = cols
+    if compute_u and full_u and m > n and u is not None:
+        u = _complete_orthonormal(u, n, dtype)
+    return u, s, rot, sweeps, off_rel
+
+
 def svd(
     a,
     *,
@@ -348,6 +424,25 @@ def svd(
     n_pad = 2 * k * b
     tol, gram_dtype_name, method, criterion = _resolve_options(
         a, config, compute_uv=compute_u)
+
+    if method == "pallas":
+        if b % 2:
+            # The self kernel splits blocks in half: b must be even.
+            b += 1
+            k = max(1, -(-n // (2 * b)))
+            n_pad = 2 * k * b
+        precondition = (config.precondition in ("auto", "on"))
+        if config.precondition not in ("auto", "on", "off"):
+            raise ValueError(f"unknown precondition mode: {config.precondition!r}")
+        bulk_bf16 = (config.bulk_bf16 if config.bulk_bf16 is not None
+                     else n <= 2048)
+        u, s, v, sweeps, off_rel = _svd_pallas(
+            a, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
+            max_sweeps=int(config.max_sweeps), precondition=precondition,
+            polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
+            interpret=not pb.supported())
+        return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
     u, s, v, sweeps, off_rel = _svd_padded(
@@ -414,6 +509,16 @@ class SweepStepper:
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
         (self.tol, self.gram_dtype_name, self.method,
          self.criterion) = _resolve_options(a, config, compute_uv=compute_u)
+        if self.method == "pallas":
+            # Host-stepped sweeps use the XLA block solvers: the fused
+            # Pallas path keeps its whole loop in one jit and has no
+            # per-sweep host boundary to checkpoint at. Re-resolve so
+            # tolerance and criterion stay a matched pair.
+            import dataclasses as _dc
+            (self.tol, self.gram_dtype_name, self.method,
+             self.criterion) = _resolve_options(
+                a, _dc.replace(config, pair_solver="hybrid"),
+                compute_uv=compute_u)
         self.abs_tol = _abs_phase_tol(a.dtype)
         self._prev_off = float("inf")
         # Hybrid runs as two host-visible stages: "bulk" (gram-eigh/abs)
