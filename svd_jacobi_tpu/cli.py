@@ -49,7 +49,7 @@ def _parse_args(argv):
     p.add_argument("--distributed", action="store_true",
                    help="solve over a mesh of all visible devices")
     p.add_argument("--pair-solver", default="auto",
-                   choices=["auto", "qr-svd", "gram-eigh", "hybrid"])
+                   choices=["auto", "pallas", "qr-svd", "gram-eigh", "hybrid"])
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--block-size", type=int, default=None)

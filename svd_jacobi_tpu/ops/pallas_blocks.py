@@ -226,7 +226,11 @@ def _circle_masks(b2):
 def _colmove(x, y, m0, m1, mlast, axis):
     """Circle-method slot move along ``axis``: X' = [x0, y0, x1..x_{b2-2}],
     Y' = [y1..y_{b2-1}, x_{b2-1}]. Masks are lane-shaped; for axis=1 pass
-    their transposes."""
+    their transposes. Width-1 halves are a fixed point (the single pair
+    (x0, y0) just keeps meeting itself) — without this guard the m0/mlast
+    masks coincide and Y would be overwritten with X."""
+    if x.shape[axis] == 1:
+        return x, y
     xr = _roll(x, 1, axis)
     yr1 = _roll(y, 1, axis)
     new_x = m0 * x + m1 * yr1 + (1.0 - m0 - m1) * xr

@@ -166,11 +166,15 @@ def _global_dmax2(top, bot):
 
 
 def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
-            bulk_bf16):
+            bulk_bf16, stall_detection=True):
     """Sweep until the masked coupling drops below ``tol``.
 
     Two phases when ``bulk_bf16``: bf16-Gram sweeps down to BULK_TOL, then
     full-precision sweeps to ``tol``. ``max_sweeps`` is a TOTAL budget.
+    Stall detection (same constants as solver._should_continue's rel
+    branch): once the coupling is in the endgame (< 1e-4) and a sweep fails
+    to shrink it 4x, the dtype's floor is reached — stop instead of burning
+    the rest of the budget.
     """
     with_v = vtop is not None
     k = top.shape[0]
@@ -179,11 +183,15 @@ def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
 
     def phase(state, stop_tol, rtol, bf16_gram):
         def cond(st):
-            _, _, _, _, off, sweeps = st
-            return jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
+            _, _, _, _, off, prev_off, sweeps = st
+            go = jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
+            if stall_detection:
+                stalled = jnp.logical_and(off < 1e-4, off > 0.25 * prev_off)
+                go = jnp.logical_and(go, jnp.logical_not(stalled))
+            return go
 
         def body(st):
-            top, bot, vtop, vbot, _, sweeps = st
+            top, bot, vtop, vbot, prev_off, _, sweeps = st
             dmax2 = _global_dmax2(top, bot)
             top, bot, nvt, nvb, off = sweep(
                 top, bot, vtop if with_v else None, vbot if with_v else None,
@@ -191,20 +199,20 @@ def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
                 bf16_gram=bf16_gram)
             if not with_v:
                 nvt, nvb = st[2], st[3]
-            return (top, bot, nvt, nvb, off, sweeps + 1)
+            return (top, bot, nvt, nvb, off, prev_off, sweeps + 1)
 
         return jax.lax.while_loop(cond, body, state)
 
     inf = jnp.float32(jnp.inf)
-    state = (top, bot, vtop, vbot, inf, jnp.int32(0))
+    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
     bulk_off = inf
     bulk_sweeps = jnp.int32(0)
     if bulk_bf16:
         state = phase(state, jnp.float32(BULK_TOL), BULK_TOL, True)
-        bulk_off, bulk_sweeps = state[4], state[5]
-        # Reset the off carry so the full-precision phase re-measures.
-        state = (state[0], state[1], state[2], state[3], inf, state[5])
-    top, bot, vtop, vbot, off, sweeps = phase(state, tol, tol, False)
+        bulk_off, bulk_sweeps = state[4], state[6]
+        # Reset the off carries so the full-precision phase re-measures.
+        state = (state[0], state[1], state[2], state[3], inf, inf, state[6])
+    top, bot, vtop, vbot, off, _, sweeps = phase(state, tol, tol, False)
     # If the bulk phase consumed the whole budget, report its statistic
     # rather than the untouched inf carry (cf. solver._svd_padded hybrid).
     off = jnp.where(sweeps > bulk_sweeps, off, bulk_off)

@@ -36,7 +36,6 @@ into the sharding so no host ever materializes the full matrix.
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Optional
 
@@ -244,16 +243,11 @@ def svd(
     n_devices = mesh.size
     b, k = _single._plan(n, n_devices, config)
     n_pad = 2 * k * b
-    tol, gram_dtype_name, method, criterion = _single._resolve_options(
+    # The sharded sweep runs the XLA block solvers inside shard_map this
+    # round (the Pallas kernels are single-device); _resolve_xla_options
+    # maps the pallas auto-choice to its hybrid equivalent.
+    tol, gram_dtype_name, method, criterion = _single._resolve_xla_options(
         a, config, compute_uv=compute_u)
-    if method == "pallas":
-        # The sharded sweep runs the XLA block solvers inside shard_map this
-        # round (the Pallas kernels are single-device); re-resolve every
-        # option as if the user had asked for the equivalent hybrid
-        # schedule, so tolerance and criterion stay a matched pair.
-        tol, gram_dtype_name, method, criterion = _single._resolve_options(
-            a, dataclasses.replace(config, pair_solver="hybrid"),
-            compute_uv=compute_u)
 
     u, s, v, sweeps, off_rel = _svd_sharded_jit(
         a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,

@@ -131,6 +131,19 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     return float(tol), jnp.dtype(gram_dtype).name, method, criterion
 
 
+def _resolve_xla_options(a, config: SVDConfig, compute_uv: bool = True):
+    """Resolve options with the Pallas path mapped to its XLA-solver
+    equivalent (hybrid) — used by entry points that run the XLA block
+    solvers (SweepStepper's host-stepped sweeps, the sharded shard_map
+    sweep), so tolerance and criterion always form a matched pair."""
+    import dataclasses as _dc
+    tol, gram, method, criterion = _resolve_options(a, config, compute_uv)
+    if method == "pallas":
+        tol, gram, method, criterion = _resolve_options(
+            a, _dc.replace(config, pair_solver="hybrid"), compute_uv)
+    return tol, gram, method, criterion
+
+
 def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
                      stall_detection=True, criterion="rel"):
     """Sweep-loop predicate shared by both solvers: continue while above tol,
@@ -330,9 +343,11 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
 
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
-    "max_sweeps", "precondition", "polish", "bulk_bf16", "interpret"))
+    "max_sweeps", "precondition", "polish", "bulk_bf16", "interpret",
+    "stall_detection"))
 def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
-                max_sweeps, precondition, polish, bulk_bf16, interpret):
+                max_sweeps, precondition, polish, bulk_bf16, interpret,
+                stall_detection=True):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -349,7 +364,10 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     if precondition:
         norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
         order = jnp.argsort(-norms)
-        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1))
+        # QR in f32 at minimum: sub-f32 dtypes have no QR kernel (LAPACK or
+        # TPU), and the factorization must be exact at working precision.
+        acc = jnp.promote_types(dtype, jnp.float32)
+        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1).astype(acc))
         work = r.T.astype(dtype)         # L: lower-triangular, (n, n)
         accumulate = compute_u           # rotations -> U
         want_cols = compute_v            # normalized columns -> V
@@ -366,7 +384,8 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
 
     top, bot, vtop, vbot, off_rel, sweeps = rounds.iterate(
         top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
-        interpret=interpret, polish=polish, bulk_bf16=bulk_bf16)
+        interpret=interpret, polish=polish, bulk_bf16=bulk_bf16,
+        stall_detection=stall_detection)
 
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
@@ -441,7 +460,8 @@ def svd(
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
             polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
-            interpret=not pb.supported())
+            interpret=not pb.supported(),
+            stall_detection=bool(config.stall_detection))
         return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
@@ -507,18 +527,12 @@ class SweepStepper:
         self.config = config
         b, k = _plan(n, 1, config)
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
+        # Host-stepped sweeps use the XLA block solvers: the fused Pallas
+        # path keeps its whole loop in one jit and has no per-sweep host
+        # boundary to checkpoint at.
         (self.tol, self.gram_dtype_name, self.method,
-         self.criterion) = _resolve_options(a, config, compute_uv=compute_u)
-        if self.method == "pallas":
-            # Host-stepped sweeps use the XLA block solvers: the fused
-            # Pallas path keeps its whole loop in one jit and has no
-            # per-sweep host boundary to checkpoint at. Re-resolve so
-            # tolerance and criterion stay a matched pair.
-            import dataclasses as _dc
-            (self.tol, self.gram_dtype_name, self.method,
-             self.criterion) = _resolve_options(
-                a, _dc.replace(config, pair_solver="hybrid"),
-                compute_uv=compute_u)
+         self.criterion) = _resolve_xla_options(a, config,
+                                                compute_uv=compute_u)
         self.abs_tol = _abs_phase_tol(a.dtype)
         self._prev_off = float("inf")
         # Hybrid runs as two host-visible stages: "bulk" (gram-eigh/abs)

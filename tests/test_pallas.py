@@ -1,0 +1,183 @@
+"""Property tests for the Pallas rotation kernels (ops/pallas_blocks.py).
+
+Run under the Pallas interpreter on the CPU test backend (tests/conftest.py);
+the compiled TPU kernel is bit-identical to `reference_cross`/`reference_self`
+by construction (same body) and is exercised on hardware by bench.py and the
+driver's entry check.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu.config import SVDConfig
+from svd_jacobi_tpu.ops import pallas_blocks as pb, rounds
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def _gram(x):
+    return jnp.einsum("kmi,kmj->kij", x, x, precision=HI,
+                      preferred_element_type=jnp.float32)
+
+
+def _rand_panels(k, m, n2, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((k, m, n2)), jnp.float32)
+
+
+@pytest.mark.parametrize("n2", [8, 32, 64])
+def test_cross_q_orthogonal(n2):
+    x = _rand_panels(3, 256, n2)
+    q = pb.cross_rotations(_gram(x), interpret=True)
+    qtq = jnp.einsum("kij,kil->kjl", q, q, precision=HI)
+    err = jnp.max(jnp.abs(qtq - jnp.eye(n2)[None]))
+    assert float(err) < 5e-6
+
+
+@pytest.mark.parametrize("n2", [8, 32, 64])
+def test_self_q_orthogonal(n2):
+    x = _rand_panels(3, 256, n2)
+    q = pb.self_rotations(_gram(x), interpret=True)
+    qtq = jnp.einsum("kij,kil->kjl", q, q, precision=HI)
+    err = jnp.max(jnp.abs(qtq - jnp.eye(n2)[None]))
+    assert float(err) < 5e-6
+
+
+def test_pallas_matches_reference_body():
+    """interpret=True pallas_call vs the pure-jnp reference: equivalent to
+    the f32 floor (op scheduling may differ slightly between the two
+    compilations, so bit-identity is not guaranteed on every backend)."""
+    g = _gram(_rand_panels(2, 128, 32))
+    assert float(jnp.max(jnp.abs(
+        pb.cross_rotations(g, interpret=True) - pb.reference_cross(g)))) < 1e-5
+    assert float(jnp.max(jnp.abs(
+        pb.self_rotations(g, interpret=True) - pb.reference_self(g)))) < 1e-5
+
+
+def test_diagonal_gram_gives_identity():
+    """Already-orthogonal columns: every rotation is skipped and the
+    tournament bookkeeping must restore the original slot order exactly —
+    Q == I bit-for-bit (this pins the roll/circle-move bookkeeping)."""
+    k, n2 = 3, 32
+    rng = np.random.default_rng(1)
+    d = jnp.asarray(rng.uniform(0.5, 2.0, (k, n2)), jnp.float32)
+    g = jnp.einsum("ki,ij->kij", d, jnp.eye(n2, dtype=jnp.float32))
+    eye = jnp.broadcast_to(jnp.eye(n2, dtype=jnp.float32)[None], (k, n2, n2))
+    assert float(jnp.max(jnp.abs(pb.cross_rotations(g, interpret=True) - eye))) == 0.0
+    assert float(jnp.max(jnp.abs(pb.self_rotations(g, interpret=True) - eye))) == 0.0
+
+
+def test_cross_contracts_coupling():
+    """One cross call reduces the cross-block coupling mass of each panel."""
+    x = _rand_panels(3, 512, 64)
+    g = _gram(x)
+    b = 32
+    q = pb.cross_rotations(g, interpret=True)
+    xn = jnp.einsum("kmi,kij->kmj", x, q, precision=HI)
+    gn = _gram(xn)
+    before = float(jnp.linalg.norm(g[:, :b, b:]))
+    after = float(jnp.linalg.norm(gn[:, :b, b:]))
+    assert after < 0.8 * before
+
+
+def test_self_sweeps_converge_as_eigensolver():
+    """Iterated self rounds diagonalize the panel Gram (block Jacobi on a
+    single block is a full Jacobi eigensolver)."""
+    x = _rand_panels(2, 256, 32, seed=3)
+    for _ in range(8):
+        q = pb.self_rotations(_gram(x), interpret=True)
+        x = jnp.einsum("kmi,kij->kmj", x, q, precision=HI)
+    g = _gram(x)
+    off = jnp.max(jnp.abs(g * (1 - jnp.eye(32)[None])))
+    scale = jnp.max(jnp.abs(g))
+    assert float(off / scale) < 1e-5
+
+
+def test_panel_stats_masked_vs_unmasked():
+    """A numerically-null column is deflated from the masked stat but not
+    the skip stat; exactly-zero columns contribute to neither."""
+    m, n2 = 128, 8
+    rng = np.random.default_rng(4)
+    x = np.asarray(rng.standard_normal((1, m, n2)), np.float32)
+    x[:, :, 5] = x[:, :, 0] * 1e-8          # null-norm column, coupled to col 0
+    x[:, :, 7] = 0.0                        # exactly-zero (padding) column
+    g = _gram(jnp.asarray(x))
+    dmax2 = jnp.max(jnp.diagonal(g[0]))
+    masked, unmasked = rounds.panel_stats(g, dmax2)
+    assert float(unmasked) > 0.9            # sees the parallel null column
+    # The masked stat deflates that ~1.0 pair; what remains is the ordinary
+    # O(1/sqrt(m)) mutual coherence of the random live columns.
+    assert float(masked) < 0.5
+    # zero column contributed nothing (no NaN/Inf)
+    assert np.isfinite(float(masked)) and np.isfinite(float(unmasked))
+
+
+@pytest.mark.parametrize("precondition", ["on", "off"])
+def test_solver_pallas_path(precondition):
+    rng = np.random.default_rng(5)
+    n = 96
+    a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    r = sj.svd(a, config=SVDConfig(pair_solver="pallas",
+                                   precondition=precondition))
+    an = np.asarray(a, np.float64)
+    s_ref = np.linalg.svd(an, compute_uv=False)
+    un = np.asarray(r.u, np.float64)
+    vn = np.asarray(r.v, np.float64)
+    sn = np.asarray(r.s, np.float64)
+    assert np.max(np.abs(sn - s_ref)) / s_ref[0] < 5e-6
+    assert np.max(np.abs(un.T @ un - np.eye(n))) < 2e-5
+    assert np.max(np.abs(vn.T @ vn - np.eye(n))) < 2e-5
+    res = np.linalg.norm(un @ np.diag(sn) @ vn.T - an) / np.linalg.norm(an)
+    assert res < 1e-5
+
+
+def test_solver_pallas_odd_block():
+    """n that forces an odd ceil(n/nblocks): the even-b fixup must hold
+    (regression: 65x65 crashed the kernel shape check)."""
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.standard_normal((65, 65)), jnp.float32)
+    r = sj.svd(a, config=SVDConfig(pair_solver="pallas"))
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+
+
+def test_solver_pallas_bf16():
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.standard_normal((80, 64)), jnp.bfloat16)
+    r = sj.svd(a, config=SVDConfig(pair_solver="pallas"))
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert r.s.dtype == jnp.bfloat16
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 0.02
+
+
+def test_solver_pallas_novec():
+    rng = np.random.default_rng(8)
+    a = jnp.asarray(rng.standard_normal((96, 80)), jnp.float32)
+    r = sj.svd(a, compute_u=False, compute_v=False,
+               config=SVDConfig(pair_solver="pallas"))
+    assert r.u is None and r.v is None
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+
+
+def test_solver_pallas_f64_rejected():
+    a = jnp.zeros((80, 80), jnp.float32).astype(jnp.float64) \
+        if jax.config.jax_enable_x64 else None
+    if a is None:
+        pytest.skip("x64 disabled")
+    with pytest.raises(ValueError, match="float32"):
+        sj.svd(a, config=SVDConfig(pair_solver="pallas"))
+
+
+def test_solver_pallas_matches_qr_svd():
+    """The kernel path and the XLA qr-svd path agree on sigma."""
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+    r1 = sj.svd(a, config=SVDConfig(pair_solver="pallas"))
+    r2 = sj.svd(a, config=SVDConfig(pair_solver="qr-svd"))
+    smax = float(r2.s[0])
+    assert np.max(np.abs(np.asarray(r1.s, np.float64)
+                         - np.asarray(r2.s, np.float64))) / smax < 5e-6
