@@ -3,12 +3,18 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 The reference publishes no numbers (SURVEY.md section 6), so the baseline is
-self-generated on the same chip: `jnp.linalg.svd` (XLA's built-in SVD) on the
-identical input — `vs_baseline` is our speedup over it (>1 means faster).
-`value` is nominal GFLOP/s using the classic 12*n^3 full-SVD flop count
-(4mn^2 + 8n^3 at m = n), so runs at different sizes stay comparable.
+self-generated on the same device: `jnp.linalg.svd` (XLA's built-in SVD) on
+the identical input — `vs_baseline` is our speedup over it (>1 means faster).
+`value` is nominal GFLOP/s using the classic full-SVD flop count
+4*m*n^2 + 8*n^3 (= 12 n^3 at m = n), so runs at different shapes stay
+comparable; `mfu` relates that to the chip's f32-effective peak.
 
-Usage: python bench.py [N] [dtype]   (defaults: 2048, float32)
+Usage:
+  python bench.py [N] [dtype] [M]      (defaults: 2048, float32, M=N)
+  flags: --baseline=xla|numpy    (numpy: for CPU-backend parity runs)
+         --oracle=auto|on|off    (off skips the host f64 sigma oracle;
+                                  auto skips it above 2048)
+         --reps=K                (best-of-K timing, default 4)
 """
 
 from __future__ import annotations
@@ -19,54 +25,99 @@ import time
 
 import numpy as np
 
+# TPU v5e single-chip peak: 197 TFLOP/s bf16. The solver's MXU work runs
+# f32-in/f32-acc (bf16x6 passes) => f32-effective peak ~= 197/6 ~= 32.8 TF/s.
+_PEAK_F32_EFF = 197e12 / 6
+
 
 def _force(tree):
     from svd_jacobi_tpu.utils._exec import force
     return force(tree)
 
 
-def _time(f, *args, reps: int = 2) -> float:
-    """Best-of-reps device wall time."""
-    _force(f(*args))  # compile + warm
+def _time(f, *args, reps: int = 2):
+    """(best_time, warm_result): best-of-reps device wall time, forced by
+    scalar readback; the warm-up call's result is returned so callers do
+    not pay an extra full solve to get the factors."""
+    warm = f(*args)
+    _force(warm)  # compile + warm
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         _force(f(*args))
         best = min(best, time.perf_counter() - t0)
-    return best
+    return best, warm
 
 
 def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-    dtype_name = sys.argv[2] if len(sys.argv) > 2 else "float32"
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
+                 for f in sys.argv[1:] if f.startswith("--"))
+    n = int(args[0]) if len(args) > 0 else 2048
+    dtype_name = args[1] if len(args) > 1 else "float32"
+    m = int(args[2]) if len(args) > 2 else n
+    baseline = flags.get("baseline", "xla")
+    oracle = flags.get("oracle", "auto")
+    reps = int(flags.get("reps", "4"))
+
+    import os
 
     import jax
+
+    # The axon TPU plugin ignores JAX_PLATFORMS from the environment; honor
+    # it through the config API so CPU-parity rows of the baseline table
+    # (JAX_PLATFORMS=cpu python bench.py ... --baseline=numpy) really run
+    # on CPU.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if dtype_name == "float64":
+        jax.config.update("jax_enable_x64", True)
+
     import jax.numpy as jnp
     import svd_jacobi_tpu as sj
     from svd_jacobi_tpu.utils import matgen, validation
 
     dtype = jnp.dtype(dtype_name)
-    a = matgen.random_dense(n, n, dtype=dtype)
+    a = matgen.random_dense(m, n, dtype=dtype)
 
-    t_ours = _time(lambda x: tuple(sj.svd(x)[:3]), a)
-    t_xla = _time(lambda x: jnp.linalg.svd(x, compute_uv=True), a)
+    t_ours, r = _time(lambda x: sj.svd(x), a, reps=reps)
+    if baseline == "numpy":
+        an = np.asarray(a)
+        t_base = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            np.linalg.svd(an, full_matrices=False)
+            t_base = min(t_base, time.perf_counter() - t0)
+        base_name = "numpy.linalg.svd same host"
+    else:
+        t_base, _ = _time(lambda x: jnp.linalg.svd(x, full_matrices=False), a,
+                          reps=reps)
+        base_name = "jnp.linalg.svd same device"
 
-    r = sj.svd(a)
-    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
-    sigma_err = float(validation.sigma_error(r.s, s_ref))
+    # Residual computed ON DEVICE at pinned precision (a host transfer of
+    # the factors through the tunnel would dominate at large N).
+    res = float(np.asarray(validation.relative_residual(a, r.u, r.s, r.v)))
+    extras = {"residual_rel": res}
+    if oracle == "auto":
+        oracle = "on" if max(m, n) <= 2048 else "off"
+    if oracle == "on":
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        extras["sigma_err"] = float(validation.sigma_error(r.s, s_ref))
 
-    flops = 12.0 * n**3  # nominal full-SVD flop count (4mn^2 + 8n^3, m = n)
+    flops = 4.0 * m * n**2 + 8.0 * n**3
+    gflops = flops / t_ours / 1e9
     print(json.dumps({
-        "metric": f"svd_{n}x{n}_{dtype_name}_gflops",
-        "value": round(flops / t_ours / 1e9, 2),
+        "metric": f"svd_{m}x{n}_{dtype_name}_gflops",
+        "value": round(gflops, 2),
         "unit": "GFLOP/s",
-        "vs_baseline": round(t_xla / t_ours, 3),
+        "vs_baseline": round(t_base / t_ours, 3),
         "time_s": round(t_ours, 4),
-        "baseline_time_s": round(t_xla, 4),
-        "baseline": "jnp.linalg.svd same chip",
+        "baseline_time_s": round(t_base, 4),
+        "baseline": base_name,
         "sweeps": int(r.sweeps),
-        "sigma_err": sigma_err,
+        "mfu": round(gflops * 1e9 / _PEAK_F32_EFF, 4),
         "device": str(jax.devices()[0]),
+        **extras,
     }))
 
 

@@ -132,17 +132,25 @@ def main(argv=None) -> int:
     dtype = jnp.dtype(args.dtype)
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
                           tol=args.tol, pair_solver=args.pair_solver)
-    devices = jax.devices()
-    log(f"devices: {devices}")
 
     mesh = None
+    ctx = None
     if args.distributed:
+        # Multi-host bootstrap MUST run before anything touches the XLA
+        # backend (jax.devices() below included): jax.distributed.initialize
+        # raises "must be called before any JAX calls" otherwise, and the
+        # program would silently degrade to independent single-host solves.
         from svd_jacobi_tpu.parallel import launch, sharded
-        ctx = launch.initialize()  # multi-host bootstrap; no-op single-host
+        ctx = launch.initialize()
         if ctx.process_count > 1:
             log(f"process {ctx.process_index}/{ctx.process_count}, "
                 f"{ctx.local_device_count} local / "
                 f"{ctx.global_device_count} global devices")
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+
+    if args.distributed:
         mesh = sharded.make_mesh()
         log(f"mesh: {mesh}")
 
@@ -200,19 +208,30 @@ def main(argv=None) -> int:
     log(f"solve {m}x{n}: time={solve_time:.3f}s sweeps={int(r.sweeps)} "
         f"residual={float(rep.residual_rel):.3e}")
 
+    multiproc = ctx is not None and ctx.process_count > 1
     if args.oracle:
-        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
-        report["solve"]["sigma_err"] = float(validation.sigma_error(r.s, s_ref))
-        log(f"sigma_err vs numpy: {report['solve']['sigma_err']:.3e}")
+        if multiproc:
+            # The global matrix is not fully addressable on any one process;
+            # np.asarray(a) would raise. (Gatherable via multihost_utils, but
+            # the host oracle at pod scale is not meaningful anyway.)
+            log("--oracle skipped: not supported with multi-process runs")
+        else:
+            s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+            report["solve"]["sigma_err"] = float(
+                validation.sigma_error(r.s, s_ref))
+            log(f"sigma_err vs numpy: {report['solve']['sigma_err']:.3e}")
 
     # Report file — JSON successor of the reference's
     # `reporte-dimension-<N>-time-<timestamp>.txt` (main.cu:1667-1669).
-    stamp = datetime.datetime.now().strftime("%d-%m-%Y-%H-%M-%S")
-    report_dir = Path(args.report_dir)
-    report_dir.mkdir(parents=True, exist_ok=True)
-    path = report_dir / f"report-dimension-{n}-time-{stamp}.json"
-    path.write_text(json.dumps(report, indent=2))
-    log(f"report: {path}")
+    # Only the coordinator writes (every process would race on the same
+    # file otherwise); all processes still print their solve line.
+    if ctx is None or ctx.is_coordinator:
+        stamp = datetime.datetime.now().strftime("%d-%m-%Y-%H-%M-%S")
+        report_dir = Path(args.report_dir)
+        report_dir.mkdir(parents=True, exist_ok=True)
+        path = report_dir / f"report-dimension-{n}-time-{stamp}.json"
+        path.write_text(json.dumps(report, indent=2))
+        log(f"report: {path}")
     print(json.dumps(report["solve"]))
     return 0
 

@@ -82,15 +82,25 @@ def initialize(
                 local_device_ids=local_device_ids,
             )
         except RuntimeError as e:
-            # Benign double-init (library + app both bootstrapping), or a
-            # backend already started before an *auto-detected* (not
-            # explicitly requested) cluster env — e.g. a single-worker dev
-            # attachment that still advertises TPU metadata. Explicit
-            # requests always surface the error.
-            benign = ("already initialized" in str(e)
-                      or (not explicit
-                          and "must be called before" in str(e)))
-            if not benign:
+            # Benign: double-init (library + app both bootstrapping).
+            # NOT silently benign: the backend was already initialized
+            # before we ran — the bootstrap cannot take effect and a
+            # multi-node job would degrade to independent single-host
+            # solves. Raise for explicit requests; warn LOUDLY for
+            # auto-detected cluster envs (which can also be false
+            # positives, e.g. a non-JAX SLURM allocation).
+            if "already initialized" in str(e):
+                pass
+            elif not explicit and "must be called before" in str(e):
+                import warnings
+                warnings.warn(
+                    "jax.distributed.initialize was skipped because the XLA "
+                    "backend is already initialized (a JAX call ran before "
+                    "launch.initialize()). If this is a multi-process job, "
+                    "each process is now running an INDEPENDENT solve — "
+                    "call launch.initialize() before any other JAX use.",
+                    RuntimeWarning, stacklevel=2)
+            else:
                 raise
     return DistributedContext(
         process_index=jax.process_index(),

@@ -539,6 +539,27 @@ class SweepStepper:
         # then "polish" (qr-svd/rel). Non-hybrid methods have one stage.
         self._stage = "bulk" if self.method == "hybrid" else "single"
         self._just_switched = False
+        self._input_digest = None
+
+    def input_digest(self) -> str:
+        """Content hash of the input matrix, computed ONCE and cached (a
+        full device->host transfer + SHA-256 per snapshot would rival the
+        cost of the sweep being checkpointed at large sizes)."""
+        if self._input_digest is None:
+            import hashlib
+            self._input_digest = hashlib.sha256(
+                np.ascontiguousarray(np.asarray(self.a)).tobytes()).hexdigest()
+        return self._input_digest
+
+    def fingerprint_extra(self) -> dict:
+        """Extra identity fields for checkpoint validation (mesh shape for
+        the sharded subclass)."""
+        return {}
+
+    def reshard(self, state: "SweepState") -> "SweepState":
+        """Hook for subclasses to re-pin loaded snapshot arrays to their
+        sharding; identity on a single device."""
+        return state
 
     def init(self) -> SweepState:
         top, bot = _blockify(self.a, self.n_pad, self.nblocks)
@@ -568,6 +589,10 @@ class SweepStepper:
             self._just_switched = False
         else:
             self._prev_off = float(state.off_rel)
+        return self._run_sweep(state, method, criterion)
+
+    def _run_sweep(self, state: SweepState, method, criterion) -> SweepState:
+        """One jitted sweep — the only piece mesh subclasses override."""
         top, bot, vtop, vbot, off = _sweep_step_jit(
             state.top, state.bot, state.vtop, state.vbot,
             with_v=self.compute_v, precision=self.config.matmul_precision,

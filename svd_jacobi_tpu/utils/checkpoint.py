@@ -15,7 +15,6 @@ Usage:
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import tempfile
@@ -31,25 +30,21 @@ from ..solver import SVDResult, SweepState, SweepStepper
 _FORMAT = 2
 
 
-def _input_digest(a) -> str:
-    """Content hash of the input matrix, so a stale checkpoint from a
-    *different* matrix with the same layout (common when a parameter sweep
-    reuses one path) is rejected instead of silently yielding the wrong
-    factors."""
-    return hashlib.sha256(np.ascontiguousarray(np.asarray(a)).tobytes()).hexdigest()
-
-
 def _fingerprint(stepper: SweepStepper) -> dict:
+    # The input content hash rejects a stale checkpoint from a *different*
+    # matrix with the same layout (common when a parameter sweep reuses one
+    # path); it is computed once and cached on the stepper.
     return {
         "format": _FORMAT,
         "m": stepper.m, "n": stepper.n, "n_pad": stepper.n_pad,
         "nblocks": stepper.nblocks,
         "dtype": str(stepper.a.dtype),
-        "input_sha256": _input_digest(stepper.a),
+        "input_sha256": stepper.input_digest(),
         "compute_u": stepper.compute_u, "compute_v": stepper.compute_v,
         "full_matrices": stepper.full_matrices,
         "config": dataclasses.asdict(stepper.config),
         "stage": stepper._stage,
+        **stepper.fingerprint_extra(),
     }
 
 
@@ -95,7 +90,7 @@ def load_state(path, stepper: SweepStepper) -> SweepState:
             vtop=jnp.asarray(z["vtop"], dtype), vbot=jnp.asarray(z["vbot"], dtype),
             off_rel=jnp.float32(z["off_rel"]), sweeps=jnp.int32(z["sweeps"]))
     stepper._stage = stage
-    return state
+    return stepper.reshard(state)
 
 
 def svd_checkpointed(
@@ -103,6 +98,7 @@ def svd_checkpointed(
     *,
     path,
     every: int = 1,
+    mesh=None,
     compute_u: bool = True,
     compute_v: bool = True,
     full_matrices: bool = False,
@@ -114,16 +110,28 @@ def svd_checkpointed(
     If ``path`` exists, the solve resumes from it (validating shape/config);
     otherwise it starts fresh. A snapshot is written every ``every`` sweeps;
     the file is removed on successful completion unless ``keep``.
+
+    ``mesh``: run the solve sharded over the given device mesh (the sharded
+    `parallel.sharded.SweepStepper`); snapshots validate the mesh shape on
+    resume. Single-controller scope (snapshots use fully-addressable
+    arrays).
     """
     a = jnp.asarray(a)
     if a.ndim == 2 and a.shape[0] < a.shape[1]:
-        r = svd_checkpointed(a.T, path=path, every=every, compute_u=compute_v,
+        r = svd_checkpointed(a.T, path=path, every=every, mesh=mesh,
+                             compute_u=compute_v,
                              compute_v=compute_u, full_matrices=full_matrices,
                              config=config, keep=keep)
         return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
                          off_rel=r.off_rel)
-    stepper = SweepStepper(a, compute_u=compute_u, compute_v=compute_v,
-                           full_matrices=full_matrices, config=config)
+    if mesh is not None:
+        from ..parallel import sharded as _sharded
+        stepper = _sharded.SweepStepper(
+            a, mesh=mesh, compute_u=compute_u, compute_v=compute_v,
+            full_matrices=full_matrices, config=config)
+    else:
+        stepper = SweepStepper(a, compute_u=compute_u, compute_v=compute_v,
+                               full_matrices=full_matrices, config=config)
     path = Path(path)
     if path.exists():
         state = load_state(path, stepper)

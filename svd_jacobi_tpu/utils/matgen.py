@@ -52,6 +52,12 @@ def with_known_spectrum(m: int, n: int, singular_values, *,
     return (q1 * s[None, :]) @ q2.T
 
 
+# Generation granularity for sharded_random: values are generated per
+# GRAIN x GRAIN subtile keyed by the subtile's GLOBAL origin, so the matrix
+# is a pure function of (seed, m, n) — bit-identical across mesh shapes.
+GRAIN = 128
+
+
 def sharded_random(m: int, n: int, sharding, *, seed: int = DEFAULT_SEED,
                    dtype=jnp.float32, triangular: bool = False) -> jax.Array:
     """Generate a matrix directly into ``sharding`` (host-sharded on
@@ -59,24 +65,37 @@ def sharded_random(m: int, n: int, sharding, *, seed: int = DEFAULT_SEED,
 
     TPU-native replacement for root-rank generation + scatter
     (main.cu:1548-1567): `jax.make_array_from_callback` asks each device for
-    its own tile, generated reproducibly with `jax.random.fold_in` on the
-    tile origin. Deterministic for a fixed (seed, sharding layout); note the
-    values DO depend on the shard decomposition — use `random_dense` when
-    bit-identical inputs across different mesh shapes are required.
+    its own tile. Each value is drawn from a key folded on the GLOBAL
+    128-aligned subtile origin (not the shard origin), so the generated
+    matrix is DECOMPOSITION-INVARIANT: the same (seed, m, n) produces
+    bit-identical values on any mesh shape, on one device, or across hosts —
+    distributed and single-chip benchmarks solve the same matrix.
 
     ``triangular=True`` zeroes the strictly-lower part per tile, producing
     the reference's upper-triangular benchmark input (main.cu:1558-1567)
     without any host materializing the full matrix.
     """
     shape = (m, n)
+    base = jax.random.PRNGKey(seed)
+
+    def _subtile(r, c):
+        key = jax.random.fold_in(jax.random.fold_in(base, r), c)
+        return jax.random.uniform(key, (GRAIN, GRAIN), dtype=dtype)
 
     def tile(index):
         row = index[0].start or 0
         col = index[1].start or 0
-        h = (index[0].stop or m) - row
-        w = (index[1].stop or n) - col
-        key = jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(seed), row), col)
-        t = jax.random.uniform(key, (h, w), dtype=dtype)
+        h = (index[0].stop if index[0].stop is not None else m) - row
+        w = (index[1].stop if index[1].stop is not None else n) - col
+        r0 = (row // GRAIN) * GRAIN
+        c0 = (col // GRAIN) * GRAIN
+        nr = -(-(row + h - r0) // GRAIN)
+        nc = -(-(col + w - c0) // GRAIN)
+        rs = r0 + GRAIN * jnp.arange(nr)
+        cs = c0 + GRAIN * jnp.arange(nc)
+        grid = jax.vmap(lambda r: jax.vmap(lambda c: _subtile(r, c))(cs))(rs)
+        full = grid.transpose(0, 2, 1, 3).reshape(nr * GRAIN, nc * GRAIN)
+        t = jax.lax.dynamic_slice(full, (row - r0, col - c0), (h, w))
         if triangular:
             rows = row + jnp.arange(h)[:, None]
             cols = col + jnp.arange(w)[None, :]

@@ -63,6 +63,7 @@ def _sync(x) -> float:
 def instrumented_svd(
     a,
     *,
+    mesh=None,
     compute_u: bool = True,
     compute_v: bool = True,
     full_matrices: bool = False,
@@ -72,17 +73,25 @@ def instrumented_svd(
 
     Runs one jitted sweep per host step, so each record's wall time is the
     real device time of that sweep (first sweep of each stage includes its
-    compilation)."""
+    compilation). ``mesh``: instrument the SHARDED solve over the given
+    device mesh instead of the single-device one.
+    """
     import jax.numpy as jnp
     a = jnp.asarray(a)
     if a.ndim == 2 and a.shape[0] < a.shape[1]:
-        r, log = instrumented_svd(a.T, compute_u=compute_v,
+        r, log = instrumented_svd(a.T, mesh=mesh, compute_u=compute_v,
                                   compute_v=compute_u,
                                   full_matrices=full_matrices, config=config)
         return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
                          off_rel=r.off_rel), log
-    stepper = SweepStepper(a, compute_u=compute_u, compute_v=compute_v,
-                           full_matrices=full_matrices, config=config)
+    if mesh is not None:
+        from ..parallel import sharded as _sharded
+        stepper = _sharded.SweepStepper(
+            a, mesh=mesh, compute_u=compute_u, compute_v=compute_v,
+            full_matrices=full_matrices, config=config)
+    else:
+        stepper = SweepStepper(a, compute_u=compute_u, compute_v=compute_v,
+                               full_matrices=full_matrices, config=config)
     state = stepper.init()
     records: List[SweepRecord] = []
     t_all = time.perf_counter()

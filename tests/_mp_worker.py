@@ -1,0 +1,46 @@
+"""Worker process for tests/test_multiprocess.py — NOT a test module.
+
+Runs one process of a 2-process CPU JAX cluster: bootstraps via
+launch.initialize (the real jax.distributed.initialize branch, the one the
+reference exercised by running on 2 MPI nodes, main.cu:1427-1442), builds
+the global mesh, runs the sharded solve on a decomposition-invariant input,
+and (on the coordinator) writes sigma to a file for the parent to check.
+"""
+
+import os
+import sys
+
+
+def main():
+    coord, pid, nproc, outfile = sys.argv[1:5]
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from svd_jacobi_tpu.parallel import launch, sharded
+
+    ctx = launch.initialize(coordinator_address=coord,
+                            num_processes=int(nproc),
+                            process_id=int(pid))
+    assert ctx.process_count == int(nproc), ctx
+    assert ctx.global_device_count == 2 * int(nproc), ctx
+
+    mesh = sharded.make_mesh()
+    a = launch.sharded_input(96, 96, mesh, seed=11)
+    r = sharded.svd(a, mesh=mesh)
+    s = [float(x) for x in r.s]  # sigma is replicated -> addressable everywhere
+
+    if ctx.is_coordinator:
+        import json
+        with open(outfile, "w") as f:
+            json.dump({"s": s, "sweeps": int(r.sweeps),
+                       "off": float(r.off_rel),
+                       "process_count": ctx.process_count,
+                       "global_devices": ctx.global_device_count}, f)
+    print(f"worker {pid} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
