@@ -55,7 +55,9 @@ class SVDConfig:
     # hundreds of applied rotations for ~5% kernel cost).
     kernel_polish: bool = True
     # bf16 Gram panels for the bulk phase (angles/stats only; applies stay
-    # f32). None = auto (on for n <= 2048, where the gram share is largest).
+    # f32). None = auto (on for n <= 2048, where the gram share is largest
+    # and it wins; off above, where the extra sweeps it causes cost more).
+    # Single-chip path only; the sharded solve runs full-precision grams.
     bulk_bf16: Optional[bool] = None
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2

@@ -50,7 +50,39 @@ def _roll(x, shift, axis):
         return jnp.roll(x, shift, axis=axis)
 
 
-def _cross_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps):
+def _read(ref, strip_vma):
+    """Read a kernel ref, optionally stripping the mesh-variance tag.
+
+    COMPILED kernels are traced with variance checking OFF, so computed
+    values carry no {V} tag — but a bare ref read DOES keep the caller's
+    tag (and a same-dtype astype is a no-op that preserves it), making
+    fori_loop carries type-inconsistent; one multiply re-derives the value
+    through an op so its aval matches everything else in the kernel.
+    INTERPRETED kernels evaluate under full variance semantics where that
+    same multiply is a varying/invarying mismatch — so there we must NOT
+    strip."""
+    x = ref[...].astype(jnp.float32)
+    return x * jnp.float32(1.0) if strip_vma else x
+
+
+def _maybe_pvary(xs, vma):
+    """INTERPRETED kernels evaluate under full variance semantics: computed
+    values (identity inits, rolls of them) start unvarying and must be
+    pvary'd onto the mesh axes to keep fori_loop carries type-consistent.
+    (Compiled kernels instead strip the tag at the ref reads — `_read` —
+    because pvary has no Mosaic lowering.)"""
+    if not vma:
+        return xs
+
+    def cast(x):
+        have = getattr(jax.typeof(x), "vma", frozenset())
+        need = tuple(a for a in vma if a not in have)
+        return jax.lax.pcast(x, need, to="varying") if need else x
+
+    return tuple(cast(x) for x in xs)
+
+
+def _cross_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps, vma=None):
     """Run ``n_steps`` cyclic cross-rotation steps on the 4-block panels.
 
     All six arrays are (kb, *, *); the aligned pairing couples column i of
@@ -89,16 +121,16 @@ def _cross_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps):
         ct = _roll(ct, -1, 1)
         gyy = _roll(_roll(gyy, -1, 1), -1, 2)
         qy = _roll(qy, -1, 2)
-        return gxx, c, ct, gyy, qx, qy
+        return _maybe_pvary((gxx, c, ct, gyy, qx, qy), vma)
 
+    init = _maybe_pvary((gxx, c, ct, gyy, qx, qy), vma)
     # Unroll pairs of steps per loop iteration: shortens the per-iteration
     # bookkeeping and gives Mosaic a longer straight-line region to schedule
     # (the chain itself is sequential; the win is reduced loop overhead).
     if n_steps % 2 == 0:
         return jax.lax.fori_loop(
-            0, n_steps // 2, lambda i, cc: step(i, step(i, cc)),
-            (gxx, c, ct, gyy, qx, qy))
-    return jax.lax.fori_loop(0, n_steps, step, (gxx, c, ct, gyy, qx, qy))
+            0, n_steps // 2, lambda i, cc: step(i, step(i, cc)), init)
+    return jax.lax.fori_loop(0, n_steps, step, init)
 
 
 
@@ -126,7 +158,7 @@ def _polish_blocks(qx, qy):
 
 
 def _cross_kernel(gxx_ref, c_ref, ct_ref, gyy_ref, qx_ref, qy_ref, *, n_steps,
-                  polish):
+                  polish, strip_vma=False, vma=None):
     f32 = jnp.float32
     kb, b, _ = gxx_ref.shape
     rows = jax.lax.broadcasted_iota(jnp.int32, (2 * b, b), 0)
@@ -134,39 +166,49 @@ def _cross_kernel(gxx_ref, c_ref, ct_ref, gyy_ref, qx_ref, qy_ref, *, n_steps,
     qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (kb, 2 * b, b))
     qy0 = jnp.broadcast_to((rows == cols + b).astype(f32)[None], (kb, 2 * b, b))
     _, _, _, _, qx, qy = _cross_blocks_body(
-        gxx_ref[...].astype(f32), c_ref[...].astype(f32),
-        ct_ref[...].astype(f32), gyy_ref[...].astype(f32),
-        qx0, qy0, n_steps)
+        _read(gxx_ref, strip_vma), _read(c_ref, strip_vma),
+        _read(ct_ref, strip_vma), _read(gyy_ref, strip_vma),
+        qx0, qy0, n_steps, vma=vma)
     if polish:
-        qx, qy = _polish_blocks(qx, qy)
+        qx, qy = _maybe_pvary(_polish_blocks(qx, qy), vma)
     qx_ref[...] = qx
     qy_ref[...] = qy
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "block_k", "passes",
-                                              "polish"))
+                                              "polish", "vma"))
 def _cross_call(gxx, c, ct, gyy, *, interpret: bool, block_k: int, passes: int,
-                polish: bool):
+                polish: bool, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     k, b, _ = gxx.shape
     kernel = functools.partial(_cross_kernel, n_steps=passes * b,
-                               polish=polish)
+                               polish=polish, strip_vma=not interpret,
+                               vma=vma if interpret else None)
     spec_in = pl.BlockSpec((block_k, b, b), lambda i: (i, 0, 0),
                            memory_space=pltpu.VMEM)
     spec_out = pl.BlockSpec((block_k, 2 * b, b), lambda i: (i, 0, 0),
                             memory_space=pltpu.VMEM)
     f32 = jnp.float32
+    out = _out_struct((k, 2 * b, b), f32, vma)
     qx, qy = pl.pallas_call(
         kernel,
         grid=(k // block_k,),
         in_specs=[spec_in] * 4,
         out_specs=[spec_out] * 2,
-        out_shape=[jax.ShapeDtypeStruct((k, 2 * b, b), f32)] * 2,
+        out_shape=[out] * 2,
         interpret=interpret,
     )(gxx.astype(f32), c.astype(f32), ct.astype(f32), gyy.astype(f32))
     return qx, qy
+
+
+def _out_struct(shape, dtype, vma):
+    """Output aval for pallas_call; under shard_map with variance checking
+    the result's varying mesh axes must be declared explicitly."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
 
 
 def supported(platform: str | None = None) -> bool:
@@ -190,7 +232,7 @@ def _pick_block_k(k: int, b: int, factor: int = 24) -> int:
 
 def cross_rotations(g: jax.Array, *, interpret: bool | None = None,
                     block_k: int | None = None, passes: int = 1,
-                    polish: bool = True) -> jax.Array:
+                    polish: bool = True, vma=None) -> jax.Array:
     """Drop-in equivalent of `pallas_jacobi2.cross_rotations` (same G in,
     same Q out), 4-block-array layout inside."""
     if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
@@ -205,7 +247,8 @@ def cross_rotations(g: jax.Array, *, interpret: bool | None = None,
     ct, gyy = g[:, b:, :b], g[:, b:, b:]
     qx, qy = _cross_call(gxx, c, ct, gyy, interpret=bool(interpret),
                          block_k=int(block_k), passes=int(passes),
-                         polish=bool(polish))
+                         polish=bool(polish),
+                         vma=tuple(vma) if vma else None)
     return jnp.concatenate([qx, qy], axis=2)
 
 
@@ -238,7 +281,7 @@ def _colmove(x, y, m0, m1, mlast, axis):
     return new_x, new_y
 
 
-def _self_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps):
+def _self_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps, vma=None):
     """n_steps circle-method tournament steps on the 4-block panels."""
     f32 = jnp.float32
     b2 = gxx.shape[-1]
@@ -268,13 +311,14 @@ def _self_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps):
         gxx, ct = _colmove(gxx, ct, m0s, m1s, mlasts, 1)
         c, gyy = _colmove(c, gyy, m0s, m1s, mlasts, 1)
         qx, qy = _colmove(qx, qy, m0, m1, mlast, 2)
-        return gxx, c, ct, gyy, qx, qy
+        return _maybe_pvary((gxx, c, ct, gyy, qx, qy), vma)
 
-    return jax.lax.fori_loop(0, n_steps, step, (gxx, c, ct, gyy, qx, qy))
+    init = _maybe_pvary((gxx, c, ct, gyy, qx, qy), vma)
+    return jax.lax.fori_loop(0, n_steps, step, init)
 
 
 def _self_kernel(gxx_ref, c_ref, ct_ref, gyy_ref, qx_ref, qy_ref, *, n_steps,
-                 polish):
+                 polish, strip_vma=False, vma=None):
     f32 = jnp.float32
     kb, b2, _ = gxx_ref.shape
     rows = jax.lax.broadcasted_iota(jnp.int32, (2 * b2, b2), 0)
@@ -282,36 +326,39 @@ def _self_kernel(gxx_ref, c_ref, ct_ref, gyy_ref, qx_ref, qy_ref, *, n_steps,
     qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (kb, 2 * b2, b2))
     qy0 = jnp.broadcast_to((rows == cols + b2).astype(f32)[None], (kb, 2 * b2, b2))
     _, _, _, _, qx, qy = _self_blocks_body(
-        gxx_ref[...].astype(f32), c_ref[...].astype(f32),
-        ct_ref[...].astype(f32), gyy_ref[...].astype(f32), qx0, qy0, n_steps)
+        _read(gxx_ref, strip_vma), _read(c_ref, strip_vma),
+        _read(ct_ref, strip_vma), _read(gyy_ref, strip_vma),
+        qx0, qy0, n_steps, vma=vma)
     if polish:
-        qx, qy = _polish_blocks(qx, qy)
+        qx, qy = _maybe_pvary(_polish_blocks(qx, qy), vma)
     qx_ref[...] = qx
     qy_ref[...] = qy
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "block_k", "passes",
-                                              "polish"))
+                                              "polish", "vma"))
 def _self_call(gxx, c, ct, gyy, *, interpret: bool, block_k: int, passes: int,
-               polish: bool):
+               polish: bool, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     k, b2, _ = gxx.shape
     kernel = functools.partial(_self_kernel,
                                n_steps=passes * max(2 * b2 - 1, 1),
-                               polish=polish)
+                               polish=polish, strip_vma=not interpret,
+                               vma=vma if interpret else None)
     spec_in = pl.BlockSpec((block_k, b2, b2), lambda i: (i, 0, 0),
                            memory_space=pltpu.VMEM)
     spec_out = pl.BlockSpec((block_k, 2 * b2, b2), lambda i: (i, 0, 0),
                             memory_space=pltpu.VMEM)
     f32 = jnp.float32
+    out = _out_struct((k, 2 * b2, b2), f32, vma)
     qx, qy = pl.pallas_call(
         kernel,
         grid=(k // block_k,),
         in_specs=[spec_in] * 4,
         out_specs=[spec_out] * 2,
-        out_shape=[jax.ShapeDtypeStruct((k, 2 * b2, b2), f32)] * 2,
+        out_shape=[out] * 2,
         interpret=interpret,
     )(gxx.astype(f32), c.astype(f32), ct.astype(f32), gyy.astype(f32))
     return qx, qy
@@ -319,7 +366,7 @@ def _self_call(gxx, c, ct, gyy, *, interpret: bool, block_k: int, passes: int,
 
 def self_rotations(g: jax.Array, *, interpret: bool | None = None,
                    block_k: int | None = None, passes: int = 1,
-                   polish: bool = True) -> jax.Array:
+                   polish: bool = True, vma=None) -> jax.Array:
     """Annihilate EVERY pair inside each (n2, n2) Gram panel exactly once
     (n2-1 circle-method steps); drop-in for `pallas_jacobi2.self_rotations`."""
     if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
@@ -333,12 +380,14 @@ def self_rotations(g: jax.Array, *, interpret: bool | None = None,
     qx, qy = _self_call(g[:, :b2, :b2], g[:, :b2, b2:], g[:, b2:, :b2],
                         g[:, b2:, b2:], interpret=bool(interpret),
                         block_k=int(block_k), passes=int(passes),
-                        polish=bool(polish))
+                        polish=bool(polish),
+                        vma=tuple(vma) if vma else None)
     return jnp.concatenate([qx, qy], axis=2)
 
 
-def reference_self(g: jax.Array) -> jax.Array:
-    """Pure-jnp reference (no Pallas) for tests."""
+def reference_self(g: jax.Array, polish: bool = False) -> jax.Array:
+    """Pure-jnp reference (no Pallas) for tests and interpreter-backend
+    mesh solves (see reference_cross)."""
     k, n2, _ = g.shape
     b2 = n2 // 2
     f32 = jnp.float32
@@ -346,15 +395,21 @@ def reference_self(g: jax.Array) -> jax.Array:
     cols = jax.lax.broadcasted_iota(jnp.int32, (2 * b2, b2), 1)
     qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (k, 2 * b2, b2))
     qy0 = jnp.broadcast_to((rows == cols + b2).astype(f32)[None], (k, 2 * b2, b2))
+    qx0 = qx0 + 0.0 * g[:, :1, :b2]
+    qy0 = qy0 + 0.0 * g[:, :1, :b2]
     _, _, _, _, qx, qy = _self_blocks_body(
         g[:, :b2, :b2].astype(f32), g[:, :b2, b2:].astype(f32),
         g[:, b2:, :b2].astype(f32), g[:, b2:, b2:].astype(f32),
         qx0, qy0, max(n2 - 1, 1))
+    if polish:
+        qx, qy = _polish_blocks(qx, qy)
     return jnp.concatenate([qx, qy], axis=2)
 
 
-def reference_cross(g: jax.Array) -> jax.Array:
-    """Pure-jnp reference (no Pallas) for tests."""
+def reference_cross(g: jax.Array, polish: bool = False) -> jax.Array:
+    """Pure-jnp reference (no Pallas) for tests — and the compute body for
+    mesh solves on interpreter backends, where plain ops keep the variance
+    types consistent that the pallas_call machinery cannot."""
     k, n2, _ = g.shape
     b = n2 // 2
     f32 = jnp.float32
@@ -362,7 +417,11 @@ def reference_cross(g: jax.Array) -> jax.Array:
     cols = jax.lax.broadcasted_iota(jnp.int32, (2 * b, b), 1)
     qx0 = jnp.broadcast_to((rows == cols).astype(f32)[None], (k, 2 * b, b))
     qy0 = jnp.broadcast_to((rows == cols + b).astype(f32)[None], (k, 2 * b, b))
+    qx0 = qx0 + 0.0 * g[:, :1, :b]   # inherit the callers' variance type
+    qy0 = qy0 + 0.0 * g[:, :1, :b]
     _, _, _, _, qx, qy = _cross_blocks_body(
         g[:, :b, :b].astype(f32), g[:, :b, b:].astype(f32),
         g[:, b:, :b].astype(f32), g[:, b:, b:].astype(f32), qx0, qy0, b)
+    if polish:
+        qx, qy = _polish_blocks(qx, qy)
     return jnp.concatenate([qx, qy], axis=2)

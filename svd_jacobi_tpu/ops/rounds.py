@@ -79,14 +79,39 @@ def panel_stats(g: jax.Array, dmax2: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return masked, unmasked
 
 
-def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram):
-    """Annihilate every within-block pair once (full tournament kernel)."""
+def _rotations(g, kind, *, interpret, polish, axis_name):
+    """Dispatch to the right rotation generator: the compiled Pallas kernel,
+    or (on interpreter backends under a mesh axis) the pure-jnp reference
+    body, which keeps shard_map variance types consistent where the
+    pallas_call machinery cannot."""
+    if axis_name is not None and interpret:
+        fn = pb.reference_self if kind == "self" else pb.reference_cross
+        return fn(g, polish=polish)
+    fn = pb.self_rotations if kind == "self" else pb.cross_rotations
+    return fn(g, interpret=interpret, polish=polish,
+              vma=(axis_name,) if axis_name is not None else None)
+
+
+def _mesh_max(x, axis_name):
+    return jax.lax.pmax(x, axis_name) if axis_name is not None else x
+
+
+def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
+               axis_name=None):
+    """Annihilate every within-block pair once (full tournament kernel).
+
+    ``axis_name``: when run under shard_map, the mesh axis — the round-skip
+    predicate and the reported stat are pmax'd so every device takes the
+    same branch and sees the global statistic.
+    """
     g = _einsum(blocks, blocks, "kmi,kmj->kij", bf16_gram)
     stat, skip = panel_stats(g, dmax2)
+    skip = _mesh_max(skip, axis_name)
 
     def do(args):
         blocks, vblocks = args
-        q = pb.self_rotations(g, interpret=interpret, polish=polish)
+        q = _rotations(g, "self", interpret=interpret, polish=polish,
+                       axis_name=axis_name)
         blocks = _einsum(blocks, q, "kmi,kij->kmj").astype(blocks.dtype)
         if vblocks is not None:
             vblocks = _einsum(vblocks, q, "kmi,kij->kmj").astype(vblocks.dtype)
@@ -94,20 +119,23 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram):
 
     blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
                                    (blocks, vblocks))
-    return blocks, vblocks, stat
+    return blocks, vblocks, _mesh_max(stat, axis_name)
 
 
 def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
-                bf16_gram):
-    """Annihilate every cross pair of each (top[i], bot[i]) block pair."""
+                bf16_gram, axis_name=None):
+    """Annihilate every cross pair of each (top[i], bot[i]) block pair.
+    ``axis_name``: see `self_round`."""
     b = top.shape[-1]
     x = jnp.concatenate([top, bot], axis=-1)
     g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
     stat, skip = panel_stats(g, dmax2)
+    skip = _mesh_max(skip, axis_name)
 
     def do(args):
         top, bot, vtop, vbot = args
-        q = pb.cross_rotations(g, interpret=interpret, polish=polish)
+        q = _rotations(g, "cross", interpret=interpret, polish=polish,
+                       axis_name=axis_name)
         xn = _einsum(jnp.concatenate([top, bot], axis=-1), q,
                      "kmi,kij->kmj").astype(top.dtype)
         top, bot = xn[..., :b], xn[..., b:]
@@ -119,7 +147,7 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
 
     top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, lambda a: a,
                                         (top, bot, vtop, vbot))
-    return top, bot, vtop, vbot, stat
+    return top, bot, vtop, vbot, _mesh_max(stat, axis_name)
 
 
 def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram):

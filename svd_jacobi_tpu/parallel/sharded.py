@@ -159,9 +159,53 @@ def _sweep_sharded(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
     return top, bot, vtop, vbot, off_rel
 
 
+def _sweep_sharded_pallas(top, bot, vtop, vbot, *, axis_name, n_devices,
+                          n_rounds, rtol, with_v, interpret, polish):
+    """One sharded sweep on the Pallas kernel path (runs under shard_map).
+
+    The round bodies are `ops.rounds.self_round`/`cross_round` with
+    ``axis_name`` set (pmax'd skip predicate and statistics); the only
+    mesh-specific piece here is the ICI ring exchange between rounds.
+    """
+    from ..ops import rounds as _rounds
+
+    dmax2 = lax.pmax(_single._global_dmax2(top, bot), axis_name)
+    k_loc = top.shape[0]
+    blocks = jnp.concatenate([top, bot], axis=0)
+    vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
+    blocks, vblocks, rel_self = _rounds.self_round(
+        blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
+        bf16_gram=False, axis_name=axis_name)
+    top, bot = blocks[:k_loc], blocks[k_loc:]
+    if with_v:
+        vtop, vbot = vblocks[:k_loc], vblocks[k_loc:]
+
+    def cross(carry, _):
+        top, bot, vtop, vbot, mx = carry
+        t, b_, nvt, nvb, stat = _rounds.cross_round(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            dmax2, rtol, interpret=interpret, polish=polish,
+            bf16_gram=False, axis_name=axis_name)
+        top, bot = t, b_
+        if with_v:
+            vtop, vbot = nvt, nvb
+        top, bot = _ring_exchange(top, bot, axis_name=axis_name,
+                                  n_devices=n_devices)
+        if with_v:
+            vtop, vbot = _ring_exchange(vtop, vbot, axis_name=axis_name,
+                                        n_devices=n_devices)
+        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
+
+    init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
+    (top, bot, vtop, vbot, off), _ = lax.scan(cross, init, None,
+                                              length=n_rounds)
+    return top, bot, vtop, vbot, off
+
+
 def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                     tol, max_sweeps, precision, gram_dtype_name, method,
-                    criterion, with_v, n_pad, nblocks, stall_detection=True):
+                    criterion, with_v, n_pad, nblocks, stall_detection=True,
+                    kernel_polish=True):
     """Body run under shard_map: while_loop(sweeps) of scan(rounds)."""
     gram_dtype = jnp.dtype(gram_dtype_name)
     if with_v:
@@ -197,6 +241,21 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
         inf = jnp.float32(jnp.inf)
         state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
         return lax.while_loop(cond, body, state)
+
+    if method == "pallas":
+        # The device-kernel path (the same kernels as the single-chip
+        # solver) sharded over the mesh: self/cross rounds run per device,
+        # the tournament rides the ICI ring, and the round-skip predicate
+        # is pmax-replicated.
+        def sweep_pallas(top, bot, vtop, vbot, _mth, _crit):
+            from ..ops import pallas_blocks as pb
+            return _sweep_sharded_pallas(
+                top, bot, vtop, vbot, axis_name=axis_name,
+                n_devices=n_devices, n_rounds=n_rounds, rtol=tol,
+                with_v=with_v, interpret=not pb.supported(),
+                polish=kernel_polish)
+
+        sweep = sweep_pallas
 
     if method == "hybrid":
         # See solver._svd_padded: abs-converged bulk phase, then a short
@@ -253,12 +312,13 @@ def svd(
     (axis_name,) = mesh.axis_names
     n_devices = mesh.size
     b, k = _single._plan(n, n_devices, config)
-    n_pad = 2 * k * b
-    # The sharded sweep runs the XLA block solvers inside shard_map this
-    # round (the Pallas kernels are single-device); _resolve_xla_options
-    # maps the pallas auto-choice to its hybrid equivalent.
-    tol, gram_dtype_name, method, criterion = _single._resolve_xla_options(
+    tol, gram_dtype_name, method, criterion = _single._resolve_options(
         a, config, compute_uv=compute_u)
+    if method == "pallas" and b % 2:
+        # The self kernel halves blocks: b must be even (keep k a multiple
+        # of the device count).
+        b += 1
+    n_pad = 2 * k * b
 
     u, s, v, sweeps, off_rel = _svd_sharded_jit(
         a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
@@ -266,17 +326,20 @@ def svd(
         full_u=full_matrices, tol=tol, max_sweeps=int(config.max_sweeps),
         precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
-        stall_detection=bool(config.stall_detection))
+        stall_detection=bool(config.stall_detection),
+        kernel_polish=bool(config.kernel_polish))
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
 @partial(jax.jit, static_argnames=(
     "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
     "compute_v", "full_u", "tol", "max_sweeps", "precision",
-    "gram_dtype_name", "method", "criterion", "stall_detection"))
+    "gram_dtype_name", "method", "criterion", "stall_detection",
+    "kernel_polish"))
 def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      compute_u, compute_v, full_u, tol, max_sweeps, precision,
-                     gram_dtype_name, method, criterion, stall_detection=True):
+                     gram_dtype_name, method, criterion, stall_detection=True,
+                     kernel_polish=True):
     m = a.shape[0]
     dtype = a.dtype
     block_spec = P(axis_name, None, None)  # shard the pair-slot axis
@@ -291,7 +354,7 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                 precision=precision, gram_dtype_name=gram_dtype_name,
                 method=method, criterion=criterion, with_v=compute_v,
                 n_pad=n_pad, nblocks=nblocks,
-                stall_detection=stall_detection),
+                stall_detection=stall_detection, kernel_polish=kernel_polish),
         mesh=mesh,
         in_specs=(block_spec,) * 2,
         out_specs=(block_spec,) * 4 + (P(), P()),

@@ -134,8 +134,9 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
 def _resolve_xla_options(a, config: SVDConfig, compute_uv: bool = True):
     """Resolve options with the Pallas path mapped to its XLA-solver
     equivalent (hybrid) — used by entry points that run the XLA block
-    solvers (SweepStepper's host-stepped sweeps, the sharded shard_map
-    sweep), so tolerance and criterion always form a matched pair."""
+    solvers (the host-stepped SweepStepper family; the fused sharded solve
+    resolves pallas natively), so tolerance and criterion always form a
+    matched pair."""
     import dataclasses as _dc
     tol, gram, method, criterion = _resolve_options(a, config, compute_uv)
     if method == "pallas":
