@@ -217,17 +217,27 @@ def supported(platform: str | None = None) -> bool:
     return platform in ("tpu", "axon")
 
 
-def _pick_block_k(k: int, b: int, factor: int = 24) -> int:
-    """Panels per grid step, bounded by scoped VMEM (~16 MB): a panel's
-    live set is 8*b^2 floats (4 G-quadrants + 2 Q halves) and Mosaic's
-    scheduling temporaries multiply that by ~3 (cross) / ~4 (self, which
-    has extra circle-move intermediates) — expressed as bytes-per-panel
-    b^2*4*factor against a 12 MB budget."""
-    budget_panels = max(1, (12 << 20) // (b * b * 4 * factor))
-    block_k = k
-    while block_k > budget_panels and block_k % 2 == 0:
-        block_k //= 2
-    return block_k
+def _pick_block_k(k: int, b: int, factor: int = 3) -> int:
+    """Panels per grid step, bounded by scoped VMEM (~16 MB).
+
+    A panel's live set is 4 (b, b) G-quadrants + 2 (2b, b) Q halves, but
+    VMEM tiles pad the LANE (last) dimension to 128 — a (32, 32) array
+    occupies a (32, 128) tile — so the per-panel footprint is
+    8 * b * max(b, 128) * 4 bytes. Mosaic's double-buffering/temporaries
+    multiply that by ~3 (cross) / ~4 (self, extra circle-move
+    intermediates); measured: 32-panel b=64 cross chunks and 64-panel
+    b2=32 self chunks both blew the 16 MB scoped limit at ~18 MB."""
+    per_panel = 8 * b * max(b, 128) * 4
+    budget_panels = max(1, (13 << 20) // (per_panel * factor))
+    if k <= budget_panels:
+        return k
+    # Largest divisor of k within budget (the grid needs block_k | k; a
+    # power-of-2-only halving would leave odd panel counts like k=17
+    # unreduced and re-blow the scoped-VMEM limit).
+    for d in range(budget_panels, 0, -1):
+        if k % d == 0:
+            return d
+    return 1
 
 
 def cross_rotations(g: jax.Array, *, interpret: bool | None = None,
@@ -374,7 +384,7 @@ def self_rotations(g: jax.Array, *, interpret: bool | None = None,
     k, n2, _ = g.shape
     b2 = n2 // 2
     if block_k is None:
-        block_k = _pick_block_k(k, b2, factor=40)
+        block_k = _pick_block_k(k, b2, factor=4)
     if interpret is None:
         interpret = not supported()
     qx, qy = _self_call(g[:, :b2, :b2], g[:, :b2, b2:], g[:, b2:, :b2],

@@ -101,8 +101,9 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
     """Annihilate every within-block pair once (full tournament kernel).
 
     ``axis_name``: when run under shard_map, the mesh axis — the round-skip
-    predicate and the reported stat are pmax'd so every device takes the
-    same branch and sees the global statistic.
+    predicate is pmax'd so every device takes the same branch. The returned
+    stat stays LOCAL (the sweep pmax's its running max once, not once per
+    round).
     """
     g = _einsum(blocks, blocks, "kmi,kmj->kij", bf16_gram)
     stat, skip = panel_stats(g, dmax2)
@@ -119,7 +120,7 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
 
     blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
                                    (blocks, vblocks))
-    return blocks, vblocks, _mesh_max(stat, axis_name)
+    return blocks, vblocks, stat
 
 
 def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
@@ -147,43 +148,56 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
 
     top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, lambda a: a,
                                         (top, bot, vtop, vbot))
-    return top, bot, vtop, vbot, _mesh_max(stat, axis_name)
+    return top, bot, vtop, vbot, stat
 
 
-def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram):
-    """One full sweep: self round + 2k-1 cross tournament rounds.
+def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
+          axis_name=None, n_rounds=None, exchange=None):
+    """One full sweep: self round + cross tournament rounds.
 
     Every pair of the n columns is annihilated exactly once: n-1 sequential
     rotation steps in total, the tournament-optimal count. Returns the max
     (deflation-masked) coupling observed across the sweep's fresh Gram
     panels — measured BEFORE each round's rotations.
+
+    Single-device default: ``sched.rotate_blocks`` between rounds. Mesh
+    callers (under shard_map) pass ``axis_name``, the global ``n_rounds``,
+    and the ICI ring ``exchange`` — the stat is pmax'd once at sweep end.
     """
     k, m, b = top.shape
     with_v = vtop is not None
+    if exchange is None:
+        exchange = sched.rotate_blocks
+    if n_rounds is None:
+        n_rounds = sched.num_rounds(2 * k)
     blocks = jnp.concatenate([top, bot], axis=0)
     vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
     blocks, vblocks, rel_self = self_round(
         blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
-        bf16_gram=bf16_gram)
+        bf16_gram=bf16_gram, axis_name=axis_name)
     top, bot = blocks[:k], blocks[k:]
     if with_v:
         vtop, vbot = vblocks[:k], vblocks[k:]
 
     def body(carry, _):
         top, bot, vtop, vbot, mx = carry
-        top, bot, vtop, vbot, stat = cross_round(
-            top, bot, vtop, vbot, dmax2, rtol, interpret=interpret,
-            polish=polish, bf16_gram=bf16_gram)
-        top, bot = sched.rotate_blocks(top, bot)
+        top, bot, nvt, nvb, stat = cross_round(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            dmax2, rtol, interpret=interpret,
+            polish=polish, bf16_gram=bf16_gram, axis_name=axis_name)
         if with_v:
-            vtop, vbot = sched.rotate_blocks(vtop, vbot)
+            vtop, vbot = nvt, nvb
+        top, bot = exchange(top, bot)
+        if with_v:
+            vtop, vbot = exchange(vtop, vbot)
         return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
 
     if not with_v:
         vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
     init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
     (top, bot, vtop, vbot, off), _ = jax.lax.scan(
-        body, init, None, length=sched.num_rounds(2 * k))
+        body, init, None, length=n_rounds)
+    off = _mesh_max(off, axis_name)
     return top, bot, (vtop if with_v else None), (vbot if with_v else None), off
 
 

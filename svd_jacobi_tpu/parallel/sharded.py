@@ -161,44 +161,20 @@ def _sweep_sharded(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
 
 def _sweep_sharded_pallas(top, bot, vtop, vbot, *, axis_name, n_devices,
                           n_rounds, rtol, with_v, interpret, polish):
-    """One sharded sweep on the Pallas kernel path (runs under shard_map).
-
-    The round bodies are `ops.rounds.self_round`/`cross_round` with
-    ``axis_name`` set (pmax'd skip predicate and statistics); the only
-    mesh-specific piece here is the ICI ring exchange between rounds.
-    """
+    """One sharded sweep on the Pallas kernel path (runs under shard_map):
+    `ops.rounds.sweep` with the mesh axis set and the ICI ring exchange as
+    the between-rounds rotation."""
     from ..ops import rounds as _rounds
 
     dmax2 = lax.pmax(_single._global_dmax2(top, bot), axis_name)
-    k_loc = top.shape[0]
-    blocks = jnp.concatenate([top, bot], axis=0)
-    vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
-    blocks, vblocks, rel_self = _rounds.self_round(
-        blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
-        bf16_gram=False, axis_name=axis_name)
-    top, bot = blocks[:k_loc], blocks[k_loc:]
+    exchange = partial(_ring_exchange, axis_name=axis_name,
+                       n_devices=n_devices)
+    top, bot, nvt, nvb, off = _rounds.sweep(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        dmax2, rtol, interpret=interpret, polish=polish, bf16_gram=False,
+        axis_name=axis_name, n_rounds=n_rounds, exchange=exchange)
     if with_v:
-        vtop, vbot = vblocks[:k_loc], vblocks[k_loc:]
-
-    def cross(carry, _):
-        top, bot, vtop, vbot, mx = carry
-        t, b_, nvt, nvb, stat = _rounds.cross_round(
-            top, bot, vtop if with_v else None, vbot if with_v else None,
-            dmax2, rtol, interpret=interpret, polish=polish,
-            bf16_gram=False, axis_name=axis_name)
-        top, bot = t, b_
-        if with_v:
-            vtop, vbot = nvt, nvb
-        top, bot = _ring_exchange(top, bot, axis_name=axis_name,
-                                  n_devices=n_devices)
-        if with_v:
-            vtop, vbot = _ring_exchange(vtop, vbot, axis_name=axis_name,
-                                        n_devices=n_devices)
-        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
-
-    init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
-    (top, bot, vtop, vbot, off), _ = lax.scan(cross, init, None,
-                                              length=n_rounds)
+        vtop, vbot = nvt, nvb
     return top, bot, vtop, vbot, off
 
 
