@@ -73,7 +73,7 @@ def initialize(
                 or num_processes is not None
                 or bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
                 or bool(os.environ.get("JAX_NUM_PROCESSES")))
-    if explicit or _cluster_env_present():
+    if (explicit or _cluster_env_present()) and not jax.distributed.is_initialized():
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
@@ -82,16 +82,14 @@ def initialize(
                 local_device_ids=local_device_ids,
             )
         except RuntimeError as e:
-            # Benign: double-init (library + app both bootstrapping).
-            # NOT silently benign: the backend was already initialized
-            # before we ran — the bootstrap cannot take effect and a
-            # multi-node job would degrade to independent single-host
-            # solves. Raise for explicit requests; warn LOUDLY for
-            # auto-detected cluster envs (which can also be false
-            # positives, e.g. a non-JAX SLURM allocation).
-            if "already initialized" in str(e):
-                pass
-            elif not explicit and "must be called before" in str(e):
+            # The backend was already initialized before we ran — the
+            # bootstrap cannot take effect and a multi-node job would
+            # degrade to independent single-host solves. Raise for explicit
+            # requests; warn LOUDLY for auto-detected cluster envs (which
+            # can also be false positives, e.g. a non-JAX SLURM
+            # allocation). Double-init is handled by the is_initialized()
+            # guard above, not exception sniffing.
+            if not explicit and "must be called before" in str(e):
                 import warnings
                 warnings.warn(
                     "jax.distributed.initialize was skipped because the XLA "
