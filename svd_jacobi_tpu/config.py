@@ -55,8 +55,10 @@ class SVDConfig:
     # hundreds of applied rotations for ~5% kernel cost).
     kernel_polish: bool = True
     # bf16 Gram panels for the bulk phase (angles/stats only; applies stay
-    # f32). None = auto (on for n <= 2048, where the gram share is largest
-    # and it wins; off above, where the extra sweeps it causes cost more).
+    # f32). None = auto (currently OFF at every size: the noisier angles
+    # cost ~2 extra sweeps, which outweighs the cheaper grams — measured at
+    # 2048^2: 0.22 s / 13 sweeps with vs 0.21 s / 11 without; same shape of
+    # result at 8192^2). Kept as an option for bandwidth-starved setups.
     # Single-chip path only; the sharded solve runs full-precision grams.
     bulk_bf16: Optional[bool] = None
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative

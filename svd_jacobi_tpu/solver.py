@@ -455,7 +455,7 @@ def svd(
         if config.precondition not in ("auto", "on", "off"):
             raise ValueError(f"unknown precondition mode: {config.precondition!r}")
         bulk_bf16 = (config.bulk_bf16 if config.bulk_bf16 is not None
-                     else n <= 2048)
+                     else False)
         u, s, v, sweeps, off_rel = _svd_pallas(
             a, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
