@@ -14,7 +14,7 @@ Usage:
   flags: --baseline=xla|numpy    (numpy: for CPU-backend parity runs)
          --oracle=auto|on|off    (off skips the host f64 sigma oracle;
                                   auto skips it above 2048)
-         --reps=K                (best-of-K timing, default 4)
+         --reps=K                (best-of-K interleaved timing, default 6)
 """
 
 from __future__ import annotations
@@ -35,18 +35,25 @@ def _force(tree):
     return force(tree)
 
 
-def _time(f, *args, reps: int = 2):
-    """(best_time, warm_result): best-of-reps device wall time, forced by
-    scalar readback; the warm-up call's result is returned so callers do
+def _time_interleaved(fns, *args, reps: int = 2):
+    """(best_times, warm_results): best-of-reps device wall time for each
+    callable, forced by scalar readback, with the timed repetitions of all
+    callables INTERLEAVED — the tunnel's latency drifts on the seconds
+    scale, so back-to-back blocks would hand whichever runs second a
+    different environment. The warm-up results are returned so callers do
     not pay an extra full solve to get the factors."""
-    warm = f(*args)
-    _force(warm)  # compile + warm
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _force(f(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best, warm
+    warms = []
+    for f in fns:
+        w = f(*args)
+        _force(w)  # compile + warm
+        warms.append(w)
+    best = [float("inf")] * len(fns)
+    for _ in range(max(1, reps)):
+        for i, f in enumerate(fns):
+            t0 = time.perf_counter()
+            _force(f(*args))
+            best[i] = min(best[i], time.perf_counter() - t0)
+    return best, warms
 
 
 def main() -> None:
@@ -58,7 +65,7 @@ def main() -> None:
     m = int(args[2]) if len(args) > 2 else n
     baseline = flags.get("baseline", "xla")
     oracle = flags.get("oracle", "auto")
-    reps = int(flags.get("reps", "4"))
+    reps = int(flags.get("reps", "6"))
 
     import os
 
@@ -80,18 +87,17 @@ def main() -> None:
     dtype = jnp.dtype(dtype_name)
     a = matgen.random_dense(m, n, dtype=dtype)
 
-    t_ours, r = _time(lambda x: sj.svd(x), a, reps=reps)
+    ours = lambda x: sj.svd(x)
     if baseline == "numpy":
         an = np.asarray(a)
-        t_base = float("inf")
-        for _ in range(max(1, reps)):
-            t0 = time.perf_counter()
-            np.linalg.svd(an, full_matrices=False)
-            t_base = min(t_base, time.perf_counter() - t0)
+        (t_ours, t_base), (r, _) = _time_interleaved(
+            [ours, lambda x: np.linalg.svd(an, full_matrices=False)], a,
+            reps=reps)
         base_name = "numpy.linalg.svd same host"
     else:
-        t_base, _ = _time(lambda x: jnp.linalg.svd(x, full_matrices=False), a,
-                          reps=reps)
+        (t_ours, t_base), (r, _) = _time_interleaved(
+            [ours, lambda x: jnp.linalg.svd(x, full_matrices=False)], a,
+            reps=reps)
         base_name = "jnp.linalg.svd same device"
 
     # Residual computed ON DEVICE at pinned precision (a host transfer of
