@@ -48,8 +48,12 @@ class SVDConfig:
     # --- Pallas-path options (pair_solver="pallas") ---
     # QR preconditioning: norm-sort columns, factor A P = Q1 R, run Jacobi
     # on L = R^T (Drmac-style: graded triangular factors converge in ~25%
-    # fewer sweeps), then U = Q1 V_L, V = P U_L. "auto" = on for m >= n.
-    precondition: str = "auto"  # "auto" | "on" | "off"
+    # fewer sweeps), then U = Q1 V_L, V = P U_L. "double" adds dgejsv's
+    # second QR (of R^T) and runs Jacobi on R2^T — fewer sweeps again on
+    # graded spectra, at the price of the extra n^3-scale QR (worthwhile
+    # only when it saves >= 2 sweeps; measured NOT worthwhile on random
+    # input, see PROFILE.md). "auto" = "on".
+    precondition: str = "auto"  # "auto" | "on" | "off" | "double"
     # One in-kernel Newton-Schulz step on each accumulated rotation Q
     # (restores orthogonality to the f32 floor; protects the residual over
     # hundreds of applied rotations for ~5% kernel cost).
@@ -61,6 +65,23 @@ class SVDConfig:
     # result at 8192^2). Kept as an option for bandwidth-starved setups.
     # Single-chip path only; the sharded solve runs full-precision grams.
     bulk_bf16: Optional[bool] = None
+    # How U is recovered on the preconditioned Pallas path. The sweep loop
+    # rotates L = R^T by an orthogonal product G (A = (Q1 G) Sigma ...):
+    #   "accumulate": carry G through every round's kernel+matmul (robust,
+    #     but doubles the loop's apply traffic);
+    #   "solve": skip the in-loop accumulation and recover G = L^{-1} W by
+    #     ONE triangular solve after convergence (dgejsv's fast path; W is
+    #     the rotated column set). One Newton-Schulz step re-orthogonalizes
+    #     G; if the pre-polish orthogonality error exceeds a gate (L too
+    #     ill-conditioned for the solve — the dgejsv COND_OK test, measured
+    #     not estimated), the solver falls back to an accumulated re-run.
+    #   "auto": currently "accumulate" at every size — measured at 8192^2
+    #     f32 on random input, the solve's verification gate fires (the
+    #     sqrt(n)*eps unconverged couplings, amplified by the scaled
+    #     condition of L, already exceed it), so the fast path would pay
+    #     for both runs. "solve" is worthwhile only when the input is known
+    #     to be modestly conditioned.
+    u_recovery: str = "auto"  # "auto" | "accumulate" | "solve"
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2
     # (LAPACK-dgesvd class). "auto" follows the pair solver.

@@ -1,0 +1,163 @@
+"""Fused pair-apply (+ tournament exchange) Pallas TPU kernel.
+
+The XLA form of a cross round's update is a chain of full-stack HBM
+round-trips per rotation round:
+
+    x  = concat([top, bot], -1)      # write + read a (k, m, 2b) copy
+    xn = x @ q                       # the only real work
+    top, bot = xn[..:b], xn[..b:]    # read + write two more copies
+    top, bot = rotate_blocks(...)    # read + write two more copies
+
+measured at 8192^2 f32 as ~190 ms/sweep of data movement against ~45 ms of
+matmul FLOPs (PROFILE.md item 8); splitting the concat into four XLA block
+matmuls makes it WORSE (the adds cannot fuse into dot epilogues — measured
+26% slower end-to-end). This kernel fuses the whole chain: each grid step
+reads the two source blocks of one output slot, computes
+
+    new_top[i] = top[pt(i)] @ qt[i][:b] + bot[pt(i)] @ qt[i][b:]
+    new_bot[i] = top[pb(i)] @ qb[i][:b] + bot[pb(i)] @ qb[i][b:]
+
+with both adds in VMEM, and writes each result DIRECTLY into its
+post-exchange slot — the (pt, pb, strip) maps encode the tournament
+rotation (parallel/schedule.py:rotate_blocks), so the separate permute
+copies disappear as well. HBM traffic per round drops from ~8 full-stack
+reads + 8 writes to 2 reads + 1 write (the two-source reads overlap).
+
+Reference lineage: this is the TPU replacement for the reference's
+per-rotation column update `jacobi_rotation` + host bookkeeping
+(lib/JacobiMethods.cu:479-510) at block granularity; the exchange fusion
+replaces its per-round re-distribution of columns (lib/JacobiMethods.cu:
+334-432) with an index-map permutation inside one kernel launch.
+
+Single-device compiled path only: the mesh solve keeps its unfused form
+(the exchange there is a `lax.ppermute` ICI hop that cannot live inside a
+kernel), and interpreter backends use the jnp reference semantics in
+ops/rounds.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def _perm_maps(k: int, exchange: bool):
+    """(pair_t, top_half_t, pair_b, top_half_b) for output slots i in [0, k).
+
+    With ``exchange``, output slot maps encode one tournament rotation
+    (schedule.rotate_blocks): new_top[0] = old pair 0's top result,
+    new_top[1] = old pair 0's bottom result, new_top[i>=2] = pair i-1's top,
+    new_bot[i<=k-2] = pair i+1's bottom, new_bot[k-1] = pair k-1's top.
+    Without it, slot i is just pair i's (top, bottom) result.
+    """
+    idx = np.arange(k)
+    if not exchange or k == 1:
+        return idx, np.ones(k, bool), idx, np.zeros(k, bool)
+    pair_t = np.where(idx <= 1, 0, idx - 1)
+    top_half_t = idx != 1
+    pair_b = np.where(idx <= k - 2, idx + 1, k - 1)
+    top_half_b = idx == k - 1
+    return pair_t, top_half_t, pair_b, top_half_b
+
+
+def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
+            out_t_ref, out_b_ref, *, b):
+    f32 = jnp.float32
+
+    def dot2(xt, xb, q):
+        mm = lambda x, w: jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())), precision=HI,
+            preferred_element_type=f32)
+        return mm(xt.astype(f32), q[:b]) + mm(xb.astype(f32), q[b:])
+
+    out_t_ref[0] = dot2(xtt_ref[0], xbt_ref[0],
+                        qt_ref[0]).astype(out_t_ref.dtype)
+    out_b_ref[0] = dot2(xtb_ref[0], xbb_ref[0],
+                        qb_ref[0]).astype(out_b_ref.dtype)
+
+
+def _chunk_limit(b: int) -> int:
+    """Row-chunk cap so one grid step fits scoped VMEM (~13 MB usable,
+    halved for Mosaic double-buffering): a step holds 6 (mc, b) x/out
+    blocks plus 2 (2b, b) q strips, all f32. Shrinks with the panel width
+    the way pallas_blocks._pick_block_k does — a user block_size of 512+
+    must not push the fused kernel over the budget the unfused path
+    respects."""
+    budget = (13 << 20) // 2
+    per_row = 6 * b * 4
+    q_bytes = 2 * (2 * b) * b * 4
+    return max(0, min(1024, (budget - q_bytes) // per_row)) // 8 * 8
+
+
+def _pick_chunk(m: int, b: int) -> int:
+    """Largest sublane-aligned divisor of m within the VMEM chunk limit
+    (the kernel grids over row chunks; a divisor avoids relying on masked
+    partial blocks). 0 if none is usable."""
+    best = 0
+    for c in range(8, min(m, _chunk_limit(b)) + 1, 8):
+        if m % c == 0:
+            best = c
+    return best
+
+
+def supported(m: int, b: int) -> bool:
+    """The fused kernel needs lane-sized panels and a usable row chunk."""
+    return b % 128 == 0 and _pick_chunk(m, b) >= 128
+
+
+@functools.partial(jax.jit, static_argnames=("exchange", "interpret"))
+def apply_exchange(top, bot, q, *, exchange: bool = True,
+                   interpret: bool = False):
+    """(new_top, new_bot) = post-exchange stacks of ([top|bot] @ q).
+
+    top/bot: (k, m, b) column stacks; q: (k, 2b, 2b) orthogonal panels.
+    Equivalent (tested) to the concat/matmul/slice + rotate_blocks chain.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, m, b = top.shape
+    mc = _pick_chunk(m, b)
+    pair_t, top_half_t, pair_b, top_half_b = _perm_maps(k, exchange)
+    # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
+    # (q is (k, 2b, 2b) — tiny next to the stacks).
+    ql, qr = q[..., :b], q[..., b:]
+    f32 = jnp.float32
+    qt = jnp.where(jnp.asarray(top_half_t)[:, None, None],
+                   jnp.take(ql, jnp.asarray(pair_t), axis=0),
+                   jnp.take(qr, jnp.asarray(pair_t), axis=0)).astype(f32)
+    qb = jnp.where(jnp.asarray(top_half_b)[:, None, None],
+                   jnp.take(ql, jnp.asarray(pair_b), axis=0),
+                   jnp.take(qr, jnp.asarray(pair_b), axis=0)).astype(f32)
+
+    # Closed-form slot maps (index maps run as scalar-core programs; no
+    # table gathers): with exchange, pt(i) = 0 for i <= 1 else i - 1 and
+    # pb(i) = min(i + 1, k - 1); identity otherwise.
+    if exchange and k > 1:
+        pt_fn = lambda i: jnp.where(i <= 1, 0, i - 1)
+        pb_fn = lambda i: jnp.minimum(i + 1, k - 1)
+    else:
+        pt_fn = pb_fn = lambda i: i
+    x_spec = lambda pair_fn: pl.BlockSpec(
+        (1, mc, b), lambda i, mi: (pair_fn(i), mi, 0),
+        memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec((1, 2 * b, b), lambda i, mi: (i, 0, 0),
+                          memory_space=pltpu.VMEM)
+    o_spec = pl.BlockSpec((1, mc, b), lambda i, mi: (i, mi, 0),
+                          memory_space=pltpu.VMEM)
+    out = jax.ShapeDtypeStruct((k, m, b), top.dtype)
+    new_top, new_bot = pl.pallas_call(
+        functools.partial(_kernel, b=b),
+        grid=(k, m // mc),
+        in_specs=[x_spec(pt_fn), x_spec(pt_fn), x_spec(pb_fn), x_spec(pb_fn),
+                  q_spec, q_spec],
+        out_specs=[o_spec, o_spec],
+        out_shape=[out, out],
+        interpret=interpret,
+    )(top, bot, top, bot, qt, qb)
+    return new_top, new_bot

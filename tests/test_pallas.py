@@ -206,3 +206,57 @@ def test_sharded_novec_pallas():
     assert r.u is None and r.v is None
     s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
     assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+
+
+# --- fused apply+exchange kernel (ops/pallas_apply.py) ---
+
+from svd_jacobi_tpu.ops import pallas_apply as pa
+from svd_jacobi_tpu.parallel import schedule as sched
+
+
+@pytest.mark.parametrize("k,m,exchange", [
+    (4, 256, True), (4, 256, False), (1, 256, True), (8, 1000, True)])
+def test_apply_exchange_matches_reference_chain(k, m, exchange):
+    """The fused kernel must equal the concat @ q + slice (+ rotate_blocks)
+    chain it replaces, to f32 dot-reassociation rounding."""
+    rng = np.random.default_rng(0)
+    b = 128
+    top = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    bot = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((k, 2 * b, 2 * b)), jnp.float32)
+    nt, nb = pa.apply_exchange(top, bot, q, exchange=exchange, interpret=True)
+    xn = jnp.einsum("kmi,kij->kmj", jnp.concatenate([top, bot], -1), q,
+                    precision=HI)
+    rt, rb = xn[..., :b], xn[..., b:]
+    if exchange:
+        rt, rb = sched.rotate_blocks(rt, rb)
+    scale = float(jnp.max(jnp.abs(xn)))
+    assert float(jnp.max(jnp.abs(nt - rt))) < 2e-5 * scale
+    assert float(jnp.max(jnp.abs(nb - rb))) < 2e-5 * scale
+
+
+def test_apply_exchange_perm_maps_match_rotate_blocks():
+    """The kernel's closed-form output-slot maps must encode exactly one
+    schedule.rotate_blocks step, for every stack width."""
+    for k in (1, 2, 3, 5, 8):
+        pair_t, half_t, pair_b, half_b = pa._perm_maps(k, exchange=True)
+        top = np.arange(k)          # slot id of each pair's top result
+        bot = np.arange(k, 2 * k)   # ... and bottom result
+        want_t, want_b = sched.rotate_indices(top, bot)
+        got_t = np.where(half_t, top[pair_t], bot[pair_t])
+        got_b = np.where(half_b, top[pair_b], bot[pair_b])
+        assert np.array_equal(got_t, want_t), k
+        assert np.array_equal(got_b, want_b), k
+
+
+def test_apply_exchange_support_predicate():
+    assert pa.supported(2048, 128)
+    assert pa.supported(5000, 128)      # chunk 1000 divides
+    assert not pa.supported(97, 128)    # no usable row chunk
+    assert not pa.supported(2048, 64)   # sub-lane panel width
+    # wide user panels must respect the per-step VMEM budget: the chunk
+    # limit shrinks with b, and b >= 1024 is rejected outright
+    assert pa._chunk_limit(512) < pa._chunk_limit(128)
+    assert not pa.supported(8192, 1024)
+    per_step = (6 * pa._chunk_limit(512) * 512 + 2 * 2 * 512 * 512) * 4
+    assert per_step <= (13 << 20) // 2
