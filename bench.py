@@ -15,6 +15,10 @@ Usage:
          --oracle=auto|on|off    (off skips the host f64 sigma oracle;
                                   auto skips it above 2048)
          --reps=K                (best-of-K interleaved timing, default 6)
+         --sweep                 (run the whole BASELINE.md accelerator
+                                  table — one JSON line per config — in a
+                                  fresh subprocess each so compile caches
+                                  and HBM don't leak across sizes)
 """
 
 from __future__ import annotations
@@ -56,10 +60,35 @@ def _time_interleaved(fns, *args, reps: int = 2):
     return best, warms
 
 
+# The measured-table configs of BASELINE.md (square + tall-skinny, f32).
+SWEEP_CONFIGS = [
+    ("2048", "float32", None),
+    ("4096", "float32", None),
+    ("5000", "float32", None),
+    ("8192", "float32", None),
+    ("2048", "float32", "16384"),
+    ("4096", "float32", "65536"),
+]
+
+
+def _sweep(passthrough) -> None:
+    """Run every SWEEP_CONFIGS row in a fresh subprocess, forwarding all
+    other flags verbatim (--reps, --oracle, --baseline keep their
+    single-config semantics and defaults)."""
+    import subprocess
+    for n, dtype, m in SWEEP_CONFIGS:
+        cmd = [sys.executable, __file__, n, dtype] + ([m] if m else [])
+        subprocess.run(cmd + passthrough, check=True)
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
                  for f in sys.argv[1:] if f.startswith("--"))
+    if "sweep" in flags:
+        _sweep([f for f in sys.argv[1:]
+                if f.startswith("--") and f.lstrip("-") != "sweep"])
+        return
     n = int(args[0]) if len(args) > 0 else 2048
     dtype_name = args[1] if len(args) > 1 else "float32"
     m = int(args[2]) if len(args) > 2 else n

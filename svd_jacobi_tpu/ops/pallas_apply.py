@@ -29,10 +29,10 @@ per-rotation column update `jacobi_rotation` + host bookkeeping
 replaces its per-round re-distribution of columns (lib/JacobiMethods.cu:
 334-432) with an index-map permutation inside one kernel launch.
 
-Single-device compiled path only: the mesh solve keeps its unfused form
-(the exchange there is a `lax.ppermute` ICI hop that cannot live inside a
-kernel), and interpreter backends use the jnp reference semantics in
-ops/rounds.py.
+Compiled paths only: the single-device solver fuses apply AND exchange;
+the compiled mesh solver fuses the apply (``exchange=False``) and keeps
+its exchange as the `lax.ppermute` ICI hop outside the kernel. Interpreter
+backends use the jnp reference semantics in ops/rounds.py.
 """
 
 from __future__ import annotations
@@ -110,13 +110,18 @@ def supported(m: int, b: int) -> bool:
     return b % 128 == 0 and _pick_chunk(m, b) >= 128
 
 
-@functools.partial(jax.jit, static_argnames=("exchange", "interpret"))
+@functools.partial(jax.jit, static_argnames=("exchange", "interpret", "vma"))
 def apply_exchange(top, bot, q, *, exchange: bool = True,
-                   interpret: bool = False):
+                   interpret: bool = False, vma=None):
     """(new_top, new_bot) = post-exchange stacks of ([top|bot] @ q).
 
     top/bot: (k, m, b) column stacks; q: (k, 2b, 2b) orthogonal panels.
     Equivalent (tested) to the concat/matmul/slice + rotate_blocks chain.
+
+    ``vma``: mesh axes the outputs vary over — required when called on
+    LOCAL stacks inside a compiled shard_map region (the mesh solver uses
+    ``exchange=False`` there: its exchange is a ppermute ICI hop that runs
+    outside the kernel). Mirrors the convention of ops/pallas_blocks.py.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -150,7 +155,8 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
                           memory_space=pltpu.VMEM)
     o_spec = pl.BlockSpec((1, mc, b), lambda i, mi: (i, mi, 0),
                           memory_space=pltpu.VMEM)
-    out = jax.ShapeDtypeStruct((k, m, b), top.dtype)
+    from .pallas_blocks import _out_struct
+    out = _out_struct((k, m, b), top.dtype, vma)
     new_top, new_bot = pl.pallas_call(
         functools.partial(_kernel, b=b),
         grid=(k, m // mc),

@@ -125,7 +125,8 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
 
 
 def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
-                bf16_gram, axis_name=None, fused_exchange=False):
+                bf16_gram, axis_name=None, fused_exchange=False,
+                fused_apply=False):
     """Annihilate every cross pair of each (top[i], bot[i]) block pair.
     ``axis_name``: see `self_round`.
 
@@ -167,10 +168,25 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
                                             (top, bot, vtop, vbot))
         return top, bot, vtop, vbot, stat
 
+    # Compiled mesh path: fuse the apply (the adds live in VMEM) but keep
+    # the exchange outside — it is the caller's ppermute ICI hop. The
+    # stacks here are the device-LOCAL views under shard_map.
+    fused_apply = (fused_apply and not interpret
+                   and pa.supported(top.shape[1], b)
+                   and (vtop is None or pa.supported(vtop.shape[1], b)))
+    vma = (axis_name,) if axis_name is not None else None
+
     def do(args):
         top, bot, vtop, vbot = args
         q = _rotations(g, "cross", interpret=interpret, polish=polish,
                        axis_name=axis_name)
+        if fused_apply:
+            top, bot = pa.apply_exchange(top, bot, q, exchange=False,
+                                         vma=vma)
+            if vtop is not None:
+                vtop, vbot = pa.apply_exchange(vtop, vbot, q,
+                                               exchange=False, vma=vma)
+            return top, bot, vtop, vbot
         xn = _einsum(jnp.concatenate([top, bot], axis=-1), q,
                      "kmi,kij->kmj").astype(top.dtype)
         top, bot = xn[..., :b], xn[..., b:]
@@ -205,6 +221,9 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     fused = (exchange is None and axis_name is None and not interpret
              and pa.supported(m, b)
              and (not with_v or pa.supported(vtop.shape[1], b)))
+    # Compiled mesh path: fuse the apply only (exchange stays the caller's
+    # ppermute ring hop).
+    mesh_fused = axis_name is not None and not interpret
     if exchange is None:
         exchange = sched.rotate_blocks
     if n_rounds is None:
@@ -224,7 +243,7 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
             top, bot, vtop if with_v else None, vbot if with_v else None,
             dmax2, rtol, interpret=interpret,
             polish=polish, bf16_gram=bf16_gram, axis_name=axis_name,
-            fused_exchange=fused)
+            fused_exchange=fused, fused_apply=mesh_fused)
         if with_v:
             vtop, vbot = nvt, nvb
         if not fused:

@@ -275,6 +275,28 @@ def _complete_orthonormal(u, n, dtype):
     return q.astype(dtype)
 
 
+def _sigma_sort(a_work, n):
+    """(sigma, column order, sorted columns) of the rotated column set:
+    sigma = column norms sorted descending (padded columns are exactly zero
+    and sort to the back; the [:n] slice drops them), columns in the
+    accumulation dtype. Shared by `_postprocess` and the triangular-solve
+    U recovery so the deflation/tie handling cannot diverge."""
+    acc = jnp.promote_types(a_work.dtype, jnp.float32)
+    s_all = jnp.linalg.norm(a_work.astype(acc), axis=0)  # (n_pad,)
+    order = jnp.argsort(-s_all)[:n]
+    s = s_all[order]
+    a_sorted = jnp.take(a_work, order, axis=1).astype(acc)
+    return s, order, a_sorted
+
+
+def _normalize_cols(a_sorted, s, dtype):
+    """Columns / sigma with the rank-deficiency guard (exact-zero sigma ->
+    zero column, not inf)."""
+    safe = jnp.maximum(s, jnp.finfo(a_sorted.dtype).tiny)
+    cols = (a_sorted / safe[None, :]).astype(dtype)
+    return jnp.where(s[None, :] > 0, cols, jnp.zeros_like(cols))
+
+
 def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
     """sigma = column norms; sort descending; U = A_work * diag(1/sigma).
 
@@ -283,19 +305,12 @@ def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
     and rank-deficiency guard it lacks.
     """
     m = a_work.shape[0]
-    acc = jnp.promote_types(dtype, jnp.float32)
-    s_all = jnp.linalg.norm(a_work.astype(acc), axis=0)  # (n_pad,)
-    # Padded columns are exactly zero -> sort to the back; slice them off.
-    order = jnp.argsort(-s_all)[:n]
-    s = s_all[order]
+    s, order, a_sorted = _sigma_sort(a_work, n)
     u = v = None
     if v_work is not None:
         v = jnp.take(v_work, order, axis=1).astype(dtype)
     if compute_u:
-        a_sorted = jnp.take(a_work, order, axis=1)
-        safe = jnp.maximum(s, jnp.finfo(acc).tiny)
-        u = (a_sorted.astype(acc) / safe[None, :]).astype(dtype)
-        u = jnp.where(s[None, :] > 0, u, jnp.zeros_like(u))
+        u = _normalize_cols(a_sorted, s, dtype)
         if full_u and m > n:
             u = _complete_orthonormal(u, n, dtype)
     return u, s.astype(dtype), v
@@ -425,10 +440,7 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
         # for the verification statistic) restores orthogonality to the
         # f32 floor when L was fit for the solve.
         a_work = _deblockify(top, bot)               # (n, n_pad)
-        s_all = jnp.linalg.norm(a_work.astype(acc), axis=0)
-        order_s = jnp.argsort(-s_all)[:n]
-        s = s_all[order_s]
-        a_sorted = jnp.take(a_work, order_s, axis=1).astype(acc)   # (n, n)
+        s, _, a_sorted = _sigma_sort(a_work, n)      # a_sorted: (n, n)
         rot = jax.lax.linalg.triangular_solve(
             r, a_sorted, left_side=True, lower=False, transpose_a=True)
         eye = jnp.eye(n, dtype=acc)
@@ -440,9 +452,7 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
             u = _complete_orthonormal(u, n, dtype)
         v = None
         if compute_v:
-            safe = jnp.maximum(s, jnp.finfo(acc).tiny)
-            cols = (a_sorted / safe[None, :]).astype(dtype)
-            cols = jnp.where(s[None, :] > 0, cols, jnp.zeros_like(cols))
+            cols = _normalize_cols(a_sorted, s, dtype)
             v = jnp.zeros_like(cols).at[order, :].set(cols)
         return u, s.astype(dtype), v, sweeps, off_rel, u_err
 
