@@ -50,6 +50,14 @@ def _parse_args(argv):
                    help="solve over a mesh of all visible devices")
     p.add_argument("--pair-solver", default="auto",
                    choices=["auto", "pallas", "qr-svd", "gram-eigh", "hybrid"])
+    p.add_argument("--precondition", default="auto",
+                   choices=["auto", "on", "off", "double"],
+                   help="QR preconditioning mode (Pallas path; 'double' = "
+                        "dgejsv-style second QR for graded spectra)")
+    p.add_argument("--u-recovery", default="auto",
+                   choices=["auto", "accumulate", "solve"],
+                   help="how U's rotation product is recovered on the "
+                        "preconditioned path (see SVDConfig.u_recovery)")
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--block-size", type=int, default=None)
@@ -131,7 +139,9 @@ def main(argv=None) -> int:
         return 2
     dtype = jnp.dtype(args.dtype)
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
-                          tol=args.tol, pair_solver=args.pair_solver)
+                          tol=args.tol, pair_solver=args.pair_solver,
+                          precondition=args.precondition,
+                          u_recovery=args.u_recovery)
 
     mesh = None
     ctx = None
@@ -163,7 +173,9 @@ def main(argv=None) -> int:
         "distributed": bool(mesh),
         "config": {"pair_solver": args.pair_solver,
                    "max_sweeps": args.max_sweeps, "tol": args.tol,
-                   "block_size": args.block_size},
+                   "block_size": args.block_size,
+                   "precondition": args.precondition,
+                   "u_recovery": args.u_recovery},
     }
 
     if not args.no_selftest:

@@ -32,6 +32,14 @@ Multi-host: build the mesh from `jax.devices()` after
 `jax.distributed.initialize()` — the same code runs over ICI within a host
 and DCN across hosts; `utils.matgen.sharded_random` generates inputs directly
 into the sharding so no host ever materializes the full matrix.
+
+Relation to sequence/context parallelism (SURVEY.md section 5): this
+ppermute round-robin is structurally the same ring algorithm as ring
+attention — each device holds resident blocks (column blocks here, Q blocks
+there), a rotating set of partner blocks rides the ICI ring one neighbor
+per step, and every resident/visitor pair interacts exactly once per
+cycle. Column-block sharding of the n axis is this workload's analogue of
+sharding the sequence axis; scaling N is the long-axis scaling story.
 """
 
 from __future__ import annotations
@@ -273,6 +281,19 @@ def svd(
     """
     if config is None:
         config = SVDConfig()
+    # Single-device-only config modes are REJECTED here rather than
+    # silently ignored: the mesh solve runs Jacobi on A directly (a
+    # distributed QR preconditioner does not exist on this path, and the
+    # triangular-solve U recovery depends on it).
+    if config.precondition not in ("auto", "off"):
+        raise ValueError(
+            f"precondition={config.precondition!r} is not supported by the "
+            "mesh solver (it runs unpreconditioned); use the single-device "
+            "svd() for QR preconditioning")
+    if config.u_recovery == "solve":
+        raise ValueError(
+            "u_recovery='solve' requires the preconditioned single-device "
+            "path; the mesh solver accumulates the rotation product")
     a = jnp.asarray(a)
     if a.ndim != 2:
         raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
