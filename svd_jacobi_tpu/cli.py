@@ -137,6 +137,13 @@ def main(argv=None) -> int:
         # self-test spends a full solve.
         log("triangular input requires m == n; use --matrix dense")
         return 2
+    if args.distributed and (args.precondition in ("on", "double")
+                             or args.u_recovery == "solve"):
+        # Knowable at parse time: these are single-device-only modes (the
+        # mesh solver would raise the same rejection mid-run).
+        log("--precondition on/double and --u-recovery solve are "
+            "single-device modes; not supported with --distributed")
+        return 2
     dtype = jnp.dtype(args.dtype)
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
                           tol=args.tol, pair_solver=args.pair_solver,

@@ -81,25 +81,29 @@ def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
                         qb_ref[0]).astype(out_b_ref.dtype)
 
 
-def _chunk_limit(b: int) -> int:
+def _chunk_limit(b: int, row_blocks: int = 6, fixed_bytes: int = None) -> int:
     """Row-chunk cap so one grid step fits scoped VMEM (~13 MB usable,
-    halved for Mosaic double-buffering): a step holds 6 (mc, b) x/out
-    blocks plus 2 (2b, b) q strips, all f32. Shrinks with the panel width
-    the way pallas_blocks._pick_block_k does — a user block_size of 512+
-    must not push the fused kernel over the budget the unfused path
-    respects."""
+    halved for Mosaic double-buffering). The apply kernel holds 6 (mc, b)
+    x/out blocks plus 2 (2b, b) q strips per step; the gram kernel
+    (ops/pallas_gram.py) passes its own smaller footprint. Shrinks with
+    the panel width the way pallas_blocks._pick_block_k does — a user
+    block_size of 512+ must not push a kernel over the budget the unfused
+    path respects."""
+    if fixed_bytes is None:
+        fixed_bytes = 2 * (2 * b) * b * 4          # the two q strips
     budget = (13 << 20) // 2
-    per_row = 6 * b * 4
-    q_bytes = 2 * (2 * b) * b * 4
-    return max(0, min(1024, (budget - q_bytes) // per_row)) // 8 * 8
+    per_row = row_blocks * b * 4
+    return max(0, min(1024, (budget - fixed_bytes) // per_row)) // 8 * 8
 
 
-def _pick_chunk(m: int, b: int) -> int:
+def _pick_chunk(m: int, b: int, row_blocks: int = 6,
+                fixed_bytes: int = None) -> int:
     """Largest sublane-aligned divisor of m within the VMEM chunk limit
     (the kernel grids over row chunks; a divisor avoids relying on masked
     partial blocks). 0 if none is usable."""
     best = 0
-    for c in range(8, min(m, _chunk_limit(b)) + 1, 8):
+    limit = _chunk_limit(b, row_blocks, fixed_bytes)
+    for c in range(8, min(m, limit) + 1, 8):
         if m % c == 0:
             best = c
     return best

@@ -1,0 +1,101 @@
+"""Pallas TPU kernel for the cross-round Gram panels.
+
+A cross round needs g = x^T x for x = [top_i | bot_i] per pair slot —
+three (b, b) quadrants gxx = t^T t, gxy = t^T b, gyy = b^T b with a long
+reduction over the m rows and a tiny output. XLA schedules this
+reduction-heavy batched einsum at ~11.6 TF/s f32-effective on v5e (vs
+~25 TF/s for the same-cost apply matmuls — PROFILE.md component table),
+leaving most of the MXU idle. This kernel grids over (pair, row-chunk),
+keeps the three quadrant accumulators resident in VMEM across the row
+chunks of a pair (TPU pallas iterates the trailing grid dimension
+innermost, so each pair's accumulation completes before the next pair
+starts), and contracts (mc, b) chunks on the MXU at HIGHEST precision.
+
+Reference lineage: the Gram elements are the alpha/beta/gamma dot
+products the reference computes per column pair in a HOST loop
+(lib/JacobiMethods.cu:450-459) — here one kernel produces every pair's
+full Gram panel on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_apply import _pick_chunk
+from .pallas_blocks import _out_struct
+
+HI = jax.lax.Precision.HIGHEST
+
+# Per-grid-step footprint for the VMEM chunk budget (_pick_chunk): 2
+# (mc, b) input blocks per row, plus 3 (b, b) f32 quadrant accumulators.
+_ROW_BLOCKS = 2
+
+
+def _fixed_bytes(b: int) -> int:
+    return 3 * b * b * 4
+
+
+def _chunk(m: int, b: int) -> int:
+    return _pick_chunk(m, b, _ROW_BLOCKS, _fixed_bytes(b))
+
+
+def _kernel(xt_ref, xb_ref, gxx_ref, gxy_ref, gyy_ref):
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        gxx_ref[...] = jnp.zeros_like(gxx_ref)
+        gxy_ref[...] = jnp.zeros_like(gxy_ref)
+        gyy_ref[...] = jnp.zeros_like(gyy_ref)
+
+    xt = xt_ref[0].astype(f32)
+    xb = xb_ref[0].astype(f32)
+    dot = lambda a, b: jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), precision=HI,
+        preferred_element_type=f32)[None]
+    gxx_ref[...] += dot(xt, xt)
+    gxy_ref[...] += dot(xt, xb)
+    gyy_ref[...] += dot(xb, xb)
+
+
+def supported(m: int, b: int) -> bool:
+    """Lane-sized panels and a usable row chunk (the gram step's smaller
+    footprint gives it a wider support window than the apply kernel)."""
+    return b % 128 == 0 and _chunk(m, b) >= 128
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "vma"))
+def gram_pairs(top, bot, *, interpret: bool = False, vma=None):
+    """(k, 2b, 2b) symmetric Gram panels of the stacked pairs.
+
+    Equal (to f32 reduction-order rounding) to
+    ``einsum('kmi,kmj->kij', x, x)`` with ``x = concat([top, bot], -1)``
+    — without materializing x. ``vma``: see pallas_apply.apply_exchange.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, m, b = top.shape
+    mc = _chunk(m, b)
+    x_spec = pl.BlockSpec((1, mc, b), lambda i, mi: (i, mi, 0),
+                          memory_space=pltpu.VMEM)
+    g_spec = pl.BlockSpec((1, b, b), lambda i, mi: (i, 0, 0),
+                          memory_space=pltpu.VMEM)
+    out = _out_struct((k, b, b), jnp.float32, vma)
+    gxx, gxy, gyy = pl.pallas_call(
+        _kernel,
+        grid=(k, m // mc),
+        in_specs=[x_spec, x_spec],
+        out_specs=[g_spec] * 3,
+        out_shape=[out] * 3,
+        interpret=interpret,
+    )(top, bot)
+    top_row = jnp.concatenate([gxx, gxy], axis=-1)
+    bot_row = jnp.concatenate([gxy.transpose(0, 2, 1), gyy], axis=-1)
+    return jnp.concatenate([top_row, bot_row], axis=-2)

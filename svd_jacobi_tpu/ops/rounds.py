@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from . import pallas_apply as pa
 from . import pallas_blocks as pb
+from . import pallas_gram as pg
 from ..parallel import schedule as sched
 
 HI = jax.lax.Precision.HIGHEST
@@ -142,8 +143,16 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
     it as the reference semantics.
     """
     b = top.shape[-1]
-    x = jnp.concatenate([top, bot], axis=-1)
-    g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
+    vma = (axis_name,) if axis_name is not None else None
+    if not bf16_gram and not interpret and pg.supported(top.shape[1], b):
+        # Compiled path: the Pallas reduction kernel forms the Gram panel
+        # at ~2x the throughput of the XLA batched einsum on this
+        # reduction-heavy small-output shape (PROFILE.md item 10), and
+        # never materializes the (k, m, 2b) concat.
+        g = pg.gram_pairs(top, bot, vma=vma)
+    else:
+        x = jnp.concatenate([top, bot], axis=-1)
+        g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
     stat, skip = panel_stats(g, dmax2)
     skip = _mesh_max(skip, axis_name)
 
@@ -174,7 +183,6 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
     fused_apply = (fused_apply and not interpret
                    and pa.supported(top.shape[1], b)
                    and (vtop is None or pa.supported(vtop.shape[1], b)))
-    vma = (axis_name,) if axis_name is not None else None
 
     def do(args):
         top, bot, vtop, vbot = args

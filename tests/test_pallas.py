@@ -260,3 +260,32 @@ def test_apply_exchange_support_predicate():
     assert not pa.supported(8192, 1024)
     per_step = (6 * pa._chunk_limit(512) * 512 + 2 * 2 * 512 * 512) * 4
     assert per_step <= (13 << 20) // 2
+
+
+# --- Gram panel kernel (ops/pallas_gram.py) ---
+
+from svd_jacobi_tpu.ops import pallas_gram as pg
+
+
+@pytest.mark.parametrize("k,m", [(4, 512), (8, 1000), (1, 256)])
+def test_gram_pairs_matches_einsum(k, m):
+    """The accumulating reduction kernel must equal the concat + einsum
+    Gram panel (to f32 reduction-order rounding; interpret mode is
+    bit-exact since both reduce in the same chunk order)."""
+    rng = np.random.default_rng(1)
+    b = 128
+    top = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    bot = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    g = pg.gram_pairs(top, bot, interpret=True)
+    x = jnp.concatenate([top, bot], -1)
+    ref = jnp.einsum("kmi,kmj->kij", x, x, precision=HI)
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(g - ref))) < 2e-5 * scale
+    # symmetry comes from construction (gxy mirrored into both triangles)
+    assert float(jnp.max(jnp.abs(g - g.transpose(0, 2, 1)))) == 0.0
+
+
+def test_gram_pairs_support_predicate():
+    assert pg.supported(2048, 128)
+    assert not pg.supported(97, 128)
+    assert not pg.supported(2048, 64)

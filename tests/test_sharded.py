@@ -198,10 +198,9 @@ def test_mesh_rejects_single_device_only_modes():
     """precondition / u_recovery='solve' are single-device features; the
     mesh solver must reject them loudly instead of silently ignoring them
     (and recording them in reports as if applied)."""
-    import pytest as _pytest
     a = jnp.ones((16, 16), jnp.float32)
     mesh = sharded.make_mesh(jax.devices()[:1])
-    with _pytest.raises(ValueError, match="precondition"):
+    with pytest.raises(ValueError, match="precondition"):
         sharded.svd(a, mesh=mesh, config=SVDConfig(precondition="double"))
-    with _pytest.raises(ValueError, match="u_recovery"):
+    with pytest.raises(ValueError, match="u_recovery"):
         sharded.svd(a, mesh=mesh, config=SVDConfig(u_recovery="solve"))
