@@ -289,3 +289,10 @@ def test_gram_pairs_support_predicate():
     assert pg.supported(2048, 128)
     assert not pg.supported(97, 128)
     assert not pg.supported(2048, 64)
+    # the gram step's smaller footprint (2 input blocks + 3 accumulators)
+    # keeps wide panels inside the VMEM budget where the apply kernel's
+    # 6-block footprint already shrinks its chunk
+    from svd_jacobi_tpu.ops import pallas_apply as pa
+    assert pg._chunk(8192, 512) >= pa._pick_chunk(8192, 512)
+    per_step = (2 * pg._chunk(8192, 512) * 512 + 3 * 512 * 512) * 4
+    assert per_step <= (13 << 20) // 2
