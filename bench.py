@@ -87,7 +87,8 @@ def main() -> None:
                  for f in sys.argv[1:] if f.startswith("--"))
     if "sweep" in flags:
         _sweep([f for f in sys.argv[1:]
-                if f.startswith("--") and f.lstrip("-") != "sweep"])
+                if f.startswith("--")
+                and f.lstrip("-").split("=", 1)[0] != "sweep"])
         return
     n = int(args[0]) if len(args) > 0 else 2048
     dtype_name = args[1] if len(args) > 1 else "float32"

@@ -54,10 +54,6 @@ def _parse_args(argv):
                    choices=["auto", "on", "off", "double"],
                    help="QR preconditioning mode (Pallas path; 'double' = "
                         "dgejsv-style second QR for graded spectra)")
-    p.add_argument("--u-recovery", default="auto",
-                   choices=["auto", "accumulate", "solve"],
-                   help="how U's rotation product is recovered on the "
-                        "preconditioned path (see SVDConfig.u_recovery)")
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--block-size", type=int, default=None)
@@ -137,18 +133,26 @@ def main(argv=None) -> int:
         # self-test spends a full solve.
         log("triangular input requires m == n; use --matrix dense")
         return 2
-    if args.distributed and (args.precondition in ("on", "double")
-                             or args.u_recovery == "solve"):
-        # Knowable at parse time: these are single-device-only modes (the
-        # mesh solver would raise the same rejection mid-run).
-        log("--precondition on/double and --u-recovery solve are "
-            "single-device modes; not supported with --distributed")
+    if args.distributed and args.precondition in ("on", "double"):
+        # Knowable at parse time: single-device-only modes (the mesh
+        # solver would raise the same rejection mid-run).
+        log("--precondition on/double are single-device modes; "
+            "not supported with --distributed")
+        return 2
+    if args.precondition in ("on", "double") and (
+            args.pair_solver in ("hybrid", "qr-svd", "gram-eigh")
+            or args.dtype == "float64"):
+        # Also knowable at parse time: preconditioning is a Pallas-path
+        # feature; these combinations resolve to the XLA block solvers,
+        # which reject it mid-run (solver.svd) — fail before the warm-up
+        # self-test spends a solve.
+        log("--precondition on/double require the Pallas pair solver "
+            "(auto/pallas, non-f64 dtype)")
         return 2
     dtype = jnp.dtype(args.dtype)
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
                           tol=args.tol, pair_solver=args.pair_solver,
-                          precondition=args.precondition,
-                          u_recovery=args.u_recovery)
+                          precondition=args.precondition)
 
     mesh = None
     ctx = None
@@ -181,8 +185,7 @@ def main(argv=None) -> int:
         "config": {"pair_solver": args.pair_solver,
                    "max_sweeps": args.max_sweeps, "tol": args.tol,
                    "block_size": args.block_size,
-                   "precondition": args.precondition,
-                   "u_recovery": args.u_recovery},
+                   "precondition": args.precondition},
     }
 
     if not args.no_selftest:

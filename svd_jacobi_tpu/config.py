@@ -65,23 +65,6 @@ class SVDConfig:
     # result at 8192^2). Kept as an option for bandwidth-starved setups.
     # Single-chip path only; the sharded solve runs full-precision grams.
     bulk_bf16: Optional[bool] = None
-    # How U is recovered on the preconditioned Pallas path. The sweep loop
-    # rotates L = R^T by an orthogonal product G (A = (Q1 G) Sigma ...):
-    #   "accumulate": carry G through every round's kernel+matmul (robust,
-    #     but doubles the loop's apply traffic);
-    #   "solve": skip the in-loop accumulation and recover G = L^{-1} W by
-    #     ONE triangular solve after convergence (dgejsv's fast path; W is
-    #     the rotated column set). One Newton-Schulz step re-orthogonalizes
-    #     G; if the pre-polish orthogonality error exceeds a gate (L too
-    #     ill-conditioned for the solve — the dgejsv COND_OK test, measured
-    #     not estimated), the solver falls back to an accumulated re-run.
-    #   "auto": currently "accumulate" at every size — measured at 8192^2
-    #     f32 on random input, the solve's verification gate fires (the
-    #     sqrt(n)*eps unconverged couplings, amplified by the scaled
-    #     condition of L, already exceed it), so the fast path would pay
-    #     for both runs. "solve" is worthwhile only when the input is known
-    #     to be modestly conditioned.
-    u_recovery: str = "auto"  # "auto" | "accumulate" | "solve"
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2
     # (LAPACK-dgesvd class). "auto" follows the pair solver.

@@ -1,9 +1,9 @@
 """Pallas TPU kernel v3: cross-pair tournament in 4-block-array layout.
 
-Same math as `pallas_jacobi2.cross_rotations` (cyclic mod-b pairing of the
-two column blocks of a panel, Rutishauser rotations, congruence on the Gram
-panel, accumulated Q) but the panel is carried as FOUR separate (kb, b, b)
-arrays
+Math: cyclic mod-b pairing of the two column blocks of a panel, Rutishauser
+rotations, congruence on the Gram panel, accumulated Q (the pure-jnp form is
+`reference_cross` below) — but the panel is carried as FOUR separate
+(kb, b, b) arrays
 
     G = [[gxx, c ], [ct, gyy]]        q = [qx | qy]  (2b rows, b cols each)
 
@@ -250,8 +250,9 @@ def _pick_block_k(k: int, b: int, factor: int = 3) -> int:
 def cross_rotations(g: jax.Array, *, interpret: bool | None = None,
                     block_k: int | None = None, passes: int = 1,
                     polish: bool = True, vma=None) -> jax.Array:
-    """Drop-in equivalent of `pallas_jacobi2.cross_rotations` (same G in,
-    same Q out), 4-block-array layout inside."""
+    """Rotation generator for a cross round: Gram panel stack G in,
+    accumulated orthogonal Q out (see `reference_cross` for the semantics);
+    4-block-array layout inside."""
     if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
         raise ValueError(f"expected (k, n2, n2) panels with even n2, got {g.shape}")
     k, n2, _ = g.shape
@@ -385,7 +386,8 @@ def self_rotations(g: jax.Array, *, interpret: bool | None = None,
                    block_k: int | None = None, passes: int = 1,
                    polish: bool = True, vma=None) -> jax.Array:
     """Annihilate EVERY pair inside each (n2, n2) Gram panel exactly once
-    (n2-1 circle-method steps); drop-in for `pallas_jacobi2.self_rotations`."""
+    (n2-1 circle-method steps); same G-in/Q-out contract as
+    `reference_self`."""
     if g.ndim != 3 or g.shape[-1] != g.shape[-2] or g.shape[-1] % 2:
         raise ValueError(f"expected (k, n2, n2) panels with even n2, got {g.shape}")
     k, n2, _ = g.shape

@@ -290,10 +290,6 @@ def svd(
             f"precondition={config.precondition!r} is not supported by the "
             "mesh solver (it runs unpreconditioned); use the single-device "
             "svd() for QR preconditioning")
-    if config.u_recovery == "solve":
-        raise ValueError(
-            "u_recovery='solve' requires the preconditioned single-device "
-            "path; the mesh solver accumulates the rotation product")
     a = jnp.asarray(a)
     if a.ndim != 2:
         raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")

@@ -172,12 +172,6 @@ def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
 # the kernel-path sweep machinery.
 _global_dmax2 = rounds._global_dmax2
 
-# Pre-polish orthogonality-error gate for the triangular-solve U recovery
-# (SVDConfig.u_recovery): below this, one Newton-Schulz step restores the
-# solved rotation product to the f32 floor (quadratic contraction); above it
-# L was too ill-conditioned and the solver re-runs with accumulation.
-_U_SOLVE_GATE = 3e-3
-
 
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
     """(m, n) -> top/bot stacks (k, m, b), zero-padding columns to n_pad."""
@@ -366,10 +360,10 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
     "max_sweeps", "precondition", "polish", "bulk_bf16", "interpret",
-    "stall_detection", "u_solve"))
+    "stall_detection"))
 def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
                 max_sweeps, precondition, polish, bulk_bf16, interpret,
-                stall_detection=True, u_solve=False):
+                stall_detection=True):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -380,18 +374,10 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     A = (Q1 V_L) S (P U_L)^T, so the ROTATION product becomes U and the
     normalized COLUMNS become V — the accumulation is only needed when U is
     wanted, and V comes free.
-
-    ``u_solve`` (precondition only): recover the rotation product by one
-    triangular solve G = L^{-1} W after the loop instead of accumulating it
-    through every round (dgejsv's fast path; see SVDConfig.u_recovery).
-    Returns the PRE-polish orthogonality error of the solved G as ``u_err``
-    so the caller can detect an ill-conditioned L and re-run accumulated;
-    ``u_err`` is 0 on the accumulate path.
     """
     m = a.shape[0]
     dtype = a.dtype
     hi = jax.lax.Precision.HIGHEST
-    u_solve = bool(u_solve) and precondition == "on" and bool(compute_u)
     if precondition in ("on", "double"):
         norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
         order = jnp.argsort(-norms)
@@ -412,7 +398,7 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
             want_cols = compute_u        # normalized columns -> U
         else:
             work = r.T.astype(dtype)     # L: lower-triangular, (n, n)
-            accumulate = compute_u and not u_solve   # rotations -> U
+            accumulate = compute_u       # rotations -> U
             want_cols = compute_v        # normalized columns -> V
     else:
         work = a
@@ -430,32 +416,6 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
         interpret=interpret, polish=polish, bulk_bf16=bulk_bf16,
         stall_detection=stall_detection)
 
-    u_err = jnp.float32(0.0)
-    if u_solve:
-        # G = L^{-1} W: the sweep loop only ever right-multiplies the padded
-        # [L | 0] by orthogonal transforms (and padded zero columns never
-        # mix — they deflate in the kernel), so W = L G[:n, :] exactly, and
-        # the sorted restriction is one triangular solve on the sorted
-        # columns. One Newton-Schulz step (reusing the G^T G already formed
-        # for the verification statistic) restores orthogonality to the
-        # f32 floor when L was fit for the solve.
-        a_work = _deblockify(top, bot)               # (n, n_pad)
-        s, _, a_sorted = _sigma_sort(a_work, n)      # a_sorted: (n, n)
-        rot = jax.lax.linalg.triangular_solve(
-            r, a_sorted, left_side=True, lower=False, transpose_a=True)
-        eye = jnp.eye(n, dtype=acc)
-        gram = jnp.matmul(rot.T, rot, precision=hi)
-        u_err = jnp.max(jnp.abs(gram - eye)).astype(jnp.float32)
-        rot = jnp.matmul(rot, 1.5 * eye - 0.5 * gram, precision=hi)
-        u = jnp.matmul(q1, rot, precision=hi).astype(dtype)
-        if full_u and m > n:
-            u = _complete_orthonormal(u, n, dtype)
-        v = None
-        if compute_v:
-            cols = _normalize_cols(a_sorted, s, dtype)
-            v = jnp.zeros_like(cols).at[order, :].set(cols)
-        return u, s.astype(dtype), v, sweeps, off_rel, u_err
-
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
     cols, s, rot = _postprocess(a_work, v_work, n, compute_u=want_cols,
@@ -469,7 +429,7 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
         if compute_v:
             v = jnp.matmul(q2, rot.astype(acc), precision=hi)
             v = jnp.zeros_like(v).at[order, :].set(v).astype(dtype)
-        return u, s, v, sweeps, off_rel, u_err
+        return u, s, v, sweeps, off_rel
     if precondition == "on":
         u = v = None
         if compute_u:
@@ -478,11 +438,11 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
                 u = _complete_orthonormal(u, n, dtype)
         if compute_v:
             v = jnp.zeros_like(cols).at[order, :].set(cols)
-        return u, s, v, sweeps, off_rel, u_err
+        return u, s, v, sweeps, off_rel
     u = cols
     if compute_u and full_u and m > n and u is not None:
         u = _complete_orthonormal(u, n, dtype)
-    return u, s, rot, sweeps, off_rel, u_err
+    return u, s, rot, sweeps, off_rel
 
 
 def svd(
@@ -521,6 +481,8 @@ def svd(
     n_pad = 2 * k * b
     tol, gram_dtype_name, method, criterion = _resolve_options(
         a, config, compute_uv=compute_u)
+    if config.precondition not in ("auto", "on", "off", "double"):
+        raise ValueError(f"unknown precondition mode: {config.precondition!r}")
 
     if method == "pallas":
         if b % 2:
@@ -528,53 +490,28 @@ def svd(
             b += 1
             k = max(1, -(-n // (2 * b)))
             n_pad = 2 * k * b
-        if config.precondition not in ("auto", "on", "off", "double"):
-            raise ValueError(f"unknown precondition mode: {config.precondition!r}")
         precondition = ("on" if config.precondition == "auto"
                         else config.precondition)
         bulk_bf16 = (config.bulk_bf16 if config.bulk_bf16 is not None
                      else False)
-        if config.u_recovery not in ("auto", "accumulate", "solve"):
-            raise ValueError(f"unknown u_recovery mode: {config.u_recovery!r}")
-        # "auto" resolves to accumulate: measured at 8192^2 f32 (random
-        # input), the solved G's pre-polish orthogonality error already
-        # exceeds the gate — the unconverged couplings (~sqrt(n)*eps) are
-        # amplified by the scaled condition of L, exactly the dgejsv
-        # COND_OK failure mode — so the "fast" path would pay solve + full
-        # accumulated re-run. Explicit "solve" remains for matrices known
-        # to be modestly conditioned, where it removes the V stacks from
-        # the whole sweep loop.
-        if config.u_recovery == "solve" and precondition != "on":
-            # Reject the unsatisfiable combination instead of silently
-            # downgrading: the solve recovery IS the triangular factor
-            # relation of the single-precondition path.
-            raise ValueError(
-                "u_recovery='solve' requires precondition 'on'/'auto' "
-                f"(got precondition={config.precondition!r})")
-        traced = isinstance(a, jax.core.Tracer)
-        u_solve = (precondition == "on" and compute_u
-                   and config.u_recovery == "solve")
-        kwargs = dict(
-            n=n, compute_u=compute_u, compute_v=compute_v,
+        u, s, v, sweeps, off_rel = _svd_pallas(
+            a, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
             polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
             interpret=not pb.supported(),
             stall_detection=bool(config.stall_detection))
-        u, s, v, sweeps, off_rel, u_err = _svd_pallas(
-            a, u_solve=u_solve, **kwargs)
-        # NB `not (<= gate)` rather than `> gate`: a rank-deficient input
-        # gives R an exact-zero diagonal, the triangular solve produces
-        # non-finite values, and a NaN u_err must take the fallback too.
-        if u_solve and not traced and not (float(u_err) <= _U_SOLVE_GATE):
-            # L was too ill-conditioned for the one-shot triangular solve
-            # (dgejsv's COND_OK test, except measured on the actual solved G
-            # rather than estimated): re-run with in-loop accumulation. The
-            # check costs one scalar readback, paid only on this path.
-            u, s, v, sweeps, off_rel, _ = _svd_pallas(
-                a, u_solve=False, **kwargs)
         return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
+    if config.precondition in ("on", "double"):
+        # Pallas-only mode explicitly requested on an XLA block-solver path
+        # (f64 input, tiny n, or explicit pair_solver): raise instead of
+        # silently ignoring it — mirroring the mesh solver's rejection of
+        # unsupported modes (parallel/sharded.py).
+        raise ValueError(
+            f"precondition={config.precondition!r} requires the Pallas "
+            f"kernel path (pair_solver='pallas'/'auto'); this solve "
+            f"resolved to pair_solver={method!r}")
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
     u, s, v, sweeps, off_rel = _svd_padded(
         a_pad, n=n, compute_u=compute_u, compute_v=compute_v,

@@ -65,17 +65,44 @@ def sigma_error(s, s_ref):
 
 
 def live_orthogonality_error(u, s):
-    """||U^T U - I|| over columns whose sigma is above the roundoff floor."""
-    import numpy as np
+    """||U^T U - I||_F over columns whose sigma is above the roundoff floor.
+
+    Computed on device (zeroing the dead columns instead of slicing keeps
+    the shape static under jit): a full-factor host transfer through the
+    tunnel would be ~1 GB at 16384^2 on every CLI validate() call. f64
+    factors with x64 disabled would be silently downcast by jit (an ~eps_f32
+    measurement floor); route them through the host instead."""
+    # NB raw input dtype, not jnp.asarray(...).dtype — the conversion itself
+    # is what would downcast an f64 numpy array under disabled x64.
+    if (str(getattr(s, "dtype", "")) == "float64"
+            and not jax.config.jax_enable_x64):
+        import numpy as np
+        un = np.asarray(u, np.float64)
+        sn = np.asarray(s, np.float64)
+        eps = np.finfo(np.float64).eps
+        live = sn > (sn[0] * max(un.shape[0], len(sn)) * eps * 10
+                     if len(sn) else 0)
+        ul = un[:, : len(sn)][:, live]
+        g = ul.T @ ul - np.eye(ul.shape[1])
+        return jnp.asarray(np.linalg.norm(g))
+    return _live_orthogonality_error_jit(u, s)
+
+
+@jax.jit
+def _live_orthogonality_error_jit(u, s):
     # jnp.finfo understands ml_dtypes (bfloat16 has numpy kind 'V', so
     # np.finfo alone would mis-handle it).
-    eps = float(jnp.finfo(jnp.asarray(s).dtype).eps)
-    u = np.asarray(u, np.float64)
-    s = np.asarray(s, np.float64)
-    live = s > (s[0] * max(u.shape[0], len(s)) * eps * 10 if len(s) else 0)
-    ul = u[:, : len(s)][:, live]
-    g = ul.T @ ul - np.eye(ul.shape[1])
-    return jnp.asarray(np.linalg.norm(g))
+    eps = jnp.finfo(jnp.asarray(s).dtype).eps
+    acc = jnp.promote_types(u.dtype, jnp.float32)
+    n = s.shape[0]
+    u = u[:, :n].astype(acc)
+    s = s.astype(acc)
+    floor = s[0] * max(u.shape[0], n) * eps * 10 if n else jnp.zeros((), acc)
+    live = s > floor
+    ul = u * live[None, :].astype(acc)
+    g = jnp.einsum("mi,mj->ij", ul, ul, precision=jax.lax.Precision.HIGHEST)
+    eye = jnp.where(live, 1.0, 0.0).astype(acc)
+    return jnp.linalg.norm(g - jnp.diag(eye))
 
 
 def validate(a, result, s_ref=None) -> ValidationReport:

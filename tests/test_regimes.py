@@ -116,110 +116,6 @@ def test_precondition_double(shape, cu, cv, full):
         assert res / np.linalg.norm(a64) < 5e-6
 
 
-def _ortho_err(x):
-    xn = np.asarray(x, np.float64)
-    g = xn.T @ xn
-    return np.max(np.abs(g - np.eye(g.shape[0])))
-
-
-@pytest.mark.parametrize("shape", [(96, 96), (160, 96)])
-def test_u_recovery_solve_well_conditioned(shape):
-    """u_recovery='solve' (dgejsv fast path: G = L^{-1} W by one triangular
-    solve instead of in-loop accumulation) must match the accumulate path's
-    accuracy on a well-conditioned input — residual, sigma, and U/V
-    orthogonality all at the f32 floor."""
-    rng = np.random.default_rng(3)
-    m, n = shape
-    a = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
-    rs = sj.svd(a, config=SVDConfig(u_recovery="solve", pair_solver="pallas"))
-    ra = sj.svd(a, config=SVDConfig(u_recovery="accumulate",
-                                    pair_solver="pallas"))
-    a64 = np.asarray(a, np.float64)
-    s_ref = np.linalg.svd(a64, compute_uv=False)
-    # The solve path's residual floor is kappa_scaled-amplified (its NS
-    # re-orthogonalization displaces G by the unconverged-coupling error —
-    # see SVDConfig.u_recovery); the accumulate path sits at the f32 floor.
-    for r, res_tol in ((rs, 2e-5), (ra, 5e-6)):
-        res = np.linalg.norm(
-            np.asarray(r.u, np.float64) * np.asarray(r.s, np.float64)
-            @ np.asarray(r.v, np.float64).T - a64) / np.linalg.norm(a64)
-        assert res < res_tol
-        assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
-        assert _ortho_err(r.u) < 5e-5
-        assert _ortho_err(r.v) < 5e-5
-
-
-def test_u_recovery_solve_ill_conditioned_falls_back():
-    """On a strongly graded spectrum L is unfit for the triangular solve:
-    the measured orthogonality gate must trigger the accumulated re-run and
-    the final result must be as accurate as the accumulate path."""
-    rng = np.random.default_rng(4)
-    n = 96
-    s_true = np.geomspace(1.0, 1e-6, n)
-    q1, _ = np.linalg.qr(rng.standard_normal((n, n)))
-    q2, _ = np.linalg.qr(rng.standard_normal((n, n)))
-    a = jnp.asarray(q1 * s_true @ q2.T, jnp.float32)
-    r = sj.svd(a, config=SVDConfig(u_recovery="solve", pair_solver="pallas",
-                                   max_sweeps=32))
-    # Whatever path it took, U must be orthogonal (the accumulate path's
-    # guarantee) — a non-orthogonal U here means the gate failed to fire.
-    assert _ortho_err(r.u) < 1e-4
-    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
-    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
-
-
-def test_u_recovery_solve_rank_deficient_nan_gate():
-    """Exact-zero columns give R an exact-zero diagonal; the triangular
-    solve then produces non-finite values and u_err is NaN. The fallback
-    gate must treat NaN as failure (a plain `> gate` comparison is False
-    for NaN) and re-run accumulated — the result must be NaN-free."""
-    rng = np.random.default_rng(7)
-    n = 96
-    a = rng.standard_normal((n, n)).astype(np.float32)
-    a[:, -8:] = 0.0
-    r = sj.svd(jnp.asarray(a), config=SVDConfig(u_recovery="solve",
-                                                pair_solver="pallas"))
-    assert np.isfinite(np.asarray(r.u)).all()
-    assert np.isfinite(np.asarray(r.s)).all()
-    s_ref = np.linalg.svd(a.astype(np.float64), compute_uv=False)
-    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
-
-
-def test_u_recovery_solve_under_outer_jit():
-    """svd() must stay traceable by an outer jit even with the solve
-    recovery: the host-readback fallback gate is skipped under trace (auto
-    resolves to accumulate there; explicit 'solve' runs gateless)."""
-    rng = np.random.default_rng(5)
-    a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
-    cfg = SVDConfig(u_recovery="solve", pair_solver="pallas")
-    f = jax.jit(lambda x: sj.svd(x, config=cfg)[:3])
-    u, s, v = f(a)
-    res = np.linalg.norm(
-        np.asarray(u, np.float64) * np.asarray(s, np.float64)
-        @ np.asarray(v, np.float64).T - np.asarray(a, np.float64))
-    # solve-recovery residual class (kappa_scaled-amplified); see above
-    assert res / np.linalg.norm(np.asarray(a)) < 2e-5
-
-
-def test_u_recovery_auto_is_accumulate():
-    """auto must resolve to the always-safe accumulate path (measured: at
-    8192^2 the solve gate fires on plain random input, so auto-solve would
-    pay for both runs — see SVDConfig.u_recovery). Behavioral check: the
-    auto and accumulate runs take the identical code path, so their outputs
-    are bitwise equal; the solve path computes U differently."""
-    assert SVDConfig().u_recovery == "auto"
-    rng = np.random.default_rng(6)
-    a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
-    r_auto = sj.svd(a, config=SVDConfig(pair_solver="pallas"))
-    r_acc = sj.svd(a, config=SVDConfig(pair_solver="pallas",
-                                       u_recovery="accumulate"))
-    r_sol = sj.svd(a, config=SVDConfig(pair_solver="pallas",
-                                       u_recovery="solve"))
-    assert np.array_equal(np.asarray(r_auto.u), np.asarray(r_acc.u))
-    assert np.array_equal(np.asarray(r_auto.v), np.asarray(r_acc.v))
-    assert not np.array_equal(np.asarray(r_auto.u), np.asarray(r_sol.u))
-
-
 @pytest.mark.parametrize("method", ["hybrid", "qr-svd"])
 def test_conditioning_sweep_xla_paths(method):
     """The XLA block-solver paths (used by the sharded solver) under a

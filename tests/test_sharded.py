@@ -195,12 +195,10 @@ def test_instrumented_sharded():
 
 
 def test_mesh_rejects_single_device_only_modes():
-    """precondition / u_recovery='solve' are single-device features; the
-    mesh solver must reject them loudly instead of silently ignoring them
-    (and recording them in reports as if applied)."""
+    """Single-device-only config modes must be rejected loudly by the mesh
+    solver instead of silently ignored (and recorded in reports as if
+    applied)."""
     a = jnp.ones((16, 16), jnp.float32)
     mesh = sharded.make_mesh(jax.devices()[:1])
     with pytest.raises(ValueError, match="precondition"):
         sharded.svd(a, mesh=mesh, config=SVDConfig(precondition="double"))
-    with pytest.raises(ValueError, match="u_recovery"):
-        sharded.svd(a, mesh=mesh, config=SVDConfig(u_recovery="solve"))
