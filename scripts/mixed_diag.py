@@ -1,0 +1,99 @@
+"""Phase breakdown of the mixed bf16-bulk regime on the attached chip.
+
+Times each stage of solver._svd_pallas's mixed path separately (bulk bf16
+sweeps / NS + reconstitution / f32 polish) and reports per-phase sweep
+counts, so MIXED_TOL and the NS step count can be tuned against the
+single-jit end-to-end number. Usage:
+
+    python scripts/mixed_diag.py [N] [mixed_tol] [ns_steps]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from svd_jacobi_tpu import solver
+from svd_jacobi_tpu.ops import rounds
+from svd_jacobi_tpu.utils import matgen
+
+
+def timed(fn, *args):
+    from svd_jacobi_tpu.utils._exec import force
+    out = fn(*args)
+    force(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    force(out)
+    return time.perf_counter() - t0, out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    mixed_tol = float(sys.argv[2]) if len(sys.argv) > 2 else rounds.MIXED_TOL
+    ns_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    a = matgen.random_dense(n, n, dtype=jnp.float32)
+    cfg_b, k = solver._plan(n, 1, __import__("svd_jacobi_tpu").SVDConfig())
+    nblocks, n_pad = 2 * k, 2 * k * cfg_b
+    print(f"n={n} b={cfg_b} k={k} mixed_tol={mixed_tol} ns={ns_steps}")
+
+    @jax.jit
+    def precond(a):
+        norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
+        order = jnp.argsort(-norms)
+        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1))
+        return q1, r, order
+
+    t_pre, (q1, r, order) = timed(precond, a)
+    work = r.T
+
+    @jax.jit
+    def bulk(work):
+        top, bot = solver._blockify(work, n_pad, nblocks)
+        vt, vb = solver._blockify(jnp.eye(n_pad, dtype=work.dtype),
+                                  n_pad, nblocks)
+        _, _, vt, vb, off, sweeps = rounds.iterate_phase(
+            top, bot, vt, vb, stop_tol=jnp.float32(mixed_tol),
+            rtol=mixed_tol, max_sweeps=32, interpret=False, polish=True,
+            bf16_gram=True, apply_x3=True,
+            stall_gate=10 * mixed_tol, stall_shrink=0.5)
+        return vt, vb, off, sweeps
+
+    t_bulk, (vt, vb, boff, bsweeps) = timed(bulk, work)
+    print(f"precond {t_pre:.3f}s | bulk {t_bulk:.3f}s sweeps={int(bsweeps)} "
+          f"off={float(boff):.3e}")
+
+    @jax.jit
+    def reconstitute(work, vt, vb):
+        g = solver._ns_orthogonalize(solver._deblockify(vt, vb), ns_steps)
+        x = jnp.matmul(work.astype(g.dtype), g[:work.shape[1], :],
+                       precision=jax.lax.Precision.HIGHEST)
+        top, bot = solver._blockify(x, n_pad, nblocks)
+        gt, gb = solver._blockify(g, n_pad, nblocks)
+        return top, bot, gt, gb
+
+    t_rec, (top, bot, gt, gb) = timed(reconstitute, work, vt, vb)
+    # orthogonality of G pre/post NS
+    g_raw = solver._deblockify(vt, vb).astype(jnp.float32)
+    gram = jnp.matmul(g_raw.T, g_raw, precision=jax.lax.Precision.HIGHEST)
+    e0 = float(jnp.max(jnp.abs(gram - jnp.eye(n_pad))))
+    print(f"reconstitute+NS {t_rec:.3f}s (G orth err pre-NS {e0:.3e})")
+
+    @jax.jit
+    def polish(top, bot, gt, gb):
+        tol = float(np.sqrt(n) * np.finfo(np.float32).eps)
+        return rounds.iterate(top, bot, gt, gb, tol=tol, max_sweeps=32,
+                              interpret=False, polish=True, bulk_bf16=False)
+
+    t_pol, (_, _, _, _, poff, psweeps) = timed(polish, top, bot, gt, gb)
+    print(f"polish {t_pol:.3f}s sweeps={int(psweeps)} off={float(poff):.3e}")
+    total = t_pre + t_bulk + t_rec + t_pol
+    print(f"total (stage sum) {total:.3f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Differential intra-jit component timing on the attached chip.
+
+THE methodology behind PROFILE.md's component table: time a `lax.fori_loop`
+of 4R vs R iterations of one component inside ONE jit and divide the time
+difference by 3R. This cancels the tunnel's per-call dispatch+readback RTT
+(~80 ms) and its per-dispatch overhead, which swamp naive per-op timing
+(the retired scripts/profile_parts2.py queued-dispatch approach measured
+negative numbers).
+
+Each iteration perturbs its input from the loop carry so XLA cannot hoist
+the body out of the loop, and the carry keeps a live data dependency so
+iterations serialize.
+
+Usage:
+    python scripts/profile_intrajit.py [component ...]
+    python scripts/profile_intrajit.py --list
+    python scripts/profile_intrajit.py --n 8192        # all, at that size
+
+Components default to the PROFILE.md table shapes (N=2048, b=128 panels
+(8, 2048, 256)); --n scales the panel stacks to that matrix size.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def _diff_time(body, init, r: int = 8, factor: int = 4):
+    """Seconds per iteration of ``body`` by the 4R-vs-R differential."""
+    from svd_jacobi_tpu.utils._exec import force
+
+    def loop(reps):
+        @jax.jit
+        def run(x):
+            return jax.lax.fori_loop(0, reps, body, x)
+        return run
+
+    short, long_ = loop(r), loop(factor * r)
+    force(short(init))   # compile + warm
+    force(long_(init))
+    ts = te = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter(); force(short(init)); ts = min(ts, time.perf_counter() - t0)
+        t0 = time.perf_counter(); force(long_(init)); te = min(te, time.perf_counter() - t0)
+    return max(0.0, (te - ts) / ((factor - 1) * r))
+
+
+def _perturb(i, x):
+    # data-dependent nudge: keeps the loop body live without changing scale
+    return x * (1.0 + jnp.float32(1e-7) * jnp.float32(i))
+
+
+def _dep(x, y):
+    # carry-shaped output that DEPENDS on the measured component's result
+    # (so it cannot be dead-code-eliminated) at ~one elementwise pass cost.
+    # NB the factor must be nonzero: XLA constant-folds `0.0 * y` and then
+    # eliminates y's producer entirely (observed: gram_einsum rows reading
+    # 0 ms). 1e-30 * y is numerically invisible but keeps the edge.
+    return x * (1.0 + jnp.float32(1e-30) * y.ravel()[0].astype(jnp.float32))
+
+
+def components(n: int, b: int = 128):
+    """name -> (body, init) registry at matrix size n (panels (k, n, 2b))."""
+    from svd_jacobi_tpu.ops import pallas_apply as pa
+    from svd_jacobi_tpu.ops import pallas_blocks as pb
+    from svd_jacobi_tpu.ops import pallas_gram as pg
+    from svd_jacobi_tpu.ops import rounds
+
+    k = max(1, n // (2 * b))
+    rng = np.random.default_rng(0)
+    top = jnp.asarray(rng.standard_normal((k, n, b)), jnp.float32)
+    bot = jnp.asarray(rng.standard_normal((k, n, b)), jnp.float32)
+    x2 = jnp.concatenate([top, bot], axis=-1)
+    g = jnp.einsum("kmi,kmj->kij", x2, x2, precision=HI)
+    q = jnp.asarray(np.stack([np.linalg.qr(
+        rng.standard_normal((2 * b, 2 * b)))[0] for _ in range(k)]),
+        jnp.float32)
+
+    reg = {}
+
+    def add(name, body, init):
+        reg[name] = (body, init)
+
+    add("gram_einsum_f32_hi",
+        lambda i, x: _dep(x, jnp.einsum("kmi,kmj->kij", _perturb(i, x), x,
+                                        precision=HI)), x2)
+    add("gram_einsum_bf16",
+        lambda i, x: _dep(x, jnp.einsum("kmi,kmj->kij",
+                                        _perturb(i, x).astype(jnp.bfloat16),
+                                        x.astype(jnp.bfloat16),
+                                        preferred_element_type=jnp.float32)),
+        x2)
+    add("gram_kernel_f32",
+        lambda i, x: _dep(x, pg.gram_pairs(_perturb(i, x)[..., :b],
+                                           x[..., b:])), x2)
+    add("gram_kernel_bf16",
+        lambda i, x: _dep(x, pg.gram_pairs(_perturb(i, x)[..., :b],
+                                           x[..., b:], bf16=True)), x2)
+    add("apply_einsum_f32_hi",
+        lambda i, x: jnp.einsum("kmi,kij->kmj", _perturb(i, x), q,
+                                precision=HI,
+                                preferred_element_type=jnp.float32), x2)
+    add("apply_einsum_bf16",
+        lambda i, x: jnp.einsum("kmi,kij->kmj",
+                                _perturb(i, x).astype(jnp.bfloat16),
+                                q.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32), x2)
+    add("apply_einsum_x3",
+        lambda i, x: rounds._einsum(_perturb(i, x), q, "kmi,kij->kmj",
+                                    x3=True), x2)
+
+    def fused(i, st, **kw):
+        t, b_ = st
+        t, b_ = pa.apply_exchange(_perturb(i, t), b_, q, **kw)
+        return t, b_
+
+    add("apply_kernel_f32_hi", lambda i, st: fused(i, st), (top, bot))
+    add("apply_kernel_x3", lambda i, st: fused(i, st, x3=True), (top, bot))
+    add("rot_kernel_cross",
+        lambda i, gg: pb.cross_rotations(_perturb(i, gg)), g)
+    return reg
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    flags = [a for a in sys.argv[1:] if a.startswith("--")]
+    n = 2048
+    for f in flags:
+        if f.startswith("--n"):
+            n = int(f.split("=", 1)[1]) if "=" in f else int(args.pop(0))
+    reg = components(n)
+    if "--list" in flags:
+        print("\n".join(reg))
+        return
+    names = args or list(reg)
+    print(f"n={n}: differential intra-jit ms/iter "
+          f"(device {jax.devices()[0]})")
+    for name in names:
+        body, init = reg[name]
+        ms = _diff_time(body, init) * 1e3
+        print(f"  {name:24s} {ms:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

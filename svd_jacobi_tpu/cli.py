@@ -133,10 +133,10 @@ def main(argv=None) -> int:
         # self-test spends a full solve.
         log("triangular input requires m == n; use --matrix dense")
         return 2
-    if args.distributed and args.precondition in ("on", "double"):
-        # Knowable at parse time: single-device-only modes (the mesh
+    if args.distributed and args.precondition == "double":
+        # Knowable at parse time: a single-device-only mode (the mesh
         # solver would raise the same rejection mid-run).
-        log("--precondition on/double are single-device modes; "
+        log("--precondition double is a single-device mode; "
             "not supported with --distributed")
         return 2
     if args.precondition in ("on", "double") and (

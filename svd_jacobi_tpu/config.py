@@ -65,6 +65,25 @@ class SVDConfig:
     # result at 8192^2). Kept as an option for bandwidth-starved setups.
     # Single-chip path only; the sharded solve runs full-precision grams.
     bulk_bf16: Optional[bool] = None
+    # Mixed-precision bulk (the BASELINE.json north-star regime: "mixed
+    # bf16 compute / fp32 accumulate", f32-class results). Three stages:
+    #   1. bulk sweeps on bf16 copies of the stacks — Gram panels AND
+    #      rotation applies run native bf16-in/f32-accumulate on the MXU
+    #      (measured 138 vs 25 TF/s for the apply matmuls) — down to the
+    #      bf16 drift floor (ops.rounds.MIXED_TOL);
+    #   2. the accumulated rotation product G is re-orthogonalized in f32
+    #      (Newton-Schulz) and the working matrix is RECONSTITUTED as
+    #      X = L @ G at HIGHEST precision — this deletes the bf16 rounding
+    #      drift between X and G, which is a backward error no amount of
+    #      later polishing could remove;
+    #   3. standard f32 sweeps polish to the f32 tolerance.
+    # The accuracy contract is therefore the same f32 class as the pure-f32
+    # path (residual/sigma set by stage 3's arithmetic), bought at bf16
+    # bulk throughput. None = auto: ON for float32 inputs on the Pallas
+    # path (the bulk stage always accumulates G — it is the reconstitution
+    # map — so NoVec solves pay a small accumulator overhead in bulk and
+    # drop it for the f32 polish). Single-chip path only.
+    mixed_bulk: Optional[bool] = None
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2
     # (LAPACK-dgesvd class). "auto" follows the pair solver.

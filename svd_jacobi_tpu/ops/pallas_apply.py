@@ -66,14 +66,33 @@ def _perm_maps(k: int, exchange: bool):
 
 
 def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
-            out_t_ref, out_b_ref, *, b):
+            out_t_ref, out_b_ref, *, b, x3):
     f32 = jnp.float32
+    bf16 = jnp.bfloat16
+
+    def raw(x, w, prec):
+        return jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                   precision=prec, preferred_element_type=f32)
+
+    if xtt_ref.dtype == bf16:
+        # bf16 stacks run the MXU natively (one bf16-in/f32-acc pass;
+        # HIGHEST is an f32-operand notion — Mosaic rejects it on bf16).
+        mm = lambda x, w: raw(x, w, None)
+    elif x3:
+        # bf16x3 split product (the mixed-bulk apply regime): ~eps_bf16^2
+        # error at 3 native passes — rotations applied this way keep the
+        # accumulated product orthogonal to ~1e-4 over a whole solve.
+        def mm(x, w):
+            xh = x.astype(bf16)
+            xl = (x - xh.astype(f32)).astype(bf16)
+            wh = w.astype(bf16)
+            wl = (w - wh.astype(f32)).astype(bf16)
+            return raw(xh, wh, None) + (raw(xl, wh, None) + raw(xh, wl, None))
+    else:
+        mm = lambda x, w: raw(x.astype(f32), w, HI)
 
     def dot2(xt, xb, q):
-        mm = lambda x, w: jax.lax.dot_general(
-            x, w, (((1,), (0,)), ((), ())), precision=HI,
-            preferred_element_type=f32)
-        return mm(xt.astype(f32), q[:b]) + mm(xb.astype(f32), q[b:])
+        return mm(xt, q[:b]) + mm(xb, q[b:])
 
     out_t_ref[0] = dot2(xtt_ref[0], xbt_ref[0],
                         qt_ref[0]).astype(out_t_ref.dtype)
@@ -114,9 +133,10 @@ def supported(m: int, b: int) -> bool:
     return b % 128 == 0 and _pick_chunk(m, b) >= 128
 
 
-@functools.partial(jax.jit, static_argnames=("exchange", "interpret", "vma"))
+@functools.partial(jax.jit, static_argnames=("exchange", "interpret", "vma",
+                                             "x3"))
 def apply_exchange(top, bot, q, *, exchange: bool = True,
-                   interpret: bool = False, vma=None):
+                   interpret: bool = False, vma=None, x3: bool = False):
     """(new_top, new_bot) = post-exchange stacks of ([top|bot] @ q).
 
     top/bot: (k, m, b) column stacks; q: (k, 2b, 2b) orthogonal panels.
@@ -136,13 +156,14 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
     # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
     # (q is (k, 2b, 2b) — tiny next to the stacks).
     ql, qr = q[..., :b], q[..., b:]
-    f32 = jnp.float32
+    # Match the q strips to the stacks' compute dtype (see _kernel).
+    qdt = jnp.bfloat16 if top.dtype == jnp.bfloat16 else jnp.float32
     qt = jnp.where(jnp.asarray(top_half_t)[:, None, None],
                    jnp.take(ql, jnp.asarray(pair_t), axis=0),
-                   jnp.take(qr, jnp.asarray(pair_t), axis=0)).astype(f32)
+                   jnp.take(qr, jnp.asarray(pair_t), axis=0)).astype(qdt)
     qb = jnp.where(jnp.asarray(top_half_b)[:, None, None],
                    jnp.take(ql, jnp.asarray(pair_b), axis=0),
-                   jnp.take(qr, jnp.asarray(pair_b), axis=0)).astype(f32)
+                   jnp.take(qr, jnp.asarray(pair_b), axis=0)).astype(qdt)
 
     # Closed-form slot maps (index maps run as scalar-core programs; no
     # table gathers): with exchange, pt(i) = 0 for i <= 1 else i - 1 and
@@ -162,7 +183,7 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
     from .pallas_blocks import _out_struct
     out = _out_struct((k, m, b), top.dtype, vma)
     new_top, new_bot = pl.pallas_call(
-        functools.partial(_kernel, b=b),
+        functools.partial(_kernel, b=b, x3=x3),
         grid=(k, m // mc),
         in_specs=[x_spec(pt_fn), x_spec(pt_fn), x_spec(pb_fn), x_spec(pb_fn),
                   q_spec, q_spec],

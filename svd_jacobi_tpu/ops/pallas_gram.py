@@ -42,7 +42,7 @@ def _chunk(m: int, b: int) -> int:
     return _pick_chunk(m, b, _ROW_BLOCKS, _fixed_bytes(b))
 
 
-def _kernel(xt_ref, xb_ref, gxx_ref, gxy_ref, gyy_ref):
+def _kernel(xt_ref, xb_ref, gxx_ref, gxy_ref, gyy_ref, *, bf16):
     from jax.experimental import pallas as pl
 
     f32 = jnp.float32
@@ -54,10 +54,20 @@ def _kernel(xt_ref, xb_ref, gxx_ref, gxy_ref, gyy_ref):
         gxy_ref[...] = jnp.zeros_like(gxy_ref)
         gyy_ref[...] = jnp.zeros_like(gyy_ref)
 
-    xt = xt_ref[0].astype(f32)
-    xb = xb_ref[0].astype(f32)
+    # bf16 stacks — or f32 stacks under the ``bf16`` compute mode (the
+    # mixed-bulk regime: Gram noise only perturbs rotation angles/stats) —
+    # contract natively in one bf16-in/f32-acc MXU pass (HIGHEST is an
+    # f32-operand notion; Mosaic rejects it on bf16). Otherwise f32 at
+    # HIGHEST. Accumulators stay f32 either way.
+    if xt_ref.dtype == jnp.bfloat16 or bf16:
+        xt = xt_ref[0].astype(jnp.bfloat16)
+        xb = xb_ref[0].astype(jnp.bfloat16)
+        prec = None
+    else:
+        xt, xb = xt_ref[0].astype(f32), xb_ref[0].astype(f32)
+        prec = HI
     dot = lambda a, b: jax.lax.dot_general(
-        a, b, (((0,), (0,)), ((), ())), precision=HI,
+        a, b, (((0,), (0,)), ((), ())), precision=prec,
         preferred_element_type=f32)[None]
     gxx_ref[...] += dot(xt, xt)
     gxy_ref[...] += dot(xt, xb)
@@ -70,13 +80,15 @@ def supported(m: int, b: int) -> bool:
     return b % 128 == 0 and _chunk(m, b) >= 128
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "vma"))
-def gram_pairs(top, bot, *, interpret: bool = False, vma=None):
+@functools.partial(jax.jit, static_argnames=("interpret", "vma", "bf16"))
+def gram_pairs(top, bot, *, interpret: bool = False, vma=None,
+               bf16: bool = False):
     """(k, 2b, 2b) symmetric Gram panels of the stacked pairs.
 
-    Equal (to f32 reduction-order rounding) to
-    ``einsum('kmi,kmj->kij', x, x)`` with ``x = concat([top, bot], -1)``
-    — without materializing x. ``vma``: see pallas_apply.apply_exchange.
+    Equal (to f32 reduction-order rounding; single-bf16-pass rounding under
+    ``bf16``) to ``einsum('kmi,kmj->kij', x, x)`` with
+    ``x = concat([top, bot], -1)`` — without materializing x. ``vma``: see
+    pallas_apply.apply_exchange.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -89,7 +101,7 @@ def gram_pairs(top, bot, *, interpret: bool = False, vma=None):
                           memory_space=pltpu.VMEM)
     out = _out_struct((k, b, b), jnp.float32, vma)
     gxx, gxy, gyy = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, bf16=bf16),
         grid=(k, m // mc),
         in_specs=[x_spec, x_spec],
         out_specs=[g_spec] * 3,

@@ -48,7 +48,35 @@ HI = jax.lax.Precision.HIGHEST
 BULK_TOL = 3e-2
 
 
-def _einsum(a, b, spec, bf16=False):
+def _split_bf16(x):
+    """(hi, lo) bf16 split of an f32 array: x ~= hi + lo to ~eps_bf16^2.
+
+    The split is done by BIT-MASKING the low mantissa half (truncation):
+    the naive form ``x - x.astype(bf16).astype(f32)`` is folded to zero by
+    XLA (verified on-chip: its x3 product came out bit-identical to the
+    single-pass bf16 product), which silently degraded the whole split to
+    one pass. hi is exact in bf16 (mantissa already truncated) and x - hi
+    is exact in f32, so the only loss is lo's own bf16 rounding (~2^-16
+    relative to x)."""
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    hi = jax.lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000),
+                                      jnp.float32)
+    return hi.astype(jnp.bfloat16), (x - hi).astype(jnp.bfloat16)
+
+
+def _einsum(a, b, spec, bf16=False, x3=False):
+    """Contraction at one of three precision regimes: f32 HIGHEST (6-pass
+    emulation, ~25 TF/s on v5e), single native bf16 pass (~138 TF/s,
+    ~eps_bf16 input rounding), or the bf16x3 split product
+    hi@hi + lo@hi + hi@lo (~46 TF/s, ~eps_bf16^2 ~ 1.5e-5 error — the
+    mixed-bulk apply regime, accurate enough that the accumulated rotation
+    product stays orthogonal to ~1e-4 over a full solve's applies)."""
+    if x3:
+        ah, al = _split_bf16(a)
+        bh, bl = _split_bf16(b)
+        f = lambda p, q: jnp.einsum(spec, p, q,
+                                    preferred_element_type=jnp.float32)
+        return f(ah, bh) + (f(al, bh) + f(ah, bl))
     if bf16:
         return jnp.einsum(spec, a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
                           preferred_element_type=jnp.float32)
@@ -99,7 +127,7 @@ def _mesh_max(x, axis_name):
 
 
 def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
-               axis_name=None):
+               axis_name=None, apply_x3=False):
     """Annihilate every within-block pair once (full tournament kernel).
 
     ``axis_name``: when run under shard_map, the mesh axis — the round-skip
@@ -115,9 +143,11 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
         blocks, vblocks = args
         q = _rotations(g, "self", interpret=interpret, polish=polish,
                        axis_name=axis_name)
-        blocks = _einsum(blocks, q, "kmi,kij->kmj").astype(blocks.dtype)
+        blocks = _einsum(blocks, q, "kmi,kij->kmj",
+                         x3=apply_x3).astype(blocks.dtype)
         if vblocks is not None:
-            vblocks = _einsum(vblocks, q, "kmi,kij->kmj").astype(vblocks.dtype)
+            vblocks = _einsum(vblocks, q, "kmi,kij->kmj",
+                              x3=apply_x3).astype(vblocks.dtype)
         return blocks, vblocks
 
     blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
@@ -127,7 +157,7 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
 
 def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
                 bf16_gram, axis_name=None, fused_exchange=False,
-                fused_apply=False):
+                fused_apply=False, apply_x3=False):
     """Annihilate every cross pair of each (top[i], bot[i]) block pair.
     ``axis_name``: see `self_round`.
 
@@ -144,12 +174,13 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
     """
     b = top.shape[-1]
     vma = (axis_name,) if axis_name is not None else None
-    if not bf16_gram and not interpret and pg.supported(top.shape[1], b):
+    if not interpret and pg.supported(top.shape[1], b):
         # Compiled path: the Pallas reduction kernel forms the Gram panel
         # at ~2x the throughput of the XLA batched einsum on this
         # reduction-heavy small-output shape (PROFILE.md item 10), and
-        # never materializes the (k, m, 2b) concat.
-        g = pg.gram_pairs(top, bot, vma=vma)
+        # never materializes the (k, m, 2b) concat (under ``bf16_gram`` it
+        # casts per-chunk in VMEM and contracts in one native pass).
+        g = pg.gram_pairs(top, bot, vma=vma, bf16=bf16_gram)
     else:
         x = jnp.concatenate([top, bot], axis=-1)
         g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
@@ -161,9 +192,9 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
             top, bot, vtop, vbot = args
             q = _rotations(g, "cross", interpret=interpret, polish=polish,
                            axis_name=axis_name)
-            top, bot = pa.apply_exchange(top, bot, q)
+            top, bot = pa.apply_exchange(top, bot, q, x3=apply_x3)
             if vtop is not None:
-                vtop, vbot = pa.apply_exchange(vtop, vbot, q)
+                vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3)
             return top, bot, vtop, vbot
 
         def skip_branch(args):
@@ -190,17 +221,18 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
                        axis_name=axis_name)
         if fused_apply:
             top, bot = pa.apply_exchange(top, bot, q, exchange=False,
-                                         vma=vma)
+                                         vma=vma, x3=apply_x3)
             if vtop is not None:
                 vtop, vbot = pa.apply_exchange(vtop, vbot, q,
-                                               exchange=False, vma=vma)
+                                               exchange=False, vma=vma,
+                                               x3=apply_x3)
             return top, bot, vtop, vbot
         xn = _einsum(jnp.concatenate([top, bot], axis=-1), q,
-                     "kmi,kij->kmj").astype(top.dtype)
+                     "kmi,kij->kmj", x3=apply_x3).astype(top.dtype)
         top, bot = xn[..., :b], xn[..., b:]
         if vtop is not None:
             vn = _einsum(jnp.concatenate([vtop, vbot], axis=-1), q,
-                         "kmi,kij->kmj").astype(vtop.dtype)
+                         "kmi,kij->kmj", x3=apply_x3).astype(vtop.dtype)
             vtop, vbot = vn[..., :b], vn[..., b:]
         return top, bot, vtop, vbot
 
@@ -210,7 +242,7 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
 
 
 def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
-          axis_name=None, n_rounds=None, exchange=None):
+          axis_name=None, n_rounds=None, exchange=None, apply_x3=False):
     """One full sweep: self round + cross tournament rounds.
 
     Every pair of the n columns is annihilated exactly once: n-1 sequential
@@ -240,7 +272,7 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
     blocks, vblocks, rel_self = self_round(
         blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
-        bf16_gram=bf16_gram, axis_name=axis_name)
+        bf16_gram=bf16_gram, axis_name=axis_name, apply_x3=apply_x3)
     top, bot = blocks[:k], blocks[k:]
     if with_v:
         vtop, vbot = vblocks[:k], vblocks[k:]
@@ -251,7 +283,7 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
             top, bot, vtop if with_v else None, vbot if with_v else None,
             dmax2, rtol, interpret=interpret,
             polish=polish, bf16_gram=bf16_gram, axis_name=axis_name,
-            fused_exchange=fused, fused_apply=mesh_fused)
+            fused_exchange=fused, fused_apply=mesh_fused, apply_x3=apply_x3)
         if with_v:
             vtop, vbot = nvt, nvb
         if not fused:
@@ -275,56 +307,81 @@ def _global_dmax2(top, bot):
                        jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
 
 
-def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
-            bulk_bf16, stall_detection=True):
-    """Sweep until the masked coupling drops below ``tol``.
+# Bulk-phase target for the mixed bf16x3-compute regime (solver
+# "mixed_bulk"): couplings below this are at the split regime's drift
+# floor (~eps_bf16^2 per apply, random-walked over a solve's ~n applies)
+# — converging the bulk further is wasted work, the f32 polish re-measures
+# from the reconstituted state anyway.
+MIXED_TOL = 1e-3
 
-    Two phases when ``bulk_bf16``: bf16-Gram sweeps down to BULK_TOL, then
-    full-precision sweeps to ``tol``. ``max_sweeps`` is a TOTAL budget.
-    Stall detection (same constants as solver._should_continue's rel
-    branch): once the coupling is in the endgame (< 1e-4) and a sweep fails
-    to shrink it 4x, the dtype's floor is reached — stop instead of burning
-    the rest of the budget.
+
+def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
+                  interpret, polish, bf16_gram, stall_detection=True,
+                  stall_gate=1e-4, stall_shrink=0.25, start_sweeps=0,
+                  apply_x3=False):
+    """`lax.while_loop` of `sweep`s until the masked coupling drops below
+    ``stop_tol`` (or the TOTAL sweep counter — which starts at
+    ``start_sweeps`` — hits ``max_sweeps``, or a stall). Stall: once the
+    coupling is below ``stall_gate`` (the phase's endgame) and a sweep
+    fails to shrink it by 1/``stall_shrink``, the phase's floor is reached.
+    Returns (top, bot, vtop, vbot, off, sweeps).
     """
     with_v = vtop is not None
     k = top.shape[0]
     if vtop is None:
         vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
 
-    def phase(state, stop_tol, rtol, bf16_gram):
-        def cond(st):
-            _, _, _, _, off, prev_off, sweeps = st
-            go = jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
-            if stall_detection:
-                stalled = jnp.logical_and(off < 1e-4, off > 0.25 * prev_off)
-                go = jnp.logical_and(go, jnp.logical_not(stalled))
-            return go
+    def cond(st):
+        _, _, _, _, off, prev_off, sweeps = st
+        go = jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
+        if stall_detection:
+            stalled = jnp.logical_and(off < stall_gate,
+                                      off > stall_shrink * prev_off)
+            go = jnp.logical_and(go, jnp.logical_not(stalled))
+        return go
 
-        def body(st):
-            top, bot, vtop, vbot, prev_off, _, sweeps = st
-            dmax2 = _global_dmax2(top, bot)
-            top, bot, nvt, nvb, off = sweep(
-                top, bot, vtop if with_v else None, vbot if with_v else None,
-                dmax2, rtol, interpret=interpret, polish=polish,
-                bf16_gram=bf16_gram)
-            if not with_v:
-                nvt, nvb = st[2], st[3]
-            return (top, bot, nvt, nvb, off, prev_off, sweeps + 1)
-
-        return jax.lax.while_loop(cond, body, state)
+    def body(st):
+        top, bot, vtop, vbot, prev_off, _, sweeps = st
+        dmax2 = _global_dmax2(top, bot)
+        top, bot, nvt, nvb, off = sweep(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            dmax2, rtol, interpret=interpret, polish=polish,
+            bf16_gram=bf16_gram, apply_x3=apply_x3)
+        if not with_v:
+            nvt, nvb = st[2], st[3]
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1)
 
     inf = jnp.float32(jnp.inf)
-    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
-    bulk_off = inf
-    bulk_sweeps = jnp.int32(0)
+    state = (top, bot, vtop, vbot, inf, inf,
+             jnp.asarray(start_sweeps, jnp.int32))
+    top, bot, vtop, vbot, off, _, sweeps = jax.lax.while_loop(
+        cond, body, state)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off, sweeps)
+
+
+def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
+            bulk_bf16, stall_detection=True, start_sweeps=0):
+    """Sweep until the masked coupling drops below ``tol``.
+
+    Two phases when ``bulk_bf16``: bf16-Gram sweeps down to BULK_TOL, then
+    full-precision sweeps to ``tol``. ``max_sweeps`` is a TOTAL budget
+    (including ``start_sweeps`` already spent by the caller — the mixed
+    bulk phase). Stall constants are solver._should_continue's rel branch.
+    """
+    kwargs = dict(max_sweeps=max_sweeps, interpret=interpret, polish=polish,
+                  stall_detection=stall_detection)
+    bulk_off = jnp.float32(jnp.inf)
+    bulk_sweeps = jnp.asarray(start_sweeps, jnp.int32)
     if bulk_bf16:
-        state = phase(state, jnp.float32(BULK_TOL), BULK_TOL, True)
-        bulk_off, bulk_sweeps = state[4], state[6]
-        # Reset the off carries so the full-precision phase re-measures.
-        state = (state[0], state[1], state[2], state[3], inf, inf, state[6])
-    top, bot, vtop, vbot, off, _, sweeps = phase(state, tol, tol, False)
+        top, bot, vtop, vbot, bulk_off, bulk_sweeps = iterate_phase(
+            top, bot, vtop, vbot, stop_tol=jnp.float32(BULK_TOL),
+            rtol=BULK_TOL, bf16_gram=True, start_sweeps=bulk_sweeps,
+            **kwargs)
+    top, bot, vtop, vbot, off, sweeps = iterate_phase(
+        top, bot, vtop, vbot, stop_tol=tol, rtol=tol, bf16_gram=False,
+        start_sweeps=bulk_sweeps, **kwargs)
     # If the bulk phase consumed the whole budget, report its statistic
     # rather than the untouched inf carry (cf. solver._svd_padded hybrid).
     off = jnp.where(sweeps > bulk_sweeps, off, bulk_off)
-    return (top, bot, (vtop if with_v else None), (vbot if with_v else None),
-            off, sweeps)
+    return top, bot, vtop, vbot, off, sweeps

@@ -282,14 +282,18 @@ def svd(
     if config is None:
         config = SVDConfig()
     # Single-device-only config modes are REJECTED here rather than
-    # silently ignored: the mesh solve runs Jacobi on A directly (a
-    # distributed QR preconditioner does not exist on this path, and the
-    # triangular-solve U recovery depends on it).
-    if config.precondition not in ("auto", "off"):
+    # silently ignored (recording them in reports as if applied).
+    if config.precondition not in ("auto", "on", "off", "double"):
+        raise ValueError(f"unknown precondition mode: {config.precondition!r}")
+    if config.precondition == "double":
         raise ValueError(
-            f"precondition={config.precondition!r} is not supported by the "
-            "mesh solver (it runs unpreconditioned); use the single-device "
-            "svd() for QR preconditioning")
+            "precondition='double' (dgejsv's second QR) is not supported by "
+            "the mesh solver; use 'on'/'auto' (single QR) or the "
+            "single-device svd()")
+    if config.mixed_bulk:
+        raise ValueError(
+            "mixed_bulk is a single-device mode (the mesh solver runs "
+            "full-precision sweeps); leave it None/False for mesh solves")
     a = jnp.asarray(a)
     if a.ndim != 2:
         raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
@@ -312,6 +316,18 @@ def svd(
         # of the device count).
         b += 1
     n_pad = 2 * k * b
+    # QR preconditioning (sweep parity with the single-chip solver — the
+    # round-3 mesh path ran Jacobi on raw A and paid ~4 extra sweeps):
+    # only the Pallas/qr-svd methods read U off the rotated columns with
+    # the inverted bookkeeping the recombination needs; gram-eigh/hybrid
+    # keep their own convergence structure, and an explicit "on" there is
+    # rejected by the single-device solver too.
+    precondition = (config.precondition == "auto" and method == "pallas"
+                    ) or config.precondition == "on"
+    if config.precondition == "on" and method != "pallas":
+        raise ValueError(
+            f"precondition='on' requires the Pallas kernel path; this "
+            f"solve resolved to pair_solver={method!r}")
 
     u, s, v, sweeps, off_rel = _svd_sharded_jit(
         a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
@@ -319,6 +335,7 @@ def svd(
         full_u=full_matrices, tol=tol, max_sweeps=int(config.max_sweeps),
         precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
+        precondition=bool(precondition),
         stall_detection=bool(config.stall_detection),
         kernel_polish=bool(config.kernel_polish))
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
@@ -327,17 +344,30 @@ def svd(
 @partial(jax.jit, static_argnames=(
     "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
     "compute_v", "full_u", "tol", "max_sweeps", "precision",
-    "gram_dtype_name", "method", "criterion", "stall_detection",
-    "kernel_polish"))
+    "gram_dtype_name", "method", "criterion", "precondition",
+    "stall_detection", "kernel_polish"))
 def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      compute_u, compute_v, full_u, tol, max_sweeps, precision,
-                     gram_dtype_name, method, criterion, stall_detection=True,
-                     kernel_polish=True):
+                     gram_dtype_name, method, criterion, precondition=False,
+                     stall_detection=True, kernel_polish=True):
     m = a.shape[0]
     dtype = a.dtype
     block_spec = P(axis_name, None, None)  # shard the pair-slot axis
 
-    top, bot = _single._blockify(a, n_pad, nblocks)
+    if precondition:
+        # Drmac-style QR preconditioning, single-controller semantics (the
+        # QR and the recombination matmuls run under GSPMD outside the
+        # shard_map loop; the sweep loop then works on the n x n triangle
+        # L = R^T — SMALLER stacks than raw A for tall inputs). The
+        # factorization and recombination are the single-device solver's
+        # own helpers, so the two paths cannot drift.
+        q1, _, order, work = _single._precondition_qr(a)
+        accumulate = compute_u        # rotations -> U
+    else:
+        work = a
+        accumulate = compute_v
+
+    top, bot = _single._blockify(work, n_pad, nblocks)
     top = lax.with_sharding_constraint(top, NamedSharding(mesh, block_spec))
     bot = lax.with_sharding_constraint(bot, NamedSharding(mesh, block_spec))
 
@@ -345,7 +375,7 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
         partial(_sharded_jacobi, axis_name=axis_name, n_devices=n_devices,
                 n_rounds=sched.num_rounds(nblocks), tol=tol, max_sweeps=max_sweeps,
                 precision=precision, gram_dtype_name=gram_dtype_name,
-                method=method, criterion=criterion, with_v=compute_v,
+                method=method, criterion=criterion, with_v=accumulate,
                 n_pad=n_pad, nblocks=nblocks,
                 stall_detection=stall_detection, kernel_polish=kernel_polish),
         mesh=mesh,
@@ -355,7 +385,14 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
     top, bot, vtop, vbot, off_rel, sweeps = jacobi(top, bot)
 
     a_work = _single._deblockify(top, bot)
-    v_work = _single._deblockify(vtop, vbot)[:n, :] if compute_v else None
+    v_work = _single._deblockify(vtop, vbot)[:n, :] if accumulate else None
+    if precondition:
+        cols, s, rot = _single._postprocess(
+            a_work, v_work, n, compute_u=compute_v, full_u=False, dtype=dtype)
+        u, v = _single._recombine_precondition(
+            cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=full_u, dtype=dtype, q1=q1, order=order)
+        return u, s, v, sweeps, off_rel
     u, s, v = _single._postprocess(a_work, v_work, n, compute_u=compute_u,
                                    full_u=full_u, dtype=dtype)
     return u, s, v, sweeps, off_rel

@@ -357,13 +357,63 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
     return u, s, v, sweeps, off_rel
 
 
+def _precondition_qr(a):
+    """Drmac-style preconditioning factorization, shared by the single-chip
+    Pallas solve and the mesh solve so their bookkeeping cannot diverge:
+    norm-sort the columns, factor A P = Q1 R, return
+    (q1, r, order, work = R^T) — the sweep loop then runs on the graded
+    lower-triangular L = R^T. QR in f32 at minimum: sub-f32 dtypes have no
+    QR kernel (LAPACK or TPU), and the factorization must be exact at
+    working precision."""
+    norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
+    order = jnp.argsort(-norms)
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1).astype(acc))
+    return q1, r, order, r.T.astype(a.dtype)
+
+
+def _recombine_precondition(cols, rot, *, m, n, compute_u, compute_v,
+                            full_u, dtype, q1, order):
+    """(u, v) recombination for the single-QR bookkeeping (rotation
+    product -> U, normalized columns -> V): with A P = Q1 L^T and
+    L = U_L S V_L^T, A = (Q1 V_L) S (P U_L)^T — so U = Q1 @ rot and V
+    scatters the normalized columns back through the norm-sort
+    permutation. Shared by solver._svd_pallas and parallel.sharded."""
+    hi = jax.lax.Precision.HIGHEST
+    u = v = None
+    if compute_u:
+        u = jnp.matmul(q1, rot, precision=hi).astype(dtype)
+        if full_u and m > n:
+            u = _complete_orthonormal(u, n, dtype)
+    if compute_v:
+        v = jnp.zeros_like(cols).at[order, :].set(cols)
+    return u, v
+
+
+def _ns_orthogonalize(g, steps: int = 3):
+    """Newton-Schulz polar iteration ``g <- g (1.5 I - 0.5 g^T g)``.
+
+    Quadratic contraction of the orthogonality error (valid for
+    ||g^T g - I|| < 1): 3 steps take the bf16 bulk accumulator's ~1e-1
+    error to the f32 floor. Padded identity rows/columns are exact fixed
+    points (their Gram block is exactly I), so the padded structure the
+    reconstitution relies on survives."""
+    hi = jax.lax.Precision.HIGHEST
+    g = g.astype(jnp.promote_types(g.dtype, jnp.float32))
+    eye = jnp.eye(g.shape[0], dtype=g.dtype)
+    for _ in range(steps):
+        gram = jnp.matmul(g.T, g, precision=hi)
+        g = jnp.matmul(g, 1.5 * eye - 0.5 * gram, precision=hi)
+    return g
+
+
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
-    "max_sweeps", "precondition", "polish", "bulk_bf16", "interpret",
-    "stall_detection"))
+    "max_sweeps", "precondition", "polish", "bulk_bf16", "mixed",
+    "interpret", "stall_detection"))
 def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
-                max_sweeps, precondition, polish, bulk_bf16, interpret,
-                stall_detection=True):
+                max_sweeps, precondition, polish, bulk_bf16, mixed,
+                interpret, stall_detection=True):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -374,17 +424,21 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     A = (Q1 V_L) S (P U_L)^T, so the ROTATION product becomes U and the
     normalized COLUMNS become V — the accumulation is only needed when U is
     wanted, and V comes free.
+
+    ``mixed`` (SVDConfig.mixed_bulk — the north-star regime): bulk sweeps
+    run on bf16 COPIES of the stacks (native bf16-in/f32-acc MXU passes)
+    while always accumulating the rotation product G; at the bf16 floor
+    (rounds.MIXED_TOL) the bf16 X is DISCARDED — its drift against L.G is
+    an irreducible backward error — and the f32 state is reconstituted as
+    X = L @ NS(G) at HIGHEST precision, from which standard f32 sweeps
+    polish to ``tol``. Result accuracy is therefore the f32 class.
     """
     m = a.shape[0]
     dtype = a.dtype
     hi = jax.lax.Precision.HIGHEST
     if precondition in ("on", "double"):
-        norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
-        order = jnp.argsort(-norms)
-        # QR in f32 at minimum: sub-f32 dtypes have no QR kernel (LAPACK or
-        # TPU), and the factorization must be exact at working precision.
+        q1, r, order, work = _precondition_qr(a)
         acc = jnp.promote_types(dtype, jnp.float32)
-        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1).astype(acc))
         if precondition == "double":
             # Second preconditioning (dgejsv's QRF-then-LQF structure): QR
             # the transposed triangle again and run Jacobi on R2^T. With
@@ -411,10 +465,46 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     else:
         vtop = vbot = None
 
+    bulk_off = jnp.float32(jnp.inf)
+    bulk_sweeps = jnp.int32(0)
+    if mixed:
+        # Stage 1 (bulk): sweeps with bf16x3 split applies (~46 TF/s vs 25
+        # at HIGHEST; per-apply error ~eps_bf16^2 so the rotation product
+        # stays orthogonal to ~1e-4) and single-pass bf16 Gram panels
+        # (noise only perturbs rotation angles/stats, harmless above
+        # MIXED_TOL). G is ALWAYS accumulated here — it is the
+        # reconstitution map — even when the caller wants no factors.
+        if accumulate:
+            gvt, gvb = vtop, vbot
+        else:
+            gvt, gvb = _blockify(jnp.eye(n_pad, dtype=dtype), n_pad, nblocks)
+        _, _, gvt, gvb, bulk_off, bulk_sweeps = rounds.iterate_phase(
+            top, bot, gvt, gvb, stop_tol=jnp.float32(rounds.MIXED_TOL),
+            rtol=rounds.MIXED_TOL, max_sweeps=max_sweeps,
+            interpret=interpret, polish=polish, bf16_gram=True,
+            apply_x3=True, stall_detection=stall_detection,
+            stall_gate=10.0 * rounds.MIXED_TOL, stall_shrink=0.5)
+        # Stage 2 (reconstitute): orthogonalize G in f32 (it is ~1e-4 off
+        # after the split-regime applies; 2 Newton-Schulz steps reach the
+        # f32 floor), then rebuild the stacks exactly as work @ G — the
+        # bulk X is DISCARDED, deleting its X-vs-L.G drift (padded columns
+        # never mix — they deflate in the kernel — so
+        # [work | 0] @ G == work @ G[:cols]).
+        g = _ns_orthogonalize(_deblockify(gvt, gvb), steps=2)
+        x = jnp.matmul(work.astype(g.dtype), g[:work.shape[1], :],
+                       precision=hi).astype(dtype)
+        top, bot = _blockify(x, n_pad, nblocks)
+        if accumulate:
+            vtop, vbot = _blockify(g.astype(dtype), n_pad, nblocks)
+
+    # f32 sweeps (stage 3 of the mixed regime, or the whole solve).
     top, bot, vtop, vbot, off_rel, sweeps = rounds.iterate(
         top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
         interpret=interpret, polish=polish, bulk_bf16=bulk_bf16,
-        stall_detection=stall_detection)
+        stall_detection=stall_detection, start_sweeps=bulk_sweeps)
+    # Mixed budget-exhaustion: report the bulk statistic if the polish
+    # never ran (cf. rounds.iterate's identical carry handling).
+    off_rel = jnp.where(sweeps > bulk_sweeps, off_rel, bulk_off)
 
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
@@ -431,13 +521,9 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
             v = jnp.zeros_like(v).at[order, :].set(v).astype(dtype)
         return u, s, v, sweeps, off_rel
     if precondition == "on":
-        u = v = None
-        if compute_u:
-            u = jnp.matmul(q1, rot, precision=hi).astype(dtype)
-            if full_u and m > n:
-                u = _complete_orthonormal(u, n, dtype)
-        if compute_v:
-            v = jnp.zeros_like(cols).at[order, :].set(cols)
+        u, v = _recombine_precondition(
+            cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=full_u, dtype=dtype, q1=q1, order=order)
         return u, s, v, sweeps, off_rel
     u = cols
     if compute_u and full_u and m > n and u is not None:
@@ -494,24 +580,40 @@ def svd(
                         else config.precondition)
         bulk_bf16 = (config.bulk_bf16 if config.bulk_bf16 is not None
                      else False)
+        # The north-star mixed regime (SVDConfig.mixed_bulk): the bf16x3
+        # split is an f32 construction, so explicit True on another dtype
+        # is rejected; auto yields to an explicitly requested bulk_bf16.
+        if config.mixed_bulk and a.dtype != jnp.float32:
+            raise ValueError(
+                "mixed_bulk (bf16x3 bulk sweeps + f32 polish) requires a "
+                f"float32 input, got {a.dtype}")
+        mixed = (config.mixed_bulk if config.mixed_bulk is not None
+                 else a.dtype == jnp.float32 and not bulk_bf16)
+        if mixed and bulk_bf16:
+            raise ValueError(
+                "bulk_bf16 (bf16 Gram panels inside the f32 loop) and "
+                "mixed_bulk (bf16x3 bulk sweeps + f32 polish) are mutually "
+                "exclusive bulk strategies")
         u, s, v, sweeps, off_rel = _svd_pallas(
             a, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
             polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
-            interpret=not pb.supported(),
+            mixed=bool(mixed), interpret=not pb.supported(),
             stall_detection=bool(config.stall_detection))
         return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
-    if config.precondition in ("on", "double"):
-        # Pallas-only mode explicitly requested on an XLA block-solver path
-        # (f64 input, tiny n, or explicit pair_solver): raise instead of
-        # silently ignoring it — mirroring the mesh solver's rejection of
-        # unsupported modes (parallel/sharded.py).
+    if config.precondition in ("on", "double") or config.mixed_bulk:
+        # Pallas-only modes explicitly requested on an XLA block-solver
+        # path (f64 input, tiny n, or explicit pair_solver): raise instead
+        # of silently ignoring them — mirroring the mesh solver's
+        # rejection of unsupported modes (parallel/sharded.py).
+        bad = ("mixed_bulk=True" if config.mixed_bulk
+               else f"precondition={config.precondition!r}")
         raise ValueError(
-            f"precondition={config.precondition!r} requires the Pallas "
-            f"kernel path (pair_solver='pallas'/'auto'); this solve "
-            f"resolved to pair_solver={method!r}")
+            f"{bad} requires the Pallas kernel path "
+            f"(pair_solver='pallas'/'auto'); this solve resolved to "
+            f"pair_solver={method!r}")
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
     u, s, v, sweeps, off_rel = _svd_padded(
         a_pad, n=n, compute_u=compute_u, compute_v=compute_v,

@@ -133,3 +133,73 @@ def test_conditioning_sweep_xla_paths(method):
     sn = np.asarray(r.s, np.float64)
     s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
     assert np.max(np.abs(sn - s_ref)) / s_ref[0] < 5e-6
+
+
+@pytest.mark.parametrize("cu,cv", [(True, True), (False, False)])
+def test_mixed_bulk_f32_accuracy_class(cu, cv):
+    """The mixed bf16x3-bulk regime (SVDConfig.mixed_bulk) must deliver the
+    SAME accuracy class as the pure-f32 path: the bulk X is discarded and
+    the state reconstituted as L @ NS(G) at HIGHEST, so residual and sigma
+    are set by the f32 polish, not the bf16 bulk."""
+    rng = np.random.default_rng(11)
+    a = jnp.asarray(rng.standard_normal((192, 192)), jnp.float32)
+    r = sj.svd(a, config=SVDConfig(mixed_bulk=True, pair_solver="pallas"),
+               compute_u=cu, compute_v=cv)
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 2e-6
+    if cu and cv:
+        u, v = np.asarray(r.u, np.float64), np.asarray(r.v, np.float64)
+        res = np.linalg.norm(u * np.asarray(r.s, np.float64) @ v.T
+                             - np.asarray(a, np.float64))
+        assert res / np.linalg.norm(np.asarray(a)) < 5e-6
+        assert np.max(np.abs(u.T @ u - np.eye(192))) < 1e-4
+        assert np.max(np.abs(v.T @ v - np.eye(192))) < 1e-4
+
+
+def test_mixed_bulk_matches_pure_f32_on_padding():
+    """Mixed reconstitution relies on padded columns never mixing
+    ([work | 0] @ G == work @ G[:n]); a non-multiple-of-block n exercises
+    real padding."""
+    rng = np.random.default_rng(12)
+    a = jnp.asarray(rng.standard_normal((150, 100)), jnp.float32)
+    r = sj.svd(a, config=SVDConfig(mixed_bulk=True, pair_solver="pallas",
+                                   block_size=16))
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 2e-6
+
+
+def test_mixed_bulk_mode_validation():
+    """Loud rejection of unsatisfiable mixed_bulk combinations: non-f32
+    input, collision with bulk_bf16, non-Pallas pair solver. Auto must
+    yield to an explicit bulk_bf16=True instead of raising."""
+    rng = np.random.default_rng(13)
+    a32 = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+    with pytest.raises(ValueError, match="float32"):
+        sj.svd(a32.astype(jnp.bfloat16),
+               config=SVDConfig(mixed_bulk=True, pair_solver="pallas"))
+    with pytest.raises(ValueError, match="exclusive"):
+        sj.svd(a32, config=SVDConfig(mixed_bulk=True, bulk_bf16=True))
+    with pytest.raises(ValueError, match="mixed_bulk"):
+        sj.svd(a32, config=SVDConfig(mixed_bulk=True, pair_solver="hybrid"))
+    r = sj.svd(a32, config=SVDConfig(bulk_bf16=True))  # auto yields
+    assert np.isfinite(np.asarray(r.s)).all()
+
+
+def test_split_bf16_not_folded():
+    """The x3 split must survive XLA: the naive cast-round-trip form was
+    constant-folded to zero (verified on-chip), silently degrading every
+    x3 product to one bf16 pass. Guard the bit-mask form."""
+    rng = np.random.default_rng(14)
+    x = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    q = jnp.asarray(np.linalg.qr(rng.standard_normal((64, 64)))[0],
+                    jnp.float32)
+    hi = jax.jit(lambda x, q: rounds._einsum(x[None], q[None], "kmi,kij->kmj"))(x, q)
+    x3 = jax.jit(lambda x, q: rounds._einsum(x[None], q[None], "kmi,kij->kmj",
+                                             x3=True))(x, q)
+    b1 = jax.jit(lambda x, q: rounds._einsum(x[None], q[None], "kmi,kij->kmj",
+                                             bf16=True))(x, q)
+    scale = float(jnp.max(jnp.abs(hi)))
+    err_x3 = float(jnp.max(jnp.abs(x3 - hi))) / scale
+    err_b1 = float(jnp.max(jnp.abs(b1 - hi))) / scale
+    assert err_x3 < 1e-4          # eps_bf16^2 class
+    assert err_x3 < err_b1 / 10   # and far below the single-pass error

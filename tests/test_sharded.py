@@ -202,3 +202,51 @@ def test_mesh_rejects_single_device_only_modes():
     mesh = sharded.make_mesh(jax.devices()[:1])
     with pytest.raises(ValueError, match="precondition"):
         sharded.svd(a, mesh=mesh, config=SVDConfig(precondition="double"))
+
+
+def test_mesh_preconditioned_solve_matches_oracle():
+    """The mesh solver preconditions by default now (QR outside shard_map,
+    inverted bookkeeping: rotations -> U, normalized columns -> V) — full
+    accuracy contract against the host oracle, including tall m > n and
+    every factor-option combination."""
+    rng = np.random.default_rng(31)
+    for (m, n), cu, cv, full in [((96, 96), True, True, False),
+                                 ((160, 96), True, True, True),
+                                 ((96, 96), True, False, False),
+                                 ((96, 96), False, True, False)]:
+        a = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        mesh = sharded.make_mesh()
+        r = sharded.svd(a, mesh=mesh, compute_u=cu, compute_v=cv,
+                        full_matrices=full)
+        a64 = np.asarray(a, np.float64)
+        s_ref = np.linalg.svd(a64, compute_uv=False)
+        assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+        if cu:
+            u = np.asarray(r.u, np.float64)
+            assert u.shape == ((m, m) if full else (m, n))
+            assert np.max(np.abs(u.T @ u - np.eye(u.shape[1]))) < 1e-4
+        if cv:
+            v = np.asarray(r.v, np.float64)
+            assert np.max(np.abs(v.T @ v - np.eye(n))) < 1e-4
+        if cu and cv:
+            res = np.linalg.norm(
+                np.asarray(r.u, np.float64)[:, :n] * np.asarray(r.s, np.float64)
+                @ np.asarray(r.v, np.float64).T - a64)
+            assert res / np.linalg.norm(a64) < 5e-6
+
+
+def test_mesh_precondition_sweep_parity():
+    """Preconditioning must cut mesh sweeps the way it does single-chip
+    (unpreconditioned mesh solves ran ~15 vs 11 sweeps at 2048^2 in r3)."""
+    rng = np.random.default_rng(32)
+    a = jnp.asarray(rng.standard_normal((128, 128)), jnp.float32)
+    mesh = sharded.make_mesh()
+    import svd_jacobi_tpu as sj
+    r_on = sharded.svd(a, mesh=mesh, config=SVDConfig(precondition="on"))
+    r_off = sharded.svd(a, mesh=mesh, config=SVDConfig(precondition="off"))
+    assert int(r_on.sweeps) <= int(r_off.sweeps)
+    # Like-for-like: the mesh runs pure-f32 sweeps, so compare against the
+    # single-chip solver with the mixed bulk off (its sweep counter counts
+    # bulk + polish otherwise).
+    single = sj.svd(a, config=SVDConfig(mixed_bulk=False))
+    assert abs(int(r_on.sweeps) - int(single.sweeps)) <= 2
