@@ -41,15 +41,7 @@ def main():
     nblocks, n_pad = 2 * k, 2 * k * cfg_b
     print(f"n={n} b={cfg_b} k={k} mixed_tol={mixed_tol} ns={ns_steps}")
 
-    @jax.jit
-    def precond(a):
-        norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
-        order = jnp.argsort(-norms)
-        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1))
-        return q1, r, order
-
-    t_pre, (q1, r, order) = timed(precond, a)
-    work = r.T
+    t_pre, (q1, r, order, work) = timed(jax.jit(solver._precondition_qr), a)
 
     @jax.jit
     def bulk(work):

@@ -78,12 +78,21 @@ class SVDConfig:
     #      later polishing could remove;
     #   3. standard f32 sweeps polish to the f32 tolerance.
     # The accuracy contract is therefore the same f32 class as the pure-f32
-    # path (residual/sigma set by stage 3's arithmetic), bought at bf16
-    # bulk throughput. None = auto: ON for float32 inputs on the Pallas
-    # path (the bulk stage always accumulates G — it is the reconstitution
-    # map — so NoVec solves pay a small accumulator overhead in bulk and
-    # drop it for the f32 polish). Single-chip path only.
+    # path (residual/sigma set by stage 3's arithmetic; measured residual
+    # is in fact ~2x BETTER — the reconstitution deletes the sweep loop's
+    # accumulated drift). None = auto: currently OFF — on v5e the fused
+    # apply kernel is HBM-traffic-bound, not FLOP-bound, so the cheaper
+    # bulk arithmetic cannot pay for the bulk+polish sweep overhead
+    # (measured at 2048/4096/8192; see PROFILE.md). The bulk stage always
+    # accumulates G — it is the reconstitution map. Single-chip path only.
     mixed_bulk: Optional[bool] = None
+    # Post-convergence sigma refinement: recompute W = A @ V (or A^T @ U)
+    # at HIGHEST from the ORIGINAL matrix and read sigma off W's
+    # compensated column norms. Removes the ~sqrt(m)*eps drift the sweep
+    # loop accumulates in the column norms (measured: sigma-err 1.2e-6 ->
+    # ~1e-7 at 2048^2 f32) for one extra matmul. None = auto: ON whenever
+    # a factor is computed (Pallas path and mesh solver); False to skip.
+    sigma_refine: Optional[bool] = None
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2
     # (LAPACK-dgesvd class). "auto" follows the pair solver.

@@ -82,11 +82,19 @@ def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
         # bf16x3 split product (the mixed-bulk apply regime): ~eps_bf16^2
         # error at 3 native passes — rotations applied this way keep the
         # accumulated product orthogonal to ~1e-4 over a whole solve.
+        # Split by BIT-MASKING the low mantissa half, like
+        # rounds._split_bf16: the naive cast-round-trip form is folded to
+        # zero by XLA (verified on-chip) and nothing stops Mosaic from
+        # learning the same simplification.
+        def split(x):
+            bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+            hi = jax.lax.bitcast_convert_type(
+                bits & jnp.uint32(0xFFFF0000), f32)
+            return hi.astype(bf16), (x - hi).astype(bf16)
+
         def mm(x, w):
-            xh = x.astype(bf16)
-            xl = (x - xh.astype(f32)).astype(bf16)
-            wh = w.astype(bf16)
-            wl = (w - wh.astype(f32)).astype(bf16)
+            xh, xl = split(x)
+            wh, wl = split(w)
             return raw(xh, wh, None) + (raw(xl, wh, None) + raw(xh, wl, None))
     else:
         mm = lambda x, w: raw(x.astype(f32), w, HI)

@@ -338,6 +338,13 @@ def svd(
         precondition=bool(precondition),
         stall_detection=bool(config.stall_detection),
         kernel_polish=bool(config.kernel_polish))
+    # Sigma refinement parity with the single-device solver: the
+    # refinement matmul runs under GSPMD against the (possibly sharded)
+    # input, outside the shard_map loop like the preconditioner.
+    refine = (config.sigma_refine if config.sigma_refine is not None
+              else (u is not None or v is not None))
+    if refine and (u is not None or v is not None):
+        u, s, v = _single._refine_sigma(a, u, s, v, use_v=v is not None)
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
@@ -436,6 +443,11 @@ class SweepStepper(_single.SweepStepper):
     SweepState contract — so `utils.checkpoint` and
     `utils.profiling.instrumented_svd` work on sharded solves unchanged.
     """
+
+    def _host_kernel_path(self) -> bool:
+        # The mesh stepper keeps the sharded XLA hybrid stepping (its
+        # kernel path lives inside shard_map and is the fused solver's).
+        return False
 
     def __init__(self, a, *, mesh: Optional[Mesh] = None,
                  compute_u: bool = True, compute_v: bool = True,
