@@ -15,10 +15,14 @@ Usage:
          --oracle=auto|on|off    (off skips the host f64 sigma oracle;
                                   auto skips it above 2048)
          --reps=K                (best-of-K interleaved timing, default 6)
+         --novec                 (sigma-only solve, jobu = jobv = NoVec)
          --sweep                 (run the whole BASELINE.md accelerator
                                   table — one JSON line per config — in a
                                   fresh subprocess each so compile caches
-                                  and HBM don't leak across sizes)
+                                  and HBM don't leak across sizes; a
+                                  baseline that cannot compile, e.g. XLA
+                                  svd at 16384^2, reports vs_baseline
+                                  null instead of failing the row)
 """
 
 from __future__ import annotations
@@ -45,40 +49,61 @@ def _time_interleaved(fns, *args, reps: int = 2):
     callables INTERLEAVED — the tunnel's latency drifts on the seconds
     scale, so back-to-back blocks would hand whichever runs second a
     different environment. The warm-up results are returned so callers do
-    not pay an extra full solve to get the factors."""
-    warms = []
-    for f in fns:
-        w = f(*args)
-        _force(w)  # compile + warm
+    not pay an extra full solve to get the factors.
+
+    A callable that FAILS to compile/run (e.g. `jnp.linalg.svd` at 16384^2
+    OOM-kills the remote TPU compile helper) gets time None and warm None
+    instead of sinking the whole bench run."""
+    warms, dead = [], set()
+    for i, f in enumerate(fns):
+        try:
+            w = f(*args)
+            _force(w)  # compile + warm
+        except Exception as e:
+            print(f"note: candidate {i} failed ({type(e).__name__}); "
+                  f"timing the others", file=sys.stderr)
+            w = None
+            dead.add(i)
         warms.append(w)
     best = [float("inf")] * len(fns)
     for _ in range(max(1, reps)):
         for i, f in enumerate(fns):
+            if i in dead:
+                continue
             t0 = time.perf_counter()
             _force(f(*args))
             best[i] = min(best[i], time.perf_counter() - t0)
+    best = [None if i in dead else b for i, b in enumerate(best)]
     return best, warms
 
 
-# The measured-table configs of BASELINE.md (square + tall-skinny, f32).
+# The measured-table configs of BASELINE.md (square + tall-skinny, f32,
+# up to the largest shapes that fit the 16 GB HBM; 16384^2 has no XLA
+# baseline — jnp.linalg.svd cannot compile there).
 SWEEP_CONFIGS = [
-    ("2048", "float32", None),
-    ("4096", "float32", None),
-    ("5000", "float32", None),
-    ("8192", "float32", None),
-    ("2048", "float32", "16384"),
-    ("4096", "float32", "65536"),
+    ("2048", "float32", None, []),
+    ("4096", "float32", None, []),
+    ("5000", "float32", None, []),
+    ("8192", "float32", None, []),
+    ("2048", "float32", "16384", []),
+    ("4096", "float32", "65536", []),
+    ("16384", "float32", None, ["--reps=1"]),
+    ("8192", "float32", "32768", []),
+    ("16384", "float32", None, ["--novec", "--reps=1"]),
 ]
 
 
 def _sweep(passthrough) -> None:
     """Run every SWEEP_CONFIGS row in a fresh subprocess, forwarding all
     other flags verbatim (--reps, --oracle, --baseline keep their
-    single-config semantics and defaults)."""
+    single-config semantics and defaults; a row's own flags win)."""
     import subprocess
-    for n, dtype, m in SWEEP_CONFIGS:
+    for n, dtype, m, row_flags in SWEEP_CONFIGS:
+        row_keys = {f.lstrip("-").split("=", 1)[0] for f in row_flags}
+        keep = [f for f in passthrough
+                if f.lstrip("-").split("=", 1)[0] not in row_keys]
         cmd = [sys.executable, __file__, n, dtype] + ([m] if m else [])
-        subprocess.run(cmd + passthrough, check=True)
+        subprocess.run(cmd + keep + row_flags, check=True)
 
 
 def main() -> None:
@@ -117,23 +142,39 @@ def main() -> None:
     dtype = jnp.dtype(dtype_name)
     a = matgen.random_dense(m, n, dtype=dtype)
 
-    ours = lambda x: sj.svd(x)
+    novec = "novec" in flags   # sigma-only solve (jobu = jobv = NoVec)
+    ours = lambda x: sj.svd(x, compute_u=not novec, compute_v=not novec)
     if baseline == "numpy":
         an = np.asarray(a)
         (t_ours, t_base), (r, _) = _time_interleaved(
-            [ours, lambda x: np.linalg.svd(an, full_matrices=False)], a,
+            [ours, lambda x: np.linalg.svd(an, full_matrices=False,
+                                           compute_uv=not novec)], a,
             reps=reps)
         base_name = "numpy.linalg.svd same host"
     else:
         (t_ours, t_base), (r, _) = _time_interleaved(
-            [ours, lambda x: jnp.linalg.svd(x, full_matrices=False)], a,
+            [ours, lambda x: jnp.linalg.svd(x, full_matrices=False,
+                                            compute_uv=not novec)], a,
             reps=reps)
         base_name = "jnp.linalg.svd same device"
 
+    if t_ours is None:
+        # Our own solver failed at this config (e.g. OOM): emit a row that
+        # says so instead of killing the rest of a --sweep run.
+        print(json.dumps({
+            "metric": f"svd_{m}x{n}_{dtype_name}"
+                      f"{'_novec' if novec else ''}_gflops",
+            "value": None, "unit": "GFLOP/s", "vs_baseline": None,
+            "error": "solver failed to compile/run at this config",
+            "device": str(jax.devices()[0])}))
+        return
+
     # Residual computed ON DEVICE at pinned precision (a host transfer of
     # the factors through the tunnel would dominate at large N).
-    res = float(np.asarray(validation.relative_residual(a, r.u, r.s, r.v)))
-    extras = {"residual_rel": res}
+    extras = {}
+    if r.u is not None and r.v is not None:
+        extras["residual_rel"] = float(
+            np.asarray(validation.relative_residual(a, r.u, r.s, r.v)))
     if oracle == "auto":
         oracle = "on" if max(m, n) <= 2048 else "off"
     if oracle == "on":
@@ -142,14 +183,17 @@ def main() -> None:
 
     flops = 4.0 * m * n**2 + 8.0 * n**3
     gflops = flops / t_ours / 1e9
+    tag = "_novec" if novec else ""
     print(json.dumps({
-        "metric": f"svd_{m}x{n}_{dtype_name}_gflops",
+        "metric": f"svd_{m}x{n}_{dtype_name}{tag}_gflops",
         "value": round(gflops, 2),
         "unit": "GFLOP/s",
-        "vs_baseline": round(t_base / t_ours, 3),
+        "vs_baseline": (round(t_base / t_ours, 3) if t_base is not None
+                        else None),
         "time_s": round(t_ours, 4),
-        "baseline_time_s": round(t_base, 4),
-        "baseline": base_name,
+        "baseline_time_s": (round(t_base, 4) if t_base is not None else None),
+        "baseline": (base_name if t_base is not None
+                     else f"{base_name}: FAILED TO COMPILE/RUN"),
         "sweeps": int(r.sweeps),
         "mfu": round(gflops * 1e9 / _PEAK_F32_EFF, 4),
         "device": str(jax.devices()[0]),

@@ -173,6 +173,15 @@ def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
 _global_dmax2 = rounds._global_dmax2
 
 
+def _host_scalar(x) -> float:
+    """Host value of a scalar that may be replicated over a multi-host
+    mesh (float()/np.asarray raise on non-fully-addressable arrays even
+    when every shard holds the same value)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return float(np.asarray(x.addressable_shards[0].data))
+    return float(x)
+
+
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
     """(m, n) -> top/bot stacks (k, m, b), zero-padding columns to n_pad."""
     m, n = a.shape
@@ -809,11 +818,23 @@ class SweepStepper:
     def input_digest(self) -> str:
         """Content hash of the input matrix, computed ONCE and cached (a
         full device->host transfer + SHA-256 per snapshot would rival the
-        cost of the sweep being checkpointed at large sizes)."""
+        cost of the sweep being checkpointed at large sizes). For a
+        non-fully-addressable (multi-host) input, hashes this process's
+        OWN shards — each process then validates its per-process snapshot
+        against the data it can actually see."""
         if self._input_digest is None:
             import hashlib
-            self._input_digest = hashlib.sha256(
-                np.ascontiguousarray(np.asarray(self.a)).tobytes()).hexdigest()
+            h = hashlib.sha256()
+            if isinstance(self.a, jax.Array) and not self.a.is_fully_addressable:
+                shards = sorted(self.a.addressable_shards,
+                                key=lambda s: str(s.index))
+                for sh in shards:
+                    h.update(str(sh.index).encode())
+                    h.update(np.ascontiguousarray(
+                        np.asarray(sh.data)).tobytes())
+            else:
+                h.update(np.ascontiguousarray(np.asarray(self.a)).tobytes())
+            self._input_digest = h.hexdigest()
         return self._input_digest
 
     def fingerprint_extra(self) -> dict:
@@ -859,7 +880,7 @@ class SweepStepper:
             self._prev_off = float("inf")
             self._just_switched = False
         else:
-            self._prev_off = float(state.off_rel)
+            self._prev_off = _host_scalar(state.off_rel)
         return self._run_sweep(state, method, criterion)
 
     def _run_sweep(self, state: SweepState, method, criterion) -> SweepState:
@@ -879,13 +900,14 @@ class SweepStepper:
         return SweepState(top, bot, vtop, vbot, off, state.sweeps + 1)
 
     def should_continue(self, state: SweepState) -> bool:
-        if int(state.sweeps) == 0:
+        sweeps = int(_host_scalar(state.sweeps))
+        if sweeps == 0:
             return True
-        if int(state.sweeps) >= self.config.max_sweeps:
+        if sweeps >= self.config.max_sweeps:
             return False
         _, criterion, tol = self._phase()
         go = bool(_should_continue(
-            float(state.off_rel), self._prev_off, int(state.sweeps),
+            _host_scalar(state.off_rel), self._prev_off, sweeps,
             tol=tol, max_sweeps=self.config.max_sweeps,
             stall_detection=self.config.stall_detection, criterion=criterion))
         if not go and self._stage == "bulk":

@@ -13,6 +13,8 @@ import sys
 
 def main():
     coord, pid, nproc, outfile = sys.argv[1:5]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "solve"
+    ckpt = sys.argv[6] if len(sys.argv) > 6 else None
 
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=2")
@@ -29,14 +31,34 @@ def main():
 
     mesh = sharded.make_mesh()
     a = launch.sharded_input(96, 96, mesh, seed=11)
-    r = sharded.svd(a, mesh=mesh)
+
+    if mode == "ckpt_save":
+        # Phase 1 of the kill-and-resume test: run two sweeps, write the
+        # per-process shard snapshots, and "crash" (exit without finish).
+        from svd_jacobi_tpu.utils import checkpoint
+        st = sharded.SweepStepper(a, mesh=mesh)
+        state = st.step(st.step(st.init()))
+        checkpoint.save_state(ckpt, st, state)
+        assert checkpoint._proc_path(ckpt).exists()
+        print(f"worker {pid} saved", flush=True)
+        return
+
+    if mode == "ckpt_resume":
+        # Phase 2: a fresh cluster resumes from the per-process files and
+        # finishes through the one-call API.
+        from svd_jacobi_tpu.utils import checkpoint
+        r = checkpoint.svd_checkpointed(a, path=ckpt, mesh=mesh)
+        assert not checkpoint._proc_path(ckpt).exists()  # removed on success
+    else:
+        r = sharded.svd(a, mesh=mesh)
     s = [float(x) for x in r.s]  # sigma is replicated -> addressable everywhere
 
     if ctx.is_coordinator:
         import json
+        from svd_jacobi_tpu.solver import _host_scalar
         with open(outfile, "w") as f:
-            json.dump({"s": s, "sweeps": int(r.sweeps),
-                       "off": float(r.off_rel),
+            json.dump({"s": s, "sweeps": int(_host_scalar(r.sweeps)),
+                       "off": float(_host_scalar(r.off_rel)),
                        "process_count": ctx.process_count,
                        "global_devices": ctx.global_device_count}, f)
     print(f"worker {pid} done", flush=True)
