@@ -125,6 +125,13 @@ def components(n: int, b: int = 128):
 
     add("apply_kernel_f32_hi", lambda i, st: fused(i, st), (top, bot))
     add("apply_kernel_x3", lambda i, st: fused(i, st, x3=True), (top, bot))
+
+    def fused_gram(i, st):
+        t, b_ = st
+        t, b_, gg = pa.apply_exchange(_perturb(i, t), b_, q, with_gram=True)
+        return _dep(t, gg), b_
+
+    add("apply_kernel_withgram", fused_gram, (top, bot))
     add("rot_kernel_cross",
         lambda i, gg: pb.cross_rotations(_perturb(i, gg)), g)
     return reg

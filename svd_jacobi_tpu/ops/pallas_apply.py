@@ -66,7 +66,8 @@ def _perm_maps(k: int, exchange: bool):
 
 
 def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
-            out_t_ref, out_b_ref, *, b, x3):
+            out_t_ref, out_b_ref, *refs, b, x3, with_gram=False,
+            gram_bf16=False):
     f32 = jnp.float32
     bf16 = jnp.bfloat16
 
@@ -102,10 +103,40 @@ def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
     def dot2(xt, xb, q):
         return mm(xt, q[:b]) + mm(xb, q[b:])
 
-    out_t_ref[0] = dot2(xtt_ref[0], xbt_ref[0],
-                        qt_ref[0]).astype(out_t_ref.dtype)
-    out_b_ref[0] = dot2(xtb_ref[0], xbb_ref[0],
-                        qb_ref[0]).astype(out_b_ref.dtype)
+    new_t = dot2(xtt_ref[0], xbt_ref[0], qt_ref[0])     # (mc, b) f32
+    new_b = dot2(xtb_ref[0], xbb_ref[0], qb_ref[0])
+    out_t_ref[0] = new_t.astype(out_t_ref.dtype)
+    out_b_ref[0] = new_b.astype(out_b_ref.dtype)
+
+    if with_gram:
+        # Epilogue: accumulate the NEXT round's Gram panel for output pair
+        # i from the freshly rotated chunks already in VMEM — this deletes
+        # the separate gram kernel's full-stack read (ops/pallas_gram.py
+        # semantics: f32 accumulators resident across the trailing
+        # row-chunk grid axis, which TPU iterates innermost).
+        from jax.experimental import pallas as pl
+
+        gxx_ref, gxy_ref, gyy_ref = refs
+        mi = pl.program_id(1)
+
+        @pl.when(mi == 0)
+        def _init():
+            gxx_ref[...] = jnp.zeros_like(gxx_ref)
+            gxy_ref[...] = jnp.zeros_like(gxy_ref)
+            gyy_ref[...] = jnp.zeros_like(gyy_ref)
+
+        if gram_bf16:
+            gt, gb = new_t.astype(bf16), new_b.astype(bf16)
+            gprec = None
+        else:
+            gt, gb = new_t, new_b
+            gprec = HI
+        gdot = lambda p, r: jax.lax.dot_general(
+            p, r, (((0,), (0,)), ((), ())), precision=gprec,
+            preferred_element_type=f32)[None]
+        gxx_ref[...] += gdot(gt, gt)
+        gxy_ref[...] += gdot(gt, gb)
+        gyy_ref[...] += gdot(gb, gb)
 
 
 def _chunk_limit(b: int, row_blocks: int = 6, fixed_bytes: int = None) -> int:
@@ -136,19 +167,33 @@ def _pick_chunk(m: int, b: int, row_blocks: int = 6,
     return best
 
 
+def _gram_fixed_bytes(b: int) -> int:
+    # q strips + the 3 f32 gram accumulators of the with_gram epilogue.
+    return 2 * (2 * b) * b * 4 + 3 * b * b * 4
+
+
 def supported(m: int, b: int) -> bool:
-    """The fused kernel needs lane-sized panels and a usable row chunk."""
-    return b % 128 == 0 and _pick_chunk(m, b) >= 128
+    """The fused kernel needs lane-sized panels and a usable row chunk
+    (gated on the LARGER with_gram footprint so one gate covers both
+    call forms)."""
+    return b % 128 == 0 and _pick_chunk(m, b, 6, _gram_fixed_bytes(b)) >= 128
 
 
 @functools.partial(jax.jit, static_argnames=("exchange", "interpret", "vma",
-                                             "x3"))
+                                             "x3", "with_gram", "gram_bf16"))
 def apply_exchange(top, bot, q, *, exchange: bool = True,
-                   interpret: bool = False, vma=None, x3: bool = False):
-    """(new_top, new_bot) = post-exchange stacks of ([top|bot] @ q).
+                   interpret: bool = False, vma=None, x3: bool = False,
+                   with_gram: bool = False, gram_bf16: bool = False):
+    """(new_top, new_bot[, g]) = post-exchange stacks of ([top|bot] @ q).
 
     top/bot: (k, m, b) column stacks; q: (k, 2b, 2b) orthogonal panels.
     Equivalent (tested) to the concat/matmul/slice + rotate_blocks chain.
+
+    ``with_gram`` (requires ``exchange``): additionally return the
+    (k, 2b, 2b) Gram panels of the POST-exchange pairs, accumulated in the
+    kernel's epilogue from the chunks already in VMEM — the next round's
+    panels at no extra HBM reads (``gram_bf16``: single-pass bf16
+    contraction, the mixed-bulk regime).
 
     ``vma``: mesh axes the outputs vary over — required when called on
     LOCAL stacks inside a compiled shard_map region (the mesh solver uses
@@ -158,8 +203,12 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if with_gram and not exchange:
+        raise ValueError("with_gram accumulates the post-EXCHANGE pairs' "
+                         "panels; it requires exchange=True")
     k, m, b = top.shape
-    mc = _pick_chunk(m, b)
+    mc = _pick_chunk(m, b, 6,
+                     _gram_fixed_bytes(b) if with_gram else None)
     pair_t, top_half_t, pair_b, top_half_b = _perm_maps(k, exchange)
     # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
     # (q is (k, 2b, 2b) — tiny next to the stacks).
@@ -190,13 +239,28 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
                           memory_space=pltpu.VMEM)
     from .pallas_blocks import _out_struct
     out = _out_struct((k, m, b), top.dtype, vma)
-    new_top, new_bot = pl.pallas_call(
-        functools.partial(_kernel, b=b, x3=x3),
+    out_specs = [o_spec, o_spec]
+    out_shapes = [out, out]
+    if with_gram:
+        g_spec = pl.BlockSpec((1, b, b), lambda i, mi: (i, 0, 0),
+                              memory_space=pltpu.VMEM)
+        g_out = _out_struct((k, b, b), jnp.float32, vma)
+        out_specs += [g_spec] * 3
+        out_shapes += [g_out] * 3
+    results = pl.pallas_call(
+        functools.partial(_kernel, b=b, x3=x3, with_gram=with_gram,
+                          gram_bf16=gram_bf16),
         grid=(k, m // mc),
         in_specs=[x_spec(pt_fn), x_spec(pt_fn), x_spec(pb_fn), x_spec(pb_fn),
                   q_spec, q_spec],
-        out_specs=[o_spec, o_spec],
-        out_shape=[out, out],
+        out_specs=out_specs,
+        out_shape=out_shapes,
         interpret=interpret,
     )(top, bot, top, bot, qt, qb)
-    return new_top, new_bot
+    if not with_gram:
+        return results[0], results[1]
+    new_top, new_bot, gxx, gxy, gyy = results
+    top_row = jnp.concatenate([gxx, gxy], axis=-1)
+    bot_row = jnp.concatenate([gxy.transpose(0, 2, 1), gyy], axis=-1)
+    g = jnp.concatenate([top_row, bot_row], axis=-2)
+    return new_top, new_bot, g

@@ -241,6 +241,45 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
     return top, bot, vtop, vbot, stat
 
 
+def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
+                      bf16_gram, apply_x3=False, interpret=False):
+    """Cross round for the single-device COMPILED path, with the Gram
+    panel as loop-carried state: ``g`` is the CURRENT pairs' panel
+    (produced by the previous round's fused apply+exchange+gram kernel, or
+    the bootstrap `pg.gram_pairs` call), and the returned panel belongs to
+    the post-exchange pairs — so the whole round is rotation kernel + ONE
+    apply kernel per stack, with zero standalone gram reads on the rotate
+    path. The skip branch pays a plain exchange + gram kernel (late
+    sweeps, where rounds are cheap anyway)."""
+    with_v = vtop is not None
+    stat, skip = panel_stats(g, dmax2)
+
+    def do(args):
+        top, bot, vtop, vbot, _ = args
+        q = _rotations(g, "cross", interpret=interpret, polish=polish,
+                       axis_name=None)
+        top, bot, g2 = pa.apply_exchange(top, bot, q, x3=apply_x3,
+                                         with_gram=True,
+                                         gram_bf16=bf16_gram,
+                                         interpret=interpret)
+        if with_v:
+            vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3,
+                                           interpret=interpret)
+        return top, bot, vtop, vbot, g2
+
+    def skip_branch(args):
+        top, bot, vtop, vbot, _ = args
+        top, bot = sched.rotate_blocks(top, bot)
+        if with_v:
+            vtop, vbot = sched.rotate_blocks(vtop, vbot)
+        g2 = pg.gram_pairs(top, bot, bf16=bf16_gram, interpret=interpret)
+        return top, bot, vtop, vbot, g2
+
+    top, bot, vtop, vbot, g = jax.lax.cond(
+        skip > rtol, do, skip_branch, (top, bot, vtop, vbot, g))
+    return top, bot, vtop, vbot, g, stat
+
+
 def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
           axis_name=None, n_rounds=None, exchange=None, apply_x3=False):
     """One full sweep: self round + cross tournament rounds.
@@ -256,10 +295,12 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     """
     k, m, b = top.shape
     with_v = vtop is not None
-    # Fused apply+exchange kernel: single-device compiled path with
-    # lane-sized panels and kernel-usable row chunks for every stack.
+    # Fused apply+exchange(+gram) kernels: single-device compiled path
+    # with lane-sized panels and kernel-usable row chunks for every stack
+    # (the gram-carried loop also needs the standalone gram kernel for its
+    # bootstrap panel and skip branch).
     fused = (exchange is None and axis_name is None and not interpret
-             and pa.supported(m, b)
+             and pa.supported(m, b) and pg.supported(m, b)
              and (not with_v or pa.supported(vtop.shape[1], b)))
     # Compiled mesh path: fuse the apply only (exchange stays the caller's
     # ppermute ring hop).
@@ -277,23 +318,44 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     if with_v:
         vtop, vbot = vblocks[:k], vblocks[k:]
 
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
+
+    if fused:
+        # Gram-carried fused loop: one bootstrap panel, then every rotate
+        # round is rotation kernel + fused apply/exchange/gram.
+        g0 = pg.gram_pairs(top, bot, bf16=bf16_gram)
+
+        def body(carry, _):
+            top, bot, vtop, vbot, g, mx = carry
+            top, bot, nvt, nvb, g, stat = cross_round_fused(
+                top, bot, vtop if with_v else None,
+                vbot if with_v else None, g, dmax2, rtol, polish=polish,
+                bf16_gram=bf16_gram, apply_x3=apply_x3)
+            if with_v:
+                vtop, vbot = nvt, nvb
+            return (top, bot, vtop, vbot, g, jnp.maximum(mx, stat)), None
+
+        init = (top, bot, vtop, vbot, g0, rel_self.astype(jnp.float32))
+        (top, bot, vtop, vbot, _, off), _ = jax.lax.scan(
+            body, init, None, length=n_rounds)
+        return (top, bot, (vtop if with_v else None),
+                (vbot if with_v else None), off)
+
     def body(carry, _):
         top, bot, vtop, vbot, mx = carry
         top, bot, nvt, nvb, stat = cross_round(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             dmax2, rtol, interpret=interpret,
             polish=polish, bf16_gram=bf16_gram, axis_name=axis_name,
-            fused_exchange=fused, fused_apply=mesh_fused, apply_x3=apply_x3)
+            fused_exchange=False, fused_apply=mesh_fused, apply_x3=apply_x3)
         if with_v:
             vtop, vbot = nvt, nvb
-        if not fused:
-            top, bot = exchange(top, bot)
-            if with_v:
-                vtop, vbot = exchange(vtop, vbot)
+        top, bot = exchange(top, bot)
+        if with_v:
+            vtop, vbot = exchange(vtop, vbot)
         return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
 
-    if not with_v:
-        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
     init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
     (top, bot, vtop, vbot, off), _ = jax.lax.scan(
         body, init, None, length=n_rounds)

@@ -296,3 +296,89 @@ def test_gram_pairs_support_predicate():
     assert pg._chunk(8192, 512) >= pa._pick_chunk(8192, 512)
     per_step = (2 * pg._chunk(8192, 512) * 512 + 3 * 512 * 512) * 4
     assert per_step <= (13 << 20) // 2
+
+
+@pytest.mark.parametrize("gram_bf16", [False, True])
+def test_apply_exchange_with_gram_matches_standalone(gram_bf16):
+    """The fused gram epilogue (with_gram=True) must equal the standalone
+    gram kernel / einsum on the post-exchange pairs — the next round's
+    panels for free."""
+    from svd_jacobi_tpu.ops import pallas_gram as pg
+    rng = np.random.default_rng(3)
+    k, m, b = 4, 256, 128
+    top = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    bot = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((k, 2 * b, 2 * b)), jnp.float32)
+    nt, nb, g = pa.apply_exchange(top, bot, q, interpret=True,
+                                  with_gram=True, gram_bf16=gram_bf16)
+    nt2, nb2 = pa.apply_exchange(top, bot, q, interpret=True)
+    np.testing.assert_array_equal(np.asarray(nt), np.asarray(nt2))
+    np.testing.assert_array_equal(np.asarray(nb), np.asarray(nb2))
+    x = jnp.concatenate([nt, nb], axis=-1)
+    if gram_bf16:
+        ref = jnp.einsum("kmi,kmj->kij", x.astype(jnp.bfloat16),
+                         x.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        tol = 5e-2   # single-pass bf16 rounding differences
+    else:
+        ref = jnp.einsum("kmi,kmj->kij", x, x, precision=HI)
+        tol = 1e-4
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(g - ref))) < tol * scale
+    # symmetric by construction
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(g.transpose(0, 2, 1)), rtol=0,
+                               atol=1e-6 * scale)
+    with pytest.raises(ValueError, match="exchange"):
+        pa.apply_exchange(top, bot, q, exchange=False, with_gram=True)
+
+
+def test_gram_carried_fused_loop_matches_unfused_sweep():
+    """The compiled path's gram-carried loop (bootstrap panel +
+    cross_round_fused scan) must converge identically to the unfused
+    reference sweep: same pair coverage, agreeing couplings and stacks to
+    rotation-angle rounding."""
+    from svd_jacobi_tpu.ops import pallas_gram as pg
+    from svd_jacobi_tpu.ops import rounds
+    rng = np.random.default_rng(4)
+    k, m, b = 2, 256, 128
+    top = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    bot = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+    dmax2 = rounds._global_dmax2(top, bot)
+
+    # Unfused reference semantics (the interpret path sweep).
+    rt, rb, _, _, off_ref = rounds.sweep(
+        top, bot, None, None, dmax2, 0.0, interpret=True, polish=True,
+        bf16_gram=False)
+
+    # Gram-carried fused structure, interpret kernels.
+    blocks = jnp.concatenate([top, bot], axis=0)
+    blocks, _, rel_self = rounds.self_round(
+        blocks, None, dmax2, 0.0, interpret=True, polish=True,
+        bf16_gram=False)
+    ft, fb = blocks[:k], blocks[k:]
+    g = pg.gram_pairs(ft, fb, interpret=True)
+    off = rel_self
+    for _ in range(rounds.sched.num_rounds(2 * k)):
+        ft, fb, _, _, g, stat = rounds.cross_round_fused(
+            ft, fb, None, None, g, dmax2, 0.0, polish=True,
+            bf16_gram=False, interpret=True)
+        off = jnp.maximum(off, stat)
+    # The fused panels differ from the stored-value grams by reduction-
+    # order rounding, and Jacobi ANGLES amplify that chaotically across
+    # rounds — the loops are equivalent algorithms, not bitwise twins. The
+    # invariants that must agree: the convergence statistic, and (for
+    # each loop) exact preservation of the input's singular values — one
+    # sweep is an orthogonal right-transform, fused or not.
+    assert abs(float(off) - float(off_ref)) < 5e-3
+
+    def glob(t, b_):
+        return np.asarray(jnp.concatenate(
+            [jnp.concatenate([t, b_], axis=0)[i] for i in range(2 * k)],
+            axis=1), np.float64)
+
+    s_in = np.linalg.svd(glob(top, bot), compute_uv=False)
+    for t, b_ in ((ft, fb), (rt, rb)):
+        s_out = np.linalg.svd(glob(t, b_), compute_uv=False)
+        np.testing.assert_allclose(s_out, s_in, rtol=0,
+                                   atol=1e-4 * s_in[0])
