@@ -54,6 +54,16 @@ def _parse_args(argv):
                    choices=["auto", "on", "off", "double"],
                    help="QR preconditioning mode (Pallas path; 'double' = "
                         "dgejsv-style second QR for graded spectra)")
+    p.add_argument("--mixed-bulk", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="bf16x3 bulk sweeps + f32 polish (the mixed "
+                        "bf16-compute/f32-accumulate regime; see "
+                        "SVDConfig.mixed_bulk — auto is currently off)")
+    p.add_argument("--sigma-refine", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="post-convergence sigma refinement (W = A V at "
+                        "HIGHEST + compensated norms; auto = on when "
+                        "factors are computed)")
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--block-size", type=int, default=None)
@@ -139,20 +149,30 @@ def main(argv=None) -> int:
         log("--precondition double is a single-device mode; "
             "not supported with --distributed")
         return 2
-    if args.precondition in ("on", "double") and (
-            args.pair_solver in ("hybrid", "qr-svd", "gram-eigh")
-            or args.dtype == "float64"):
-        # Also knowable at parse time: preconditioning is a Pallas-path
-        # feature; these combinations resolve to the XLA block solvers,
-        # which reject it mid-run (solver.svd) — fail before the warm-up
-        # self-test spends a solve.
-        log("--precondition on/double require the Pallas pair solver "
-            "(auto/pallas, non-f64 dtype)")
+    if args.distributed and args.mixed_bulk == "on":
+        log("--mixed-bulk on is a single-device mode; "
+            "not supported with --distributed")
+        return 2
+    if (args.precondition in ("on", "double") or args.mixed_bulk == "on") \
+            and (args.pair_solver in ("hybrid", "qr-svd", "gram-eigh")
+                 or args.dtype == "float64"):
+        # Also knowable at parse time: preconditioning / the mixed bulk
+        # are Pallas-path features; these combinations resolve to the XLA
+        # block solvers, which reject them mid-run (solver.svd) — fail
+        # before the warm-up self-test spends a solve.
+        log("--precondition on/double and --mixed-bulk on require the "
+            "Pallas pair solver (auto/pallas, non-f64 dtype)")
+        return 2
+    if args.mixed_bulk == "on" and args.dtype == "bfloat16":
+        log("--mixed-bulk on requires a float32 input")
         return 2
     dtype = jnp.dtype(args.dtype)
+    tristate = {"auto": None, "on": True, "off": False}
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
                           tol=args.tol, pair_solver=args.pair_solver,
-                          precondition=args.precondition)
+                          precondition=args.precondition,
+                          mixed_bulk=tristate[args.mixed_bulk],
+                          sigma_refine=tristate[args.sigma_refine])
 
     mesh = None
     ctx = None
@@ -185,7 +205,9 @@ def main(argv=None) -> int:
         "config": {"pair_solver": args.pair_solver,
                    "max_sweeps": args.max_sweeps, "tol": args.tol,
                    "block_size": args.block_size,
-                   "precondition": args.precondition},
+                   "precondition": args.precondition,
+                   "mixed_bulk": args.mixed_bulk,
+                   "sigma_refine": args.sigma_refine},
     }
 
     if not args.no_selftest:

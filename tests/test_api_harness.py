@@ -211,3 +211,39 @@ def test_gesvd_mesh_routing(eight_devices):
         @ np.asarray(vt, np.float64)
     res = np.linalg.norm(rec - np.asarray(a, np.float64)) / np.linalg.norm(np.asarray(a))
     assert res < 1e-5
+
+
+def test_cli_parse_time_mode_rejections(tmp_path, monkeypatch):
+    """Unsatisfiable flag combinations die at parse time (exit 2), before
+    the warm-up self-test spends a solve."""
+    # cli.main re-applies JAX_PLATFORMS from the environment, which would
+    # flip the suite's forced-CPU backend onto a real attached TPU.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    from svd_jacobi_tpu import cli
+    base = ["64", "--no-selftest", "--report-dir", str(tmp_path)]
+    assert cli.main(base + ["--distributed", "--precondition", "double"]) == 2
+    assert cli.main(base + ["--distributed", "--mixed-bulk", "on"]) == 2
+    assert cli.main(base + ["--mixed-bulk", "on",
+                            "--pair-solver", "hybrid"]) == 2
+    assert cli.main(base + ["--precondition", "on",
+                            "--dtype", "float64"]) == 2
+    assert cli.main(base + ["--mixed-bulk", "on",
+                            "--dtype", "bfloat16"]) == 2
+
+
+def test_cli_mixed_and_refine_flags(tmp_path, capsys, monkeypatch):
+    """The mixed-bulk and sigma-refine knobs reach the solver through the
+    CLI and are recorded in the report."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)  # see above
+    import json as _json
+    from svd_jacobi_tpu import cli
+    rc = cli.main(["96", "--matrix", "dense", "--no-selftest",
+                   "--mixed-bulk", "on", "--sigma-refine", "on",
+                   "--oracle", "--report-dir", str(tmp_path)])
+    assert rc == 0
+    solve = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert solve["residual_rel"] < 1e-5
+    assert solve["sigma_err"] < 1e-6
+    rep = _json.loads(next(tmp_path.glob("report-*.json")).read_text())
+    assert rep["config"]["mixed_bulk"] == "on"
+    assert rep["config"]["sigma_refine"] == "on"
