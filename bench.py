@@ -65,6 +65,21 @@ def _time_interleaved(fns, *args, reps: int = 2):
             w = None
             dead.add(i)
         warms.append(w)
+    if dead:
+        # A failed candidate (OOM-killed remote compile, device OOM) can
+        # leave the backend in a degraded state; one untimed re-run of each
+        # live candidate restores caches before anything is measured
+        # (observed: 16384^2 measured 99 s right after the baseline's
+        # compiler was OOM-killed vs 39 s clean).
+        for i, f in enumerate(fns):
+            if i not in dead:
+                try:
+                    _force(f(*args))
+                except Exception as e:
+                    print(f"note: candidate {i} failed on the re-warm "
+                          f"({type(e).__name__})", file=sys.stderr)
+                    dead.add(i)
+                    warms[i] = None
     best = [float("inf")] * len(fns)
     for _ in range(max(1, reps)):
         for i, f in enumerate(fns):

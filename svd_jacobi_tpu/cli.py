@@ -61,8 +61,9 @@ def _parse_args(argv):
                         "SVDConfig.mixed_bulk — auto is currently off)")
     p.add_argument("--sigma-refine", default="auto",
                    choices=["auto", "on", "off"],
-                   help="post-convergence sigma refinement (W = A V at "
-                        "HIGHEST + compensated norms; auto = on when "
+                   help="post-convergence sigma refinement (recompute the "
+                        "rotated columns from the solve's working matrix "
+                        "at HIGHEST + compensated norms; auto = on when "
                         "factors are computed)")
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)

@@ -86,12 +86,15 @@ class SVDConfig:
     # (measured at 2048/4096/8192; see PROFILE.md). The bulk stage always
     # accumulates G — it is the reconstitution map. Single-chip path only.
     mixed_bulk: Optional[bool] = None
-    # Post-convergence sigma refinement: recompute W = A @ V (or A^T @ U)
-    # at HIGHEST from the ORIGINAL matrix and read sigma off W's
-    # compensated column norms. Removes the ~sqrt(m)*eps drift the sweep
-    # loop accumulates in the column norms (measured: sigma-err 1.2e-6 ->
-    # ~1e-7 at 2048^2 f32) for one extra matmul. None = auto: ON whenever
-    # a factor is computed (Pallas path and mesh solver); False to skip.
+    # Post-convergence sigma refinement: recompute the rotated columns
+    # W = work @ V_norm (or work^T @ U) at HIGHEST against the solve's
+    # WORKING matrix — the n x n QR triangle L on the preconditioned
+    # paths (sigma(L) = sigma(A) to QR's tiny backward error; 2n^3 flops
+    # instead of touching the m x n input), A itself otherwise — and read
+    # sigma off compensated column norms. Removes the ~sqrt(m)*eps drift
+    # the sweep loop accumulates (measured: sigma-err 1.2e-6 -> 1.2e-7 at
+    # 2048^2 f32) for ~one small matmul. None = auto: ON whenever a
+    # factor is computed (every solver path); False to skip.
     sigma_refine: Optional[bool] = None
     # Convergence criterion: "rel" = dgesvj scaled coupling (relative
     # accuracy even for tiny sigmas), "abs" = coupling / sigma_max^2

@@ -329,34 +329,29 @@ def svd(
             f"precondition='on' requires the Pallas kernel path; this "
             f"solve resolved to pair_solver={method!r}")
 
+    refine = (config.sigma_refine if config.sigma_refine is not None
+              else (compute_u or compute_v))
     u, s, v, sweeps, off_rel = _svd_sharded_jit(
         a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
         n_devices=n_devices, compute_u=compute_u, compute_v=compute_v,
         full_u=full_matrices, tol=tol, max_sweeps=int(config.max_sweeps),
         precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
-        precondition=bool(precondition),
+        precondition=bool(precondition), refine=bool(refine),
         stall_detection=bool(config.stall_detection),
         kernel_polish=bool(config.kernel_polish))
-    # Sigma refinement parity with the single-device solver: the
-    # refinement matmul runs under GSPMD against the (possibly sharded)
-    # input, outside the shard_map loop like the preconditioner.
-    refine = (config.sigma_refine if config.sigma_refine is not None
-              else (u is not None or v is not None))
-    if refine and (u is not None or v is not None):
-        u, s, v = _single._refine_sigma(a, u, s, v, use_v=v is not None)
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
 @partial(jax.jit, static_argnames=(
     "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
     "compute_v", "full_u", "tol", "max_sweeps", "precision",
-    "gram_dtype_name", "method", "criterion", "precondition",
+    "gram_dtype_name", "method", "criterion", "precondition", "refine",
     "stall_detection", "kernel_polish"))
 def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      compute_u, compute_v, full_u, tol, max_sweeps, precision,
                      gram_dtype_name, method, criterion, precondition=False,
-                     stall_detection=True, kernel_polish=True):
+                     refine=False, stall_detection=True, kernel_polish=True):
     m = a.shape[0]
     dtype = a.dtype
     block_spec = P(axis_name, None, None)  # shard the pair-slot axis
@@ -396,12 +391,22 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
     if precondition:
         cols, s, rot = _single._postprocess(
             a_work, v_work, n, compute_u=compute_v, full_u=False, dtype=dtype)
+        if refine:
+            # Against the n x n triangle (sigma(L) = sigma(A)); runs under
+            # GSPMD outside the shard_map loop like the preconditioner.
+            cols, s, rot = _single._refine_from_work(work, cols, s, rot)
         u, v = _single._recombine_precondition(
             cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_u, dtype=dtype, q1=q1, order=order)
         return u, s, v, sweeps, off_rel
-    u, s, v = _single._postprocess(a_work, v_work, n, compute_u=compute_u,
-                                   full_u=full_u, dtype=dtype)
+    cols, s, rot = _single._postprocess(a_work, v_work, n,
+                                        compute_u=compute_u,
+                                        full_u=False, dtype=dtype)
+    if refine:
+        cols, s, rot = _single._refine_from_work(work, cols, s, rot)
+    u, v = cols, rot
+    if compute_u and full_u and m > n and u is not None:
+        u = _single._complete_orthonormal(u, n, dtype)
     return u, s, v, sweeps, off_rel
 
 

@@ -395,39 +395,43 @@ def _colnorms_compensated(w):
     return jnp.sqrt(s2)
 
 
-@partial(jax.jit, static_argnames=("use_v",))
-def _refine_sigma(a, u, s, v, *, use_v: bool):
-    """Rayleigh-class sigma refinement after convergence (VERDICT r3 item
-    4): recompute W = A @ V (or W = A^T @ U) at HIGHEST from the ORIGINAL
-    matrix and read sigma off W's column norms. The matmul's rounding
-    noise is essentially orthogonal to each singular direction, so the
-    norm only picks up its projection (~eps, not ~sqrt(n)*eps), and the
-    compensated column norms keep the summation at the same level —
-    measured: sigma-err 1.2e-6 -> ~1e-7 at 2048^2 f32, for one extra
-    matmul (~0.5% of the solve). Factors are re-permuted if near-ties
-    swap order."""
-    acc = jnp.promote_types(a.dtype, jnp.float32)
+def _refine_from_work(work, cols, s, rot):
+    """Sigma refinement against the solve's own WORKING matrix, applied
+    before factor recombination: with X = work @ G converged and sorted,
+    sigma_i = ||work @ rot_i|| through the re-normalized rotation product
+    (preferred), or ||work^T @ cols_i|| when only the column factor
+    exists. On the preconditioned paths work is the
+    n x n triangle L with sigma(L) = sigma(A) up to QR's backward error
+    (measured 6e-8 at 512^2), so the product costs 2n^3 instead of
+    re-touching the m x n input (16x cheaper at 65536x4096 — the
+    original A @ V form measurably ate the tall-skinny advantage).
+
+    The probe factor must have UNIT column norms: a norm error eta in the
+    probe is a FIRST-order sigma error (||work @ (1+eta) v|| =
+    (1+eta) sigma). ``cols`` is normalized by construction; the
+    accumulated ``rot`` drifts ~1e-5 off unit norm over a solve's applies
+    (measured: refining through raw rot gave serr 4e-6 vs 1.6e-8 through
+    cols), so the rot fallback re-normalizes with compensated norms
+    first. Returns (cols, s, rot) re-permuted by the refined order; no-op
+    when neither factor exists."""
+    if cols is None and rot is None:
+        return cols, s, rot
+    acc = jnp.promote_types(work.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
-    if use_v:
-        w = jnp.matmul(a.astype(acc), v.astype(acc), precision=hi)
-    else:
-        # Only the singular columns: a full_matrices U is (m, m) and its
-        # orthonormal completion has no sigma.
-        w = jnp.matmul(a.T.astype(acc), u[:, : s.shape[0]].astype(acc),
+    if rot is not None:
+        # Measured preference (512^2 CPU f32): work @ rot_normalized gives
+        # serr ~1e-7 vs ~3.5e-7 for work^T @ cols.
+        probe = rot.astype(acc)
+        norms = jnp.maximum(_colnorms_compensated(probe),
+                            jnp.finfo(acc).tiny)
+        w = jnp.matmul(work.astype(acc), probe / norms[None, :],
                        precision=hi)
+    else:
+        w = jnp.matmul(work.T.astype(acc), cols.astype(acc), precision=hi)
     s2 = _colnorms_compensated(w).astype(s.dtype)
     order = jnp.argsort(-s2)
-    s2 = s2[order]
-    n = s.shape[0]
-
-    def permute(x):
-        # full_matrices U is (m, m): permute only the n singular columns,
-        # leaving the orthonormal completion in place.
-        if x is None:
-            return None
-        return x.at[:, :n].set(jnp.take(x[:, :n], order, axis=1))
-
-    return permute(u), s2, permute(v)
+    take = lambda x: None if x is None else jnp.take(x, order, axis=1)
+    return take(cols), s2[order], take(rot)
 
 
 def _precondition_qr(a):
@@ -483,10 +487,10 @@ def _ns_orthogonalize(g, steps: int = 3):
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
     "max_sweeps", "precondition", "polish", "bulk_bf16", "mixed",
-    "interpret", "stall_detection"))
+    "interpret", "stall_detection", "refine"))
 def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
                 max_sweeps, precondition, polish, bulk_bf16, mixed,
-                interpret, stall_detection=True):
+                interpret, stall_detection=True, refine=False):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -583,6 +587,8 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
     cols, s, rot = _postprocess(a_work, v_work, n, compute_u=want_cols,
                                 full_u=False, dtype=dtype)
+    if refine:
+        cols, s, rot = _refine_from_work(work, cols, s, rot)
     if precondition == "double":
         u = v = None
         if compute_u:
@@ -672,17 +678,16 @@ def svd(
                 "bulk_bf16 (bf16 Gram panels inside the f32 loop) and "
                 "mixed_bulk (bf16x3 bulk sweeps + f32 polish) are mutually "
                 "exclusive bulk strategies")
+        refine = (config.sigma_refine if config.sigma_refine is not None
+                  else (compute_u or compute_v))
         u, s, v, sweeps, off_rel = _svd_pallas(
             a, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
             polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
             mixed=bool(mixed), interpret=not pb.supported(),
-            stall_detection=bool(config.stall_detection))
-        refine = (config.sigma_refine if config.sigma_refine is not None
-                  else (u is not None or v is not None))
-        if refine and (u is not None or v is not None):
-            u, s, v = _refine_sigma(a, u, s, v, use_v=v is not None)
+            stall_detection=bool(config.stall_detection),
+            refine=bool(refine))
         return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
     if config.precondition in ("on", "double") or config.mixed_bulk:
@@ -703,7 +708,27 @@ def svd(
         max_sweeps=int(config.max_sweeps), precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
         stall_detection=bool(config.stall_detection))
+    refine = (config.sigma_refine if config.sigma_refine is not None
+              else (u is not None or v is not None))
+    if refine and (u is not None or v is not None):
+        # Parity with the Pallas path and the mesh solver: the XLA block
+        # solvers run on A directly, so the working matrix IS a.
+        u, s, v = _refine_xla_jit(a, u, s, v, n=n,
+                                  with_u=u is not None,
+                                  with_v=v is not None,
+                                  full_u=bool(full_matrices))
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+
+
+@partial(jax.jit, static_argnames=("n", "with_u", "with_v", "full_u"))
+def _refine_xla_jit(a, u, s, v, *, n, with_u, with_v, full_u):
+    cols = u[:, :n] if with_u else None
+    cols, s, v2 = _refine_from_work(a, cols, s, v if with_v else None)
+    if with_u:
+        u = u.at[:, :n].set(cols) if full_u and u.shape[1] > n else cols
+    if with_v:
+        v = v2
+    return u, s, v
 
 
 # ---------------------------------------------------------------------------
@@ -922,12 +947,12 @@ class SweepStepper:
 
     def finish(self, state: SweepState) -> SVDResult:
         if self._kernel_path:
-            q1, order, _ = self._precond_state()
+            q1, order, work = self._precond_state()
             refine = (self.config.sigma_refine
                       if self.config.sigma_refine is not None
                       else (self.compute_u or self.compute_v))
             u, s, v = _finish_pallas_jit(
-                state.top, state.bot, state.vtop, state.vbot, self.a,
+                state.top, state.bot, state.vtop, state.vbot, work,
                 q1, order, n=self.n, compute_u=self.compute_u,
                 compute_v=self.compute_v, full_u=self.full_matrices,
                 precondition=self._precondition, refine=bool(refine))
@@ -981,18 +1006,21 @@ def _sweep_step_pallas_jit(top, bot, vtop, vbot, rtol, *, with_v, polish,
 
 @partial(jax.jit, static_argnames=("n", "compute_u", "compute_v", "full_u",
                                    "precondition", "refine"))
-def _finish_pallas_jit(top, bot, vtop, vbot, a, q1, order, *, n, compute_u,
-                       compute_v, full_u, precondition, refine):
+def _finish_pallas_jit(top, bot, vtop, vbot, work, q1, order, *, n,
+                       compute_u, compute_v, full_u, precondition, refine):
     """Kernel-path postprocessing + recombination (+ sigma refinement) in
-    one jit — identical factor bookkeeping to `_svd_pallas`."""
-    m = a.shape[0]
-    dtype = a.dtype
+    one jit — identical factor bookkeeping to `_svd_pallas` (including the
+    work-matrix-based refinement)."""
+    m = q1.shape[0] if precondition else work.shape[0]
+    dtype = work.dtype
     accumulate = compute_u if precondition else compute_v
     want_cols = compute_v if precondition else compute_u
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
     cols, s, rot = _postprocess(a_work, v_work, n, compute_u=want_cols,
                                 full_u=False, dtype=dtype)
+    if refine:
+        cols, s, rot = _refine_from_work(work, cols, s, rot)
     if precondition:
         u, v = _recombine_precondition(
             cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
@@ -1001,6 +1029,4 @@ def _finish_pallas_jit(top, bot, vtop, vbot, a, q1, order, *, n, compute_u,
         u, v = cols, rot
         if compute_u and full_u and m > n and u is not None:
             u = _complete_orthonormal(u, n, dtype)
-    if refine and (u is not None or v is not None):
-        u, s, v = _refine_sigma(a, u, s, v, use_v=v is not None)
     return u, s, v
