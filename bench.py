@@ -16,6 +16,10 @@ Usage:
                                   auto skips it above 2048)
          --reps=K                (best-of-K interleaved timing, default 6)
          --novec                 (sigma-only solve, jobu = jobv = NoVec)
+         --no-baseline           (skip the XLA baseline entirely — for
+                                  sizes where its attempt is KNOWN to OOM
+                                  the device and poison the heap for the
+                                  timed run that follows)
          --sweep                 (run the whole BASELINE.md accelerator
                                   table — one JSON line per config — in a
                                   fresh subprocess each so compile caches
@@ -64,6 +68,8 @@ def _time_interleaved(fns, *args, reps: int = 2):
                   f"timing the others", file=sys.stderr)
             w = None
             dead.add(i)
+            import gc
+            gc.collect()   # release the failed attempt's device buffers
         warms.append(w)
     if dead:
         # A failed candidate (OOM-killed remote compile, device OOM) can
@@ -103,7 +109,7 @@ SWEEP_CONFIGS = [
     ("2048", "float32", "16384", []),
     ("4096", "float32", "65536", []),
     ("16384", "float32", None, ["--reps=1"]),
-    ("8192", "float32", "32768", []),
+    ("8192", "float32", "32768", ["--no-baseline", "--reps=1"]),
     ("16384", "float32", None, ["--novec", "--reps=1"]),
 ]
 
@@ -159,7 +165,12 @@ def main() -> None:
 
     novec = "novec" in flags   # sigma-only solve (jobu = jobv = NoVec)
     ours = lambda x: sj.svd(x, compute_u=not novec, compute_v=not novec)
-    if baseline == "numpy":
+    attempted_baseline = "no-baseline" not in flags
+    if not attempted_baseline:
+        (t_ours,), (r,) = _time_interleaved([ours], a, reps=reps)
+        t_base = None
+        base_name = "skipped (--no-baseline: known to OOM at this size)"
+    elif baseline == "numpy":
         an = np.asarray(a)
         (t_ours, t_base), (r, _) = _time_interleaved(
             [ours, lambda x: np.linalg.svd(an, full_matrices=False,
@@ -207,7 +218,7 @@ def main() -> None:
                         else None),
         "time_s": round(t_ours, 4),
         "baseline_time_s": (round(t_base, 4) if t_base is not None else None),
-        "baseline": (base_name if t_base is not None
+        "baseline": (base_name if t_base is not None or not attempted_baseline
                      else f"{base_name}: FAILED TO COMPILE/RUN"),
         "sweeps": int(r.sweeps),
         "mfu": round(gflops * 1e9 / _PEAK_F32_EFF, 4),
