@@ -100,7 +100,11 @@ def _time_interleaved(fns, *args, reps: int = 2):
 
 # The measured-table configs of BASELINE.md (square + tall-skinny, f32,
 # up to the largest shapes that fit the 16 GB HBM; 16384^2 has no XLA
-# baseline — jnp.linalg.svd cannot compile there).
+# baseline — jnp.linalg.svd cannot compile there). The f64 row runs the
+# fp64 accuracy class (the reference's end-to-end precision,
+# lib/Matrix.cuh:13) on the CPU backend every round — f64 routes to the
+# qr-svd XLA block solver (solver._resolve_options: the Pallas kernel
+# computes rotations in f32 and the TPU has no native f64 MXU).
 SWEEP_CONFIGS = [
     ("2048", "float32", None, []),
     ("4096", "float32", None, []),
@@ -108,9 +112,11 @@ SWEEP_CONFIGS = [
     ("8192", "float32", None, []),
     ("2048", "float32", "16384", []),
     ("4096", "float32", "65536", []),
-    ("16384", "float32", None, ["--reps=1"]),
-    ("8192", "float32", "32768", ["--no-baseline", "--reps=1"]),
-    ("16384", "float32", None, ["--novec", "--reps=1"]),
+    ("512", "float64", None, ["--platform=cpu", "--baseline=numpy"]),
+    ("16384", "float32", None, ["--reps=2"]),
+    ("8192", "float32", "32768", ["--no-baseline", "--reps=2"]),
+    ("16384", "float32", None, ["--novec", "--reps=2"]),
+    ("20000", "float32", None, ["--no-baseline", "--reps=2"]),
 ]
 
 
@@ -148,11 +154,12 @@ def main() -> None:
     import jax
 
     # The axon TPU plugin ignores JAX_PLATFORMS from the environment; honor
-    # it through the config API so CPU-parity rows of the baseline table
-    # (JAX_PLATFORMS=cpu python bench.py ... --baseline=numpy) really run
-    # on CPU.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # it (and the --platform flag, which lets --sweep rows pin their own
+    # backend) through the config API so CPU-parity rows of the baseline
+    # table really run on CPU.
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
     if dtype_name == "float64":
         jax.config.update("jax_enable_x64", True)
 
@@ -164,7 +171,19 @@ def main() -> None:
     a = matgen.random_dense(m, n, dtype=dtype)
 
     novec = "novec" in flags   # sigma-only solve (jobu = jobv = NoVec)
-    ours = lambda x: sj.svd(x, compute_u=not novec, compute_v=not novec)
+    # --precondition=off: skip the Drmac QR (its Q1/R factors are 2 extra
+    # n^2 buffers — the difference between fitting and OOM at 30000^2).
+    # --block-size=K / --mixed-bulk: the block-width and mixed-regime
+    # sweeps of PROFILE.md run through the same bench harness.
+    cfg = sj.SVDConfig(
+        precondition=flags.get("precondition", "auto"),
+        block_size=(int(flags["block-size"]) if "block-size" in flags
+                    else None),
+        mixed_bulk=({"on": True, "off": False, "auto": None}
+                    [flags.get("mixed-bulk", "auto")]),
+        mixed_store=flags.get("mixed-store", "auto"))
+    ours = lambda x: sj.svd(x, compute_u=not novec, compute_v=not novec,
+                            config=cfg)
     attempted_baseline = "no-baseline" not in flags
     if not attempted_baseline:
         (t_ours,), (r,) = _time_interleaved([ours], a, reps=reps)

@@ -1,11 +1,15 @@
 """Phase breakdown of the mixed bf16-bulk regime on the attached chip.
 
-Times each stage of solver._svd_pallas's mixed path separately (bulk bf16
+Times each stage of solver._svd_pallas's mixed path separately (bulk
 sweeps / NS + reconstitution / f32 polish) and reports per-phase sweep
-counts, so MIXED_TOL and the NS step count can be tuned against the
-single-jit end-to-end number. Usage:
+counts, so MIXED_TOL, the storage regime (SVDConfig.mixed_store), and the
+NS step count can be tuned against the single-jit end-to-end number.
+Usage:
 
-    python scripts/mixed_diag.py [N] [mixed_tol] [ns_steps]
+    python scripts/mixed_diag.py [N] [store] [mixed_tol] [ns_steps]
+
+store: f32 (x3 split applies, f32-stored stacks), bf16 (bf16-STORED X
+stacks), bf16g (X and the rotation product G both bf16-stored).
 """
 
 import sys
@@ -34,12 +38,16 @@ def timed(fn, *args):
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
-    mixed_tol = float(sys.argv[2]) if len(sys.argv) > 2 else rounds.MIXED_TOL
-    ns_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    store = sys.argv[2] if len(sys.argv) > 2 else "f32"
+    assert store in ("f32", "bf16", "bf16g"), store
+    mixed_tol = float(sys.argv[3]) if len(sys.argv) > 3 else rounds.MIXED_TOL
+    ns_steps = (int(sys.argv[4]) if len(sys.argv) > 4
+                else (4 if store == "bf16g" else 2))
     a = matgen.random_dense(n, n, dtype=jnp.float32)
     cfg_b, k = solver._plan(n, 1, __import__("svd_jacobi_tpu").SVDConfig())
     nblocks, n_pad = 2 * k, 2 * k * cfg_b
-    print(f"n={n} b={cfg_b} k={k} mixed_tol={mixed_tol} ns={ns_steps}")
+    print(f"n={n} b={cfg_b} k={k} store={store} mixed_tol={mixed_tol} "
+          f"ns={ns_steps}")
 
     t_pre, (q1, r, order, work) = timed(jax.jit(solver._precondition_qr), a)
 
@@ -48,6 +56,10 @@ def main():
         top, bot = solver._blockify(work, n_pad, nblocks)
         vt, vb = solver._blockify(jnp.eye(n_pad, dtype=work.dtype),
                                   n_pad, nblocks)
+        if store in ("bf16", "bf16g"):
+            top, bot = top.astype(jnp.bfloat16), bot.astype(jnp.bfloat16)
+        if store == "bf16g":
+            vt, vb = vt.astype(jnp.bfloat16), vb.astype(jnp.bfloat16)
         _, _, vt, vb, off, sweeps = rounds.iterate_phase(
             top, bot, vt, vb, stop_tol=jnp.float32(mixed_tol),
             rtol=mixed_tol, max_sweeps=32, interpret=False, polish=True,
@@ -61,7 +73,8 @@ def main():
 
     @jax.jit
     def reconstitute(work, vt, vb):
-        g = solver._ns_orthogonalize(solver._deblockify(vt, vb), ns_steps)
+        g = solver._ns_orthogonalize(
+            solver._deblockify(vt, vb).astype(jnp.float32), ns_steps)
         x = jnp.matmul(work.astype(g.dtype), g[:work.shape[1], :],
                        precision=jax.lax.Precision.HIGHEST)
         top, bot = solver._blockify(x, n_pad, nblocks)

@@ -125,13 +125,20 @@ def components(n: int, b: int = 128):
 
     add("apply_kernel_f32_hi", lambda i, st: fused(i, st), (top, bot))
     add("apply_kernel_x3", lambda i, st: fused(i, st, x3=True), (top, bot))
+    # bf16-STORED stacks (SVDConfig.mixed_store="bf16"/"bf16g"): half the
+    # HBM bytes per round AND one native MXU pass instead of 3/6.
+    tb16, bb16 = top.astype(jnp.bfloat16), bot.astype(jnp.bfloat16)
+    add("apply_kernel_bf16st", lambda i, st: fused(i, st), (tb16, bb16))
 
-    def fused_gram(i, st):
+    def fused_gram(i, st, **kw):
         t, b_ = st
-        t, b_, gg = pa.apply_exchange(_perturb(i, t), b_, q, with_gram=True)
+        t, b_, gg = pa.apply_exchange(_perturb(i, t), b_, q, with_gram=True,
+                                      **kw)
         return _dep(t, gg), b_
 
     add("apply_kernel_withgram", fused_gram, (top, bot))
+    add("apply_kernel_withgram_bf16st",
+        lambda i, st: fused_gram(i, st, gram_bf16=True), (tb16, bb16))
     add("rot_kernel_cross",
         lambda i, gg: pb.cross_rotations(_perturb(i, gg)), g)
     return reg
@@ -140,16 +147,18 @@ def components(n: int, b: int = 128):
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = [a for a in sys.argv[1:] if a.startswith("--")]
-    n = 2048
+    n, b = 2048, 128
     for f in flags:
         if f.startswith("--n"):
             n = int(f.split("=", 1)[1]) if "=" in f else int(args.pop(0))
-    reg = components(n)
+        if f.startswith("--b"):
+            b = int(f.split("=", 1)[1]) if "=" in f else int(args.pop(0))
+    reg = components(n, b)
     if "--list" in flags:
         print("\n".join(reg))
         return
     names = args or list(reg)
-    print(f"n={n}: differential intra-jit ms/iter "
+    print(f"n={n} b={b}: differential intra-jit ms/iter "
           f"(device {jax.devices()[0]})")
     for name in names:
         body, init = reg[name]

@@ -65,6 +65,13 @@ def _parse_args(argv):
                         "rotated columns from the solve's working matrix "
                         "at HIGHEST + compensated norms; auto = on when "
                         "factors are computed)")
+    p.add_argument("--jobu", default="some", choices=["all", "some", "none"],
+                   help="left-factor job option (the reference driver's "
+                        "SVD_OPTIONS, main.cu:1587, lib/JacobiMethods.cuh:"
+                        "25-29): all = full (m, m) U, some = economy, "
+                        "none = sigma-only")
+    p.add_argument("--jobv", default="some", choices=["all", "some", "none"],
+                   help="right-factor job option (see --jobu)")
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--block-size", type=int, default=None)
@@ -88,11 +95,19 @@ def _force(tree):
 
 
 def _solve(a, args, config, mesh):
+    """Run the solver with the driver's jobu/jobv mapped exactly as
+    `lapack.gesvd` maps SVD_OPTIONS (NoVec -> compute_*=False, AllVec ->
+    full_matrices) so sigma-only and AllVec runs are reproducible from the
+    CLI alone (reference: main.cu:1587)."""
     import svd_jacobi_tpu as sj
+    cu, cv = args.jobu != "none", args.jobv != "none"
+    full = args.jobu == "all" or args.jobv == "all"
     if mesh is not None:
         from svd_jacobi_tpu.parallel import sharded
-        return sharded.svd(a, mesh=mesh, config=config)
-    return sj.svd(a, config=config)
+        return sharded.svd(a, mesh=mesh, compute_u=cu, compute_v=cv,
+                           full_matrices=full, config=config)
+    return sj.svd(a, compute_u=cu, compute_v=cv, full_matrices=full,
+                  config=config)
 
 
 def _self_test(args, config, log) -> dict:
@@ -104,8 +119,12 @@ def _self_test(args, config, log) -> dict:
 
     n = args.selftest_n
     a = matgen.random_dense(n, n, seed=args.seed + 1, dtype=jnp.dtype(args.dtype))
+    # The self-test checks the residual, so it always computes economy
+    # factors regardless of the main run's jobu/jobv.
+    st_args = argparse.Namespace(**{**vars(args), "jobu": "some",
+                                    "jobv": "some"})
     t0 = time.perf_counter()
-    r = _solve(a, args, config, None)
+    r = _solve(a, st_args, config, None)
     _force(tuple(r[:3]))
     dt = time.perf_counter() - t0
     rep = validation.validate(a, r)
@@ -208,7 +227,8 @@ def main(argv=None) -> int:
                    "block_size": args.block_size,
                    "precondition": args.precondition,
                    "mixed_bulk": args.mixed_bulk,
-                   "sigma_refine": args.sigma_refine},
+                   "sigma_refine": args.sigma_refine,
+                   "jobu": args.jobu, "jobv": args.jobv},
     }
 
     if not args.no_selftest:
@@ -240,18 +260,23 @@ def main(argv=None) -> int:
         jax.profiler.stop_trace()
         report["profile_dir"] = args.profile
 
-    rep = validation.validate(a, r)
+    rep = validation.validate(a, r).as_dict()
     report["solve"] = {
         "time_s": solve_time,
         "sweeps": int(r.sweeps),
         "off_norm": float(r.off_rel),
-        "residual_rel": float(rep.residual_rel),
-        "u_orth": float(rep.u_orth),
-        "u_orth_live": float(rep.u_orth_live),
-        "v_orth": float(rep.v_orth),
+        "jobu": args.jobu,
+        "jobv": args.jobv,
+        # None where the job options suppressed a factor (e.g. sigma-only).
+        "residual_rel": rep["residual_rel"],
+        "u_orth": rep["u_orth"],
+        "u_orth_live": rep["u_orth_live"],
+        "v_orth": rep["v_orth"],
     }
+    res_str = ("n/a (factor suppressed)" if rep["residual_rel"] is None
+               else f"{rep['residual_rel']:.3e}")
     log(f"solve {m}x{n}: time={solve_time:.3f}s sweeps={int(r.sweeps)} "
-        f"residual={float(rep.residual_rel):.3e}")
+        f"residual={res_str}")
 
     multiproc = ctx is not None and ctx.process_count > 1
     if args.oracle:

@@ -86,6 +86,22 @@ class SVDConfig:
     # (measured at 2048/4096/8192; see PROFILE.md). The bulk stage always
     # accumulates G — it is the reconstitution map. Single-chip path only.
     mixed_bulk: Optional[bool] = None
+    # Storage regime for the mixed bulk phase's block stacks. The fused
+    # apply kernel is HBM-traffic-bound (~21 flops/byte vs the f32 ridge
+    # ~30 — PROFILE.md item 12), so the lever is BYTES, not MXU passes:
+    #   "f32"   — f32-stored stacks, bf16x3 split applies (the round-4
+    #             regime: cheaper arithmetic, unchanged traffic);
+    #   "bf16"  — the X stacks are STORED bf16 (halving the dominant X
+    #             apply+gram traffic; X is discarded at reconstitution, so
+    #             its storage rounding is absorbed by the tolerated
+    #             MIXED_TOL drift) while the rotation product G stays
+    #             f32-stored with x3 applies;
+    #   "bf16g" — G stored bf16 as well (halving its traffic too); G's
+    #             storage rounding random-walks ~1e-1 off orthogonal over a
+    #             solve, paid back by two extra Newton-Schulz steps at
+    #             reconstitution.
+    # "auto" picks the measured-best regime for the platform.
+    mixed_store: str = "auto"  # "auto" | "f32" | "bf16" | "bf16g"
     # Post-convergence sigma refinement: recompute the rotated columns
     # W = work @ V_norm (or work^T @ U) at HIGHEST against the solve's
     # WORKING matrix — the n x n QR triangle L on the preconditioned

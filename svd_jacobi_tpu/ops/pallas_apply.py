@@ -75,24 +75,38 @@ def _kernel(xtt_ref, xbt_ref, xtb_ref, xbb_ref, qt_ref, qb_ref,
         return jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                                    precision=prec, preferred_element_type=f32)
 
-    if xtt_ref.dtype == bf16:
-        # bf16 stacks run the MXU natively (one bf16-in/f32-acc pass;
-        # HIGHEST is an f32-operand notion — Mosaic rejects it on bf16).
-        mm = lambda x, w: raw(x, w, None)
-    elif x3:
-        # bf16x3 split product (the mixed-bulk apply regime): ~eps_bf16^2
-        # error at 3 native passes — rotations applied this way keep the
-        # accumulated product orthogonal to ~1e-4 over a whole solve.
-        # Split by BIT-MASKING the low mantissa half, like
-        # rounds._split_bf16: the naive cast-round-trip form is folded to
-        # zero by XLA (verified on-chip) and nothing stops Mosaic from
-        # learning the same simplification.
-        def split(x):
-            bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
-            hi = jax.lax.bitcast_convert_type(
-                bits & jnp.uint32(0xFFFF0000), f32)
-            return hi.astype(bf16), (x - hi).astype(bf16)
+    def split(x):
+        # BIT-MASK the low mantissa half, like rounds._split_bf16: the
+        # naive cast-round-trip form is folded to zero by XLA (verified
+        # on-chip) and nothing stops Mosaic from learning the same
+        # simplification.
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        hi = jax.lax.bitcast_convert_type(
+            bits & jnp.uint32(0xFFFF0000), f32)
+        return hi.astype(bf16), (x - hi).astype(bf16)
 
+    if xtt_ref.dtype == bf16:
+        if x3 and qt_ref.dtype == f32:
+            # bf16-STORED stacks under the mixed regime: the stack side
+            # already paid its eps_bf16 storage rounding, but the q side
+            # must NOT — a bf16-cast q floors every rotation angle at
+            # eps_bf16 and stalls the bulk at ~5e-3 coupling (measured:
+            # the bulk then hands the polish 8 sweeps instead of 4). Split
+            # the f32 q into hi+lo bf16 halves: two native passes, q error
+            # ~eps_bf16^2, angle accuracy restored.
+            def mm(x, w):
+                wh, wl = split(w)
+                return raw(x, wh, None) + raw(x, wl, None)
+        else:
+            # Plain bf16 solves (bf16 INPUT dtype, bf16-class accuracy):
+            # one native bf16-in/f32-acc pass (HIGHEST is an f32-operand
+            # notion — Mosaic rejects it on bf16).
+            mm = lambda x, w: raw(x, w, None)
+    elif x3:
+        # bf16x3 split product (the f32-stored mixed-bulk regime):
+        # ~eps_bf16^2 error at 3 native passes — rotations applied this
+        # way keep the accumulated product orthogonal to ~1e-4 over a
+        # whole solve.
         def mm(x, w):
             xh, xl = split(x)
             wh, wl = split(w)
@@ -213,8 +227,12 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
     # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
     # (q is (k, 2b, 2b) — tiny next to the stacks).
     ql, qr = q[..., :b], q[..., b:]
-    # Match the q strips to the stacks' compute dtype (see _kernel).
-    qdt = jnp.bfloat16 if top.dtype == jnp.bfloat16 else jnp.float32
+    # Match the q strips to the stacks' compute dtype (see _kernel): bf16
+    # for plain bf16 solves, but f32 for bf16-STORED stacks under x3 — the
+    # kernel splits that q into two bf16 passes (qx2) to keep rotation
+    # angles at eps_bf16^2 accuracy.
+    qdt = (jnp.bfloat16 if top.dtype == jnp.bfloat16 and not x3
+           else jnp.float32)
     qt = jnp.where(jnp.asarray(top_half_t)[:, None, None],
                    jnp.take(ql, jnp.asarray(pair_t), axis=0),
                    jnp.take(qr, jnp.asarray(pair_t), axis=0)).astype(qdt)

@@ -70,7 +70,23 @@ def _einsum(a, b, spec, bf16=False, x3=False):
     ~eps_bf16 input rounding), or the bf16x3 split product
     hi@hi + lo@hi + hi@lo (~46 TF/s, ~eps_bf16^2 ~ 1.5e-5 error — the
     mixed-bulk apply regime, accurate enough that the accumulated rotation
-    product stays orthogonal to ~1e-4 over a full solve's applies)."""
+    product stays orthogonal to ~1e-4 over a full solve's applies).
+
+    bf16-STORED operands (the byte-halved mixed-bulk storage regimes)
+    contract natively: the stack side already paid its eps_bf16 storage
+    rounding, so extra passes on IT claw nothing back — but an f32 q
+    under ``x3`` is split into hi+lo bf16 halves (two passes, "qx2"):
+    casting q to one bf16 pass floors every rotation angle at eps_bf16
+    and stalls the bulk at ~5e-3 coupling (measured on-chip)."""
+    if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16:
+        if x3 and a.dtype == jnp.bfloat16 and b.dtype != jnp.bfloat16:
+            bh, bl = _split_bf16(b.astype(jnp.float32))
+            f = lambda q: jnp.einsum(spec, a, q,
+                                     preferred_element_type=jnp.float32)
+            return f(bh) + f(bl)
+        return jnp.einsum(spec, a.astype(jnp.bfloat16),
+                          b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
     if x3:
         ah, al = _split_bf16(a)
         bh, bl = _split_bf16(b)
@@ -369,6 +385,27 @@ def _global_dmax2(top, bot):
                        jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
 
 
+def should_continue(off, prev_off, sweeps, *, tol, max_sweeps,
+                    stall_detection=True, stall_gate=1e-4,
+                    stall_shrink=0.25):
+    """THE sweep-loop predicate — one definition shared by every iterate
+    loop (solver._should_continue, `iterate_phase`, the mesh solver's
+    while_loops): continue while the coupling is above ``tol``, the sweep
+    counter is under ``max_sweeps``, and the loop has not stalled. Stall:
+    once the coupling is below ``stall_gate`` (the phase's endgame) a sweep
+    that fails to shrink it past ``stall_shrink * prev_off`` means the
+    phase's roundoff floor is reached. The gate/shrink constants are the
+    caller's — they are measured per criterion/regime, not derived (a
+    mistuned threshold cost 100x sigma error; see solver._should_continue
+    for the per-criterion values)."""
+    go = jnp.logical_and(sweeps < max_sweeps, off > tol)
+    if stall_detection:
+        stalled = jnp.logical_and(off < stall_gate,
+                                  off > stall_shrink * prev_off)
+        go = jnp.logical_and(go, jnp.logical_not(stalled))
+    return go
+
+
 # Bulk-phase target for the mixed bf16x3-compute regime (solver
 # "mixed_bulk"): couplings below this are at the split regime's drift
 # floor (~eps_bf16^2 per apply, random-walked over a solve's ~n applies)
@@ -395,12 +432,11 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
 
     def cond(st):
         _, _, _, _, off, prev_off, sweeps = st
-        go = jnp.logical_and(sweeps < max_sweeps, off > stop_tol)
-        if stall_detection:
-            stalled = jnp.logical_and(off < stall_gate,
-                                      off > stall_shrink * prev_off)
-            go = jnp.logical_and(go, jnp.logical_not(stalled))
-        return go
+        return should_continue(off, prev_off, sweeps, tol=stop_tol,
+                               max_sweeps=max_sweeps,
+                               stall_detection=stall_detection,
+                               stall_gate=stall_gate,
+                               stall_shrink=stall_shrink)
 
     def body(st):
         top, bot, vtop, vbot, prev_off, _, sweeps = st

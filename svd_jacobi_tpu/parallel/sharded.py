@@ -419,6 +419,40 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
 
 
 @partial(jax.jit, static_argnames=(
+    "mesh", "axis_name", "n_devices", "nblocks", "with_v", "rtol",
+    "polish", "interpret"))
+def _sweep_step_sharded_pallas_jit(top, bot, vtop, vbot, *, mesh, axis_name,
+                                   n_devices, nblocks, with_v, rtol, polish,
+                                   interpret):
+    """One kernel-path sweep for the host-stepped MESH API: the same
+    `_sweep_sharded_pallas` the fused mesh solver while_loops, under one
+    jitted shard_map per host step (mirroring the single-device
+    `solver._sweep_step_pallas_jit`) — so checkpointed/instrumented mesh
+    solves no longer downgrade to the ~5x-slower XLA block stepping."""
+    block_spec = P(axis_name, None, None)
+    sharding = NamedSharding(mesh, block_spec)
+    top = lax.with_sharding_constraint(top, sharding)
+    bot = lax.with_sharding_constraint(bot, sharding)
+    vtop = lax.with_sharding_constraint(vtop, sharding)
+    vbot = lax.with_sharding_constraint(vbot, sharding)
+
+    def body(top, bot, vtop, vbot):
+        t, b, nvt, nvb, off = _sweep_sharded_pallas(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            axis_name=axis_name, n_devices=n_devices,
+            n_rounds=sched.num_rounds(nblocks), rtol=rtol, with_v=with_v,
+            interpret=interpret, polish=polish)
+        if with_v:
+            vtop, vbot = nvt, nvb
+        return t, b, vtop, vbot, off
+
+    step = jax.shard_map(body, mesh=mesh,
+                         in_specs=(block_spec,) * 4,
+                         out_specs=(block_spec,) * 4 + (P(),))
+    return step(top, bot, vtop, vbot)
+
+
+@partial(jax.jit, static_argnames=(
     "mesh", "axis_name", "n_devices", "nblocks", "with_v", "precision",
     "gram_dtype_name", "method", "criterion"))
 def _sweep_step_sharded_jit(top, bot, vtop, vbot, *, mesh, axis_name,
@@ -444,15 +478,13 @@ def _sweep_step_sharded_jit(top, bot, vtop, vbot, *, mesh, axis_name,
 
 class SweepStepper(_single.SweepStepper):
     """`solver.SweepStepper` over a device mesh: one jitted shard_map sweep
-    per host step. Same stage machinery (hybrid bulk -> polish), same
-    SweepState contract — so `utils.checkpoint` and
-    `utils.profiling.instrumented_svd` work on sharded solves unchanged.
-    """
-
-    def _host_kernel_path(self) -> bool:
-        # The mesh stepper keeps the sharded XLA hybrid stepping (its
-        # kernel path lives inside shard_map and is the fused solver's).
-        return False
+    per host step. Same stage machinery, same SweepState contract — so
+    `utils.checkpoint` and `utils.profiling.instrumented_svd` work on
+    sharded solves unchanged. On the Pallas path the host steps the SAME
+    sharded kernel sweep the fused mesh solver runs
+    (`_sweep_sharded_pallas` under one shard_map per step), with the fused
+    path's QR-preconditioned bookkeeping; other methods keep the sharded
+    XLA hybrid stepping."""
 
     def __init__(self, a, *, mesh: Optional[Mesh] = None,
                  compute_u: bool = True, compute_v: bool = True,
@@ -467,8 +499,12 @@ class SweepStepper(_single.SweepStepper):
         self.n_devices = mesh.size
         super().__init__(a, compute_u=compute_u, compute_v=compute_v,
                          full_matrices=full_matrices, config=config)
-        # Re-plan with the mesh's device count (the base class planned for 1).
+        # Re-plan with the mesh's device count (the base class planned for
+        # 1), mirroring `sharded.svd`'s geometry exactly (including the
+        # even-b adjustment for the self kernel).
         b, k = _single._plan(self.n, self.n_devices, config)
+        if self._kernel_path and b % 2:
+            b += 1
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
         self._sharding = NamedSharding(mesh, P(self.axis_name, None, None))
 
@@ -477,16 +513,25 @@ class SweepStepper(_single.SweepStepper):
                 "n_devices": self.n_devices}
 
     def init(self):
-        """Sharded init: A blocks via blockify + sharding constraint, V
-        blocks via the per-shard identity construction (`_identity_blocks`
-        under shard_map) — no device ever materializes the replicated
+        """Sharded init: block stacks via blockify + device_put, V/G blocks
+        via the per-shard identity construction (`_identity_blocks` under
+        shard_map) — no device ever materializes the replicated
         n_pad x n_pad identity the base class would build (16 GB at
-        65536^2 f32, exactly the scale this stepper exists for)."""
-        top, bot = _single._blockify(self.a, self.n_pad, self.nblocks)
+        65536^2 f32, exactly the scale this stepper exists for). On the
+        kernel path the stacks hold the QR triangle L = R^T and the
+        identity accumulates the ROTATION product (fused-path
+        bookkeeping); otherwise A and V."""
+        if self._kernel_path:
+            _, _, work = self._precond_state()
+            top, bot = _single._blockify(work, self.n_pad, self.nblocks)
+            accumulate = self._accumulate
+        else:
+            top, bot = _single._blockify(self.a, self.n_pad, self.nblocks)
+            accumulate = self.compute_v
         top = jax.device_put(top, self._sharding)
         bot = jax.device_put(bot, self._sharding)
         k = self.nblocks // 2
-        if self.compute_v:
+        if accumulate:
             block_spec = P(self.axis_name, None, None)
             build = jax.jit(jax.shard_map(
                 partial(_identity_blocks, k, self.n_pad, self.a.dtype,
@@ -510,6 +555,17 @@ class SweepStepper(_single.SweepStepper):
             off_rel=state.off_rel, sweeps=state.sweeps)
 
     def _run_sweep(self, state, method, criterion):
+        if self._kernel_path:
+            from ..ops import pallas_blocks as pb
+            top, bot, vtop, vbot, off = _sweep_step_sharded_pallas_jit(
+                state.top, state.bot, state.vtop, state.vbot,
+                mesh=self.mesh, axis_name=self.axis_name,
+                n_devices=self.n_devices, nblocks=self.nblocks,
+                with_v=self._accumulate, rtol=float(self.tol),
+                polish=bool(self.config.kernel_polish),
+                interpret=not pb.supported())
+            return _single.SweepState(top, bot, vtop, vbot, off,
+                                      state.sweeps + 1)
         top, bot, vtop, vbot, off = _sweep_step_sharded_jit(
             state.top, state.bot, state.vtop, state.vbot,
             mesh=self.mesh, axis_name=self.axis_name,

@@ -147,24 +147,25 @@ def _resolve_xla_options(a, config: SVDConfig, compute_uv: bool = True):
 
 def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
                      stall_detection=True, criterion="rel"):
-    """Sweep-loop predicate shared by both solvers: continue while above tol,
-    under the sweep cap, and not stalled (in the endgame — off < 1e-4, close
-    to the floor — a sweep that fails to keep shrinking the coupling means
-    the dtype's roundoff floor is reached). The gate/shrink thresholds
-    differ per criterion — see the inline comments; the constants are
-    measured, not derived (a mistuned threshold cost 100x sigma error)."""
-    go = jnp.logical_and(sweeps < max_sweeps, off_rel > tol)
-    if stall_detection:
-        if criterion == "rel":
-            gate, shrink = 1e-4, 0.25
-        else:
-            # Gate near the floor (tol is set just above it) and use a
-            # gentler shrink test: the abs path contracts only ~2-4x per
-            # sweep mid-range, so a 4x test there misfires sweeps early.
-            gate, shrink = 4.0 * tol, 0.75
-        stalled = jnp.logical_and(off_rel < gate, off_rel > shrink * prev_off)
-        go = jnp.logical_and(go, jnp.logical_not(stalled))
-    return go
+    """Criterion-aware wrapper over the ONE shared sweep-loop predicate
+    (`ops.rounds.should_continue` — also used by `rounds.iterate_phase`
+    and the mesh while_loops, so the stall logic cannot drift again):
+    continue while above tol, under the sweep cap, and not stalled. The
+    gate/shrink constants are measured, not derived (a mistuned threshold
+    cost 100x sigma error):
+      * "rel": gate 1e-4 (the endgame, close to the f32 coupling floor),
+        shrink 0.25;
+      * "abs": gate just above tol (tol is set near the floor) and a
+        gentler 0.75 shrink — the abs path contracts only ~2-4x per sweep
+        mid-range, so a 4x test there misfires sweeps early."""
+    if criterion == "rel":
+        gate, shrink = 1e-4, 0.25
+    else:
+        gate, shrink = 4.0 * tol, 0.75
+    return rounds.should_continue(off_rel, prev_off, sweeps, tol=tol,
+                                  max_sweeps=max_sweeps,
+                                  stall_detection=stall_detection,
+                                  stall_gate=gate, stall_shrink=shrink)
 
 
 # Max squared column norm over both stacks (the GLOBAL deflation scale; mesh
@@ -487,10 +488,11 @@ def _ns_orthogonalize(g, steps: int = 3):
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
     "max_sweeps", "precondition", "polish", "bulk_bf16", "mixed",
-    "interpret", "stall_detection", "refine"))
+    "mixed_store", "interpret", "stall_detection", "refine"))
 def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
                 max_sweeps, precondition, polish, bulk_bf16, mixed,
-                interpret, stall_detection=True, refine=False):
+                mixed_store="f32", interpret=False, stall_detection=True,
+                refine=False):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -545,29 +547,46 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     bulk_off = jnp.float32(jnp.inf)
     bulk_sweeps = jnp.int32(0)
     if mixed:
-        # Stage 1 (bulk): sweeps with bf16x3 split applies (~46 TF/s vs 25
-        # at HIGHEST; per-apply error ~eps_bf16^2 so the rotation product
-        # stays orthogonal to ~1e-4) and single-pass bf16 Gram panels
-        # (noise only perturbs rotation angles/stats, harmless above
-        # MIXED_TOL). G is ALWAYS accumulated here — it is the
-        # reconstitution map — even when the caller wants no factors.
+        # Stage 1 (bulk): cheap sweeps down to the bf16 drift floor. G is
+        # ALWAYS accumulated here — it is the reconstitution map — even
+        # when the caller wants no factors. ``mixed_store`` picks the
+        # storage regime (the kernel is HBM-byte-bound, so bytes are the
+        # lever — see SVDConfig.mixed_store):
+        #   "f32":   f32-stored stacks, bf16x3 split applies + single-pass
+        #            bf16 Gram panels (per-apply error ~eps_bf16^2);
+        #   "bf16":  X stacks stored bf16 (native single-pass applies,
+        #            half the X bytes; X is DISCARDED at reconstitution so
+        #            its storage rounding — coupling noise
+        #            ~eps_bf16/sqrt(n) per round, drift
+        #            ~sqrt(rounds)*eps_bf16/sqrt(n) vs L.G — is absorbed
+        #            by the MIXED_TOL contract), G still f32 + x3;
+        #   "bf16g": G stored bf16 as well — its storage rounding
+        #            random-walks G ~1e-1 off orthogonal, paid back by two
+        #            extra Newton-Schulz steps on readback.
         if accumulate:
             gvt, gvb = vtop, vbot
         else:
             gvt, gvb = _blockify(jnp.eye(n_pad, dtype=dtype), n_pad, nblocks)
+        bf16 = jnp.bfloat16
+        xt, xb = top, bot
+        if mixed_store in ("bf16", "bf16g"):
+            xt, xb = top.astype(bf16), bot.astype(bf16)
+        if mixed_store == "bf16g":
+            gvt, gvb = gvt.astype(bf16), gvb.astype(bf16)
         _, _, gvt, gvb, bulk_off, bulk_sweeps = rounds.iterate_phase(
-            top, bot, gvt, gvb, stop_tol=jnp.float32(rounds.MIXED_TOL),
+            xt, xb, gvt, gvb, stop_tol=jnp.float32(rounds.MIXED_TOL),
             rtol=rounds.MIXED_TOL, max_sweeps=max_sweeps,
             interpret=interpret, polish=polish, bf16_gram=True,
             apply_x3=True, stall_detection=stall_detection,
             stall_gate=10.0 * rounds.MIXED_TOL, stall_shrink=0.5)
-        # Stage 2 (reconstitute): orthogonalize G in f32 (it is ~1e-4 off
-        # after the split-regime applies; 2 Newton-Schulz steps reach the
-        # f32 floor), then rebuild the stacks exactly as work @ G — the
-        # bulk X is DISCARDED, deleting its X-vs-L.G drift (padded columns
-        # never mix — they deflate in the kernel — so
-        # [work | 0] @ G == work @ G[:cols]).
-        g = _ns_orthogonalize(_deblockify(gvt, gvb), steps=2)
+        # Stage 2 (reconstitute): orthogonalize G in f32 (~1e-4 off after
+        # the f32-accumulated regimes — 2 Newton-Schulz steps reach the
+        # f32 floor; ~1e-1 off after bf16 storage — 4 steps), then rebuild
+        # the stacks exactly as work @ G — the bulk X is DISCARDED,
+        # deleting its X-vs-L.G drift (padded columns never mix — they
+        # deflate in the kernel — so [work | 0] @ G == work @ G[:cols]).
+        g = _ns_orthogonalize(_deblockify(gvt, gvb).astype(jnp.float32),
+                              steps=4 if mixed_store == "bf16g" else 2)
         x = jnp.matmul(work.astype(g.dtype), g[:work.shape[1], :],
                        precision=hi).astype(dtype)
         top, bot = _blockify(x, n_pad, nblocks)
@@ -678,6 +697,15 @@ def svd(
                 "bulk_bf16 (bf16 Gram panels inside the f32 loop) and "
                 "mixed_bulk (bf16x3 bulk sweeps + f32 polish) are mutually "
                 "exclusive bulk strategies")
+        if config.mixed_store not in ("auto", "f32", "bf16", "bf16g"):
+            raise ValueError(
+                f"unknown mixed_store mode: {config.mixed_store!r}")
+        # auto = "bf16": the bulk's fused apply kernel is HBM-byte-bound
+        # (PROFILE.md item 12), so halving the X bytes is the measured-best
+        # regime on v5e; "bf16g" halves G's bytes too but its storage
+        # rounding costs polish sweeps (see PROFILE.md round-5 items).
+        mixed_store = (config.mixed_store if config.mixed_store != "auto"
+                       else "bf16")
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
         u, s, v, sweeps, off_rel = _svd_pallas(
@@ -685,7 +713,8 @@ def svd(
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
             polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
-            mixed=bool(mixed), interpret=not pb.supported(),
+            mixed=bool(mixed), mixed_store=mixed_store,
+            interpret=not pb.supported(),
             stall_detection=bool(config.stall_detection),
             refine=bool(refine))
         return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)

@@ -159,6 +159,23 @@ class TestCli:
                        "--report-dir", str(tmp_path)])
         assert rc == 2
 
+    def test_cli_jobu_jobv(self, tmp_path, capsys):
+        """Driver-level SVD_OPTIONS parity (reference main.cu:1587): a
+        sigma-only run from the CLI alone reports null factor metrics, and
+        the job options land in the JSON report."""
+        from svd_jacobi_tpu import cli
+        rc = cli.main(["64", "--dtype", "float64", "--no-selftest",
+                       "--matrix", "dense", "--jobu", "none", "--jobv",
+                       "none", "--oracle", "--report-dir", str(tmp_path)])
+        assert rc == 0
+        solve = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert solve["residual_rel"] is None
+        assert solve["u_orth"] is None and solve["v_orth"] is None
+        assert solve["sigma_err"] < 1e-12      # sigma still computed + checked
+        rep = json.loads(next(tmp_path.glob("report-*.json")).read_text())
+        assert rep["config"]["jobu"] == "none"
+        assert rep["solve"]["jobv"] == "none"
+
 
 def test_profiling_log_json():
     a = matgen.random_dense(24, 24, dtype=jnp.float64, seed=12)

@@ -135,15 +135,22 @@ def test_conditioning_sweep_xla_paths(method):
     assert np.max(np.abs(sn - s_ref)) / s_ref[0] < 5e-6
 
 
-@pytest.mark.parametrize("cu,cv", [(True, True), (False, False)])
-def test_mixed_bulk_f32_accuracy_class(cu, cv):
-    """The mixed bf16x3-bulk regime (SVDConfig.mixed_bulk) must deliver the
-    SAME accuracy class as the pure-f32 path: the bulk X is discarded and
-    the state reconstituted as L @ NS(G) at HIGHEST, so residual and sigma
-    are set by the f32 polish, not the bf16 bulk."""
+@pytest.mark.parametrize("store,cu,cv", [
+    ("f32", True, True), ("f32", False, False),
+    ("bf16", True, True), ("bf16", False, False),
+    ("bf16g", True, True),
+])
+def test_mixed_bulk_f32_accuracy_class(store, cu, cv):
+    """The mixed-bulk regime (SVDConfig.mixed_bulk) must deliver the SAME
+    accuracy class as the pure-f32 path in EVERY storage regime
+    (mixed_store): the bulk X is discarded and the state reconstituted as
+    L @ NS(G) at HIGHEST, so residual and sigma are set by the f32 polish —
+    not by the bf16 bulk arithmetic ("f32"/x3), the bf16-STORED X stacks
+    ("bf16"), or the bf16-stored rotation product ("bf16g")."""
     rng = np.random.default_rng(11)
     a = jnp.asarray(rng.standard_normal((192, 192)), jnp.float32)
-    r = sj.svd(a, config=SVDConfig(mixed_bulk=True, pair_solver="pallas"),
+    r = sj.svd(a, config=SVDConfig(mixed_bulk=True, pair_solver="pallas",
+                                   mixed_store=store),
                compute_u=cu, compute_v=cv)
     s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
     assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 2e-6
@@ -154,6 +161,14 @@ def test_mixed_bulk_f32_accuracy_class(cu, cv):
         assert res / np.linalg.norm(np.asarray(a)) < 5e-6
         assert np.max(np.abs(u.T @ u - np.eye(192))) < 1e-4
         assert np.max(np.abs(v.T @ v - np.eye(192))) < 1e-4
+
+
+def test_mixed_store_validation():
+    rng = np.random.default_rng(15)
+    a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+    with pytest.raises(ValueError, match="mixed_store"):
+        sj.svd(a, config=SVDConfig(mixed_bulk=True, pair_solver="pallas",
+                                   mixed_store="fp8"))
 
 
 def test_mixed_bulk_matches_pure_f32_on_padding():
