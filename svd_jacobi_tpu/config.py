@@ -100,7 +100,11 @@ class SVDConfig:
     #             storage rounding random-walks ~1e-1 off orthogonal over a
     #             solve, paid back by two extra Newton-Schulz steps at
     #             reconstitution.
-    # "auto" picks the measured-best regime for the platform.
+    # "auto" = "f32", the measured end-to-end best on v5e: the bf16 modes
+    # make the bulk monotonically faster (4.19/3.51/2.76 s at 8192^2) but
+    # each byte saved costs f32 polish sweeps (4/6/8) — storage rounding
+    # degrades the reconstituted state (PROFILE.md item 17). The bf16
+    # modes stay selectable for chips with a different cost structure.
     mixed_store: str = "auto"  # "auto" | "f32" | "bf16" | "bf16g"
     # Post-convergence sigma refinement: recompute the rotated columns
     # W = work @ V_norm (or work^T @ U) at HIGHEST against the solve's
@@ -128,8 +132,18 @@ class SVDConfig:
             if self.block_size < 1:
                 raise ValueError(f"block_size must be >= 1, got {self.block_size}")
             return self.block_size
-        # TPU-friendly default: lane-aligned 128-wide blocks once n is big
-        # enough; otherwise roughly n/8 so there is parallelism across pairs.
+        # TPU-friendly default: lane-aligned blocks once n is big enough
+        # (otherwise roughly n/8 so there is parallelism across pairs).
+        # b=256 doubles the fused apply's arithmetic intensity (crossing
+        # the f32 ridge) at the price of a costlier rotation kernel;
+        # measured end-to-end (PROFILE.md item 18) it wins from n = 8192
+        # up (16384^2: 34.8 vs 39.0 s) and loses below (4096^2: 0.98 vs
+        # 0.88 s) — including small-n tall-skinny (65536x4096: 1.35 vs
+        # 1.21 s), which the n-threshold excludes. b=512 exceeds the
+        # rotation kernel's scoped-VMEM budget and measured 2.1x slower
+        # through the XLA fallback.
+        if n >= 8192:
+            return 256
         if n >= 2048:
             return 128
         b = 1

@@ -223,6 +223,12 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
     k, m, b = top.shape
     mc = _pick_chunk(m, b, 6,
                      _gram_fixed_bytes(b) if with_gram else None)
+    if mc == 0:
+        raise ValueError(
+            f"no usable VMEM row chunk for apply_exchange at (m, b) = "
+            f"({m}, {b}) with_gram={with_gram} — the per-step footprint "
+            f"exceeds the scoped-VMEM budget; gate callers on "
+            f"pallas_apply.supported()")
     pair_t, top_half_t, pair_b, top_half_b = _perm_maps(k, exchange)
     # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
     # (q is (k, 2b, 2b) — tiny next to the stacks).

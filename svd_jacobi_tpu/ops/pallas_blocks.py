@@ -224,18 +224,35 @@ def supported(platform: str | None = None) -> bool:
     return platform in ("tpu", "axon")
 
 
-def _pick_block_k(k: int, b: int, factor: int = 3) -> int:
-    """Panels per grid step, bounded by scoped VMEM (~16 MB).
+# Scoped-VMEM model shared by the block picker (`_pick_block_k`), the
+# oversized-panel gate (`kernel_fits`), and rounds._rotations' fallback
+# dispatch — ONE set of constants so retuning cannot desynchronize them.
+# A panel's live set is 4 (b2, b2) G-quadrants + 2 (2b2, b2) Q halves, but
+# VMEM tiles pad the LANE (last) dimension to 128 — a (32, 32) array
+# occupies a (32, 128) tile — so the per-panel footprint is
+# 8 * b2 * max(b2, 128) * 4 bytes. Mosaic's double-buffering/temporaries
+# multiply that by ~3 (cross) / ~4 (self, extra circle-move
+# intermediates); measured: 32-panel b=64 cross chunks and 64-panel
+# b2=32 self chunks both blew the 16 MB scoped limit at ~18 MB.
+VMEM_BUDGET = 13 << 20
+CROSS_FACTOR, SELF_FACTOR = 3, 4
 
-    A panel's live set is 4 (b, b) G-quadrants + 2 (2b, b) Q halves, but
-    VMEM tiles pad the LANE (last) dimension to 128 — a (32, 32) array
-    occupies a (32, 128) tile — so the per-panel footprint is
-    8 * b * max(b, 128) * 4 bytes. Mosaic's double-buffering/temporaries
-    multiply that by ~3 (cross) / ~4 (self, extra circle-move
-    intermediates); measured: 32-panel b=64 cross chunks and 64-panel
-    b2=32 self chunks both blew the 16 MB scoped limit at ~18 MB."""
-    per_panel = 8 * b * max(b, 128) * 4
-    budget_panels = max(1, (13 << 20) // (per_panel * factor))
+
+def _panel_bytes(b2: int) -> int:
+    return 8 * b2 * max(b2, 128) * 4
+
+
+def kernel_fits(b2: int, factor: int) -> bool:
+    """Whether even a SINGLE panel of half-width ``b2`` fits the scoped-VMEM
+    budget (same model as `_pick_block_k`): b >= 512 panels exceed it at
+    block_k = 1 and must fall back to the XLA reference bodies."""
+    return _panel_bytes(b2) * factor <= VMEM_BUDGET
+
+
+def _pick_block_k(k: int, b: int, factor: int = CROSS_FACTOR) -> int:
+    """Panels per grid step, bounded by scoped VMEM (see the model above)."""
+    per_panel = _panel_bytes(b)
+    budget_panels = max(1, VMEM_BUDGET // (per_panel * factor))
     if k <= budget_panels:
         return k
     # Largest divisor of k within budget (the grid needs block_k | k; a
@@ -393,7 +410,7 @@ def self_rotations(g: jax.Array, *, interpret: bool | None = None,
     k, n2, _ = g.shape
     b2 = n2 // 2
     if block_k is None:
-        block_k = _pick_block_k(k, b2, factor=4)
+        block_k = _pick_block_k(k, b2, factor=SELF_FACTOR)
     if interpret is None:
         interpret = not supported()
     qx, qy = _self_call(g[:, :b2, :b2], g[:, :b2, b2:], g[:, b2:, :b2],

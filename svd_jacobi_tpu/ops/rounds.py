@@ -129,8 +129,13 @@ def _rotations(g, kind, *, interpret, polish, axis_name):
     """Dispatch to the right rotation generator: the compiled Pallas kernel,
     or (on interpreter backends under a mesh axis) the pure-jnp reference
     body, which keeps shard_map variance types consistent where the
-    pallas_call machinery cannot."""
-    if axis_name is not None and interpret:
+    pallas_call machinery cannot. Panels too wide for the kernel's
+    scoped-VMEM budget (explicit block_size >= 512) also take the
+    reference body — as plain compiled XLA — instead of dying in Mosaic."""
+    b2 = g.shape[-1] // 2   # both kernels carry half-width 4-block panels
+    factor = pb.CROSS_FACTOR if kind == "cross" else pb.SELF_FACTOR
+    oversized = not pb.kernel_fits(b2, factor)
+    if (axis_name is not None and interpret) or oversized:
         fn = pb.reference_self if kind == "self" else pb.reference_cross
         return fn(g, polish=polish)
     fn = pb.self_rotations if kind == "self" else pb.cross_rotations

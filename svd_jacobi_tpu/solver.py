@@ -700,12 +700,15 @@ def svd(
         if config.mixed_store not in ("auto", "f32", "bf16", "bf16g"):
             raise ValueError(
                 f"unknown mixed_store mode: {config.mixed_store!r}")
-        # auto = "bf16": the bulk's fused apply kernel is HBM-byte-bound
-        # (PROFILE.md item 12), so halving the X bytes is the measured-best
-        # regime on v5e; "bf16g" halves G's bytes too but its storage
-        # rounding costs polish sweeps (see PROFILE.md round-5 items).
+        # auto = "f32": measured at 8192^2 on v5e (PROFILE.md item 17) the
+        # byte-halved regimes make the bulk monotonically faster (4.19 ->
+        # 3.51 -> 2.76 s) but every byte saved costs polish sweeps (4 ->
+        # 6 -> 8; storage rounding degrades the reconstituted state), so
+        # f32 storage + x3 applies stays the best END-TO-END mixed mode
+        # (6.27 vs 6.47 vs 6.66 s). The bf16 regimes remain selectable for
+        # chips whose polish-phase cost structure differs.
         mixed_store = (config.mixed_store if config.mixed_store != "auto"
-                       else "bf16")
+                       else "f32")
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
         u, s, v, sweeps, off_rel = _svd_pallas(

@@ -235,6 +235,40 @@ def test_apply_exchange_matches_reference_chain(k, m, exchange):
     assert float(jnp.max(jnp.abs(nb - rb))) < 2e-5 * scale
 
 
+def test_apply_exchange_bf16_stored_qx2_angles():
+    """bf16-STORED stacks under x3 (the mixed_store="bf16"/"bf16g" bulk):
+    the kernel must split the f32 q into two bf16 passes (qx2) instead of
+    casting it — a bf16-cast q floors rotation angles at eps_bf16 and
+    stalls the bulk at ~5e-3 coupling (measured on-chip). Verify the qx2
+    result tracks the exact product on the SAME bf16-valued stacks to
+    ~eps_bf16^2, an order below the bf16-cast-q error."""
+    rng = np.random.default_rng(3)
+    k, m, b = 2, 256, 128
+    top = jnp.asarray(rng.standard_normal((k, m, b)), jnp.bfloat16)
+    bot = jnp.asarray(rng.standard_normal((k, m, b)), jnp.bfloat16)
+    q = jnp.asarray(np.stack([np.linalg.qr(
+        rng.standard_normal((2 * b, 2 * b)))[0] for _ in range(k)]),
+        jnp.float32)
+    nt, nb = pa.apply_exchange(top, bot, q, x3=True, interpret=True)
+    # Exact product on the bf16-valued stacks (storage rounding excluded —
+    # it is the q-side error being bounded here).
+    xf = jnp.concatenate([top, bot], -1).astype(jnp.float32)
+    xn = jnp.einsum("kmi,kij->kmj", xf, q, precision=HI)
+    rt, rb = sched.rotate_blocks(xn[..., :b], xn[..., b:])
+    scale = float(jnp.max(jnp.abs(xn)))
+    err = max(float(jnp.max(jnp.abs(nt.astype(jnp.float32) - rt))),
+              float(jnp.max(jnp.abs(nb.astype(jnp.float32) - rb))))
+    # bf16 OUTPUT storage rounding alone is ~4e-3*scale; a bf16-cast q
+    # would add ~4e-3*sqrt(2b)*scale on top. qx2 must stay at the
+    # storage-rounding level.
+    assert err < 5e-3 * scale, err
+    # And the same contract through rounds._einsum (the non-fused path).
+    e2 = rounds._einsum(jnp.concatenate([top, bot], -1), q, "kmi,kij->kmj",
+                        x3=True)
+    err2 = float(jnp.max(jnp.abs(e2 - xn))) / scale
+    assert err2 < 5e-4, err2
+
+
 def test_apply_exchange_perm_maps_match_rotate_blocks():
     """The kernel's closed-form output-slot maps must encode exactly one
     schedule.rotate_blocks step, for every stack width."""
