@@ -116,7 +116,19 @@ SWEEP_CONFIGS = [
     ("16384", "float32", None, ["--reps=2"]),
     ("8192", "float32", "32768", ["--no-baseline", "--reps=2"]),
     ("16384", "float32", None, ["--novec", "--reps=2"]),
-    ("20000", "float32", None, ["--no-baseline", "--reps=2"]),
+    # The reference's staged scale targets (runSVDMPICUDAWithoutCMake.slurm
+    # :34-36). 20000^2 sigma-only fits the attachment's ~90 s
+    # single-execution deadline fused (PROFILE.md item 19); the 30000-class
+    # row (30208^2 = next exact block multiple) must run host-stepped
+    # (one jitted sweep per execution) with the input buffer released
+    # after init (--donate) to fit HBM.
+    ("20000", "float32", None, ["--novec", "--no-baseline", "--reps=2"]),
+    ("20000", "float32", None, ["--no-baseline", "--reps=1", "--stepped",
+                                "--donate", "--precondition=off",
+                                "--sigma-refine=off"]),
+    ("30208", "float32", None, ["--novec", "--no-baseline", "--reps=1",
+                                "--precondition=off", "--stepped",
+                                "--donate"]),
 ]
 
 
@@ -171,6 +183,8 @@ def main() -> None:
     a = matgen.random_dense(m, n, dtype=dtype)
 
     novec = "novec" in flags   # sigma-only solve (jobu = jobv = NoVec)
+    stepped = "stepped" in flags
+    attempted_baseline = "no-baseline" not in flags
     # --precondition=off: skip the Drmac QR (its Q1/R factors are 2 extra
     # n^2 buffers — the difference between fitting and OOM at 30000^2).
     # --block-size=K / --mixed-bulk: the block-width and mixed-regime
@@ -181,10 +195,68 @@ def main() -> None:
                     else None),
         mixed_bulk=({"on": True, "off": False, "auto": None}
                     [flags.get("mixed-bulk", "auto")]),
-        mixed_store=flags.get("mixed-store", "auto"))
+        mixed_store=flags.get("mixed-store", "auto"),
+        sigma_refine={"on": True, "off": False}.get(
+            flags.get("sigma-refine")),
+        donate_input="donate" in flags)
     ours = lambda x: sj.svd(x, compute_u=not novec, compute_v=not novec,
                             config=cfg)
-    attempted_baseline = "no-baseline" not in flags
+    if stepped:
+        # Host-stepped solve (solver.SweepStepper, the checkpoint-grade
+        # API): ONE jitted sweep per device execution. Required at the
+        # largest sizes on this attachment — the tunnel enforces a ~90 s
+        # single-execution deadline (measured, PROFILE.md item 19), which
+        # a fused 30208^2 solve (~12 s/sweep x 16 sweeps) cannot fit; the
+        # stepper's per-sweep executions ride well under it. Timing
+        # includes the per-step host dispatch (~0.1 s/sweep here).
+        from svd_jacobi_tpu import solver as _solver
+
+        def ours(x):
+            st = _solver.SweepStepper(x, compute_u=not novec,
+                                      compute_v=not novec, config=cfg)
+            state = st.init()
+            while st.should_continue(state):
+                state = st.step(state)
+            return st.finish(state)
+    if ("donate" in flags or "fused-gen" in flags) and attempted_baseline:
+        # Both modes drop the caller-held input (a = None); the baseline
+        # lambda would receive None and its failure would be mis-reported
+        # as the "ours alone" encoding. Make the flag requirement loud.
+        raise SystemExit("--donate/--fused-gen require --no-baseline "
+                         "(the input buffer is consumed/never held; the "
+                         "XLA baseline cannot run on the same input)")
+    if "fused-gen" in flags and stepped:
+        raise SystemExit("--fused-gen is incompatible with --stepped (the "
+                         "host-stepped loop cannot run under one jit); "
+                         "use --stepped --donate for the large stepped "
+                         "rows")
+    if "donate" in flags and "fused-gen" not in flags:
+        # SVDConfig.donate_input consumes the input buffer (XLA aliases it
+        # to a same-shaped factor output — usable for full-vector solves),
+        # so each timed repetition regenerates the deterministic matrix;
+        # residual/oracle need a surviving copy and are skipped.
+        base = ours
+        ours = lambda _x: base(matgen.random_dense(m, n, dtype=dtype))
+        a = None
+    if "fused-gen" in flags:
+        # Largest-size rows: generate the (deterministic) input INSIDE the
+        # solve's jit program, so the matrix is an internal temp XLA frees
+        # after blockification instead of a caller-held buffer pinned
+        # across the whole solve (plain donation is "not usable" for
+        # sigma-only solves — there is no same-shaped output to alias).
+        # Gen cost (one threefry pass) rides inside the timing; residual /
+        # sigma-oracle need a host-visible copy and are skipped — the
+        # accuracy class is pinned at the smaller sizes. Use exact
+        # block-multiple N (e.g. 30208 = 2*59*256) to avoid the padding
+        # copy as well.
+        base = ours
+
+        @jax.jit
+        def _run():
+            return base(matgen.random_dense(m, n, dtype=dtype))
+
+        ours = lambda _x: _run()
+        a = None
     if not attempted_baseline:
         (t_ours,), (r,) = _time_interleaved([ours], a, reps=reps)
         t_base = None
@@ -217,12 +289,12 @@ def main() -> None:
     # Residual computed ON DEVICE at pinned precision (a host transfer of
     # the factors through the tunnel would dominate at large N).
     extras = {}
-    if r.u is not None and r.v is not None:
+    if a is not None and r.u is not None and r.v is not None:
         extras["residual_rel"] = float(
             np.asarray(validation.relative_residual(a, r.u, r.s, r.v)))
     if oracle == "auto":
         oracle = "on" if max(m, n) <= 2048 else "off"
-    if oracle == "on":
+    if oracle == "on" and a is not None:
         s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
         extras["sigma_err"] = float(validation.sigma_error(r.s, s_ref))
 

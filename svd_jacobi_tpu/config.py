@@ -126,6 +126,12 @@ class SVDConfig:
     # (roundoff floor reached; thresholds per criterion, see
     # solver._should_continue). Disable to run until tol or max_sweeps.
     stall_detection: bool = True
+    # Donate the input buffer to the solve (XLA donation on the Pallas
+    # path, m >= n): the caller's device array is CONSUMED — invalidated
+    # after the call — freeing its n*m*4 bytes for the sweep loop's
+    # working set. This is the difference between fitting and OOM at the
+    # chip's largest sizes (30000^2 sigma-only needs it on 16 GB HBM).
+    donate_input: bool = False
 
     def pick_block_size(self, n: int) -> int:
         if self.block_size is not None:

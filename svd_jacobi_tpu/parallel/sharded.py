@@ -542,6 +542,8 @@ class SweepStepper(_single.SweepStepper):
             vtop, vbot = build()
         else:
             vtop = vbot = jnp.zeros((k, 0, top.shape[2]), self.a.dtype)
+        if self.config.donate_input:
+            self._release_input()
         return _single.SweepState(top, bot, vtop, vbot,
                                   jnp.float32(jnp.inf), jnp.int32(0))
 

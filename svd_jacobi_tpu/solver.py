@@ -485,14 +485,16 @@ def _ns_orthogonalize(g, steps: int = 3):
     return g
 
 
-@partial(jax.jit, static_argnames=(
+_PALLAS_STATIC = (
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
     "max_sweeps", "precondition", "polish", "bulk_bf16", "mixed",
-    "mixed_store", "interpret", "stall_detection", "refine"))
-def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
-                max_sweeps, precondition, polish, bulk_bf16, mixed,
-                mixed_store="f32", interpret=False, stall_detection=True,
-                refine=False):
+    "mixed_store", "interpret", "stall_detection", "refine")
+
+
+def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
+                     tol, max_sweeps, precondition, polish, bulk_bf16, mixed,
+                     mixed_store="f32", interpret=False, stall_detection=True,
+                     refine=False):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -629,6 +631,15 @@ def _svd_pallas(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad, tol,
     return u, s, rot, sweeps, off_rel
 
 
+_svd_pallas = partial(jax.jit, static_argnames=_PALLAS_STATIC)(
+    _svd_pallas_impl)
+# Input-donating twin (SVDConfig.donate_input): same trace, but XLA may
+# reuse the caller's input buffer — required headroom at the chip's
+# largest sizes (the caller's array is invalidated).
+_svd_pallas_donated = partial(jax.jit, static_argnames=_PALLAS_STATIC,
+                              donate_argnums=(0,))(_svd_pallas_impl)
+
+
 def svd(
     a,
     *,
@@ -711,7 +722,8 @@ def svd(
                        else "f32")
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
-        u, s, v, sweeps, off_rel = _svd_pallas(
+        solve = _svd_pallas_donated if config.donate_input else _svd_pallas
+        u, s, v, sweeps, off_rel = solve(
             a, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
@@ -811,6 +823,9 @@ class SweepStepper:
             raise ValueError("SweepStepper requires m >= n; pass a.T and "
                              "swap u/v (as svd() does)")
         self.a, self.m, self.n = a, m, n
+        # Retained past a donate_input release (checkpoint fingerprints
+        # and resume read the dtype after self.a is gone).
+        self.input_dtype = a.dtype
         self.compute_u, self.compute_v = compute_u, compute_v
         self.full_matrices = full_matrices
         self.config = config
@@ -872,13 +887,48 @@ class SweepStepper:
                 self._pc = (None, None, self.a)
         return self._pc
 
+    def _release_input(self):
+        """Free the input buffer after init (SVDConfig.donate_input): the
+        stepped solve then holds only the block stacks (+ the QR factors
+        when preconditioned) — the difference between fitting and
+        RESOURCE_EXHAUSTED at the chip's largest sizes (30208^2 sigma-only
+        needs it on 16 GB HBM; PROFILE.md item 19). The caller's array is
+        invalidated. Incompatible with checkpoint digest validation
+        (`input_digest` raises afterwards) and, on the unpreconditioned
+        path, with sigma refinement (no working matrix survives to refine
+        against)."""
+        if self._kernel_path:
+            self._precond_state()   # q1/order/work computed + cached first
+            if not self._precondition:
+                refine = (self.config.sigma_refine
+                          if self.config.sigma_refine is not None
+                          else (self.compute_u or self.compute_v))
+                if refine:
+                    raise ValueError(
+                        "donate_input on the unpreconditioned stepper "
+                        "cannot refine sigma (the working matrix is "
+                        "released); set sigma_refine=False or "
+                        "precondition='on'")
+                # Zero-width surrogate keeps finish()'s shapes/dtype
+                # without holding the m x n buffer.
+                self._pc = (None, None,
+                            jnp.zeros((self.m, 0), self.a.dtype))
+        if isinstance(self.a, jax.Array):
+            self.a.delete()
+        self.a = None
+
     def input_digest(self) -> str:
         """Content hash of the input matrix, computed ONCE and cached (a
         full device->host transfer + SHA-256 per snapshot would rival the
         cost of the sweep being checkpointed at large sizes). For a
         non-fully-addressable (multi-host) input, hashes this process's
         OWN shards — each process then validates its per-process snapshot
-        against the data it can actually see."""
+        against the data it can actually see. Unavailable after
+        `donate_input` released the input."""
+        if self.a is None:
+            raise ValueError("input buffer was released (donate_input); "
+                             "no digest available for checkpoint "
+                             "validation")
         if self._input_digest is None:
             import hashlib
             h = hashlib.sha256()
@@ -918,6 +968,8 @@ class SweepStepper:
                                    self.n_pad, self.nblocks)
         else:
             vtop = vbot = jnp.zeros((k, 0, top.shape[2]), self.a.dtype)
+        if self.config.donate_input:
+            self._release_input()
         return SweepState(top, bot, vtop, vbot,
                           jnp.float32(jnp.inf), jnp.int32(0))
 

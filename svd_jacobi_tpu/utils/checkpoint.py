@@ -63,10 +63,14 @@ def _fingerprint(stepper: SweepStepper) -> dict:
     # path); it is computed once and cached on the stepper.
     return {
         "format": _FORMAT,
+        # Digest first: after a donate_input release this raises the loud
+        # "input buffer was released" ValueError (checkpoint validation
+        # needs the input content; release and checkpointing are
+        # mutually exclusive by design).
+        "input_sha256": stepper.input_digest(),
         "m": stepper.m, "n": stepper.n, "n_pad": stepper.n_pad,
         "nblocks": stepper.nblocks,
-        "dtype": str(stepper.a.dtype),
-        "input_sha256": stepper.input_digest(),
+        "dtype": str(stepper.input_dtype),
         "compute_u": stepper.compute_u, "compute_v": stepper.compute_v,
         "full_matrices": stepper.full_matrices,
         "config": dataclasses.asdict(stepper.config),
@@ -160,7 +164,7 @@ def load_state(path, stepper: SweepStepper) -> SweepState:
         return _load_state_multiprocess(path, stepper)
     with np.load(path) as z:
         stage = _validate_meta(z, stepper, path)
-        dtype = stepper.a.dtype
+        dtype = stepper.input_dtype
         state = SweepState(
             top=jnp.asarray(z["top"], dtype), bot=jnp.asarray(z["bot"], dtype),
             vtop=jnp.asarray(z["vtop"], dtype), vbot=jnp.asarray(z["vbot"], dtype),
@@ -176,7 +180,7 @@ def _load_state_multiprocess(path, stepper) -> SweepState:
     if sharding is None:
         raise ValueError("multi-process resume requires a mesh SweepStepper")
     ppath = _proc_path(path)
-    dtype = stepper.a.dtype
+    dtype = stepper.input_dtype
     k = stepper.nblocks // 2
     with np.load(ppath) as z:
         stage = _validate_meta(z, stepper, ppath)

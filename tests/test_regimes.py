@@ -16,11 +16,18 @@ from svd_jacobi_tpu import solver
 HI = jax.lax.Precision.HIGHEST
 
 
-def test_default_block_size_is_128_for_large_n():
+def test_default_block_size_thresholds():
+    """Measured defaults (PROFILE.md item 18): lane-sized 128 from 2048,
+    widened to 256 from 8192 where the fused apply crosses the f32 ridge
+    and rounds/sweep halve."""
     assert SVDConfig().pick_block_size(2048) == 128
-    assert SVDConfig().pick_block_size(65536) == 128
+    assert SVDConfig().pick_block_size(4096) == 128
+    assert SVDConfig().pick_block_size(8192) == 256
+    assert SVDConfig().pick_block_size(65536) == 256
     b, k = solver._plan(2048, 1, SVDConfig())
     assert b == 128 and 2 * k * b == 2048
+    b, k = solver._plan(16384, 1, SVDConfig())
+    assert b == 256 and 2 * k * b == 16384
 
 
 def test_b128_sweep_path():
@@ -161,6 +168,62 @@ def test_mixed_bulk_f32_accuracy_class(store, cu, cv):
         assert res / np.linalg.norm(np.asarray(a)) < 5e-6
         assert np.max(np.abs(u.T @ u - np.eye(192))) < 1e-4
         assert np.max(np.abs(v.T @ v - np.eye(192))) < 1e-4
+
+
+def test_donate_input_correctness():
+    """SVDConfig.donate_input routes through the donating jit twin: same
+    results (the caller's buffer may be invalidated; the CPU backend may
+    ignore donation, so only correctness is asserted here — the memory
+    effect is the measured 30000^2 sigma-only chip row in BASELINE.md)."""
+    rng = np.random.default_rng(16)
+    an = rng.standard_normal((128, 96)).astype(np.float32)
+    r = sj.svd(jnp.asarray(an), config=SVDConfig(donate_input=True))
+    s_ref = np.linalg.svd(an.astype(np.float64), compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 2e-6
+
+
+def test_stepper_donate_input_releases_and_solves():
+    """donate_input on the host-stepped API: the input buffer is released
+    at init (the 30208^2 sigma-only chip row depends on this headroom —
+    PROFILE.md item 19), the solve still converges, and checkpoint digest
+    validation is refused loudly."""
+    rng = np.random.default_rng(17)
+    an = rng.standard_normal((128, 128)).astype(np.float32)
+    # Unpreconditioned sigma-only (the 30208^2 recipe).
+    st = solver.SweepStepper(jnp.asarray(an), compute_u=False,
+                             compute_v=False,
+                             config=SVDConfig(precondition="off",
+                                              donate_input=True))
+    state = st.init()
+    assert st.a is None
+    with pytest.raises(ValueError, match="released"):
+        st.input_digest()
+    while st.should_continue(state):
+        state = st.step(state)
+    r = st.finish(state)
+    s_ref = np.linalg.svd(an.astype(np.float64), compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+    # Preconditioned full-vector variant (q1/work survive the release).
+    st2 = solver.SweepStepper(jnp.asarray(an),
+                              config=SVDConfig(donate_input=True))
+    state = st2.init()
+    assert st2.a is None
+    while st2.should_continue(state):
+        state = st2.step(state)
+    r2 = st2.finish(state)
+    assert np.max(np.abs(np.asarray(r2.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+    res = np.linalg.norm(np.asarray(r2.u, np.float64)
+                         * np.asarray(r2.s, np.float64)
+                         @ np.asarray(r2.v, np.float64).T
+                         - an.astype(np.float64))
+    assert res / np.linalg.norm(an) < 5e-6
+    # Unpreconditioned + refine-on is unsatisfiable: loud rejection.
+    st3 = solver.SweepStepper(jnp.asarray(an),
+                              config=SVDConfig(precondition="off",
+                                               donate_input=True,
+                                               sigma_refine=True))
+    with pytest.raises(ValueError, match="refine"):
+        st3.init()
 
 
 def test_mixed_store_validation():
