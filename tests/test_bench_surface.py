@@ -1,0 +1,63 @@
+"""The bench driver surface (bench.py): flag guards and row modes.
+
+bench.py is the driver-facing entry point (one JSON line per run, the
+BASELINE.md table generator), so its flag semantics are part of the
+framework's contract: --stepped must solve through the host-stepped API,
+--fused-gen/--donate must refuse to fabricate "ours alone" baseline rows,
+and incompatible combinations must fail loudly at parse time (mirroring
+the CLI's parse-time rejections).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+BENCH = str(Path(__file__).resolve().parent.parent / "bench.py")
+
+
+def _run(*args):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, BENCH, *args, "--platform=cpu"],
+        capture_output=True, text=True, env=env, timeout=600)
+
+
+def test_bench_stepped_row():
+    p = _run("96", "--novec", "--no-baseline", "--reps=1", "--stepped")
+    assert p.returncode == 0, p.stderr[-500:]
+    row = json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "svd_96x96_float32_novec_gflops"
+    assert row["sweeps"] >= 1 and row["value"] > 0
+
+
+def test_bench_fused_gen_row():
+    p = _run("96", "--novec", "--no-baseline", "--reps=1", "--fused-gen")
+    assert p.returncode == 0, p.stderr[-500:]
+    row = json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["value"] > 0
+
+
+def test_bench_donate_requires_no_baseline():
+    p = _run("96", "--donate", "--reps=1")
+    assert p.returncode != 0
+    assert "no-baseline" in (p.stderr + p.stdout)
+
+
+def test_bench_fused_gen_stepped_conflict():
+    p = _run("96", "--fused-gen", "--stepped", "--no-baseline")
+    assert p.returncode != 0
+    assert "incompatible" in (p.stderr + p.stdout)
+
+
+def test_bench_donate_stepped_row():
+    """The 30208^2 recipe's flag combination, exercised end-to-end at toy
+    size: stepped solve, input released after init, sigma still correct
+    enough to produce a row."""
+    p = _run("96", "--novec", "--no-baseline", "--reps=1", "--stepped",
+             "--donate", "--precondition=off")
+    assert p.returncode == 0, p.stderr[-500:]
+    row = json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["value"] > 0
