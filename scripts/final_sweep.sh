@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One-shot round-close measurement run: the full BASELINE.md sweep plus the
+# mesh-of-1 parity row, each in its own subprocess (compile caches and HBM
+# do not leak across sizes). Writes JSON lines to reports/final_sweep.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+out=reports/final_sweep.jsonl
+: > "$out"
+echo "== bench --sweep =="
+python -u bench.py --sweep 2>&1 | grep -v WARNING | tee -a "$out"
+echo "== mesh-of-1 2048^2 parity =="
+python -u - << 'EOF' 2>&1 | grep -v WARNING | tee -a reports/final_sweep.jsonl
+import json, time
+import jax, jax.numpy as jnp
+from svd_jacobi_tpu.parallel import sharded
+from svd_jacobi_tpu.utils import matgen
+from svd_jacobi_tpu.utils._exec import force
+a = matgen.random_dense(2048, 2048, dtype=jnp.float32)
+mesh = sharded.make_mesh(jax.devices()[:1])
+f = lambda: sharded.svd(a, mesh=mesh)
+r = f(); force(tuple(r[:3]))
+best = 1e9
+for _ in range(3):
+    t0 = time.perf_counter(); force(tuple(f()[:3]))
+    best = min(best, time.perf_counter() - t0)
+print(json.dumps({"metric": "mesh1_svd_2048_f32_time_s",
+                  "value": round(best, 4), "unit": "s",
+                  "sweeps": int(r.sweeps)}))
+EOF
+echo "done: $out"
