@@ -283,6 +283,23 @@ def test_apply_exchange_perm_maps_match_rotate_blocks():
         assert np.array_equal(got_b, want_b), k
 
 
+def test_oversized_rotation_panels_fall_back_to_reference():
+    """Panels beyond the rotation kernels' scoped-VMEM budget (explicit
+    block_size >= 512: per-panel live set ~8 MB x double-buffering) must
+    route to the XLA reference bodies instead of dying in Mosaic
+    (PROFILE.md item 18). This test passes ONLY via the fallback: it calls
+    the dispatcher with interpret=False on the CPU backend, where the
+    compiled-kernel branch could not run at all."""
+    assert not pb.kernel_fits(512, pb.CROSS_FACTOR)
+    assert pb.kernel_fits(256, pb.CROSS_FACTOR)
+    assert pb.kernel_fits(128, pb.SELF_FACTOR)
+    x = _rand_panels(1, 64, 1024, seed=9)   # b2 = 512 cross panel
+    q = rounds._rotations(_gram(x), "cross", interpret=False, polish=False,
+                          axis_name=None)
+    qtq = jnp.einsum("kij,kil->kjl", q, q, precision=HI)
+    assert float(jnp.max(jnp.abs(qtq - jnp.eye(1024)[None]))) < 1e-4
+
+
 def test_apply_exchange_support_predicate():
     assert pa.supported(2048, 128)
     assert pa.supported(5000, 128)      # chunk 1000 divides
