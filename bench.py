@@ -132,6 +132,12 @@ SWEEP_CONFIGS = [
 ]
 
 
+# Exit code for "backend unreachable" (the watchdog row): lets --sweep
+# stop after the first dead-backend row instead of paying the discovery
+# deadline once per remaining config.
+_BACKEND_DOWN_RC = 3
+
+
 def _sweep(passthrough) -> None:
     """Run every SWEEP_CONFIGS row in a fresh subprocess, forwarding all
     other flags verbatim (--reps, --oracle, --baseline keep their
@@ -142,7 +148,13 @@ def _sweep(passthrough) -> None:
         keep = [f for f in passthrough
                 if f.lstrip("-").split("=", 1)[0] not in row_keys]
         cmd = [sys.executable, __file__, n, dtype] + ([m] if m else [])
-        subprocess.run(cmd + keep + row_flags, check=True)
+        rc = subprocess.run(cmd + keep + row_flags).returncode
+        if rc == _BACKEND_DOWN_RC:
+            print("sweep aborted: accelerator backend unreachable",
+                  file=sys.stderr)
+            sys.exit(_BACKEND_DOWN_RC)
+        if rc != 0:
+            raise subprocess.CalledProcessError(rc, cmd)
 
 
 def main() -> None:
@@ -174,6 +186,36 @@ def main() -> None:
         jax.config.update("jax_platforms", platform)
     if dtype_name == "float64":
         jax.config.update("jax_enable_x64", True)
+
+    # Backend watchdog: if the attachment's device pool is down,
+    # jax.devices() HANGS indefinitely (observed: relay accepts TCP,
+    # backend never answers). Probe it on a daemon thread with a deadline
+    # so the bench emits a parseable error row instead of hanging until
+    # an external kill.
+    import threading
+    probe = {}
+
+    def _discover():
+        try:
+            probe["devices"] = jax.devices()
+        except Exception as e:      # raised fast != hung: report verbatim
+            probe["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_discover, daemon=True)
+    t.start()
+    t.join(timeout=float(flags.get("backend-timeout", "300")))
+    if "devices" not in probe:
+        why = probe.get("error",
+                        "device discovery hung past the deadline — "
+                        "device pool down?")
+        print(json.dumps({
+            "metric": f"svd_{m}x{n}_{dtype_name}"
+                      f"{'_novec' if 'novec' in flags else ''}_gflops",
+            "value": None, "unit": "GFLOP/s", "vs_baseline": None,
+            "error": f"accelerator backend unreachable ({why})"}))
+        # Distinct exit code so --sweep's parent stops instead of burning
+        # the deadline once per remaining row.
+        sys.exit(_BACKEND_DOWN_RC)
 
     import jax.numpy as jnp
     import svd_jacobi_tpu as sj
