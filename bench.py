@@ -20,6 +20,11 @@ Usage:
                                   sizes where its attempt is KNOWN to OOM
                                   the device and poison the heap for the
                                   timed run that follows)
+         --backend-timeout=SECS  (deadline for device discovery, default
+                                  300 — a downed device pool HANGS
+                                  jax.devices(); past the deadline a
+                                  parseable error row is emitted and the
+                                  process exits with code 3)
          --sweep                 (run the whole BASELINE.md accelerator
                                   table — one JSON line per config — in a
                                   fresh subprocess each so compile caches
@@ -148,13 +153,14 @@ def _sweep(passthrough) -> None:
         keep = [f for f in passthrough
                 if f.lstrip("-").split("=", 1)[0] not in row_keys]
         cmd = [sys.executable, __file__, n, dtype] + ([m] if m else [])
-        rc = subprocess.run(cmd + keep + row_flags).returncode
+        full_cmd = cmd + keep + row_flags
+        rc = subprocess.run(full_cmd).returncode
         if rc == _BACKEND_DOWN_RC:
             print("sweep aborted: accelerator backend unreachable",
                   file=sys.stderr)
             sys.exit(_BACKEND_DOWN_RC)
         if rc != 0:
-            raise subprocess.CalledProcessError(rc, cmd)
+            raise subprocess.CalledProcessError(rc, full_cmd)
 
 
 def main() -> None:
@@ -189,25 +195,21 @@ def main() -> None:
 
     # Backend watchdog: if the attachment's device pool is down,
     # jax.devices() HANGS indefinitely (observed: relay accepts TCP,
-    # backend never answers). Probe it on a daemon thread with a deadline
-    # so the bench emits a parseable error row instead of hanging until
-    # an external kill.
-    import threading
-    probe = {}
-
-    def _discover():
-        try:
-            probe["devices"] = jax.devices()
-        except Exception as e:      # raised fast != hung: report verbatim
-            probe["error"] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=_discover, daemon=True)
-    t.start()
-    t.join(timeout=float(flags.get("backend-timeout", "300")))
-    if "devices" not in probe:
-        why = probe.get("error",
-                        "device discovery hung past the deadline — "
-                        "device pool down?")
+    # backend never answers). Probe it behind a deadline so the bench
+    # emits a parseable error row instead of hanging until an external
+    # kill.
+    from svd_jacobi_tpu.utils._exec import probe_devices
+    try:
+        backend_timeout = float(flags.get("backend-timeout", "300"))
+        if backend_timeout < 10.0:
+            raise ValueError
+    except ValueError:
+        raise SystemExit("--backend-timeout=SECONDS (>= 10) required, got "
+                         f"{flags.get('backend-timeout')!r}")
+    devices, err = probe_devices(backend_timeout)
+    if devices is None:
+        why = err or ("device discovery hung past the deadline — "
+                      "device pool down?")
         print(json.dumps({
             "metric": f"svd_{m}x{n}_{dtype_name}"
                       f"{'_novec' if 'novec' in flags else ''}_gflops",

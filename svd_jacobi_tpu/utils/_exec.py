@@ -17,3 +17,31 @@ def force(tree) -> float:
     import jax.numpy as jnp
     leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
     return float(np.asarray(sum(jnp.sum(x) for x in leaves)))
+
+
+def probe_devices(timeout: float):
+    """(devices, error) — `jax.devices()` behind a deadline.
+
+    Device discovery HANGS (never returns) when the attachment's device
+    pool is down (PROFILE.md item 19's environment), so callers that must
+    stay responsive (bench.py's watchdog, `dryrun_multichip`'s
+    CPU-fallback decision) probe it on a daemon thread. Returns
+    (devices, None) on success, (None, message) when discovery raised —
+    reported verbatim, a fast error is NOT a hang — or (None, None) when
+    it timed out."""
+    import threading
+
+    import jax
+
+    out = {}
+
+    def _discover():
+        try:
+            out["devices"] = jax.devices()
+        except Exception as e:
+            out["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_discover, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    return out.get("devices"), out.get("error")
