@@ -32,6 +32,15 @@ Usage:
                                   baseline that cannot compile, e.g. XLA
                                   svd at 16384^2, reports vs_baseline
                                   null instead of failing the row)
+         --manifest=PATH         (run manifest: append one obs.manifest
+                                  JSONL record per run; default
+                                  reports/manifest.jsonl, =off disables)
+         --telemetry             (also capture the in-graph per-sweep
+                                  event stream into the manifest, from ONE
+                                  extra UNTIMED telemetered solve after
+                                  the timing loop — the timed repetitions
+                                  stay on the zero-telemetry jit, so the
+                                  reported numbers are unperturbed)
 """
 
 from __future__ import annotations
@@ -345,7 +354,7 @@ def main() -> None:
     flops = 4.0 * m * n**2 + 8.0 * n**3
     gflops = flops / t_ours / 1e9
     tag = "_novec" if novec else ""
-    print(json.dumps({
+    row = {
         "metric": f"svd_{m}x{n}_{dtype_name}{tag}_gflops",
         "value": round(gflops, 2),
         "unit": "GFLOP/s",
@@ -359,7 +368,69 @@ def main() -> None:
         "mfu": round(gflops * 1e9 / _PEAK_F32_EFF, 4),
         "device": str(jax.devices()[0]),
         **extras,
-    }))
+    }
+    print(json.dumps(row))
+
+    manifest_path = flags.get("manifest", "reports/manifest.jsonl")
+    if manifest_path == "1":
+        # Bare `--manifest` (the flag parser's valueless sentinel): treat
+        # as a boolean enable, not a file literally named "1".
+        manifest_path = "reports/manifest.jsonl"
+    if manifest_path != "off":
+        from svd_jacobi_tpu import obs
+        events = None
+        if "telemetry" in flags:
+            # One extra untimed solve with the event stream baked in — the
+            # telemetered program is a different jit entry, so the timed
+            # numbers above are untouched. Guarded: a failed replay (e.g.
+            # OOM at the largest sizes) must not lose the manifest record
+            # the timed row already earned.
+            try:
+                if stepped:
+                    # The host-stepped path has no in-graph emission
+                    # sites; record the per-sweep stream (incl. real wall
+                    # times) from one instrumented host-stepped solve.
+                    from svd_jacobi_tpu.utils import profiling
+                    src = (a if a is not None
+                           else matgen.random_dense(m, n, dtype=dtype))
+                    _, log = profiling.instrumented_svd(
+                        src, compute_u=not novec, compute_v=not novec,
+                        config=cfg)
+                    events = log.to_events()
+                else:
+                    fn = ours
+                    if "fused-gen" in flags:
+                        # `ours` replays a jit closure traced while
+                        # telemetry was off (a cache hit emits nothing).
+                        # A FRESH jit of the same closure traces inside
+                        # the capture, keeping the generated matrix an
+                        # internal temp like the timed fused-gen run.
+                        run_tel = jax.jit(lambda: base(
+                            matgen.random_dense(m, n, dtype=dtype)))
+                        fn = lambda _x: run_tel()
+                    with obs.metrics.capture() as events:
+                        _force(fn(a))
+            except Exception as e:
+                print(f"note: telemetry replay failed "
+                      f"({type(e).__name__}); manifest written without "
+                      f"events", file=sys.stderr)
+                events = None
+        record = obs.manifest.build(
+            "bench", m=m, n=n, dtype=dtype_name, config=cfg,
+            solve={"time_s": float(t_ours), "sweeps": int(r.sweeps),
+                   "off_norm": float(r.off_rel),
+                   "gflops": round(gflops, 2),
+                   "vs_baseline": row["vs_baseline"],
+                   "mfu": row["mfu"],
+                   **extras},
+            stages=[{"name": "best_of_reps", "time_s": float(t_ours)}],
+            telemetry=events,
+            metric=row["metric"], baseline=row["baseline"],
+            baseline_time_s=row["baseline_time_s"],
+            novec=novec, stepped=stepped, reps=reps,
+            argv=sys.argv[1:])
+        obs.manifest.append(manifest_path, record)
+        print(f"manifest: {manifest_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

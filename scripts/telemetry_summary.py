@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Render or diff `obs.manifest` run records.
+
+A manifest is a JSONL stream — one schema-versioned record per CLI/bench
+run (see `svd_jacobi_tpu/obs/manifest.py`). This tool is the human end of
+it:
+
+    # render every record of a manifest (newest last)
+    python scripts/telemetry_summary.py reports/manifest.jsonl
+
+    # render only the last record
+    python scripts/telemetry_summary.py reports/manifest.jsonl --last
+
+    # diff two records (by index into one file, or across two files);
+    # negative indices count from the end, like Python
+    python scripts/telemetry_summary.py reports/manifest.jsonl --diff -2 -1
+    python scripts/telemetry_summary.py a.jsonl b.jsonl --diff -1 -1
+
+Runs entirely on the host — no jax import, so it works on machines without
+an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import sys
+from pathlib import Path
+
+# Load obs/manifest.py directly by file path: importing the package would
+# execute svd_jacobi_tpu/__init__.py, which pulls in the solver and jax —
+# exactly the dependency this host-side tool promises not to need.
+_MANIFEST = (Path(__file__).resolve().parent.parent / "svd_jacobi_tpu"
+             / "obs" / "manifest.py")
+_spec = importlib.util.spec_from_file_location("_svdj_manifest", _MANIFEST)
+manifest = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(manifest)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render or diff svd_jacobi_tpu run manifests (JSONL).")
+    p.add_argument("manifest", help="manifest file (JSONL)")
+    p.add_argument("manifest_b", nargs="?", default=None,
+                   help="second manifest for a cross-file --diff")
+    p.add_argument("--last", action="store_true",
+                   help="render only the newest record")
+    p.add_argument("--diff", nargs=2, type=int, metavar=("I", "J"),
+                   help="diff record I against record J (indices into the "
+                        "manifest; with two files, I indexes the first and "
+                        "J the second; negative = from the end)")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-validate every record and exit non-zero on "
+                        "the first violation")
+    args = p.parse_args(argv)
+
+    records = manifest.load(args.manifest)
+    if not records:
+        print(f"{args.manifest}: empty manifest", file=sys.stderr)
+        return 1
+
+    if args.validate:
+        for i, rec in enumerate(records):
+            try:
+                manifest.validate(rec)
+            except ValueError as e:
+                print(f"{args.manifest}[{i}]: {e}", file=sys.stderr)
+                return 1
+        print(f"{args.manifest}: {len(records)} valid record(s)")
+        return 0
+
+    if args.diff is not None:
+        i, j = args.diff
+        records_b = (manifest.load(args.manifest_b)
+                     if args.manifest_b else records)
+        try:
+            a, b = records[i], records_b[j]
+        except IndexError:
+            print(f"record index out of range ({len(records)} and "
+                  f"{len(records_b)} records)", file=sys.stderr)
+            return 1
+        print(manifest.diff(a, b))
+        return 0
+
+    for rec in (records[-1:] if args.last else records):
+        print(manifest.summarize(rec))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,9 +7,10 @@ on the MXU, sharded over TPU meshes with ICI collectives, with a
 LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 """
 
+from . import obs
 from .config import SVDConfig
 from .solver import SVDResult, svd
 
 __version__ = "0.1.0"
 
-__all__ = ["svd", "SVDConfig", "SVDResult", "__version__"]
+__all__ = ["svd", "SVDConfig", "SVDResult", "obs", "__version__"]

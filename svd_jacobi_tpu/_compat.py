@@ -1,0 +1,69 @@
+"""Version shims for JAX APIs the solver uses across jax releases.
+
+The mesh solver is written against the current `jax.shard_map` /
+`jax.lax.pcast` surface; older jaxlibs (0.4.x) ship the same machinery as
+`jax.experimental.shard_map.shard_map` without the varying-axes (vma)
+type system. One import site per symbol keeps every caller
+version-agnostic:
+
+  * `shard_map(f, mesh=..., in_specs=..., out_specs=...)` — the public
+    `jax.shard_map` when it exists; otherwise the experimental one with
+    replication checking disabled (check_rep predates pcast/pvary, so
+    replicated loop-carry inits would be rejected for the exact reason
+    pcast was later added).
+  * `pcast(x, axes, to=...)` — `jax.lax.pcast` when it exists; identity
+    otherwise (no vma checker to satisfy).
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+    def pcast(x, axes, *, to=None):
+        del axes, to
+        return x
+
+
+def vma(x) -> frozenset:
+    """Varying-axes set of a traced value; empty on jaxes without vma."""
+    if hasattr(jax, "typeof"):
+        return getattr(jax.typeof(x), "vma", frozenset())
+    return frozenset()
+
+
+def enable_cpu_collectives() -> None:
+    """Multi-process CPU runs need a cross-process collectives backend.
+    Newer jaxes default `jax_cpu_collectives_implementation` to "gloo";
+    0.4.x defaults it to "none" and cross-host psum/pmax then fail with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Must run before the CPU client is created; harmless on TPU."""
+    try:
+        if jax.config._read("jax_cpu_collectives_implementation") in (
+                None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # flag renamed/absent: the default is already a real backend
+
+
+def distributed_is_initialized() -> bool:
+    """`jax.distributed.is_initialized()` where it exists; on older jaxes
+    fall back to the global distributed state's coordination client."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:
+        return False

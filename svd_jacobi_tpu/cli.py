@@ -11,18 +11,22 @@ residual to `reporte-dimension-<N>-time-<timestamp>.txt` (main.cu:1667-1669).
 Here: rectangular sizes, reproducible warm-up (the reference's warm-up is
 unseeded, quirk #9), orthogonality checks the reference lacks, sweeps /
 convergence diagnostics, optional mesh-distributed solve and profiler trace,
-and a structured JSON report.
+and a schema-versioned run manifest: every run appends ONE JSONL record
+(`obs.manifest`) to `<report-dir>/manifest.jsonl` — device topology, config
+hash, per-stage wall times, solve metrics, and (with --telemetry) the
+in-graph per-sweep event stream from the FUSED solve. Render or diff
+records with `scripts/telemetry_summary.py`.
 
 Usage:
     python -m svd_jacobi_tpu.cli N [M] [--dtype f32] [--distributed]
         [--matrix triangular|dense] [--no-selftest] [--report-dir DIR]
-        [--profile DIR] [--oracle]
+        [--profile DIR] [--oracle] [--telemetry]
 """
 
 from __future__ import annotations
 
 import argparse
-import datetime
+import contextlib
 import json
 import sys
 import time
@@ -82,10 +86,18 @@ def _parse_args(argv):
                    help="warm-up self-test size (reference: 1000)")
     p.add_argument("--oracle", action="store_true",
                    help="also compare sigma against numpy.linalg.svd (host)")
-    p.add_argument("--report-dir", default=".",
-                   help="where to write the JSON report file")
+    p.add_argument("--report-dir", default="reports",
+                   help="directory of the run manifest (one JSONL record "
+                        "per run appended to <dir>/manifest.jsonl)")
     p.add_argument("--profile", default=None, metavar="DIR",
-                   help="capture a jax.profiler trace of the solve into DIR")
+                   help="capture a jax.profiler trace of the solve into DIR "
+                        "(obs.trace: creates the dir, warns instead of "
+                        "raising when the profiler is unavailable)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="record the in-graph per-sweep event stream "
+                        "(obs.metrics) of the timed solve into the "
+                        "manifest; the solve is retraced with "
+                        "jax.debug.callback emission baked in")
     return p.parse_args(argv)
 
 
@@ -215,24 +227,22 @@ def main(argv=None) -> int:
         mesh = sharded.make_mesh()
         log(f"mesh: {mesh}")
 
-    report = {
-        "dimension": {"m": m, "n": n},
-        "dtype": args.dtype,
+    # Extra (schema-open) manifest fields + per-stage wall times. The
+    # CLI-level job options ride in `extra` (they are driver surface, not
+    # SVDConfig fields — the config hash stays comparable with bench runs).
+    extra = {
         "matrix": args.matrix,
         "seed": args.seed,
-        "devices": [str(d) for d in devices],
         "distributed": bool(mesh),
-        "config": {"pair_solver": args.pair_solver,
-                   "max_sweeps": args.max_sweeps, "tol": args.tol,
-                   "block_size": args.block_size,
-                   "precondition": args.precondition,
-                   "mixed_bulk": args.mixed_bulk,
-                   "sigma_refine": args.sigma_refine,
-                   "jobu": args.jobu, "jobv": args.jobv},
+        "jobu": args.jobu, "jobv": args.jobv,
     }
+    stages = []
 
     if not args.no_selftest:
-        report["self_test"] = _self_test(args, config, log)
+        t0 = time.perf_counter()
+        extra["self_test"] = _self_test(args, config, log)
+        stages.append({"name": "self_test",
+                       "time_s": time.perf_counter() - t0})
 
     if mesh is not None:
         # Generate directly into the mesh sharding: no host materializes the
@@ -246,28 +256,43 @@ def main(argv=None) -> int:
     else:
         a = matgen.random_dense(m, n, seed=args.seed, dtype=dtype)
 
-    # Compile outside the timed region (the reference's timing also excludes
-    # setup; its warm-up test additionally pre-warms the CUDA context).
-    _force(tuple(_solve(a, args, config, mesh)[:3]))
+    from svd_jacobi_tpu import obs
 
-    if args.profile:
-        jax.profiler.start_trace(args.profile)
+    # Compile outside the timed region (the reference's timing also excludes
+    # setup; its warm-up test additionally pre-warms the CUDA context). With
+    # --telemetry the warm-up also runs telemetered — the emission sites are
+    # part of the jit cache key, so the timed run reuses this compilation.
     t0 = time.perf_counter()
-    r = _solve(a, args, config, mesh)
-    _force(tuple(r[:3]))
-    solve_time = time.perf_counter() - t0
+    with (obs.metrics.capture() if args.telemetry
+          else contextlib.nullcontext([])):
+        _force(tuple(_solve(a, args, config, mesh)[:3]))
+    stages.append({"name": "warmup_compile",
+                   "time_s": time.perf_counter() - t0})
+
+    profile_ctx = (obs.trace(args.profile) if args.profile
+                   else contextlib.nullcontext())
+    with profile_ctx:
+        with (obs.metrics.capture() if args.telemetry
+              else contextlib.nullcontext([])) as events:
+            # Timed region innermost: trace start/stop (stop serializes
+            # the trace to disk) and the capture-exit flush barrier must
+            # not inflate the reported solve time.
+            t0 = time.perf_counter()
+            r = _solve(a, args, config, mesh)
+            _force(tuple(r[:3]))
+            solve_time = time.perf_counter() - t0
+    stages.append({"name": "solve", "time_s": solve_time})
     if args.profile:
-        jax.profiler.stop_trace()
-        report["profile_dir"] = args.profile
+        extra["profile_dir"] = args.profile
 
     rep = validation.validate(a, r).as_dict()
-    report["solve"] = {
+    solve = {
         "time_s": solve_time,
         "sweeps": int(r.sweeps),
         "off_norm": float(r.off_rel),
-        "jobu": args.jobu,
-        "jobv": args.jobv,
-        # None where the job options suppressed a factor (e.g. sigma-only).
+        # None where the job options suppressed a factor (e.g. sigma-only);
+        # jobu/jobv themselves ride at manifest top level with the other
+        # CLI-surface options.
         "residual_rel": rep["residual_rel"],
         "u_orth": rep["u_orth"],
         "u_orth_live": rep["u_orth_live"],
@@ -287,22 +312,23 @@ def main(argv=None) -> int:
             log("--oracle skipped: not supported with multi-process runs")
         else:
             s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
-            report["solve"]["sigma_err"] = float(
-                validation.sigma_error(r.s, s_ref))
-            log(f"sigma_err vs numpy: {report['solve']['sigma_err']:.3e}")
+            solve["sigma_err"] = float(validation.sigma_error(r.s, s_ref))
+            log(f"sigma_err vs numpy: {solve['sigma_err']:.3e}")
 
-    # Report file — JSON successor of the reference's
-    # `reporte-dimension-<N>-time-<timestamp>.txt` (main.cu:1667-1669).
+    # Run manifest — schema-versioned JSONL successor of the reference's
+    # `reporte-dimension-<N>-time-<timestamp>.txt` (main.cu:1667-1669) and
+    # of this driver's own timestamped report-dimension-*.json dumps.
     # Only the coordinator writes (every process would race on the same
     # file otherwise); all processes still print their solve line.
+    record = obs.manifest.build(
+        "cli", m=m, n=n, dtype=args.dtype, config=config, solve=solve,
+        stages=stages, telemetry=(list(events) if args.telemetry else None),
+        **extra)
     if ctx is None or ctx.is_coordinator:
-        stamp = datetime.datetime.now().strftime("%d-%m-%Y-%H-%M-%S")
-        report_dir = Path(args.report_dir)
-        report_dir.mkdir(parents=True, exist_ok=True)
-        path = report_dir / f"report-dimension-{n}-time-{stamp}.json"
-        path.write_text(json.dumps(report, indent=2))
-        log(f"report: {path}")
-    print(json.dumps(report["solve"]))
+        path = obs.manifest.append(
+            Path(args.report_dir) / "manifest.jsonl", record)
+        log(f"manifest: {path}")
+    print(json.dumps(solve))
     return 0
 
 

@@ -1,0 +1,34 @@
+"""Observability subsystem: in-graph metrics, named-scope tracing, and
+structured run manifests.
+
+Three pillars (successors of the reference's wall-clock bracket + free-text
+report file, main.cu:1586-1669):
+
+  * `obs.metrics` — a jit-safe event stream: the fused solve loops
+    (`solver.py`, `ops/rounds.py`, `parallel/sharded.py`) emit per-sweep
+    off-norm, stage transitions, and rotation-round counters through
+    `jax.debug.callback` from INSIDE `lax.while_loop`/`lax.scan`, gated by
+    a static flag so the telemetry-off path compiles to identical HLO.
+    The sharded path emits already-pmax'd (replicated) values and the host
+    sink reports once per event from process 0.
+  * `obs.scopes` — `jax.named_scope` annotations on every hot region
+    (Gram panels, rotation kernels, apply+exchange, QR precondition,
+    polish, recombination), so `--profile` Perfetto/TensorBoard traces map
+    to code instead of anonymous fusions. Always on: scopes are metadata
+    only and cost nothing at runtime.
+  * `obs.manifest` — schema-versioned JSONL run records (device topology,
+    jaxlib/config hash, per-stage wall time, sweep telemetry, residuals)
+    written by `cli.py` and `bench.py`; `scripts/telemetry_summary.py`
+    renders or diffs them.
+
+`obs.trace(dir)` wraps `jax.profiler` traces robustly (creates the dir,
+warns instead of raising when the profiler is unavailable).
+"""
+
+from . import manifest, metrics, scopes
+from .metrics import capture, emit, enabled
+from .scopes import scope
+from .trace import trace
+
+__all__ = ["manifest", "metrics", "scopes", "capture", "emit", "enabled",
+           "scope", "trace"]

@@ -1,0 +1,245 @@
+"""Schema-versioned structured run manifests (JSONL).
+
+Successor of the reference's free-text report file (main.cu:1667-1669) and
+of this repo's own ad-hoc `report-dimension-*.json` dumps: every CLI/bench
+run appends ONE self-describing JSON record to a `.jsonl` manifest, so runs
+accumulate in a single greppable/diffable stream instead of littering
+timestamped files. `scripts/telemetry_summary.py` renders a manifest or
+diffs two records.
+
+A record carries:
+
+  * identity: ``schema_version``, ``kind`` ("cli" | "bench"), ``timestamp``;
+  * environment: jax/jaxlib versions, backend, device kind/count/topology,
+    process count — everything needed to know WHERE a number came from;
+  * the solve spec: dimension, dtype, solver config + its content hash
+    (``config_sha256`` — two records with equal hashes ran the same solver
+    configuration, whatever the field spelling);
+  * results: per-stage wall times, solve metrics (time, sweeps, off-norm,
+    residual/orthogonality, sigma error), and — when telemetry was on —
+    the in-graph per-sweep event stream from `obs.metrics`.
+
+Validation is self-contained (`validate`): required keys and types are
+checked against `SCHEMA`, unknown extra keys are allowed (forward
+compatibility), and version mismatches fail loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# Required top-level fields and their types. Optional fields are listed
+# with ``None`` allowed. Nested specs: dicts map field -> type-tuple.
+_NUM = (int, float)
+SCHEMA: Dict[str, Any] = {
+    "schema_version": int,
+    "kind": str,                      # "cli" | "bench"
+    "timestamp": str,                 # ISO 8601
+    "environment": {
+        "jax": str,
+        "jaxlib": str,
+        "backend": str,               # "cpu" | "tpu" | ...
+        "device_kind": str,
+        "device_count": int,
+        "process_count": int,
+    },
+    "dimension": {"m": int, "n": int},
+    "dtype": str,
+    "config": dict,
+    "config_sha256": str,
+    "stages": list,                   # [{"name": str, "time_s": float}]
+    "solve": dict,                    # time_s/sweeps/off_norm/residual_rel...
+    "telemetry": (list, type(None)),  # obs.metrics events, or None when off
+}
+
+_STAGE_FIELDS = {"name": str, "time_s": _NUM}
+_SOLVE_REQUIRED = {"time_s": _NUM, "sweeps": int, "off_norm": _NUM}
+_EVENT_REQUIRED = {"event": str}
+
+
+def environment() -> dict:
+    """Environment block: versions + device topology of THIS runtime."""
+    import jax
+    import jaxlib
+    devices = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": devices[0].platform if devices else "unknown",
+        "device_kind": devices[0].device_kind if devices else "unknown",
+        "device_count": len(devices),
+        "process_count": jax.process_count(),
+    }
+
+
+def config_hash(config) -> str:
+    """Content hash of a solver configuration (SVDConfig or plain dict):
+    canonical-JSON SHA-256, so two runs with equal hashes solved under the
+    same configuration regardless of how the record spells the fields."""
+    if dataclasses.is_dataclass(config):
+        config = dataclasses.asdict(config)
+    canon = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def build(kind: str, *, m: int, n: int, dtype: str, config,
+          solve: dict, stages: Optional[List[dict]] = None,
+          telemetry: Optional[List[dict]] = None, **extra) -> dict:
+    """Assemble a schema-valid record. ``extra`` keys (seed, matrix,
+    distributed, argv, self_test, ...) ride along at top level — the
+    schema allows unknown keys so drivers can attach context freely."""
+    if dataclasses.is_dataclass(config):
+        config_dict = dataclasses.asdict(config)
+    else:
+        config_dict = dict(config)
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": kind,
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "dimension": {"m": int(m), "n": int(n)},
+        "dtype": str(dtype),
+        "config": {k: (v if v is None or isinstance(v, (bool, int, float,
+                                                        str)) else str(v))
+                   for k, v in config_dict.items()},
+        "config_sha256": config_hash(config_dict),
+        "stages": list(stages or []),
+        "solve": dict(solve),
+        "telemetry": telemetry,
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
+def _check(cond: bool, errors: List[str], msg: str) -> None:
+    if not cond:
+        errors.append(msg)
+
+
+def _check_fields(obj, spec, where: str, errors: List[str]) -> None:
+    if not isinstance(obj, dict):
+        errors.append(f"{where}: expected object, got {type(obj).__name__}")
+        return
+    for key, typ in spec.items():
+        if key not in obj:
+            errors.append(f"{where}.{key}: missing")
+        elif isinstance(typ, dict):
+            _check_fields(obj[key], typ, f"{where}.{key}", errors)
+        elif not isinstance(obj[key], typ):
+            errors.append(f"{where}.{key}: expected "
+                          f"{getattr(typ, '__name__', typ)}, got "
+                          f"{type(obj[key]).__name__}")
+
+
+def validate(record: dict) -> None:
+    """Raise ValueError listing every schema violation (empty = valid)."""
+    errors: List[str] = []
+    _check(isinstance(record, dict), errors, "record: not an object")
+    if not isinstance(record, dict):
+        raise ValueError("; ".join(errors))
+    _check_fields(record, SCHEMA, "record", errors)
+    if record.get("schema_version") not in (None, SCHEMA_VERSION):
+        errors.append(f"record.schema_version: {record['schema_version']} "
+                      f"!= supported {SCHEMA_VERSION}")
+    for i, st in enumerate(record.get("stages") or []):
+        _check_fields(st, _STAGE_FIELDS, f"record.stages[{i}]", errors)
+    if isinstance(record.get("solve"), dict):
+        _check_fields(record["solve"], _SOLVE_REQUIRED, "record.solve",
+                      errors)
+    tel = record.get("telemetry")
+    if tel is not None:
+        for i, ev in enumerate(tel):
+            _check_fields(ev, _EVENT_REQUIRED, f"record.telemetry[{i}]",
+                          errors)
+    if errors:
+        raise ValueError("invalid manifest record: " + "; ".join(errors))
+
+
+def append(path, record: dict) -> Path:
+    """Validate and append one JSONL record (creating parent dirs)."""
+    validate(record)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return path
+
+
+def load(path) -> List[dict]:
+    """Read every record of a JSONL manifest (skipping blank lines)."""
+    records = []
+    with Path(path).open() as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def summarize(record: dict) -> str:
+    """One human-readable block per record (telemetry_summary's renderer)."""
+    dim = record.get("dimension", {})
+    env = record.get("environment", {})
+    solve = record.get("solve", {})
+    lines = [
+        f"{record.get('kind', '?')} run @ {record.get('timestamp', '?')}",
+        f"  matrix {dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
+        f"backend={env.get('backend')} ({env.get('device_count')}x "
+        f"{env.get('device_kind')}, {env.get('process_count')} proc)",
+        f"  config {record.get('config_sha256', '')[:12]}  "
+        f"jax {env.get('jax')} / jaxlib {env.get('jaxlib')}",
+    ]
+    for st in record.get("stages") or []:
+        lines.append(f"  stage {st.get('name', '?'):<12} "
+                     f"{st.get('time_s', float('nan')):9.3f} s")
+    keys = ("time_s", "sweeps", "off_norm", "residual_rel", "u_orth",
+            "v_orth", "sigma_err", "gflops", "vs_baseline")
+    kv = [f"{k}={solve[k]:.4g}" if isinstance(solve.get(k), float)
+          else f"{k}={solve[k]}" for k in keys if solve.get(k) is not None]
+    lines.append("  solve " + "  ".join(kv))
+    tel = record.get("telemetry")
+    if tel:
+        sweeps = [e for e in tel if e.get("event") == "sweep"]
+        lines.append(f"  telemetry: {len(tel)} events, {len(sweeps)} sweeps")
+        for e in sweeps:
+            extra = ""
+            if "rounds_rotated" in e:
+                extra = (f"  rounds {e['rounds_rotated']}"
+                         f"/{e.get('rounds_total', '?')}")
+            lines.append(f"    sweep {e.get('sweep', '?'):>3} "
+                         f"[{e.get('path', '?')}/{e.get('stage', '?')}] "
+                         f"off={e.get('off_rel', float('nan')):.3e}{extra}")
+    return "\n".join(lines)
+
+
+def diff(a: dict, b: dict) -> str:
+    """Field-level diff of two records' comparable metrics."""
+    lines = []
+    if a.get("config_sha256") != b.get("config_sha256"):
+        lines.append("config differs:")
+        ca, cb = a.get("config", {}), b.get("config", {})
+        for k in sorted(set(ca) | set(cb)):
+            if ca.get(k) != cb.get(k):
+                lines.append(f"  {k}: {ca.get(k)!r} -> {cb.get(k)!r}")
+    for section in ("environment", "dimension"):
+        sa, sb = a.get(section, {}), b.get(section, {})
+        for k in sorted(set(sa) | set(sb)):
+            if sa.get(k) != sb.get(k):
+                lines.append(f"{section}.{k}: {sa.get(k)!r} -> {sb.get(k)!r}")
+    sa, sb = a.get("solve", {}), b.get("solve", {})
+    for k in sorted(set(sa) | set(sb)):
+        va, vb = sa.get(k), sb.get(k)
+        if isinstance(va, _NUM) and isinstance(vb, _NUM) and va:
+            lines.append(f"solve.{k}: {va:.6g} -> {vb:.6g} "
+                         f"({(vb - va) / abs(va) * 100.0:+.1f}%)")
+        elif va != vb:
+            lines.append(f"solve.{k}: {va!r} -> {vb!r}")
+    return "\n".join(lines) or "(records are metric-identical)"

@@ -1,0 +1,172 @@
+"""Jit-safe in-graph metrics: a host-side event stream fed by
+`jax.debug.callback` from inside the fused solve loops.
+
+Design constraints (why this is not a logging module):
+
+  * Events are emitted from INSIDE `lax.while_loop`/`lax.scan` bodies of
+    jitted programs — the only mechanism that can observe the fused solve
+    without host-stepping it (which measures a different program; see
+    `utils/profiling.instrumented_svd` and PROFILE.md's intra-jit
+    methodology) is a runtime callback.
+  * The zero-telemetry path must compile to IDENTICAL HLO: emission sites
+    are gated by a static `telemetry` argument threaded through the jitted
+    entry points, so the flag is part of the jit cache key and the
+    disabled trace contains no callback (and no counter carries) at all.
+    `emit` additionally no-ops when the module flag is off, as a guard
+    against an ungated call site.
+  * Under `shard_map` a callback fires once per LOCAL device with
+    identical (pmax-replicated) values; the dispatcher deduplicates by
+    counting ``replicas`` occurrences of each event identity, and only
+    process 0 of a multi-process run records — so the sharded path
+    reports each sweep exactly once.
+
+Usage (host side):
+
+    with obs.metrics.capture() as events:
+        r = sj.svd(a)                 # retraces with telemetry baked in
+    # events == [{"event": "sweep", "stage": ..., "off_rel": ...}, ...]
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Callable, Dict, List
+
+import numpy as np
+
+_lock = threading.RLock()
+_enabled = False
+_sinks: List[Callable[[dict], None]] = []
+_pending: Dict[tuple, int] = {}
+_site_counter = itertools.count()
+
+
+def enabled() -> bool:
+    """Trace-time telemetry flag — solver entry points pass this as the
+    static `telemetry` argument of their jits, so toggling it retraces."""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    with _lock:
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+@contextlib.contextmanager
+def capture():
+    """Enable telemetry and collect events into the yielded list.
+
+    Nesting is allowed (each capture sees events emitted while it is
+    active); the enabled flag is restored on exit. Exit drains the
+    runtime's callback queue (`jax.effects_barrier`) first — deliveries
+    are asynchronous, and without the barrier events from a solve that
+    just returned would race the sink removal and be lost.
+    """
+    global _enabled
+    events: List[dict] = []
+    with _lock:
+        prev = _enabled
+        _sinks.append(events.append)
+        enable()
+    try:
+        yield events
+    finally:
+        try:
+            flush()
+        finally:
+            # The barrier re-raises deferred callback/runtime errors; the
+            # sink removal and flag restore must survive them or telemetry
+            # stays globally on (and the dead list keeps growing).
+            with _lock:
+                _sinks.remove(events.append)
+                _enabled = prev
+
+
+def flush() -> None:
+    """Block until every already-dispatched callback has been delivered."""
+    import jax
+    jax.effects_barrier()
+
+
+def add_sink(fn: Callable[[dict], None]) -> Callable[[], None]:
+    """Register a persistent event sink; returns a remover. Sinks receive
+    plain-dict events on the runtime callback thread (keep them cheap)."""
+    with _lock:
+        _sinks.append(fn)
+
+    def remove():
+        with _lock:
+            if fn in _sinks:
+                _sinks.remove(fn)
+    return remove
+
+
+def _scalar(v):
+    """numpy scalar/0-d array -> plain python int/float/bool."""
+    a = np.asarray(v)
+    if a.dtype.kind in "iu":
+        return int(a)
+    if a.dtype.kind == "b":
+        return bool(a)
+    return float(a)
+
+
+def _dispatch(site: int, replicas: int, record: dict) -> None:
+    import jax
+    if jax.process_index() != 0:
+        return
+    with _lock:
+        if replicas > 1:
+            # Replicated emission (shard_map): every local device delivers
+            # the same values; count occurrences of this exact event and
+            # forward only the first of each cycle of ``replicas``.
+            key = (site, tuple(sorted((k, repr(v))
+                                      for k, v in record.items())))
+            n = _pending.get(key, 0) + 1
+            if n >= replicas:
+                _pending.pop(key, None)
+            else:
+                _pending[key] = n
+            if n > 1:
+                return
+        for sink in list(_sinks):
+            sink(record)
+
+
+def emit(event: str, *, meta: dict | None = None, replicas: int = 1,
+         **fields) -> None:
+    """Emit one event from inside a jitted computation.
+
+    ``event``/``meta`` are trace-time constants (strings, ints); ``fields``
+    are traced scalars delivered at runtime. ``replicas``: how many times
+    the runtime will deliver this callback per logical event (= local
+    device count when emitting replicated values under shard_map; the
+    dispatcher forwards one).
+
+    Call sites MUST be gated by a static telemetry flag — `emit` inserts a
+    `jax.debug.callback` into the trace, and the telemetry-off path must
+    stay HLO-identical. The `_enabled` check here is a second line of
+    defense, not the gate.
+    """
+    if not _enabled:
+        return
+    import jax
+    site = next(_site_counter)
+    static = dict(meta or {})
+    static["event"] = event
+
+    def _cb(**kw):
+        record = dict(static)
+        record.update((k, _scalar(v)) for k, v in kw.items())
+        _dispatch(site, replicas, record)
+
+    jax.debug.callback(_cb, **fields)

@@ -1,0 +1,35 @@
+"""Named-scope annotations for profiler traces.
+
+`scope("gram")` is `jax.named_scope("svdj/gram")`: the scope name rides the
+XLA metadata of every op traced inside it, so Perfetto/TensorBoard traces
+(and HLO dumps) show `svdj/gram`, `svdj/rotations`, `svdj/apply_exchange`
+instead of anonymous `fusion.123` regions. Scopes are always on — they are
+pure metadata with zero runtime cost and do not change the computation —
+unlike `obs.metrics`, which inserts callbacks and is therefore gated.
+
+The scope names used across the solver stack map onto PROFILE.md's
+component-cost rows:
+
+    svdj/gram            Gram panel formation (einsum or Pallas kernel)
+    svdj/rotations       the rotation generator (Pallas kernels / reference)
+    svdj/apply           rotation apply matmuls (unfused form)
+    svdj/apply_exchange  fused apply+exchange(+gram) kernel
+    svdj/exchange        tournament block exchange (ring hop on mesh)
+    svdj/precondition_qr Drmac QR preconditioning
+    svdj/reconstitute    mixed-bulk Newton-Schulz + X = L @ G rebuild
+    svdj/postprocess     sigma sort + factor normalization
+    svdj/sigma_refine    post-convergence sigma refinement
+    svdj/recombine       preconditioned-path factor recombination
+    svdj/pair_solve      XLA block solvers (gram-eigh / qr-svd)
+"""
+
+from __future__ import annotations
+
+import jax
+
+PREFIX = "svdj"
+
+
+def scope(name: str):
+    """Context manager annotating ops traced inside with ``svdj/<name>``."""
+    return jax.named_scope(f"{PREFIX}/{name}")

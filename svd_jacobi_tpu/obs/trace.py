@@ -1,0 +1,46 @@
+"""Robust `jax.profiler` trace context.
+
+Successor of `utils.profiling.trace` (which re-exports this): creates the
+log directory if missing and degrades to a warning — instead of raising
+mid-solve — when the profiler is unavailable on the backend (some CPU
+jaxlibs and remote-attachment tunnels ship without profiler support, and a
+failed `start_trace` used to kill the solve it was meant to observe).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import warnings
+from pathlib import Path
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """XLA profiler trace of the enclosed block (TensorBoard/Perfetto).
+
+    Creates ``log_dir`` (parents included) if missing. If the profiler
+    cannot start — backend without profiler support, or a trace already
+    active — warns and runs the block untraced instead of raising.
+    """
+    import jax
+
+    log_dir = Path(log_dir)
+    started = False
+    try:
+        log_dir.mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(str(log_dir))
+        started = True
+    except Exception as e:  # profiler unavailable: observe-only must not kill
+        warnings.warn(f"obs.trace: profiler unavailable, running untraced "
+                      f"({type(e).__name__}: {e})", RuntimeWarning,
+                      stacklevel=3)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                warnings.warn(f"obs.trace: stop_trace failed "
+                              f"({type(e).__name__}: {e})", RuntimeWarning,
+                              stacklevel=3)

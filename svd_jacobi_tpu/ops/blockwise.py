@@ -29,6 +29,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import _compat
+
 
 def _precision(name: str) -> jax.lax.Precision:
     return {
@@ -149,7 +151,7 @@ def _maybe_pvary(x, axis_name):
     """
     if axis_name is None:
         return x
-    return jax.lax.pcast(x, (axis_name,), to="varying")
+    return _compat.pcast(x, (axis_name,), to="varying")
 
 
 def givens_cleanup_sweep(p: jax.Array, dmax2: jax.Array,

@@ -24,6 +24,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .. import _compat
+
 _TINY = 1e-30
 
 
@@ -75,9 +77,9 @@ def _maybe_pvary(xs, vma):
         return xs
 
     def cast(x):
-        have = getattr(jax.typeof(x), "vma", frozenset())
+        have = _compat.vma(x)
         need = tuple(a for a in vma if a not in have)
-        return jax.lax.pcast(x, need, to="varying") if need else x
+        return _compat.pcast(x, need, to="varying") if need else x
 
     return tuple(cast(x) for x in xs)
 

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from . import pallas_apply as pa
 from . import pallas_blocks as pb
 from . import pallas_gram as pg
+from ..obs import metrics
+from ..obs.scopes import scope
 from ..parallel import schedule as sched
 
 HI = jax.lax.Precision.HIGHEST
@@ -135,12 +137,13 @@ def _rotations(g, kind, *, interpret, polish, axis_name):
     b2 = g.shape[-1] // 2   # both kernels carry half-width 4-block panels
     factor = pb.CROSS_FACTOR if kind == "cross" else pb.SELF_FACTOR
     oversized = not pb.kernel_fits(b2, factor)
-    if (axis_name is not None and interpret) or oversized:
-        fn = pb.reference_self if kind == "self" else pb.reference_cross
-        return fn(g, polish=polish)
-    fn = pb.self_rotations if kind == "self" else pb.cross_rotations
-    return fn(g, interpret=interpret, polish=polish,
-              vma=(axis_name,) if axis_name is not None else None)
+    with scope("rotations"):
+        if (axis_name is not None and interpret) or oversized:
+            fn = pb.reference_self if kind == "self" else pb.reference_cross
+            return fn(g, polish=polish)
+        fn = pb.self_rotations if kind == "self" else pb.cross_rotations
+        return fn(g, interpret=interpret, polish=polish,
+                  vma=(axis_name,) if axis_name is not None else None)
 
 
 def _mesh_max(x, axis_name):
@@ -148,15 +151,18 @@ def _mesh_max(x, axis_name):
 
 
 def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
-               axis_name=None, apply_x3=False):
+               axis_name=None, apply_x3=False, return_rotated=False):
     """Annihilate every within-block pair once (full tournament kernel).
 
     ``axis_name``: when run under shard_map, the mesh axis — the round-skip
     predicate is pmax'd so every device takes the same branch. The returned
     stat stays LOCAL (the sweep pmax's its running max once, not once per
-    round).
+    round). ``return_rotated``: also return the skip decision as an int32
+    0/1 (telemetry's rotation-round counter; only computed when asked so
+    the zero-telemetry trace is unchanged).
     """
-    g = _einsum(blocks, blocks, "kmi,kmj->kij", bf16_gram)
+    with scope("gram"):
+        g = _einsum(blocks, blocks, "kmi,kmj->kij", bf16_gram)
     stat, skip = panel_stats(g, dmax2)
     skip = _mesh_max(skip, axis_name)
 
@@ -164,21 +170,24 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
         blocks, vblocks = args
         q = _rotations(g, "self", interpret=interpret, polish=polish,
                        axis_name=axis_name)
-        blocks = _einsum(blocks, q, "kmi,kij->kmj",
-                         x3=apply_x3).astype(blocks.dtype)
-        if vblocks is not None:
-            vblocks = _einsum(vblocks, q, "kmi,kij->kmj",
-                              x3=apply_x3).astype(vblocks.dtype)
+        with scope("apply"):
+            blocks = _einsum(blocks, q, "kmi,kij->kmj",
+                             x3=apply_x3).astype(blocks.dtype)
+            if vblocks is not None:
+                vblocks = _einsum(vblocks, q, "kmi,kij->kmj",
+                                  x3=apply_x3).astype(vblocks.dtype)
         return blocks, vblocks
 
     blocks, vblocks = jax.lax.cond(skip > rtol, do, lambda a: a,
                                    (blocks, vblocks))
+    if return_rotated:
+        return blocks, vblocks, stat, (skip > rtol).astype(jnp.int32)
     return blocks, vblocks, stat
 
 
 def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
                 bf16_gram, axis_name=None, fused_exchange=False,
-                fused_apply=False, apply_x3=False):
+                fused_apply=False, apply_x3=False, return_rotated=False):
     """Annihilate every cross pair of each (top[i], bot[i]) block pair.
     ``axis_name``: see `self_round`.
 
@@ -195,16 +204,17 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
     """
     b = top.shape[-1]
     vma = (axis_name,) if axis_name is not None else None
-    if not interpret and pg.supported(top.shape[1], b):
-        # Compiled path: the Pallas reduction kernel forms the Gram panel
-        # at ~2x the throughput of the XLA batched einsum on this
-        # reduction-heavy small-output shape (PROFILE.md item 10), and
-        # never materializes the (k, m, 2b) concat (under ``bf16_gram`` it
-        # casts per-chunk in VMEM and contracts in one native pass).
-        g = pg.gram_pairs(top, bot, vma=vma, bf16=bf16_gram)
-    else:
-        x = jnp.concatenate([top, bot], axis=-1)
-        g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
+    with scope("gram"):
+        if not interpret and pg.supported(top.shape[1], b):
+            # Compiled path: the Pallas reduction kernel forms the Gram
+            # panel at ~2x the throughput of the XLA batched einsum on this
+            # reduction-heavy small-output shape (PROFILE.md item 10), and
+            # never materializes the (k, m, 2b) concat (under ``bf16_gram``
+            # it casts per-chunk in VMEM and contracts in one native pass).
+            g = pg.gram_pairs(top, bot, vma=vma, bf16=bf16_gram)
+        else:
+            x = jnp.concatenate([top, bot], axis=-1)
+            g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
     stat, skip = panel_stats(g, dmax2)
     skip = _mesh_max(skip, axis_name)
 
@@ -213,20 +223,26 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
             top, bot, vtop, vbot = args
             q = _rotations(g, "cross", interpret=interpret, polish=polish,
                            axis_name=axis_name)
-            top, bot = pa.apply_exchange(top, bot, q, x3=apply_x3)
-            if vtop is not None:
-                vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3)
+            with scope("apply_exchange"):
+                top, bot = pa.apply_exchange(top, bot, q, x3=apply_x3)
+                if vtop is not None:
+                    vtop, vbot = pa.apply_exchange(vtop, vbot, q,
+                                                   x3=apply_x3)
             return top, bot, vtop, vbot
 
         def skip_branch(args):
             top, bot, vtop, vbot = args
-            top, bot = sched.rotate_blocks(top, bot)
-            if vtop is not None:
-                vtop, vbot = sched.rotate_blocks(vtop, vbot)
+            with scope("exchange"):
+                top, bot = sched.rotate_blocks(top, bot)
+                if vtop is not None:
+                    vtop, vbot = sched.rotate_blocks(vtop, vbot)
             return top, bot, vtop, vbot
 
         top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, skip_branch,
                                             (top, bot, vtop, vbot))
+        if return_rotated:
+            return (top, bot, vtop, vbot, stat,
+                    (skip > rtol).astype(jnp.int32))
         return top, bot, vtop, vbot, stat
 
     # Compiled mesh path: fuse the apply (the adds live in VMEM) but keep
@@ -240,30 +256,34 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
         top, bot, vtop, vbot = args
         q = _rotations(g, "cross", interpret=interpret, polish=polish,
                        axis_name=axis_name)
-        if fused_apply:
-            top, bot = pa.apply_exchange(top, bot, q, exchange=False,
-                                         vma=vma, x3=apply_x3)
+        with scope("apply"):
+            if fused_apply:
+                top, bot = pa.apply_exchange(top, bot, q, exchange=False,
+                                             vma=vma, x3=apply_x3)
+                if vtop is not None:
+                    vtop, vbot = pa.apply_exchange(vtop, vbot, q,
+                                                   exchange=False, vma=vma,
+                                                   x3=apply_x3)
+                return top, bot, vtop, vbot
+            xn = _einsum(jnp.concatenate([top, bot], axis=-1), q,
+                         "kmi,kij->kmj", x3=apply_x3).astype(top.dtype)
+            top, bot = xn[..., :b], xn[..., b:]
             if vtop is not None:
-                vtop, vbot = pa.apply_exchange(vtop, vbot, q,
-                                               exchange=False, vma=vma,
-                                               x3=apply_x3)
-            return top, bot, vtop, vbot
-        xn = _einsum(jnp.concatenate([top, bot], axis=-1), q,
-                     "kmi,kij->kmj", x3=apply_x3).astype(top.dtype)
-        top, bot = xn[..., :b], xn[..., b:]
-        if vtop is not None:
-            vn = _einsum(jnp.concatenate([vtop, vbot], axis=-1), q,
-                         "kmi,kij->kmj", x3=apply_x3).astype(vtop.dtype)
-            vtop, vbot = vn[..., :b], vn[..., b:]
+                vn = _einsum(jnp.concatenate([vtop, vbot], axis=-1), q,
+                             "kmi,kij->kmj", x3=apply_x3).astype(vtop.dtype)
+                vtop, vbot = vn[..., :b], vn[..., b:]
         return top, bot, vtop, vbot
 
     top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, lambda a: a,
                                         (top, bot, vtop, vbot))
+    if return_rotated:
+        return top, bot, vtop, vbot, stat, (skip > rtol).astype(jnp.int32)
     return top, bot, vtop, vbot, stat
 
 
 def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
-                      bf16_gram, apply_x3=False, interpret=False):
+                      bf16_gram, apply_x3=False, interpret=False,
+                      return_rotated=False):
     """Cross round for the single-device COMPILED path, with the Gram
     panel as loop-carried state: ``g`` is the CURRENT pairs' panel
     (produced by the previous round's fused apply+exchange+gram kernel, or
@@ -279,30 +299,37 @@ def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
         top, bot, vtop, vbot, _ = args
         q = _rotations(g, "cross", interpret=interpret, polish=polish,
                        axis_name=None)
-        top, bot, g2 = pa.apply_exchange(top, bot, q, x3=apply_x3,
-                                         with_gram=True,
-                                         gram_bf16=bf16_gram,
-                                         interpret=interpret)
-        if with_v:
-            vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3,
-                                           interpret=interpret)
+        with scope("apply_exchange"):
+            top, bot, g2 = pa.apply_exchange(top, bot, q, x3=apply_x3,
+                                             with_gram=True,
+                                             gram_bf16=bf16_gram,
+                                             interpret=interpret)
+            if with_v:
+                vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3,
+                                               interpret=interpret)
         return top, bot, vtop, vbot, g2
 
     def skip_branch(args):
         top, bot, vtop, vbot, _ = args
-        top, bot = sched.rotate_blocks(top, bot)
-        if with_v:
-            vtop, vbot = sched.rotate_blocks(vtop, vbot)
-        g2 = pg.gram_pairs(top, bot, bf16=bf16_gram, interpret=interpret)
+        with scope("exchange"):
+            top, bot = sched.rotate_blocks(top, bot)
+            if with_v:
+                vtop, vbot = sched.rotate_blocks(vtop, vbot)
+        with scope("gram"):
+            g2 = pg.gram_pairs(top, bot, bf16=bf16_gram,
+                               interpret=interpret)
         return top, bot, vtop, vbot, g2
 
     top, bot, vtop, vbot, g = jax.lax.cond(
         skip > rtol, do, skip_branch, (top, bot, vtop, vbot, g))
+    if return_rotated:
+        return top, bot, vtop, vbot, g, stat, (skip > rtol).astype(jnp.int32)
     return top, bot, vtop, vbot, g, stat
 
 
 def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
-          axis_name=None, n_rounds=None, exchange=None, apply_x3=False):
+          axis_name=None, n_rounds=None, exchange=None, apply_x3=False,
+          telemetry=False):
     """One full sweep: self round + cross tournament rounds.
 
     Every pair of the n columns is annihilated exactly once: n-1 sequential
@@ -313,6 +340,12 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     Single-device default: ``sched.rotate_blocks`` between rounds. Mesh
     callers (under shard_map) pass ``axis_name``, the global ``n_rounds``,
     and the ICI ring ``exchange`` — the stat is pmax'd once at sweep end.
+
+    ``telemetry`` (static): additionally return the number of rounds whose
+    round-skip gate fired the rotations (`obs.metrics`' rotation-round
+    counter) as a trailing int32 — the counter rides the scan carry, so
+    the flag must be OFF on the zero-telemetry path to keep its HLO
+    byte-identical.
     """
     k, m, b = top.shape
     with_v = vtop is not None
@@ -332,9 +365,15 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
         n_rounds = sched.num_rounds(2 * k)
     blocks = jnp.concatenate([top, bot], axis=0)
     vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
-    blocks, vblocks, rel_self = self_round(
+    self_out = self_round(
         blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
-        bf16_gram=bf16_gram, axis_name=axis_name, apply_x3=apply_x3)
+        bf16_gram=bf16_gram, axis_name=axis_name, apply_x3=apply_x3,
+        return_rotated=telemetry)
+    if telemetry:
+        blocks, vblocks, rel_self, cnt0 = self_out
+    else:
+        blocks, vblocks, rel_self = self_out
+        cnt0 = None
     top, bot = blocks[:k], blocks[k:]
     if with_v:
         vtop, vbot = vblocks[:k], vblocks[k:]
@@ -345,43 +384,62 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     if fused:
         # Gram-carried fused loop: one bootstrap panel, then every rotate
         # round is rotation kernel + fused apply/exchange/gram.
-        g0 = pg.gram_pairs(top, bot, bf16=bf16_gram)
+        with scope("gram"):
+            g0 = pg.gram_pairs(top, bot, bf16=bf16_gram)
 
         def body(carry, _):
-            top, bot, vtop, vbot, g, mx = carry
-            top, bot, nvt, nvb, g, stat = cross_round_fused(
+            top, bot, vtop, vbot, g, mx = carry[:6]
+            out = cross_round_fused(
                 top, bot, vtop if with_v else None,
                 vbot if with_v else None, g, dmax2, rtol, polish=polish,
-                bf16_gram=bf16_gram, apply_x3=apply_x3)
+                bf16_gram=bf16_gram, apply_x3=apply_x3,
+                return_rotated=telemetry)
+            top, bot, nvt, nvb, g, stat = out[:6]
             if with_v:
                 vtop, vbot = nvt, nvb
-            return (top, bot, vtop, vbot, g, jnp.maximum(mx, stat)), None
+            new = (top, bot, vtop, vbot, g, jnp.maximum(mx, stat))
+            if telemetry:
+                new += (carry[6] + out[6],)
+            return new, None
 
         init = (top, bot, vtop, vbot, g0, rel_self.astype(jnp.float32))
-        (top, bot, vtop, vbot, _, off), _ = jax.lax.scan(
-            body, init, None, length=n_rounds)
-        return (top, bot, (vtop if with_v else None),
-                (vbot if with_v else None), off)
+        if telemetry:
+            init += (cnt0,)
+        carry, _ = jax.lax.scan(body, init, None, length=n_rounds)
+        top, bot, vtop, vbot, _, off = carry[:6]
+        out = (top, bot, (vtop if with_v else None),
+               (vbot if with_v else None), off)
+        return out + (carry[6],) if telemetry else out
 
     def body(carry, _):
-        top, bot, vtop, vbot, mx = carry
-        top, bot, nvt, nvb, stat = cross_round(
+        top, bot, vtop, vbot, mx = carry[:5]
+        out = cross_round(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             dmax2, rtol, interpret=interpret,
             polish=polish, bf16_gram=bf16_gram, axis_name=axis_name,
-            fused_exchange=False, fused_apply=mesh_fused, apply_x3=apply_x3)
+            fused_exchange=False, fused_apply=mesh_fused, apply_x3=apply_x3,
+            return_rotated=telemetry)
+        top, bot, nvt, nvb, stat = out[:5]
         if with_v:
             vtop, vbot = nvt, nvb
-        top, bot = exchange(top, bot)
-        if with_v:
-            vtop, vbot = exchange(vtop, vbot)
-        return (top, bot, vtop, vbot, jnp.maximum(mx, stat)), None
+        with scope("exchange"):
+            top, bot = exchange(top, bot)
+            if with_v:
+                vtop, vbot = exchange(vtop, vbot)
+        new = (top, bot, vtop, vbot, jnp.maximum(mx, stat))
+        if telemetry:
+            new += (carry[5] + out[5],)
+        return new, None
 
     init = (top, bot, vtop, vbot, rel_self.astype(jnp.float32))
-    (top, bot, vtop, vbot, off), _ = jax.lax.scan(
-        body, init, None, length=n_rounds)
+    if telemetry:
+        init += (cnt0,)
+    carry, _ = jax.lax.scan(body, init, None, length=n_rounds)
+    top, bot, vtop, vbot, off = carry[:5]
     off = _mesh_max(off, axis_name)
-    return top, bot, (vtop if with_v else None), (vbot if with_v else None), off
+    out = (top, bot, (vtop if with_v else None),
+           (vbot if with_v else None), off)
+    return out + (carry[5],) if telemetry else out
 
 
 def _global_dmax2(top, bot):
@@ -422,18 +480,32 @@ MIXED_TOL = 1e-3
 def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
                   interpret, polish, bf16_gram, stall_detection=True,
                   stall_gate=1e-4, stall_shrink=0.25, start_sweeps=0,
-                  apply_x3=False):
+                  apply_x3=False, telemetry=False, stage="single"):
     """`lax.while_loop` of `sweep`s until the masked coupling drops below
     ``stop_tol`` (or the TOTAL sweep counter — which starts at
     ``start_sweeps`` — hits ``max_sweeps``, or a stall). Stall: once the
     coupling is below ``stall_gate`` (the phase's endgame) and a sweep
     fails to shrink it by 1/``stall_shrink``, the phase's floor is reached.
     Returns (top, bot, vtop, vbot, off, sweeps).
+
+    ``telemetry`` (static): emit one `obs.metrics` "sweep" event per loop
+    iteration — post-sweep off-norm and the rotation-round counters —
+    tagged with ``stage``. Off by default; the disabled trace is the seed
+    trace.
     """
     with_v = vtop is not None
     k = top.shape[0]
     if vtop is None:
         vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
+    n_rounds_total = 1 + sched.num_rounds(2 * k)   # self + cross rounds
+    # Label events with the path sweep() will actually take (same
+    # predicate as its fused apply+exchange+gram gate) — interpret-mode /
+    # oversized-panel solves run the unfused kernel rounds.
+    m_rows, b = top.shape[1], top.shape[2]
+    path = ("fused" if (not interpret and pa.supported(m_rows, b)
+                        and pg.supported(m_rows, b)
+                        and (not with_v or pa.supported(vtop.shape[1], b)))
+            else "kernel")
 
     def cond(st):
         _, _, _, _, off, prev_off, sweeps = st
@@ -446,10 +518,17 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
     def body(st):
         top, bot, vtop, vbot, prev_off, _, sweeps = st
         dmax2 = _global_dmax2(top, bot)
-        top, bot, nvt, nvb, off = sweep(
+        out = sweep(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             dmax2, rtol, interpret=interpret, polish=polish,
-            bf16_gram=bf16_gram, apply_x3=apply_x3)
+            bf16_gram=bf16_gram, apply_x3=apply_x3, telemetry=telemetry)
+        top, bot, nvt, nvb, off = out[:5]
+        if telemetry:
+            metrics.emit("sweep",
+                         meta={"path": path, "stage": stage,
+                               "rounds_total": n_rounds_total},
+                         sweep=sweeps + 1, off_rel=off,
+                         rounds_rotated=out[5])
         if not with_v:
             nvt, nvb = st[2], st[3]
         return (top, bot, nvt, nvb, off, prev_off, sweeps + 1)
@@ -464,7 +543,8 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
 
 
 def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
-            bulk_bf16, stall_detection=True, start_sweeps=0):
+            bulk_bf16, stall_detection=True, start_sweeps=0,
+            telemetry=False, stage="single"):
     """Sweep until the masked coupling drops below ``tol``.
 
     Two phases when ``bulk_bf16``: bf16-Gram sweeps down to BULK_TOL, then
@@ -473,17 +553,17 @@ def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
     bulk phase). Stall constants are solver._should_continue's rel branch.
     """
     kwargs = dict(max_sweeps=max_sweeps, interpret=interpret, polish=polish,
-                  stall_detection=stall_detection)
+                  stall_detection=stall_detection, telemetry=telemetry)
     bulk_off = jnp.float32(jnp.inf)
     bulk_sweeps = jnp.asarray(start_sweeps, jnp.int32)
     if bulk_bf16:
         top, bot, vtop, vbot, bulk_off, bulk_sweeps = iterate_phase(
             top, bot, vtop, vbot, stop_tol=jnp.float32(BULK_TOL),
             rtol=BULK_TOL, bf16_gram=True, start_sweeps=bulk_sweeps,
-            **kwargs)
+            stage="bulk_bf16", **kwargs)
     top, bot, vtop, vbot, off, sweeps = iterate_phase(
         top, bot, vtop, vbot, stop_tol=tol, rtol=tol, bf16_gram=False,
-        start_sweeps=bulk_sweeps, **kwargs)
+        start_sweeps=bulk_sweeps, stage=stage, **kwargs)
     # If the bulk phase consumed the whole budget, report its statistic
     # rather than the untouched inf carry (cf. solver._svd_padded hybrid).
     off = jnp.where(sweeps > bulk_sweeps, off, bulk_off)

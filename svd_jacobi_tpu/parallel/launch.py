@@ -33,6 +33,7 @@ from typing import Optional
 
 import jax
 
+from .. import _compat
 from ..utils import matgen
 
 
@@ -73,7 +74,9 @@ def initialize(
                 or num_processes is not None
                 or bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
                 or bool(os.environ.get("JAX_NUM_PROCESSES")))
-    if (explicit or _cluster_env_present()) and not jax.distributed.is_initialized():
+    if ((explicit or _cluster_env_present())
+            and not _compat.distributed_is_initialized()):
+        _compat.enable_cpu_collectives()
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

@@ -53,7 +53,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import _compat
 from ..config import SVDConfig
+from ..obs import metrics
 from ..ops import blockwise
 from . import schedule as sched
 from .. import solver as _single
@@ -156,7 +158,7 @@ def _sweep_sharded(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
     # one pmax per sweep is enough.
     dmax2 = lax.pmax(_single._global_dmax2(top, bot), axis_name)
     init = (top, bot, vtop, vbot,
-            lax.pcast(jnp.zeros((), jnp.float32), (axis_name,),
+            _compat.pcast(jnp.zeros((), jnp.float32), (axis_name,),
                       to="varying"))
     (top, bot, vtop, vbot, local_rel), _ = lax.scan(
         partial(round_body, dmax2=dmax2), init, None, length=n_rounds)
@@ -189,8 +191,17 @@ def _sweep_sharded_pallas(top, bot, vtop, vbot, *, axis_name, n_devices,
 def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                     tol, max_sweeps, precision, gram_dtype_name, method,
                     criterion, with_v, n_pad, nblocks, stall_detection=True,
-                    kernel_polish=True):
-    """Body run under shard_map: while_loop(sweeps) of scan(rounds)."""
+                    kernel_polish=True, telemetry=False, replicas=1):
+    """Body run under shard_map: while_loop(sweeps) of scan(rounds).
+
+    ``telemetry`` (static): emit one `obs.metrics` "sweep" event per loop
+    iteration with the pmax'd (mesh-replicated) off-norm. The callback
+    fires once per LOCAL device with identical values; ``replicas`` (the
+    local device count of the mesh) lets the host dispatcher forward each
+    event exactly once, and only process 0 records — so a multi-chip solve
+    reports each sweep once. Off by default: the disabled trace is
+    byte-identical to the untelemetered one.
+    """
     gram_dtype = jnp.dtype(gram_dtype_name)
     if with_v:
         vtop, vbot = _identity_blocks(nblocks // 2, n_pad, top.dtype,
@@ -198,7 +209,7 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                                       local_shape=top.shape)
     else:
         # Zero-width placeholders keep one traced signature (cf. solver.py).
-        vtop = vbot = lax.pcast(
+        vtop = vbot = _compat.pcast(
             jnp.zeros((top.shape[0], 0, top.shape[2]), top.dtype),
             (axis_name,), to="varying")
 
@@ -208,7 +219,7 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                               precision=precision, gram_dtype=gram_dtype,
                               method=mth, criterion=crit, with_v=with_v)
 
-    def iterate(top, bot, vtop, vbot, mth, crit, t, budget):
+    def iterate(top, bot, vtop, vbot, mth, crit, t, budget, stage):
         def cond(state):
             _, _, _, _, off_rel, prev_off, sweeps = state
             return _single._should_continue(off_rel, prev_off, sweeps,
@@ -220,6 +231,14 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
             top, bot, vtop, vbot, prev_off, _, sweeps = state
             top, bot, vtop, vbot, off_rel = sweep(top, bot, vtop, vbot,
                                                   mth, crit)
+            if telemetry:
+                # off_rel is pmax'd -> identical on every device; the
+                # dispatcher collapses the per-device deliveries.
+                metrics.emit("sweep",
+                             meta={"path": "sharded", "stage": stage,
+                                   "method": mth, "devices": n_devices},
+                             replicas=replicas,
+                             sweep=sweeps + 1, off_rel=off_rel)
             return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
 
         inf = jnp.float32(jnp.inf)
@@ -246,14 +265,19 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
         # relative-criterion polish phase for U orthogonality.
         top, bot, vtop, vbot, off1, _, s1 = iterate(
             top, bot, vtop, vbot, "gram-eigh", "abs",
-            _single._abs_phase_tol(top.dtype), max_sweeps)
+            _single._abs_phase_tol(top.dtype), max_sweeps, "bulk")
+        if telemetry:
+            metrics.emit("stage",
+                         meta={"path": "sharded", "stage": "bulk"},
+                         replicas=replicas, sweeps=s1, off_rel=off1)
         top, bot, vtop, vbot, off2, _, s2 = iterate(
-            top, bot, vtop, vbot, "qr-svd", criterion, tol, max_sweeps - s1)
+            top, bot, vtop, vbot, "qr-svd", criterion, tol, max_sweeps - s1,
+            "polish")
         # Zero-iteration polish leaves its init off = inf; see solver.py.
         off_rel = jnp.where(s2 > 0, off2, off1)
         return top, bot, vtop, vbot, off_rel, s1 + s2
     top, bot, vtop, vbot, off_rel, _, sweeps = iterate(
-        top, bot, vtop, vbot, method, criterion, tol, max_sweeps)
+        top, bot, vtop, vbot, method, criterion, tol, max_sweeps, "single")
     return top, bot, vtop, vbot, off_rel, sweeps
 
 
@@ -339,7 +363,8 @@ def svd(
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
         precondition=bool(precondition), refine=bool(refine),
         stall_detection=bool(config.stall_detection),
-        kernel_polish=bool(config.kernel_polish))
+        kernel_polish=bool(config.kernel_polish),
+        telemetry=bool(metrics.enabled()))
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
@@ -347,11 +372,12 @@ def svd(
     "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
     "compute_v", "full_u", "tol", "max_sweeps", "precision",
     "gram_dtype_name", "method", "criterion", "precondition", "refine",
-    "stall_detection", "kernel_polish"))
+    "stall_detection", "kernel_polish", "telemetry"))
 def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      compute_u, compute_v, full_u, tol, max_sweeps, precision,
                      gram_dtype_name, method, criterion, precondition=False,
-                     refine=False, stall_detection=True, kernel_polish=True):
+                     refine=False, stall_detection=True, kernel_polish=True,
+                     telemetry=False):
     m = a.shape[0]
     dtype = a.dtype
     block_spec = P(axis_name, None, None)  # shard the pair-slot axis
@@ -373,13 +399,18 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
     top = lax.with_sharding_constraint(top, NamedSharding(mesh, block_spec))
     bot = lax.with_sharding_constraint(bot, NamedSharding(mesh, block_spec))
 
-    jacobi = jax.shard_map(
+    # The sweep-event callback fires once per device this process runs;
+    # the host dispatcher needs that count to forward each event once.
+    replicas = sum(1 for d in mesh.devices.flat
+                   if d.process_index == jax.process_index())
+    jacobi = _compat.shard_map(
         partial(_sharded_jacobi, axis_name=axis_name, n_devices=n_devices,
                 n_rounds=sched.num_rounds(nblocks), tol=tol, max_sweeps=max_sweeps,
                 precision=precision, gram_dtype_name=gram_dtype_name,
                 method=method, criterion=criterion, with_v=accumulate,
                 n_pad=n_pad, nblocks=nblocks,
-                stall_detection=stall_detection, kernel_polish=kernel_polish),
+                stall_detection=stall_detection, kernel_polish=kernel_polish,
+                telemetry=telemetry, replicas=max(1, replicas)),
         mesh=mesh,
         in_specs=(block_spec,) * 2,
         out_specs=(block_spec,) * 4 + (P(), P()),
@@ -446,7 +477,7 @@ def _sweep_step_sharded_pallas_jit(top, bot, vtop, vbot, *, mesh, axis_name,
             vtop, vbot = nvt, nvb
         return t, b, vtop, vbot, off
 
-    step = jax.shard_map(body, mesh=mesh,
+    step = _compat.shard_map(body, mesh=mesh,
                          in_specs=(block_spec,) * 4,
                          out_specs=(block_spec,) * 4 + (P(),))
     return step(top, bot, vtop, vbot)
@@ -464,7 +495,7 @@ def _sweep_step_sharded_jit(top, bot, vtop, vbot, *, mesh, axis_name,
     bot = lax.with_sharding_constraint(bot, sharding)
     vtop = lax.with_sharding_constraint(vtop, sharding)
     vbot = lax.with_sharding_constraint(vbot, sharding)
-    step = jax.shard_map(
+    step = _compat.shard_map(
         partial(_sweep_sharded, axis_name=axis_name, n_devices=n_devices,
                 n_rounds=sched.num_rounds(nblocks),
                 precision=precision, gram_dtype=jnp.dtype(gram_dtype_name),
@@ -533,7 +564,7 @@ class SweepStepper(_single.SweepStepper):
         k = self.nblocks // 2
         if accumulate:
             block_spec = P(self.axis_name, None, None)
-            build = jax.jit(jax.shard_map(
+            build = jax.jit(_compat.shard_map(
                 partial(_identity_blocks, k, self.n_pad, self.a.dtype,
                         axis_name=self.axis_name,
                         local_shape=(k // self.n_devices, self.n_pad,

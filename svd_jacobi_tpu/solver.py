@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import SVDConfig
+from .obs import metrics
+from .obs.scopes import scope
 from .ops import blockwise, rounds
 from .ops import pallas_blocks as pb
 from .parallel import schedule as sched
@@ -233,10 +235,13 @@ def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd",
 
 
 def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
-                    gram_dtype, method, criterion, stall_detection=True):
+                    gram_dtype, method, criterion, stall_detection=True,
+                    telemetry=False, stage="single"):
     """while_loop over sweeps until the scaled coupling drops below tol.
 
-    Also stops on *stall* — see `_should_continue`.
+    Also stops on *stall* — see `_should_continue`. ``telemetry`` (static,
+    baked into the caller's jit key): emit an `obs.metrics` "sweep" event
+    per iteration; off keeps the trace identical to the untelemetered one.
     """
     with_v = vtop is not None
     k = top.shape[0]
@@ -257,6 +262,11 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
             top, bot, vtop if with_v else None, vbot if with_v else None,
             precision=precision, gram_dtype=gram_dtype, method=method,
             criterion=criterion, dmax2=dmax2)
+        if telemetry:
+            metrics.emit("sweep",
+                         meta={"path": "xla", "stage": stage,
+                               "method": method, "criterion": criterion},
+                         sweep=sweeps + 1, off_rel=off_rel)
         if not with_v:
             vtop, vbot = state[2], state[3]
         return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
@@ -308,24 +318,26 @@ def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
     U = A * Sigma^{-1}: lib/JacobiMethods.cu:1156-1173) plus the descending sort
     and rank-deficiency guard it lacks.
     """
-    m = a_work.shape[0]
-    s, order, a_sorted = _sigma_sort(a_work, n)
-    u = v = None
-    if v_work is not None:
-        v = jnp.take(v_work, order, axis=1).astype(dtype)
-    if compute_u:
-        u = _normalize_cols(a_sorted, s, dtype)
-        if full_u and m > n:
-            u = _complete_orthonormal(u, n, dtype)
-    return u, s.astype(dtype), v
+    with scope("postprocess"):
+        m = a_work.shape[0]
+        s, order, a_sorted = _sigma_sort(a_work, n)
+        u = v = None
+        if v_work is not None:
+            v = jnp.take(v_work, order, axis=1).astype(dtype)
+        if compute_u:
+            u = _normalize_cols(a_sorted, s, dtype)
+            if full_u and m > n:
+                u = _complete_orthonormal(u, n, dtype)
+        return u, s.astype(dtype), v
 
 
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "tol", "max_sweeps",
-    "precision", "gram_dtype_name", "method", "criterion", "stall_detection"))
+    "precision", "gram_dtype_name", "method", "criterion", "stall_detection",
+    "telemetry"))
 def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
                 max_sweeps, precision, gram_dtype_name, method, criterion,
-                stall_detection=True):
+                stall_detection=True, telemetry=False):
     m, n_pad = a.shape
     dtype = a.dtype
     gram_dtype = jnp.dtype(gram_dtype_name)
@@ -345,12 +357,17 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
             top, bot, vtop, vbot, tol=_abs_phase_tol(dtype),
             max_sweeps=max_sweeps,
             precision=precision, gram_dtype=gram_dtype, method="gram-eigh",
-            criterion="abs", stall_detection=stall_detection)
+            criterion="abs", stall_detection=stall_detection,
+            telemetry=telemetry, stage="bulk")
+        if telemetry:
+            metrics.emit("stage", meta={"path": "xla", "stage": "bulk"},
+                         sweeps=s1, off_rel=off1)
         # max_sweeps stays a TOTAL budget across both phases.
         top, bot, vtop, vbot, off2, s2 = _jacobi_iterate(
             top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps - s1,
             precision=precision, gram_dtype=gram_dtype, method="qr-svd",
-            criterion=criterion, stall_detection=stall_detection)
+            criterion=criterion, stall_detection=stall_detection,
+            telemetry=telemetry, stage="polish")
         # A zero-iteration polish (bulk ate the budget) leaves its init
         # off = inf; report the bulk statistic instead.
         off_rel = jnp.where(s2 > 0, off2, off1)
@@ -359,7 +376,8 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
         top, bot, vtop, vbot, off_rel, sweeps = _jacobi_iterate(
             top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
             precision=precision, gram_dtype=gram_dtype, method=method,
-            criterion=criterion, stall_detection=stall_detection)
+            criterion=criterion, stall_detection=stall_detection,
+            telemetry=telemetry, stage="single")
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if compute_v else None
     u, s, v = _postprocess(a_work, v_work, n, compute_u=compute_u,
@@ -417,22 +435,24 @@ def _refine_from_work(work, cols, s, rot):
     when neither factor exists."""
     if cols is None and rot is None:
         return cols, s, rot
-    acc = jnp.promote_types(work.dtype, jnp.float32)
-    hi = jax.lax.Precision.HIGHEST
-    if rot is not None:
-        # Measured preference (512^2 CPU f32): work @ rot_normalized gives
-        # serr ~1e-7 vs ~3.5e-7 for work^T @ cols.
-        probe = rot.astype(acc)
-        norms = jnp.maximum(_colnorms_compensated(probe),
-                            jnp.finfo(acc).tiny)
-        w = jnp.matmul(work.astype(acc), probe / norms[None, :],
-                       precision=hi)
-    else:
-        w = jnp.matmul(work.T.astype(acc), cols.astype(acc), precision=hi)
-    s2 = _colnorms_compensated(w).astype(s.dtype)
-    order = jnp.argsort(-s2)
-    take = lambda x: None if x is None else jnp.take(x, order, axis=1)
-    return take(cols), s2[order], take(rot)
+    with scope("sigma_refine"):
+        acc = jnp.promote_types(work.dtype, jnp.float32)
+        hi = jax.lax.Precision.HIGHEST
+        if rot is not None:
+            # Measured preference (512^2 CPU f32): work @ rot_normalized
+            # gives serr ~1e-7 vs ~3.5e-7 for work^T @ cols.
+            probe = rot.astype(acc)
+            norms = jnp.maximum(_colnorms_compensated(probe),
+                                jnp.finfo(acc).tiny)
+            w = jnp.matmul(work.astype(acc), probe / norms[None, :],
+                           precision=hi)
+        else:
+            w = jnp.matmul(work.T.astype(acc), cols.astype(acc),
+                           precision=hi)
+        s2 = _colnorms_compensated(w).astype(s.dtype)
+        order = jnp.argsort(-s2)
+        take = lambda x: None if x is None else jnp.take(x, order, axis=1)
+        return take(cols), s2[order], take(rot)
 
 
 def _precondition_qr(a):
@@ -443,11 +463,12 @@ def _precondition_qr(a):
     lower-triangular L = R^T. QR in f32 at minimum: sub-f32 dtypes have no
     QR kernel (LAPACK or TPU), and the factorization must be exact at
     working precision."""
-    norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
-    order = jnp.argsort(-norms)
-    acc = jnp.promote_types(a.dtype, jnp.float32)
-    q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1).astype(acc))
-    return q1, r, order, r.T.astype(a.dtype)
+    with scope("precondition_qr"):
+        norms = jnp.sum(a.astype(jnp.float32) ** 2, axis=0)
+        order = jnp.argsort(-norms)
+        acc = jnp.promote_types(a.dtype, jnp.float32)
+        q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1).astype(acc))
+        return q1, r, order, r.T.astype(a.dtype)
 
 
 def _recombine_precondition(cols, rot, *, m, n, compute_u, compute_v,
@@ -457,15 +478,16 @@ def _recombine_precondition(cols, rot, *, m, n, compute_u, compute_v,
     L = U_L S V_L^T, A = (Q1 V_L) S (P U_L)^T — so U = Q1 @ rot and V
     scatters the normalized columns back through the norm-sort
     permutation. Shared by solver._svd_pallas and parallel.sharded."""
-    hi = jax.lax.Precision.HIGHEST
-    u = v = None
-    if compute_u:
-        u = jnp.matmul(q1, rot, precision=hi).astype(dtype)
-        if full_u and m > n:
-            u = _complete_orthonormal(u, n, dtype)
-    if compute_v:
-        v = jnp.zeros_like(cols).at[order, :].set(cols)
-    return u, v
+    with scope("recombine"):
+        hi = jax.lax.Precision.HIGHEST
+        u = v = None
+        if compute_u:
+            u = jnp.matmul(q1, rot, precision=hi).astype(dtype)
+            if full_u and m > n:
+                u = _complete_orthonormal(u, n, dtype)
+        if compute_v:
+            v = jnp.zeros_like(cols).at[order, :].set(cols)
+        return u, v
 
 
 def _ns_orthogonalize(g, steps: int = 3):
@@ -476,25 +498,26 @@ def _ns_orthogonalize(g, steps: int = 3):
     error to the f32 floor. Padded identity rows/columns are exact fixed
     points (their Gram block is exactly I), so the padded structure the
     reconstitution relies on survives."""
-    hi = jax.lax.Precision.HIGHEST
-    g = g.astype(jnp.promote_types(g.dtype, jnp.float32))
-    eye = jnp.eye(g.shape[0], dtype=g.dtype)
-    for _ in range(steps):
-        gram = jnp.matmul(g.T, g, precision=hi)
-        g = jnp.matmul(g, 1.5 * eye - 0.5 * gram, precision=hi)
-    return g
+    with scope("ns_orthogonalize"):
+        hi = jax.lax.Precision.HIGHEST
+        g = g.astype(jnp.promote_types(g.dtype, jnp.float32))
+        eye = jnp.eye(g.shape[0], dtype=g.dtype)
+        for _ in range(steps):
+            gram = jnp.matmul(g.T, g, precision=hi)
+            g = jnp.matmul(g, 1.5 * eye - 0.5 * gram, precision=hi)
+        return g
 
 
 _PALLAS_STATIC = (
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
     "max_sweeps", "precondition", "polish", "bulk_bf16", "mixed",
-    "mixed_store", "interpret", "stall_detection", "refine")
+    "mixed_store", "interpret", "stall_detection", "refine", "telemetry")
 
 
 def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
                      tol, max_sweeps, precondition, polish, bulk_bf16, mixed,
                      mixed_store="f32", interpret=False, stall_detection=True,
-                     refine=False):
+                     refine=False, telemetry=False):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -580,26 +603,36 @@ def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
             rtol=rounds.MIXED_TOL, max_sweeps=max_sweeps,
             interpret=interpret, polish=polish, bf16_gram=True,
             apply_x3=True, stall_detection=stall_detection,
-            stall_gate=10.0 * rounds.MIXED_TOL, stall_shrink=0.5)
+            stall_gate=10.0 * rounds.MIXED_TOL, stall_shrink=0.5,
+            telemetry=telemetry, stage="mixed_bulk")
+        if telemetry:
+            # No "path" tag here: the stage's own sweep events carry the
+            # exact fused/kernel label (rounds.iterate_phase computes the
+            # real kernel gate; duplicating an approximation of it here
+            # could disagree within one record).
+            metrics.emit("stage", meta={"stage": "mixed_bulk"},
+                         sweeps=bulk_sweeps, off_rel=bulk_off)
         # Stage 2 (reconstitute): orthogonalize G in f32 (~1e-4 off after
         # the f32-accumulated regimes — 2 Newton-Schulz steps reach the
         # f32 floor; ~1e-1 off after bf16 storage — 4 steps), then rebuild
         # the stacks exactly as work @ G — the bulk X is DISCARDED,
         # deleting its X-vs-L.G drift (padded columns never mix — they
         # deflate in the kernel — so [work | 0] @ G == work @ G[:cols]).
-        g = _ns_orthogonalize(_deblockify(gvt, gvb).astype(jnp.float32),
-                              steps=4 if mixed_store == "bf16g" else 2)
-        x = jnp.matmul(work.astype(g.dtype), g[:work.shape[1], :],
-                       precision=hi).astype(dtype)
-        top, bot = _blockify(x, n_pad, nblocks)
-        if accumulate:
-            vtop, vbot = _blockify(g.astype(dtype), n_pad, nblocks)
+        with scope("reconstitute"):
+            g = _ns_orthogonalize(_deblockify(gvt, gvb).astype(jnp.float32),
+                                  steps=4 if mixed_store == "bf16g" else 2)
+            x = jnp.matmul(work.astype(g.dtype), g[:work.shape[1], :],
+                           precision=hi).astype(dtype)
+            top, bot = _blockify(x, n_pad, nblocks)
+            if accumulate:
+                vtop, vbot = _blockify(g.astype(dtype), n_pad, nblocks)
 
     # f32 sweeps (stage 3 of the mixed regime, or the whole solve).
     top, bot, vtop, vbot, off_rel, sweeps = rounds.iterate(
         top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
         interpret=interpret, polish=polish, bulk_bf16=bulk_bf16,
-        stall_detection=stall_detection, start_sweeps=bulk_sweeps)
+        stall_detection=stall_detection, start_sweeps=bulk_sweeps,
+        telemetry=telemetry, stage="polish" if mixed else "single")
     # Mixed budget-exhaustion: report the bulk statistic if the polish
     # never ran (cf. rounds.iterate's identical carry handling).
     off_rel = jnp.where(sweeps > bulk_sweeps, off_rel, bulk_off)
@@ -731,7 +764,7 @@ def svd(
             mixed=bool(mixed), mixed_store=mixed_store,
             interpret=not pb.supported(),
             stall_detection=bool(config.stall_detection),
-            refine=bool(refine))
+            refine=bool(refine), telemetry=bool(metrics.enabled()))
         return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
     if config.precondition in ("on", "double") or config.mixed_bulk:
@@ -751,7 +784,8 @@ def svd(
         full_u=full_matrices, nblocks=2 * k, tol=tol,
         max_sweeps=int(config.max_sweeps), precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
-        stall_detection=bool(config.stall_detection))
+        stall_detection=bool(config.stall_detection),
+        telemetry=bool(metrics.enabled()))
     refine = (config.sigma_refine if config.sigma_refine is not None
               else (u is not None or v is not None))
     if refine and (u is not None or v is not None):
@@ -794,6 +828,17 @@ class SweepState(NamedTuple):
     vbot: jax.Array
     off_rel: jax.Array
     sweeps: jax.Array
+
+
+class PhaseInfo(NamedTuple):
+    """Public view of a stepper's CURRENT phase — what the next `step`
+    will run. Consumed by `utils.profiling.instrumented_svd` and
+    `utils.checkpoint` (which used to reach into `_stage`/`_phase`)."""
+
+    stage: str       # "bulk" | "polish" | "single"
+    method: str      # pair solver of the next sweep
+    criterion: str   # "rel" | "abs"
+    tol: float       # tolerance the next should_continue tests against
 
 
 class SweepStepper:
@@ -980,6 +1025,31 @@ class SweepStepper:
         if self._stage == "polish":
             return "qr-svd", self.criterion, self.tol
         return self.method, self.criterion, self.tol
+
+    def phase_info(self, state: "SweepState | None" = None) -> PhaseInfo:
+        """Public view of the phase the next `step` will run.
+
+        The stage machinery is host-side (it advances in `should_continue`),
+        so ``state`` is accepted for call-site symmetry but unused today.
+        This is the supported surface for instrumentation/checkpointing
+        (`utils.profiling`, `utils.checkpoint`) — `_phase`/`_stage` are
+        internals.
+        """
+        del state
+        method, criterion, tol = self._phase()
+        return PhaseInfo(stage=self._stage, method=method,
+                         criterion=criterion, tol=float(tol))
+
+    def restore_stage(self, stage: str) -> None:
+        """Restore the host-side stage machinery to a snapshotted stage
+        (the write-side counterpart of `phase_info`, used by
+        `utils.checkpoint` on resume). Resets the stall comparator — the
+        pre-snapshot off-norm history is gone with the process."""
+        if stage not in ("bulk", "polish", "single"):
+            raise ValueError(f"unknown solve stage {stage!r}")
+        self._stage = stage
+        self._prev_off = float("inf")
+        self._just_switched = False
 
     def step(self, state: SweepState) -> SweepState:
         method, criterion, _ = self._phase()

@@ -74,7 +74,7 @@ def _fingerprint(stepper: SweepStepper) -> dict:
         "compute_u": stepper.compute_u, "compute_v": stepper.compute_v,
         "full_matrices": stepper.full_matrices,
         "config": dataclasses.asdict(stepper.config),
-        "stage": stepper._stage,
+        "stage": stepper.phase_info().stage,
         **stepper.fingerprint_extra(),
     }
 
@@ -169,7 +169,7 @@ def load_state(path, stepper: SweepStepper) -> SweepState:
             top=jnp.asarray(z["top"], dtype), bot=jnp.asarray(z["bot"], dtype),
             vtop=jnp.asarray(z["vtop"], dtype), vbot=jnp.asarray(z["vbot"], dtype),
             off_rel=jnp.float32(z["off_rel"]), sweeps=jnp.int32(z["sweeps"]))
-    stepper._stage = stage
+    stepper.restore_stage(stage)
     return stepper.reshard(state)
 
 
@@ -221,7 +221,7 @@ def _load_state_multiprocess(path, stepper) -> SweepState:
             f"torn multi-process checkpoint {path}: per-process snapshots "
             f"are from different sweeps {sweeps_all.ravel().tolist()}; "
             "delete them and restart the solve")
-    stepper._stage = stage
+    stepper.restore_stage(stage)
     return state
 
 

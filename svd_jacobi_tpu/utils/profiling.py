@@ -1,27 +1,38 @@
-"""Observability: per-sweep structured metrics and profiler tracing.
+"""Host-stepped per-sweep instrumentation (compat shim over `obs`).
 
 The reference's only instrumentation is a wall-clock bracket around the
 solver call plus stdout prints mirrored to a report file (reference:
-`omp_get_wtime` at main.cu:1586,1610; report at main.cu:1667-1669). Here:
+`omp_get_wtime` at main.cu:1586,1610; report at main.cu:1667-1669).
 
-  * `trace(dir)` — context manager around `jax.profiler` for XLA-level
-    traces viewable in TensorBoard/Perfetto;
-  * `instrumented_svd(a, ...)` — runs the solve sweep-by-sweep (SweepStepper)
-    and records per-sweep off-norm, stage, and wall time, returning
-    (result, SweepLog); `SweepLog.to_json()` is the structured successor of
-    the reference's free-text report.
+This module predates the `svd_jacobi_tpu.obs` telemetry subsystem and is
+now a thin layer over it:
+
+  * `trace(dir)` — re-export of `obs.trace`: a robust `jax.profiler`
+    context (creates the dir, warns instead of raising when the profiler
+    is unavailable on the backend);
+  * `instrumented_svd(a, ...)` — runs the solve sweep-by-sweep
+    (SweepStepper) and records per-sweep off-norm, stage, and wall time,
+    returning (result, SweepLog).
+
+NOTE on methodology: `instrumented_svd` host-steps the solve, so it
+measures a DIFFERENT program than the fused `solver.svd`/`sharded.svd`
+paths (one jitted sweep per device execution vs. one fused while_loop;
+see PROFILE.md's intra-jit section). Use it when you want real per-sweep
+*wall times* under host control. To observe the fused solve itself
+without perturbing it, use the in-graph event stream instead:
+
+    with obs.metrics.capture() as events:
+        r = sj.svd(a)          # fused solve, telemetry baked into the jit
 """
 
 from __future__ import annotations
 
-import contextlib
 import json
 import time
 from typing import List, NamedTuple, Optional
 
-import numpy as np
-
 from ..config import SVDConfig
+from ..obs.trace import trace  # noqa: F401  (public re-export)
 from ..solver import SVDResult, SweepStepper
 
 
@@ -43,16 +54,13 @@ class SweepLog(NamedTuple):
             "sweeps": [r._asdict() for r in self.records],
         }, indent=2)
 
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """XLA profiler trace of the enclosed block (TensorBoard-viewable)."""
-    import jax
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    def to_events(self) -> List[dict]:
+        """The log as `obs.manifest`-schema telemetry events (so a
+        host-stepped run's sweep stream drops into the same manifest slot
+        as a fused run's `obs.metrics.capture` stream)."""
+        return [{"event": "sweep", "path": "stepped", "sweep": r.sweep,
+                 "stage": r.stage, "method": r.method, "off_rel": r.off_norm,
+                 "time_s": r.time_s} for r in self.records]
 
 
 def _sync(x) -> float:
@@ -96,13 +104,12 @@ def instrumented_svd(
     records: List[SweepRecord] = []
     t_all = time.perf_counter()
     while stepper.should_continue(state):
-        method, _, _ = stepper._phase()
-        stage = stepper._stage
+        phase = stepper.phase_info(state)
         t0 = time.perf_counter()
         state = stepper.step(state)
         _sync(state.off_rel)
         records.append(SweepRecord(
-            sweep=int(state.sweeps), stage=stage, method=method,
+            sweep=int(state.sweeps), stage=phase.stage, method=phase.method,
             off_norm=float(state.off_rel), time_s=time.perf_counter() - t0))
     result = stepper.finish(state)
     _sync(result.s)

@@ -140,11 +140,17 @@ class TestCli:
         solve = json.loads(out)
         assert solve["residual_rel"] < 1e-12
         assert solve["sigma_err"] < 1e-12
-        reports = list(tmp_path.glob("report-dimension-64-*.json"))
-        assert len(reports) == 1
-        rep = json.loads(reports[0].read_text())
+        from svd_jacobi_tpu.obs import manifest
+        records = manifest.load(tmp_path / "manifest.jsonl")
+        assert len(records) == 1
+        manifest.validate(records[0])
+        rep = records[0]
+        assert rep["kind"] == "cli"
         assert rep["self_test"]["ok"]
         assert rep["solve"]["sweeps"] >= 1
+        assert {s["name"] for s in rep["stages"]} == {
+            "self_test", "warmup_compile", "solve"}
+        assert rep["telemetry"] is None      # no --telemetry flag
 
     def test_cli_distributed(self, tmp_path, eight_devices):
         from svd_jacobi_tpu import cli
@@ -172,9 +178,9 @@ class TestCli:
         assert solve["residual_rel"] is None
         assert solve["u_orth"] is None and solve["v_orth"] is None
         assert solve["sigma_err"] < 1e-12      # sigma still computed + checked
-        rep = json.loads(next(tmp_path.glob("report-*.json")).read_text())
-        assert rep["config"]["jobu"] == "none"
-        assert rep["solve"]["jobv"] == "none"
+        from svd_jacobi_tpu.obs import manifest
+        rep = manifest.load(tmp_path / "manifest.jsonl")[-1]
+        assert rep["jobu"] == "none" and rep["jobv"] == "none"
 
 
 def test_profiling_log_json():
@@ -261,6 +267,9 @@ def test_cli_mixed_and_refine_flags(tmp_path, capsys, monkeypatch):
     solve = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert solve["residual_rel"] < 1e-5
     assert solve["sigma_err"] < 1e-6
-    rep = _json.loads(next(tmp_path.glob("report-*.json")).read_text())
-    assert rep["config"]["mixed_bulk"] == "on"
-    assert rep["config"]["sigma_refine"] == "on"
+    from svd_jacobi_tpu.obs import manifest
+    rep = manifest.load(tmp_path / "manifest.jsonl")[-1]
+    # The manifest records the RESOLVED SVDConfig (tri-state flags land as
+    # booleans), not the CLI spelling.
+    assert rep["config"]["mixed_bulk"] is True
+    assert rep["config"]["sigma_refine"] is True

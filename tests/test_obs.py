@@ -1,0 +1,272 @@
+"""The telemetry subsystem (svd_jacobi_tpu.obs): jit-safe metrics, run
+manifests, robust tracing.
+
+What is actually being proven:
+
+  * the event stream observes the FUSED solve (events emitted from inside
+    `lax.while_loop` via `jax.debug.callback`), on both the single-device
+    and the mesh path — not a host-stepped replica of it;
+  * the mesh path reports each sweep exactly ONCE (the per-device
+    replicated deliveries are collapsed by the dispatcher);
+  * the zero-telemetry path lowers to HLO with no callbacks, and the HLO
+    is independent of the host-side enable flag — telemetry is a static
+    trace-time property, so leaving it off cannot perturb production
+    solves;
+  * manifest records round-trip through JSONL with schema validation.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig, obs, solver
+from svd_jacobi_tpu.obs import manifest, metrics
+from svd_jacobi_tpu.utils import matgen
+
+CFG = SVDConfig(max_sweeps=24)
+
+
+def _ref_sigma(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+class TestMetricsSingleDevice:
+    def test_capture_fused_pallas_path(self):
+        """Per-sweep events from inside the fused kernel-path solve, with
+        off-norm trajectory and rotation-round counters."""
+        a = matgen.random_dense(96, 96, dtype=jnp.float32, seed=3)
+        with metrics.capture() as events:
+            r = sj.svd(a, config=CFG)
+        sweeps = [e for e in events if e["event"] == "sweep"]
+        assert len(sweeps) == int(r.sweeps)
+        assert [e["sweep"] for e in sweeps] == list(range(1, len(sweeps) + 1))
+        # "fused" when the compiled fused kernels run; "kernel" on the
+        # interpret-mode rounds (CPU backend).
+        assert sweeps[0]["path"] in ("fused", "kernel")
+        # The final event's off-norm is the solve's reported statistic.
+        assert sweeps[-1]["off_rel"] == pytest.approx(float(r.off_rel))
+        for e in sweeps:
+            assert 0 <= e["rounds_rotated"] <= e["rounds_total"]
+        # Convergence: the deflation endgame rotates fewer rounds.
+        assert sweeps[-1]["rounds_rotated"] <= sweeps[0]["rounds_rotated"]
+        # The solve is still correct with telemetry baked in.
+        np.testing.assert_allclose(np.asarray(r.s, np.float64),
+                                   _ref_sigma(a), rtol=1e-4, atol=1e-4)
+
+    def test_capture_xla_path(self):
+        """The XLA block-solver path (f64 -> qr-svd) emits the same
+        stream shape."""
+        a = matgen.random_dense(48, 48, dtype=jnp.float64, seed=4)
+        with metrics.capture() as events:
+            r = sj.svd(a, config=CFG)
+        sweeps = [e for e in events if e["event"] == "sweep"]
+        assert len(sweeps) == int(r.sweeps)
+        assert sweeps[0]["path"] == "xla"
+        assert all(isinstance(e["off_rel"], float) for e in sweeps)
+
+    def test_disabled_is_silent(self):
+        a = matgen.random_dense(48, 48, dtype=jnp.float32, seed=5)
+        sink_hits = []
+        remove = metrics.add_sink(sink_hits.append)
+        try:
+            sj.svd(a, config=CFG)
+            metrics.flush()
+        finally:
+            remove()
+        assert sink_hits == []
+
+    def test_capture_restores_flag_and_nests(self):
+        assert not metrics.enabled()
+        with metrics.capture() as outer:
+            assert metrics.enabled()
+            with metrics.capture() as inner:
+                metrics.emit  # noqa: B018  (flag state is what's under test)
+                assert metrics.enabled()
+            assert metrics.enabled()
+        assert not metrics.enabled()
+        assert outer == [] and inner == []
+
+
+class TestMetricsMesh:
+    def test_capture_sharded_reports_once(self, eight_devices):
+        """The mesh solve emits pmax-replicated values once per local
+        device; the dispatcher must collapse them to ONE event per sweep."""
+        from svd_jacobi_tpu.parallel import sharded
+        a = matgen.random_dense(96, 96, dtype=jnp.float32, seed=6)
+        with metrics.capture() as events:
+            r = sharded.svd(a, config=CFG)
+        sweeps = [e for e in events if e["event"] == "sweep"]
+        assert len(sweeps) == int(r.sweeps)          # not 8x
+        assert [e["sweep"] for e in sweeps] == list(range(1, len(sweeps) + 1))
+        assert sweeps[0]["path"] == "sharded"
+        assert sweeps[0]["devices"] == 8
+        assert sweeps[-1]["off_rel"] == pytest.approx(float(r.off_rel))
+
+    def test_sharded_result_unchanged_by_telemetry(self, eight_devices):
+        from svd_jacobi_tpu.parallel import sharded
+        a = matgen.random_dense(96, 96, dtype=jnp.float32, seed=7)
+        r_plain = sharded.svd(a, config=CFG)
+        with metrics.capture():
+            r_tel = sharded.svd(a, config=CFG)
+        np.testing.assert_array_equal(np.asarray(r_plain.s),
+                                      np.asarray(r_tel.s))
+
+
+class TestHloEquivalence:
+    """Telemetry must be free when off: the flag is static, so the
+    telemetry-off program contains no callback and is byte-identical no
+    matter what the host-side enable flag says (i.e. identical to the
+    pre-telemetry seed program modulo scope names, which are metadata on
+    the same ops)."""
+
+    def _lower(self, telemetry):
+        a = jnp.zeros((16, 16), jnp.float32)
+        return solver._svd_padded.lower(
+            a, n=16, compute_u=True, compute_v=True, full_u=False,
+            nblocks=2, tol=1e-7, max_sweeps=4, precision="highest",
+            gram_dtype_name="float32", method="qr-svd", criterion="rel",
+            telemetry=telemetry).as_text()
+
+    def test_off_has_no_callback_and_ignores_host_flag(self):
+        text_off = self._lower(False)
+        try:
+            metrics.enable()
+            text_off_enabled = self._lower(False)
+            text_on = self._lower(True)
+        finally:
+            metrics.disable()
+        assert "callback" not in text_off
+        assert text_off == text_off_enabled
+        assert "callback" in text_on
+        assert text_on != text_off
+
+    def test_fused_sweep_off_has_no_extra_carry(self):
+        """rounds.sweep with telemetry off returns the seed's 5-tuple (no
+        rotation counter riding the scan carry)."""
+        from svd_jacobi_tpu.ops import rounds
+        k, mrows, b = 2, 16, 4
+        top = jnp.ones((k, mrows, b), jnp.float32)
+        bot = jnp.ones((k, mrows, b), jnp.float32)
+        dmax2 = jnp.float32(1.0)
+        out = jax.eval_shape(
+            lambda t, bo: rounds.sweep(t, bo, None, None, dmax2, 1e-6,
+                                       interpret=True, polish=False,
+                                       bf16_gram=False), top, bot)
+        assert len(out) == 5
+        out_t = jax.eval_shape(
+            lambda t, bo: rounds.sweep(t, bo, None, None, dmax2, 1e-6,
+                                       interpret=True, polish=False,
+                                       bf16_gram=False, telemetry=True),
+            top, bot)
+        assert len(out_t) == 6
+
+
+class TestManifest:
+    def _record(self, **over):
+        kw = dict(m=64, n=64, dtype="float32", config=SVDConfig(),
+                  solve={"time_s": 1.0, "sweeps": 8, "off_norm": 1e-7},
+                  stages=[{"name": "solve", "time_s": 1.0}],
+                  telemetry=[{"event": "sweep", "sweep": 1,
+                              "off_rel": 0.5}])
+        kw.update(over)
+        return manifest.build("cli", **kw)
+
+    def test_round_trip(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        rec = self._record(seed=123)
+        manifest.append(path, rec)
+        manifest.append(path, self._record(telemetry=None))
+        loaded = manifest.load(path)
+        assert len(loaded) == 2
+        for r in loaded:
+            manifest.validate(r)
+        assert loaded[0] == json.loads(json.dumps(rec))  # JSON-stable
+        assert loaded[0]["seed"] == 123                  # extras survive
+        assert loaded[1]["telemetry"] is None
+
+    def test_validate_rejects_missing_and_wrong_types(self):
+        rec = self._record()
+        bad = dict(rec)
+        del bad["environment"]
+        with pytest.raises(ValueError, match="environment"):
+            manifest.validate(bad)
+        bad = json.loads(json.dumps(rec))
+        bad["solve"]["sweeps"] = "eight"
+        with pytest.raises(ValueError, match="solve.sweeps"):
+            manifest.validate(bad)
+        bad = json.loads(json.dumps(rec))
+        bad["schema_version"] = 999
+        with pytest.raises(ValueError, match="schema_version"):
+            manifest.validate(bad)
+
+    def test_config_hash_is_content_addressed(self):
+        h1 = manifest.config_hash(SVDConfig())
+        h2 = manifest.config_hash(SVDConfig())
+        h3 = manifest.config_hash(SVDConfig(max_sweeps=7))
+        assert h1 == h2 != h3
+
+    def test_summarize_and_diff_render(self):
+        rec = self._record()
+        text = manifest.summarize(rec)
+        assert "64x64" in text and "sweep" in text
+        d = manifest.diff(rec, self._record(
+            solve={"time_s": 2.0, "sweeps": 9, "off_norm": 1e-7}))
+        assert "solve.time_s" in d and "+100.0%" in d
+
+
+class TestTraceRobustness:
+    def test_creates_dir_and_degrades_to_warning(self, tmp_path,
+                                                 monkeypatch):
+        target = tmp_path / "nested" / "trace_out"
+
+        def boom(*a, **k):
+            raise RuntimeError("no profiler on this backend")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        ran = False
+        with pytest.warns(RuntimeWarning, match="profiler unavailable"):
+            with obs.trace(target):
+                ran = True
+        assert ran
+        assert target.is_dir()    # created even though tracing failed
+
+    def test_noop_when_mkdir_fails(self, tmp_path, monkeypatch):
+        # A file where the dir should go: mkdir raises -> warn, still run.
+        clash = tmp_path / "clash"
+        clash.write_text("")
+        ran = False
+        with pytest.warns(RuntimeWarning):
+            with obs.trace(clash):
+                ran = True
+        assert ran
+
+
+class TestPhaseInfo:
+    def test_public_accessor_tracks_hybrid_stages(self):
+        a = matgen.random_dense(48, 48, dtype=jnp.float64, seed=9)
+        st = solver.SweepStepper(
+            a, config=SVDConfig(pair_solver="hybrid", max_sweeps=24))
+        state = st.init()
+        info = st.phase_info(state)
+        assert info.stage == "bulk"
+        assert info.method == "gram-eigh" and info.criterion == "abs"
+        seen = {info.stage}
+        while st.should_continue(state):
+            state = st.step(state)
+            seen.add(st.phase_info(state).stage)
+        assert seen == {"bulk", "polish"}
+        r = st.finish(state)
+        np.testing.assert_allclose(np.asarray(r.s), _ref_sigma(a),
+                                   rtol=1e-8, atol=1e-10)
+
+    def test_sharded_stepper_inherits_accessor(self, eight_devices):
+        from svd_jacobi_tpu.parallel import sharded
+        a = matgen.random_dense(96, 96, dtype=jnp.float32, seed=10)
+        st = sharded.SweepStepper(a, config=CFG)
+        info = st.phase_info(st.init())
+        assert info.stage in ("bulk", "single")
+        assert isinstance(info.tol, float)

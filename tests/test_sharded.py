@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu import SVDConfig, _compat
 from svd_jacobi_tpu.parallel import schedule as sched, sharded
 from svd_jacobi_tpu.utils import matgen, validation
 
@@ -34,7 +34,7 @@ def test_ring_exchange_matches_schedule(ndev, eight_devices):
         return sharded._ring_exchange(top, bot, axis_name="blocks",
                                       n_devices=ndev)
 
-    ring = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, spec),
+    ring = jax.jit(_compat.shard_map(step, mesh=mesh, in_specs=(spec, spec),
                                  out_specs=(spec, spec)))
     t_ring, b_ring = top0, bot0
     t_ref, b_ref = top0, bot0

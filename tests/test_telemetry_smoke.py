@@ -1,0 +1,84 @@
+"""Tier-1-safe end-to-end smoke of the telemetry pipeline: the real CLI
+and bench drivers, run as subprocesses on the CPU backend at toy size,
+must emit schema-valid manifest records — including the in-graph per-sweep
+event stream — and the summary tool must render them.
+
+This is the CI gate for the whole chain: solver emission sites ->
+obs.metrics dispatch -> obs.manifest JSONL -> scripts/telemetry_summary.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+from svd_jacobi_tpu.obs import manifest  # noqa: E402
+
+
+def _run(cmd, cwd=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # no virtual-device fan-out
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=cwd or ROOT, timeout=600)
+
+
+def test_cli_telemetry_end_to_end(tmp_path):
+    p = _run([sys.executable, "-m", "svd_jacobi_tpu.cli", "64",
+              "--matrix", "dense", "--no-selftest", "--telemetry",
+              "--max-sweeps", "16", "--report-dir", str(tmp_path)])
+    assert p.returncode == 0, p.stderr[-800:]
+    solve = json.loads(p.stdout.strip().splitlines()[-1])
+    assert solve["sweeps"] >= 1
+
+    records = manifest.load(tmp_path / "manifest.jsonl")
+    assert len(records) == 1
+    rec = records[0]
+    manifest.validate(rec)
+    assert rec["kind"] == "cli"
+    assert rec["environment"]["backend"] == "cpu"
+    # Per-stage wall times and the fused solve's per-sweep stream.
+    assert {s["name"] for s in rec["stages"]} >= {"warmup_compile", "solve"}
+    sweeps = [e for e in rec["telemetry"] if e["event"] == "sweep"]
+    assert len(sweeps) == rec["solve"]["sweeps"]
+    offs = [e["off_rel"] for e in sweeps]
+    assert offs[-1] == min(offs)         # converging trajectory
+
+    # The summary tool renders and validates it.
+    p = _run([sys.executable, str(ROOT / "scripts" / "telemetry_summary.py"),
+              str(tmp_path / "manifest.jsonl"), "--validate"])
+    assert p.returncode == 0, p.stderr[-800:]
+    p = _run([sys.executable, str(ROOT / "scripts" / "telemetry_summary.py"),
+              str(tmp_path / "manifest.jsonl"), "--last"])
+    assert p.returncode == 0 and "telemetry:" in p.stdout
+
+
+def test_bench_telemetry_end_to_end(tmp_path):
+    mpath = tmp_path / "bench.jsonl"
+    p = _run([sys.executable, str(ROOT / "bench.py"), "96", "float32",
+              "--reps=1", "--oracle=off", "--no-baseline", "--telemetry",
+              f"--manifest={mpath}", "--platform=cpu"])
+    assert p.returncode == 0, p.stderr[-800:]
+    row = json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["value"] > 0
+
+    records = manifest.load(mpath)
+    assert len(records) == 1
+    rec = records[0]
+    manifest.validate(rec)
+    assert rec["kind"] == "bench"
+    assert rec["solve"]["sweeps"] == row["sweeps"]
+    sweeps = [e for e in rec["telemetry"] if e["event"] == "sweep"]
+    # The untimed telemetered solve re-runs the same deterministic solve.
+    assert len(sweeps) == row["sweeps"]
+
+
+def test_bench_manifest_off(tmp_path):
+    p = _run([sys.executable, str(ROOT / "bench.py"), "96", "float32",
+              "--reps=1", "--oracle=off", "--no-baseline",
+              "--manifest=off", "--platform=cpu"], cwd=tmp_path)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert not (tmp_path / "reports").exists()
