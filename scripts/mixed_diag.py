@@ -4,6 +4,13 @@ Times each stage of solver._svd_pallas's mixed path separately (bulk
 sweeps / NS + reconstitution / f32 polish) and reports per-phase sweep
 counts, so MIXED_TOL, the storage regime (SVDConfig.mixed_store), and the
 NS step count can be tuned against the single-jit end-to-end number.
+
+Before timing, each phase's jaxpr is screened with the shared
+dtype-boundary pass (`analysis.jaxpr_checks.check_dtype_boundaries`) —
+the mixed regime's whole point is that ONLY the declared bf16<->f32
+boundaries appear, and an accidental upcast in a hand-built probe stage
+silently un-mixes the measurement (this used to be eyeballed).
+
 Usage:
 
     python scripts/mixed_diag.py [N] [store] [mixed_tol] [ns_steps]
@@ -21,9 +28,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from svd_jacobi_tpu import solver
+from svd_jacobi_tpu import SVDConfig, solver
+from svd_jacobi_tpu.analysis import jaxpr_checks, render_findings
+from svd_jacobi_tpu.ops import pallas_blocks as pb
 from svd_jacobi_tpu.ops import rounds
 from svd_jacobi_tpu.utils import matgen
+
+# Compiled kernels on chip; interpreter bodies elsewhere (same trace
+# structure — the dtype-boundary screen is identical), mirroring solver.
+INTERPRET = not pb.supported()
 
 
 def timed(fn, *args):
@@ -36,6 +49,17 @@ def timed(fn, *args):
     return time.perf_counter() - t0, out
 
 
+def check_boundaries(name, fn, *args):
+    """Screen one probe stage with the shared jaxpr dtype-boundary pass
+    (f32 working dtype: bf16<->f32 moves are the only declared mix)."""
+    findings = jaxpr_checks.check_dtype_boundaries(
+        jax.make_jaxpr(fn)(*args), f"mixed_diag.{name}", jnp.float32)
+    if findings:
+        print(render_findings(findings,
+                              header=f"{name}: dtype-boundary violations:"))
+        sys.exit(1)
+
+
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     store = sys.argv[2] if len(sys.argv) > 2 else "f32"
@@ -44,7 +68,7 @@ def main():
     ns_steps = (int(sys.argv[4]) if len(sys.argv) > 4
                 else (4 if store == "bf16g" else 2))
     a = matgen.random_dense(n, n, dtype=jnp.float32)
-    cfg_b, k = solver._plan(n, 1, __import__("svd_jacobi_tpu").SVDConfig())
+    cfg_b, k = solver._plan(n, 1, SVDConfig())
     nblocks, n_pad = 2 * k, 2 * k * cfg_b
     print(f"n={n} b={cfg_b} k={k} store={store} mixed_tol={mixed_tol} "
           f"ns={ns_steps}")
@@ -62,11 +86,12 @@ def main():
             vt, vb = vt.astype(jnp.bfloat16), vb.astype(jnp.bfloat16)
         _, _, vt, vb, off, sweeps = rounds.iterate_phase(
             top, bot, vt, vb, stop_tol=jnp.float32(mixed_tol),
-            rtol=mixed_tol, max_sweeps=32, interpret=False, polish=True,
+            rtol=mixed_tol, max_sweeps=32, interpret=INTERPRET, polish=True,
             bf16_gram=True, apply_x3=True,
             stall_gate=10 * mixed_tol, stall_shrink=0.5)
         return vt, vb, off, sweeps
 
+    check_boundaries("bulk", bulk, work)
     t_bulk, (vt, vb, boff, bsweeps) = timed(bulk, work)
     print(f"precond {t_pre:.3f}s | bulk {t_bulk:.3f}s sweeps={int(bsweeps)} "
           f"off={float(boff):.3e}")
@@ -81,6 +106,7 @@ def main():
         gt, gb = solver._blockify(g, n_pad, nblocks)
         return top, bot, gt, gb
 
+    check_boundaries("reconstitute", reconstitute, work, vt, vb)
     t_rec, (top, bot, gt, gb) = timed(reconstitute, work, vt, vb)
     # orthogonality of G pre/post NS
     g_raw = solver._deblockify(vt, vb).astype(jnp.float32)
@@ -92,8 +118,10 @@ def main():
     def polish(top, bot, gt, gb):
         tol = float(np.sqrt(n) * np.finfo(np.float32).eps)
         return rounds.iterate(top, bot, gt, gb, tol=tol, max_sweeps=32,
-                              interpret=False, polish=True, bulk_bf16=False)
+                              interpret=INTERPRET, polish=True,
+                              bulk_bf16=False)
 
+    check_boundaries("polish", polish, top, bot, gt, gb)
     t_pol, (_, _, _, _, poff, psweeps) = timed(polish, top, bot, gt, gb)
     print(f"polish {t_pol:.3f}s sweeps={int(psweeps)} off={float(poff):.3e}")
     total = t_pre + t_bulk + t_rec + t_pol

@@ -1,0 +1,78 @@
+"""graftcheck — static analysis + sanitizers for the fused Jacobi hot paths.
+
+The reference CUDA/MPI code could lean on compiler warnings and
+`cuda-memcheck`; a JAX port has neither, and its core invariants live in
+artifacts no text linter sees — the traced jaxpr and the lowered StableHLO.
+This package checks the REAL compiled artifacts of the production entry
+points (resolved through `solver._plan_entry` / `parallel.sharded._plan_entry`,
+so the probes are exactly the programs `svd()` dispatches), plus the source
+properties that decide whether those artifacts stay sane:
+
+  * `jaxpr_checks`  — traverses the closed jaxprs of every public entry
+    point: no host callbacks when telemetry is statically off, no float
+    upcasts beyond the declared mixed-precision boundaries
+    (`config.MIXED_PRECISION_BOUNDARIES`), no host-transfer primitives
+    inside `while_loop`/`scan` bodies.
+  * `hlo_checks`    — lowers/compiles the hot paths: the sharded round
+    loop's collective budget (`config.COLLECTIVE_BUDGET` — exact
+    `collective_permute`/`all_reduce` counts, zero `all_gather`), buffer
+    donation surviving to input-output aliasing, and the telemetry-off
+    HLO-equivalence guarantee (generalized from tests/test_obs.py).
+  * `ast_lint`      — custom AST rules with GRAFT0xx codes: host
+    materialization of traced values (GRAFT001), Python control flow on
+    traced booleans (GRAFT002), `jnp` computation at import time
+    (GRAFT003), jit cache-key hygiene (GRAFT004), and named-scope coverage
+    of the PROFILE.md hot regions (GRAFT005, `config.HOT_SCOPES`).
+  * `recompile_guard` — hooks JAX's compilation monitoring
+    (`/jax/core/compile/backend_compile_duration`) plus per-entry jit
+    cache sizes, and fails when an entry point retraces beyond its
+    declared budget (`config.RETRACE_BUDGETS`) across a multi-size solve
+    sequence — the Brent-Luk schedule leaking into a jit key is exactly
+    this failure.
+  * `sanitize`      — the runtime-sanitizer context (jax_debug_nans,
+    jax_debug_infs, jax_transfer_guard) behind the `-m sanitized` pytest
+    lane and the CLI's `--sanitized` flag.
+
+`python -m svd_jacobi_tpu.analysis` runs every pass and appends one
+schema-versioned "analysis" record to the run manifest (`obs.manifest`);
+tests/conftest.py runs the cheap passes (AST lint + jaxpr) before every
+tier-1 pytest session so contract violations fail fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation, from any pass.
+
+    ``code`` namespaces the rule: GRAFT0xx (AST lint), JAXPR0xx,
+    HLO0xx, RETRACE0xx. ``where`` is "path:line" for source findings and
+    the probe entry name for artifact findings.
+    """
+
+    code: str
+    where: str
+    message: str
+    suggestion: str = ""
+
+    def render(self) -> str:
+        s = f"{self.where}: {self.code} {self.message}"
+        if self.suggestion:
+            s += f" [fix: {self.suggestion}]"
+        return s
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def render_findings(findings: List[Finding], header: Optional[str] = None) -> str:
+    lines = [header] if header else []
+    lines += [f.render() for f in findings]
+    return "\n".join(lines)
+
+
+__all__ = ["Finding", "render_findings"]

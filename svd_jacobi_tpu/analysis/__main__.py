@@ -1,0 +1,109 @@
+"""`python -m svd_jacobi_tpu.analysis` — run every graftcheck pass.
+
+Runs on a deterministic 8-virtual-device CPU backend (mirroring
+tests/conftest.py) regardless of attached hardware: the contracts under
+check are trace/lowering-structural, and an analysis run must never dial
+an accelerator. Exit 0 iff every pass is clean; one schema-versioned
+"analysis" record is appended to ``<report-dir>/manifest.jsonl``
+(render with ``scripts/telemetry_summary.py``).
+
+    python -m svd_jacobi_tpu.analysis                    # all passes
+    python -m svd_jacobi_tpu.analysis --passes ast,jaxpr # fail-fast subset
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile")
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="svd-graftcheck",
+        description="Static analysis + sanitizer contract checks for the "
+                    "fused Jacobi hot paths.")
+    p.add_argument("--passes", default=",".join(PASS_NAMES),
+                   help=f"comma-separated subset of {PASS_NAMES}")
+    p.add_argument("--report-dir", default="reports",
+                   help="manifest directory (one 'analysis' JSONL record "
+                        "appended per run); 'off' disables the record")
+    p.add_argument("--json", action="store_true",
+                   help="print the full report record to stdout as JSON")
+    return p.parse_args(argv)
+
+
+def _setup_backend() -> None:
+    """Deterministic virtual-CPU backend, BEFORE anything touches XLA."""
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # x64 on so the f64 qr-svd entry is probed too (mirrors tests).
+    jax.config.update("jax_enable_x64", True)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    selected = [s.strip() for s in args.passes.split(",") if s.strip()]
+    unknown = sorted(set(selected) - set(PASS_NAMES))
+    if unknown:
+        print(f"unknown passes: {unknown} (known: {list(PASS_NAMES)})",
+              file=sys.stderr)
+        return 2
+    _setup_backend()
+
+    from . import render_findings
+    from .. import obs
+
+    def run_pass(name):
+        from . import ast_lint, hlo_checks, jaxpr_checks, recompile_guard
+        if name == "ast":
+            return ast_lint.lint_package(), None
+        if name == "jaxpr":
+            return jaxpr_checks.check_default_entries(), None
+        if name == "hlo":
+            return hlo_checks.check_default_entries(), None
+        findings, report = recompile_guard.run_default_sequence()
+        return findings, report
+
+    passes = []
+    ok = True
+    for name in selected:
+        t0 = time.perf_counter()
+        findings, extra = run_pass(name)
+        dt = time.perf_counter() - t0
+        entry = {"name": name, "ok": not findings,
+                 "findings": [f.as_dict() for f in findings],
+                 "time_s": dt}
+        if extra is not None:
+            entry["detail"] = extra
+        passes.append(entry)
+        status = "ok" if not findings else f"{len(findings)} finding(s)"
+        print(f"pass {name:<9} {status}  ({dt:.2f} s)", file=sys.stderr)
+        if findings:
+            ok = False
+            print(render_findings(findings), file=sys.stderr)
+
+    record = obs.manifest.build_analysis(
+        passes=passes, argv=list(sys.argv[1:] if argv is None else argv))
+    if args.report_dir != "off":
+        path = obs.manifest.append(
+            Path(args.report_dir) / "manifest.jsonl", record)
+        print(f"manifest: {path}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(record, sort_keys=True))
+    else:
+        print(json.dumps({"ok": ok,
+                          "findings_total": record["findings_total"],
+                          "passes": {p["name"]: p["ok"] for p in passes}}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

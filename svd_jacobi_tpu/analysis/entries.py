@@ -1,0 +1,138 @@
+"""Probe registry: the entry points the artifact passes check.
+
+Each `EntryProbe` wraps one fused jitted entry point with concrete example
+arguments, resolved through the SAME planning helpers production uses
+(`solver._plan_entry`, `parallel.sharded._plan_entry`) — so a probe is
+byte-for-byte the program `svd()` / `sharded.svd()` would dispatch for that
+(input, config), and the contract checks cannot drift from reality the way
+hand-rebuilt call signatures would.
+
+Probes are tiny (toy shapes) because the contracts under test are
+STRUCTURAL — which primitives/collectives appear, where dtypes widen — and
+structure is shape-independent here: the same trace code paths run at
+32 columns and at 32768 (the jit keys differ only in shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SVDConfig
+
+
+@dataclasses.dataclass
+class EntryProbe:
+    """One checkable entry point: ``fn(*args, **kwargs)`` is the real call.
+
+    ``telemetry_key``: name of the static kwarg gating `obs.metrics`
+    emission (every fused entry has one); None for entries without the
+    flag. ``entry_id``: the `config.RETRACE_BUDGETS` key of the underlying
+    jit object, for compile-count attribution.
+    """
+
+    name: str
+    fn: Any
+    args: tuple
+    kwargs: Dict[str, Any]
+    entry_id: str = ""
+    telemetry_key: Optional[str] = "telemetry"
+
+    def with_kwargs(self, **over) -> "EntryProbe":
+        return dataclasses.replace(self, kwargs={**self.kwargs, **over})
+
+    def lower(self):
+        return self.fn.lower(*self.args, **self.kwargs)
+
+    def closed_jaxpr(self):
+        """The probe's closed jaxpr. Wrapping the jit call under
+        `make_jaxpr` yields one pjit eqn whose params carry the full inner
+        jaxpr — the checkers recurse through it."""
+        kwargs = self.kwargs
+        return jax.make_jaxpr(lambda *xs: self.fn(*xs, **kwargs))(*self.args)
+
+    @property
+    def input_dtype(self):
+        return self.args[0].dtype
+
+
+def _single_probe(name: str, a, config: SVDConfig, *, compute_u=True,
+                  compute_v=True, full_matrices=False) -> EntryProbe:
+    from .. import solver
+    entry, fn, a_in, kwargs = solver._plan_entry(
+        a, config, compute_u=compute_u, compute_v=compute_v,
+        full_matrices=full_matrices)
+    entry_id = {"padded": "solver._svd_padded",
+                "pallas": ("solver._svd_pallas_donated" if config.donate_input
+                           else "solver._svd_pallas")}[entry]
+    return EntryProbe(name=name, fn=fn, args=(a_in,), kwargs=kwargs,
+                      entry_id=entry_id)
+
+
+def single_device_probes(include_f64: Optional[bool] = None) -> List[EntryProbe]:
+    """Probes for every single-device fused entry/regime. ``include_f64``
+    defaults to whether x64 is enabled (the f64 qr-svd path needs it)."""
+    if include_f64 is None:
+        include_f64 = bool(jax.config.jax_enable_x64)
+    a32 = jnp.zeros((48, 32), jnp.float32)
+    probes = [
+        # The production kernel path (QR-preconditioned; Pallas interpret
+        # mode on CPU backends — same trace structure as the compiled
+        # kernels modulo the pallas_call bodies).
+        _single_probe("pallas", a32, SVDConfig(pair_solver="pallas")),
+        # The north-star mixed regime: bf16 bulk + f32 reconstitute+polish
+        # — the path with the most dtype boundaries to get wrong.
+        _single_probe("pallas_mixed", a32,
+                      SVDConfig(pair_solver="pallas", mixed_bulk=True)),
+        # Buffer-donating twin (checked for input-output aliasing).
+        _single_probe("pallas_donated", a32,
+                      SVDConfig(pair_solver="pallas", donate_input=True)),
+        # Sigma-only fast path (gram-eigh, abs criterion).
+        _single_probe("padded_novec", a32, SVDConfig(pair_solver="gram-eigh"),
+                      compute_u=False, compute_v=False),
+        # XLA block-solver path (hybrid: bulk + polish phase loops).
+        _single_probe("padded_hybrid", a32, SVDConfig(pair_solver="hybrid")),
+    ]
+    if include_f64:
+        a64 = jnp.zeros((48, 32), jnp.float64)
+        probes.append(_single_probe("padded_f64_qr", a64, SVDConfig()))
+    return probes
+
+
+def mesh_probes(mesh=None) -> List[EntryProbe]:
+    """Probes for the sharded entry point. Names here key
+    `config.COLLECTIVE_BUDGET`; geometry comes from the production planner
+    so the even-b kernel fix-up and per-device pair slots are included.
+    Returns [] when fewer than 2 devices are attached (the CLI and the
+    tests provide an 8-device virtual CPU mesh)."""
+    from ..parallel import sharded
+
+    if mesh is None:
+        if len(jax.devices()) < 2:
+            return []
+        mesh = sharded.make_mesh()
+    n = 96
+    a = jnp.zeros((n, n), jnp.float32)
+
+    def probe(name, config, **solve_kw):
+        kwargs = sharded._plan_entry(a, mesh, config, **solve_kw)
+        return EntryProbe(name=name, fn=sharded._svd_sharded_jit,
+                          args=(a,), kwargs=kwargs,
+                          entry_id="sharded._svd_sharded_jit")
+
+    return [
+        probe("sharded_pallas", SVDConfig(pair_solver="pallas")),
+        probe("sharded_pallas_novec", SVDConfig(pair_solver="pallas"),
+              compute_u=False, compute_v=False),
+        probe("sharded_hybrid", SVDConfig(pair_solver="hybrid")),
+    ]
+
+
+def all_probes(include_mesh: bool = True) -> List[EntryProbe]:
+    probes = single_device_probes()
+    if include_mesh:
+        probes += mesh_probes()
+    return probes

@@ -1,0 +1,181 @@
+"""HLO contract checks: compile/lower the hot paths and assert properties
+of the artifacts XLA actually receives.
+
+Three contracts (codes HLO001-003):
+
+  * HLO001 — collective budget. The sharded round loop's only legal
+    communication is the tournament ring exchange (2 `collective_permute`
+    hops per stack per round) plus the pmax'd convergence machinery
+    (`all_reduce`); an `all_gather` would mean some step materializes a
+    gathered matrix. Counted on the LOWERED StableHLO module — shard_map
+    collectives are explicit there, while-loop bodies appear exactly once
+    (not unrolled), and the GSPMD postprocessing outside the loop has not
+    yet been partitioned into collectives — so the module count IS the
+    sweep loop's budget. Exact equality against
+    `config.COLLECTIVE_BUDGET`, so nothing rides in silently.
+  * HLO002 — buffer donation. `SVDConfig.donate_input` must survive all
+    the way down: the donated entry's lowered module marks the input
+    donated (`tf.aliasing_output`/`jax.buffer_donor`) and the compiled
+    executable reports input-output aliasing; the undonated twin must
+    mark nothing (donating by accident invalidates caller arrays).
+  * HLO003 — telemetry-off HLO equivalence (the generalization of
+    tests/test_obs.py's original check, which tested one entry): for every
+    fused entry, the telemetry-off lowering contains no callback custom
+    call, is byte-identical whether or not the host-side enable flag is
+    set, and differs from the telemetry-on lowering (proving the flag is
+    real, not dead).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from . import Finding
+from .. import config as _config
+
+COLLECTIVE_OPS = ("collective_permute", "all_gather", "all_reduce",
+                  "all_to_all", "reduce_scatter")
+# Markers of a donated parameter in lowered StableHLO (jax spells it either
+# way across versions) and of realized aliasing in a compiled executable.
+DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+ALIAS_MARKER = "input_output_alias"
+
+
+def collective_counts(lowered_text: str) -> Dict[str, int]:
+    """Static occurrence count of each collective op in a lowered module."""
+    return {op: len(re.findall(rf"stablehlo\.{op}\b", lowered_text))
+            for op in COLLECTIVE_OPS}
+
+
+def check_collective_budget(probe, budget: Optional[Dict[str, int]] = None
+                            ) -> List[Finding]:
+    """HLO001 for one mesh probe. ``budget`` defaults to the declared
+    `config.COLLECTIVE_BUDGET[probe.name]`."""
+    if budget is None:
+        budget = _config.COLLECTIVE_BUDGET.get(probe.name)
+        if budget is None:
+            return [Finding(
+                code="HLO001", where=probe.name,
+                message=("no declared collective budget for this entry — "
+                         "declare it in config.COLLECTIVE_BUDGET"),
+                suggestion="add exact per-op counts with a derivation")]
+    text = probe.lower().as_text()
+    counts = collective_counts(text)
+    findings = []
+    for op, expected in budget.items():
+        got = counts.get(op, 0)
+        if got != expected:
+            findings.append(Finding(
+                code="HLO001", where=probe.name,
+                message=(f"collective budget violated: {got} "
+                         f"stablehlo.{op} ops in the lowered module, "
+                         f"declared {expected}"),
+                suggestion=("if the change is intentional, update "
+                            "config.COLLECTIVE_BUDGET with the new "
+                            "derivation; otherwise find the op that "
+                            "snuck into the sweep loop")))
+    return findings
+
+
+def check_donation(donated_probe, plain_probe) -> List[Finding]:
+    """HLO002: donation marks the donated entry (and only it), and
+    survives compilation to input-output aliasing."""
+    findings = []
+    donated_lowered = donated_probe.lower()
+    donated_text = donated_lowered.as_text()
+    plain_text = plain_probe.lower().as_text()
+    if not any(m in donated_text for m in DONATION_MARKERS):
+        findings.append(Finding(
+            code="HLO002", where=donated_probe.name,
+            message=("donate_input entry lowered WITHOUT a donation "
+                     "marker — XLA will keep the caller's input buffer "
+                     "alive and the largest sizes OOM"),
+            suggestion=("check donate_argnums on the jit wrapper "
+                        "(solver._svd_pallas_donated)")))
+    if any(m in plain_text for m in DONATION_MARKERS):
+        findings.append(Finding(
+            code="HLO002", where=plain_probe.name,
+            message=("undonated entry carries a donation marker — the "
+                     "caller's array would be invalidated without "
+                     "donate_input"),
+            suggestion="remove the stray donate_argnums"))
+    if not findings:
+        compiled = donated_lowered.compile().as_text()
+        if ALIAS_MARKER not in compiled:
+            findings.append(Finding(
+                code="HLO002", where=donated_probe.name,
+                message=("donation did not survive compilation: no "
+                         "input_output_alias in the executable (the "
+                         "donated buffer is copied, not reused)"),
+                suggestion=("the donated shape/dtype must match an "
+                            "output's exactly for XLA to alias it")))
+    return findings
+
+
+def check_telemetry_invariance(probe) -> List[Finding]:
+    """HLO003 for one entry: telemetry-off lowering is callback-free,
+    independent of the host-side enable flag, and distinct from the
+    telemetry-on lowering."""
+    from ..obs import metrics
+
+    if not probe.telemetry_key:
+        return []
+    key = probe.telemetry_key
+    prev = metrics.enabled()
+    try:
+        # Baseline under a DISABLED module flag — with ambient enable
+        # state the flag-independence comparison would compare two
+        # identically-enabled lowerings and could never fail.
+        metrics.disable()
+        off = probe.with_kwargs(**{key: False}).lower().as_text()
+        metrics.enable()
+        off_enabled = probe.with_kwargs(**{key: False}).lower().as_text()
+        on = probe.with_kwargs(**{key: True}).lower().as_text()
+    finally:
+        metrics.enable() if prev else metrics.disable()
+    findings = []
+    if "callback" in off:
+        findings.append(Finding(
+            code="HLO003", where=probe.name,
+            message=("telemetry-off lowering contains a callback custom "
+                     "call — the zero-telemetry program is no longer the "
+                     "seed program"),
+            suggestion=("an emit call site lost its static telemetry "
+                        "gate; see obs.metrics design notes")))
+    if off != off_enabled:
+        findings.append(Finding(
+            code="HLO003", where=probe.name,
+            message=("telemetry-off lowering depends on the host-side "
+                     "enable flag — telemetry must be a static trace "
+                     "property, not runtime state"),
+            suggestion=("something reads obs.metrics.enabled() inside "
+                        "the traced function instead of threading it as "
+                        "a static argument")))
+    if on == off:
+        findings.append(Finding(
+            code="HLO003", where=probe.name,
+            message=("telemetry-on lowering is identical to telemetry-off "
+                     "— the telemetry flag is dead on this entry"),
+            suggestion="thread the flag into the sweep loop's emit sites"))
+    return findings
+
+
+def check_default_entries(include_mesh: bool = True) -> List[Finding]:
+    """The full HLO pass over the declared probes: telemetry invariance on
+    every entry, donation on the donated/plain pallas pair, collective
+    budgets on every mesh probe."""
+    from . import entries
+
+    findings: List[Finding] = []
+    singles = {p.name: p for p in entries.single_device_probes()}
+    for probe in singles.values():
+        findings += check_telemetry_invariance(probe)
+    if "pallas_donated" in singles and "pallas" in singles:
+        findings += check_donation(singles["pallas_donated"],
+                                   singles["pallas"])
+    if include_mesh:
+        for probe in entries.mesh_probes():
+            findings += check_collective_budget(probe)
+            findings += check_telemetry_invariance(probe)
+    return findings

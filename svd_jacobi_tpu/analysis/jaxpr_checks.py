@@ -1,0 +1,173 @@
+"""Jaxpr contract checks: traverse the closed jaxprs of the fused entry
+points and fail on structural violations no source linter can see.
+
+Three contracts (codes JAXPR001-003):
+
+  * JAXPR001 — host callbacks present when telemetry is statically off.
+    `obs.metrics.emit` inserts a `debug_callback` primitive into the
+    trace; the telemetry-off program must contain NONE (the byte-identical
+    HLO guarantee starts here). An ungated emit call site — one not behind
+    the static ``telemetry`` flag — shows up as exactly this violation
+    whenever the module enable flag happens to be on at trace time.
+  * JAXPR002 — float-widening `convert_element_type` outside the declared
+    mixed-precision boundaries. The allowed set is
+    `config.MIXED_PRECISION_BOUNDARIES` plus anything no wider than the
+    solve's declared accumulation dtype, promote_types(input, float32) —
+    a silent f32 -> f64 upcast in an f32 solve (the classic Jacobi
+    accuracy-story killer: 2x bytes, no MXU) is the target.
+  * JAXPR003 — host-transfer primitives (callbacks, host-bound
+    device_put) inside `while_loop`/`scan` bodies: a transfer per sweep
+    serializes the fused loop on the host link.
+
+The traversal recurses through every sub-jaxpr (pjit, while, scan, cond
+branches, custom_*, pallas_call kernel bodies), so nothing hides inside
+control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from . import Finding
+from .. import config as _config
+
+# Primitives that call back into the host at runtime.
+HOST_CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "outside_call",
+    "host_callback_call", "debug_print",
+})
+# Primitives that move buffers between memories/hosts.
+TRANSFER_PRIMS = frozenset({"device_put", "copy_to_host_async"})
+# Primitives whose bodies execute repeatedly (per sweep / per round).
+LOOP_PRIMS = frozenset({"while", "scan", "fori_loop"})
+
+
+def _sub_jaxprs(value) -> Iterator:
+    """Yield every (Closed)Jaxpr reachable from an eqn param value."""
+    from jax.core import ClosedJaxpr, Jaxpr
+    if isinstance(value, ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, Jaxpr):
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+    elif isinstance(value, dict):
+        for v in value.values():
+            yield from _sub_jaxprs(v)
+
+
+def iter_eqns(jaxpr, in_loop: bool = False) -> Iterator[Tuple[object, bool]]:
+    """(eqn, inside_loop_body) over the whole jaxpr tree."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        sub_in_loop = in_loop or eqn.primitive.name in LOOP_PRIMS
+        for p in eqn.params.values():
+            for sub in _sub_jaxprs(p):
+                yield from iter_eqns(sub, sub_in_loop)
+
+
+def _float_width(dtype) -> Optional[int]:
+    """Bit width for float dtypes (incl. the ml_dtypes extension floats,
+    whose numpy ``kind`` is not 'f'); None for non-floats."""
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        return None
+    if dt.kind == "f" or "float" in dt.name:
+        return dt.itemsize * 8
+    return None
+
+
+def check_host_callbacks(closed_jaxpr, entry_name: str) -> List[Finding]:
+    """JAXPR001: no host-callback primitive may appear anywhere in a
+    telemetry-off trace. Callers must trace with the telemetry flag off."""
+    findings = []
+    for eqn, _ in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in HOST_CALLBACK_PRIMS:
+            findings.append(Finding(
+                code="JAXPR001", where=entry_name,
+                message=(f"host callback primitive "
+                         f"'{eqn.primitive.name}' in a telemetry-off "
+                         f"trace — the zero-telemetry path must compile "
+                         f"to callback-free HLO"),
+                suggestion=("gate the obs.metrics.emit call site behind "
+                            "the static telemetry flag threaded through "
+                            "the jitted entry point")))
+    return findings
+
+
+def check_dtype_boundaries(closed_jaxpr, entry_name: str,
+                           input_dtype) -> List[Finding]:
+    """JAXPR002: every float-widening convert_element_type must stay within
+    the declared mixed-precision boundaries."""
+    import jax.numpy as jnp
+    findings = []
+    acc_width = _float_width(jnp.promote_types(input_dtype, jnp.float32))
+    allowed = _config.MIXED_PRECISION_BOUNDARIES
+    seen = set()
+    for eqn, _ in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = eqn.invars[0].aval.dtype
+        dst = eqn.outvars[0].aval.dtype
+        sw, dw = _float_width(src), _float_width(dst)
+        if sw is None or dw is None or dw <= sw:
+            continue  # not a float-to-float widening
+        pair = (str(src), str(dst))
+        if pair in allowed or (acc_width is not None and dw <= acc_width):
+            continue
+        if pair in seen:
+            continue
+        seen.add(pair)
+        findings.append(Finding(
+            code="JAXPR002", where=entry_name,
+            message=(f"undeclared float upcast {pair[0]} -> {pair[1]} "
+                     f"(declared accumulation width for a {input_dtype} "
+                     f"solve is {acc_width} bits)"),
+            suggestion=("keep arithmetic at the working dtype, or declare "
+                        "the boundary in "
+                        "config.MIXED_PRECISION_BOUNDARIES")))
+    return findings
+
+
+def check_transfers_in_loops(closed_jaxpr, entry_name: str) -> List[Finding]:
+    """JAXPR003: no transfer/callback primitive inside a loop body."""
+    findings = []
+    for eqn, in_loop in iter_eqns(closed_jaxpr.jaxpr):
+        if not in_loop:
+            continue
+        name = eqn.primitive.name
+        if name in TRANSFER_PRIMS:
+            findings.append(Finding(
+                code="JAXPR003", where=entry_name,
+                message=(f"transfer primitive '{name}' inside a "
+                         f"while_loop/scan body — a host/device hop per "
+                         f"sweep serializes the fused loop"),
+                suggestion=("hoist the transfer out of the loop or keep "
+                            "the value resident on device")))
+    return findings
+
+
+def check_probe(probe, *, telemetry_off: bool = True) -> List[Finding]:
+    """Run every jaxpr pass on one entry probe (telemetry forced off
+    unless the probe has no telemetry flag)."""
+    if telemetry_off and probe.telemetry_key:
+        probe = probe.with_kwargs(**{probe.telemetry_key: False})
+    closed = probe.closed_jaxpr()
+    findings = check_host_callbacks(closed, probe.name)
+    findings += check_dtype_boundaries(closed, probe.name, probe.input_dtype)
+    findings += check_transfers_in_loops(closed, probe.name)
+    return findings
+
+
+def check_default_entries(include_mesh: bool = True) -> List[Finding]:
+    """The pass the CLI and the tier-1 fail-fast hook run: every declared
+    entry probe, telemetry statically off."""
+    from . import entries
+    findings: List[Finding] = []
+    for probe in entries.all_probes(include_mesh=include_mesh):
+        findings += check_probe(probe)
+    return findings

@@ -1,0 +1,172 @@
+"""Retrace budget guard: fail when an entry point compiles more than its
+declared budget across a solve sequence.
+
+The fused solvers are only fast because the whole sweep loop compiles ONCE
+per problem key (shape x dtype x static config). The failure this guard
+exists for: something dynamic leaks into a jit cache key — the Brent-Luk
+round schedule as a fresh array/object per call, an unhashable config
+sneaking into static_argnames, a per-sweep Python value — and every solve
+(or worse, every SWEEP) retraces, turning seconds into minutes without a
+single wrong number. `config.RETRACE_BUDGETS` declares compiles-per-
+distinct-problem (1 everywhere: a repeated solve never retraces); the
+guard measures two ways and cross-checks:
+
+  * per-entry jit cache sizes (`PjitFunction._cache_size`) — exact
+    attribution of which entry grew;
+  * JAX's compilation monitoring stream
+    (`/jax/core/compile/backend_compile_duration` via
+    `jax.monitoring.register_event_duration_secs_listener`) — the global
+    backend-compile count, catching retraces in entries nobody declared.
+
+Usage (also wired as the `-m sanitized` lane's fixture and the CLI pass):
+
+    with RecompileGuard() as guard:
+        guard.expect("solver._svd_pallas", problems=2)
+        for n in (64, 96):
+            svd(matgen.random_dense(n, n))   # first solves: compile
+            svd(matgen.random_dense(n, n))   # repeats: MUST be cache hits
+    findings = guard.check()                 # [] or RETRACE001 findings
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import Finding
+from .. import config as _config
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def default_entries() -> Dict[str, object]:
+    """The declared entry points (keys of config.RETRACE_BUDGETS) resolved
+    to their live jit objects."""
+    from .. import solver
+    from ..parallel import sharded
+    return {
+        "solver._svd_padded": solver._svd_padded,
+        "solver._svd_pallas": solver._svd_pallas,
+        "solver._svd_pallas_donated": solver._svd_pallas_donated,
+        "sharded._svd_sharded_jit": sharded._svd_sharded_jit,
+    }
+
+
+def _cache_size(jit_fn) -> int:
+    try:
+        return int(jit_fn._cache_size())
+    except AttributeError:
+        # Older/newer jax spelling; treat as unobservable rather than
+        # failing the guard itself.
+        return 0
+
+
+class RecompileGuard:
+    """Context manager measuring compiles per entry over its lifetime."""
+
+    def __init__(self, budgets: Optional[Dict[str, int]] = None,
+                 entries: Optional[Dict[str, object]] = None):
+        self.budgets = dict(_config.RETRACE_BUDGETS if budgets is None
+                            else budgets)
+        self.entries = default_entries() if entries is None else dict(entries)
+        self.expected: Dict[str, int] = {}
+        self.backend_compiles = 0
+        self._start: Dict[str, int] = {}
+        self._listening = False
+
+    def expect(self, name: str, problems: int = 1) -> None:
+        """Declare that ``problems`` distinct problem keys will be solved
+        through entry ``name`` inside the guard."""
+        if name not in self.entries:
+            raise KeyError(f"unknown entry {name!r}; known: "
+                           f"{sorted(self.entries)}")
+        self.expected[name] = self.expected.get(name, 0) + int(problems)
+
+    # -- monitoring hook ----------------------------------------------------
+    def _on_duration(self, name: str, duration: float, **kw) -> None:
+        # Gated on _listening: if unregistration is unavailable (private
+        # jax API moved), the still-registered bound method goes inert
+        # instead of mutating an exited guard's counts forever.
+        if self._listening and name == _COMPILE_EVENT:
+            self.backend_compiles += 1
+
+    def __enter__(self) -> "RecompileGuard":
+        import jax.monitoring
+        self._start = {n: _cache_size(f) for n, f in self.entries.items()}
+        jax.monitoring.register_event_duration_secs_listener(
+            self._on_duration)
+        self._listening = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._listening:
+            self._listening = False   # inert even if unregistration fails
+            try:
+                from jax._src import monitoring as _m
+                _m._unregister_event_duration_listener_by_callback(
+                    self._on_duration)
+            except Exception:
+                pass  # listener stays registered but gated off
+
+
+    # -- results ------------------------------------------------------------
+    def new_traces(self) -> Dict[str, int]:
+        """Entry -> cache entries added since __enter__."""
+        return {n: _cache_size(f) - self._start.get(n, 0)
+                for n, f in self.entries.items()}
+
+    def report(self) -> dict:
+        return {"new_traces": self.new_traces(),
+                "backend_compiles": self.backend_compiles,
+                "expected": dict(self.expected)}
+
+    def check(self) -> List[Finding]:
+        """RETRACE001 findings for every entry that out-compiled its
+        budget (declared problems x budget-per-problem)."""
+        findings = []
+        for name, problems in self.expected.items():
+            budget = self.budgets.get(name, 1) * problems
+            got = self.new_traces().get(name, 0)
+            if got > budget:
+                findings.append(Finding(
+                    code="RETRACE001", where=name,
+                    message=(f"entry retraced {got}x for {problems} "
+                             f"distinct problem(s) (budget {budget}) — "
+                             f"something dynamic is in the jit cache key"),
+                    suggestion=("check that every static argument is "
+                                "hashable and value-stable across calls "
+                                "(schedules, configs, tolerances)")))
+        return findings
+
+
+def run_default_sequence() -> tuple:
+    """The CLI's retrace pass: a multi-size, repeated-solve sequence over
+    the single-device entries (and the mesh entry when a mesh exists);
+    every repeat must be a cache hit. Returns (findings, report)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import solver
+    from ..config import SVDConfig
+    from ..utils import matgen
+
+    sizes = (32, 48)
+    pallas_cfg = SVDConfig(pair_solver="pallas", max_sweeps=8)
+    hybrid_cfg = SVDConfig(pair_solver="hybrid", max_sweeps=8)
+    mesh_ok = len(jax.devices()) >= 2
+    with RecompileGuard() as guard:
+        guard.expect("solver._svd_pallas", problems=len(sizes))
+        guard.expect("solver._svd_padded", problems=len(sizes))
+        for n in sizes:
+            a = matgen.random_dense(n, n, seed=n, dtype=jnp.float32)
+            for _ in range(2):  # second pass must not retrace
+                solver.svd(a, config=pallas_cfg)
+                solver.svd(a, config=hybrid_cfg)
+        if mesh_ok:
+            from ..parallel import sharded
+            guard.expect("sharded._svd_sharded_jit", problems=1)
+            am = matgen.random_dense(96, 96, seed=96, dtype=jnp.float32)
+            for _ in range(2):
+                sharded.svd(am, config=SVDConfig(max_sweeps=8))
+        findings = guard.check()
+        report = guard.report()
+    return findings, report

@@ -1,0 +1,54 @@
+"""Runtime sanitizers: JAX's nan/inf debug checks + the transfer guard,
+as one restorable context.
+
+This is the configuration behind the ``-m sanitized`` pytest lane
+(tests/test_sanitized.py) and the CLI's ``--sanitized`` flag:
+
+  * ``jax_debug_nans`` / ``jax_debug_infs`` — recheck jitted outputs for
+    NaN/Inf and re-run de-optimized to locate the producing primitive.
+    The solver's loop carries use +inf SENTINELS (the off-norm comparator
+    inits) deliberately; those live inside the fused loops and never
+    reach jit outputs, so debug_infs stays usable — a regression that
+    leaks a sentinel into a result will trip it.
+  * ``jax_transfer_guard_device_to_host="disallow"`` — implicit
+    device->host transfers inside the guarded region raise. The fused
+    solves keep the matrix resident on device by contract; a mid-solve
+    host read becomes a hard error instead of a silent per-sweep PCIe/ICI
+    round trip. Only the d2h direction is guarded: implicit HOST-to-device
+    transfers are idiomatic JAX (every Python scalar operand of an eager
+    op is one), so guarding them rejects correct library code.
+
+Note the flags are jit-cache-relevant state: entering the context
+retraces the entries it touches (expected; the sanitized lane carries its
+own compile budget).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def sanitized(*, nans: bool = True, infs: bool = True,
+              transfer_guard: str = "disallow"):
+    """Enable the runtime sanitizers, restoring previous state on exit.
+
+    ``transfer_guard`` applies to the device->host direction only (see
+    module docstring); pass "" to disable it.
+    """
+    import jax
+
+    prev_nans = jax.config.jax_debug_nans
+    prev_infs = jax.config.jax_debug_infs
+    stack = contextlib.ExitStack()
+    try:
+        jax.config.update("jax_debug_nans", bool(nans))
+        jax.config.update("jax_debug_infs", bool(infs))
+        if transfer_guard:
+            stack.enter_context(
+                jax.transfer_guard_device_to_host(transfer_guard))
+        yield
+    finally:
+        stack.close()
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_debug_infs", prev_infs)

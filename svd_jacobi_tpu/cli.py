@@ -98,6 +98,12 @@ def _parse_args(argv):
                         "(obs.metrics) of the timed solve into the "
                         "manifest; the solve is retraced with "
                         "jax.debug.callback emission baked in")
+    p.add_argument("--sanitized", action="store_true",
+                   help="run the solves under JAX runtime sanitizers "
+                        "(jax_debug_nans + jax_debug_infs + device-to-host "
+                        "transfer guard — analysis.sanitize, the CI "
+                        "'-m sanitized' lane's configuration); timings are "
+                        "NOT comparable to unsanitized runs")
     return p.parse_args(argv)
 
 
@@ -236,11 +242,26 @@ def main(argv=None) -> int:
         "distributed": bool(mesh),
         "jobu": args.jobu, "jobv": args.jobv,
     }
+    if args.sanitized:
+        extra["sanitized"] = True
     stages = []
+
+    def san_ctx():
+        """Fresh sanitizer context per solve region (self-test, warm-up,
+        timed run) under --sanitized: NaN/Inf screening + the d2h transfer
+        guard, the `-m sanitized` CI lane's configuration. A context per
+        region (not one process-wide stack) so sanitizer state is restored
+        even when the solve raises — which is exactly what the sanitizers
+        are armed to do."""
+        if not args.sanitized:
+            return contextlib.nullcontext()
+        from svd_jacobi_tpu.analysis.sanitize import sanitized
+        return sanitized()
 
     if not args.no_selftest:
         t0 = time.perf_counter()
-        extra["self_test"] = _self_test(args, config, log)
+        with san_ctx():
+            extra["self_test"] = _self_test(args, config, log)
         stages.append({"name": "self_test",
                        "time_s": time.perf_counter() - t0})
 
@@ -263,15 +284,16 @@ def main(argv=None) -> int:
     # --telemetry the warm-up also runs telemetered — the emission sites are
     # part of the jit cache key, so the timed run reuses this compilation.
     t0 = time.perf_counter()
-    with (obs.metrics.capture() if args.telemetry
-          else contextlib.nullcontext([])):
-        _force(tuple(_solve(a, args, config, mesh)[:3]))
+    with san_ctx():
+        with (obs.metrics.capture() if args.telemetry
+              else contextlib.nullcontext([])):
+            _force(tuple(_solve(a, args, config, mesh)[:3]))
     stages.append({"name": "warmup_compile",
                    "time_s": time.perf_counter() - t0})
 
     profile_ctx = (obs.trace(args.profile) if args.profile
                    else contextlib.nullcontext())
-    with profile_ctx:
+    with profile_ctx, san_ctx():
         with (obs.metrics.capture() if args.telemetry
               else contextlib.nullcontext([])) as events:
             # Timed region innermost: trace start/stop (stop serializes

@@ -156,3 +156,86 @@ class SVDConfig:
         while b * 16 <= n and b < 128:
             b *= 2
         return b
+
+
+# ---------------------------------------------------------------------------
+# Declared static-analysis contracts — the machine-checked invariants that
+# `svd_jacobi_tpu.analysis` enforces against the REAL compiled artifacts
+# (jaxprs / lowered StableHLO), not source text. They live here, next to the
+# solver configuration they constrain, so a solver change that moves a
+# boundary has to move the declaration in the same review.
+
+# Float-to-float conversions the solver is ALLOWED to introduce beyond the
+# working dtype's accumulation width. The accumulation contract is
+# promote_types(input_dtype, float32) — bf16 inputs accumulate Gram panels /
+# rotations / postprocessing in f32 (SVDConfig.gram_dtype's default), which
+# is the single declared mixed-precision boundary. Anything ELSE that widens
+# a float (e.g. a silent f32 -> f64 upcast sneaking into an f32 solve — the
+# classic accuracy-story-destroying bug in Jacobi codes) is a contract
+# violation flagged by analysis.jaxpr_checks.check_dtype_boundaries.
+MIXED_PRECISION_BOUNDARIES = frozenset({
+    ("bfloat16", "float32"),
+    ("float16", "float32"),
+})
+
+# Collective budget of the sharded round loop, counted on the LOWERED
+# StableHLO module of `parallel.sharded._svd_sharded_jit` (the shard_map
+# sweep body appears exactly once in the module — scan/while bodies are not
+# unrolled — so a static op count IS the per-sweep budget). Counts are per
+# probe entry (see analysis.entries):
+#   * collective_permute: the tournament ring exchange — 2 hops (one block
+#     right, one left) per stack; the V stacks double it when a factor is
+#     accumulated. The reference moved O(n) columns through rank 0 per
+#     round (lib/JacobiMethods.cu:334-432); 2 hops/stack/round is the
+#     floor, and any regression above it re-introduces transport cost.
+#   * all_reduce: the pmax'd convergence machinery — per sweep-loop body:
+#     dmax2 (1) + sweep-end off-norm (1), plus the kernel path's round-skip
+#     gates (self round 1 + cross round 1; the XLA block solvers have no
+#     skip gate). The hybrid XLA path carries two phase loops (bulk +
+#     polish), so its static per-loop counts appear twice.
+#   * all_gather / all_to_all / reduce_scatter: the sweep loop must never
+#     materialize a gathered matrix — budget zero, always.
+# analysis.hlo_checks.check_collective_budget asserts EXACT equality so a
+# new collective cannot ride in silently.
+COLLECTIVE_BUDGET = {
+    "sharded_pallas": {"collective_permute": 4, "all_reduce": 4,
+                       "all_gather": 0, "all_to_all": 0, "reduce_scatter": 0},
+    "sharded_pallas_novec": {"collective_permute": 2, "all_reduce": 4,
+                             "all_gather": 0, "all_to_all": 0,
+                             "reduce_scatter": 0},
+    "sharded_hybrid": {"collective_permute": 8, "all_reduce": 4,
+                       "all_gather": 0, "all_to_all": 0, "reduce_scatter": 0},
+}
+
+# Compilation budget per fused entry point: how many times an entry may
+# compile per DISTINCT problem key (shape x dtype x static config). 1 means
+# "a repeated solve of the same problem never retraces" — the invariant the
+# Brent-Luk schedule leaking into the jit key would break (a retrace per
+# sweep turns a 2 s solve into minutes). Enforced by
+# analysis.recompile_guard.RecompileGuard over a multi-size sequence.
+RETRACE_BUDGETS = {
+    "solver._svd_padded": 1,
+    "solver._svd_pallas": 1,
+    "solver._svd_pallas_donated": 1,
+    "sharded._svd_sharded_jit": 1,
+}
+
+# PROFILE.md hot-region coverage: every component row of the cost tables
+# must keep its `jax.named_scope` annotation (obs.scopes) so profiler
+# traces stay mappable to the measured numbers. scope name ->
+# (module path relative to the package root, function that must contain
+# `with scope("<name>")`). Enforced by analysis.ast_lint rule GRAFT005.
+HOT_SCOPES = {
+    "gram": ("ops/rounds.py", "self_round"),
+    "rotations": ("ops/rounds.py", "_rotations"),
+    "apply": ("ops/rounds.py", "self_round"),
+    "apply_exchange": ("ops/rounds.py", "cross_round_fused"),
+    "exchange": ("ops/rounds.py", "sweep"),
+    "pair_solve": ("ops/blockwise.py", "orthogonalize_pairs"),
+    "precondition_qr": ("solver.py", "_precondition_qr"),
+    "reconstitute": ("solver.py", "_svd_pallas_impl"),
+    "ns_orthogonalize": ("solver.py", "_ns_orthogonalize"),
+    "postprocess": ("solver.py", "_postprocess"),
+    "sigma_refine": ("solver.py", "_refine_from_work"),
+    "recombine": ("solver.py", "_recombine_precondition"),
+}

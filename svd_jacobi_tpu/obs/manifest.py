@@ -37,10 +37,13 @@ SCHEMA_VERSION = 1
 
 # Required top-level fields and their types. Optional fields are listed
 # with ``None`` allowed. Nested specs: dicts map field -> type-tuple.
+# Records come in two shapes sharing the identity/environment base:
+# solve records ("cli" | "bench") and static-analysis reports ("analysis",
+# written by `python -m svd_jacobi_tpu.analysis`).
 _NUM = (int, float)
-SCHEMA: Dict[str, Any] = {
+_BASE_SCHEMA: Dict[str, Any] = {
     "schema_version": int,
-    "kind": str,                      # "cli" | "bench"
+    "kind": str,                      # "cli" | "bench" | "analysis"
     "timestamp": str,                 # ISO 8601
     "environment": {
         "jax": str,
@@ -50,6 +53,8 @@ SCHEMA: Dict[str, Any] = {
         "device_count": int,
         "process_count": int,
     },
+}
+_SOLVE_SCHEMA: Dict[str, Any] = {
     "dimension": {"m": int, "n": int},
     "dtype": str,
     "config": dict,
@@ -58,10 +63,18 @@ SCHEMA: Dict[str, Any] = {
     "solve": dict,                    # time_s/sweeps/off_norm/residual_rel...
     "telemetry": (list, type(None)),  # obs.metrics events, or None when off
 }
+_ANALYSIS_SCHEMA: Dict[str, Any] = {
+    "passes": list,                   # [{"name", "ok", "findings", "time_s"}]
+    "ok": bool,
+    "findings_total": int,
+}
+# Back-compat name: the solve-record schema as one flat dict.
+SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
 
 _STAGE_FIELDS = {"name": str, "time_s": _NUM}
 _SOLVE_REQUIRED = {"time_s": _NUM, "sweeps": int, "off_norm": _NUM}
 _EVENT_REQUIRED = {"event": str}
+_PASS_FIELDS = {"name": str, "ok": bool, "findings": list, "time_s": _NUM}
 
 
 def environment() -> dict:
@@ -119,6 +132,28 @@ def build(kind: str, *, m: int, n: int, dtype: str, config,
     return record
 
 
+def build_analysis(*, passes: List[dict], **extra) -> dict:
+    """Assemble a schema-valid static-analysis record
+    (`python -m svd_jacobi_tpu.analysis`). ``passes``:
+    [{"name", "ok", "findings": [finding dicts], "time_s"}]; overall
+    ``ok``/``findings_total`` are derived. ``extra`` rides along like in
+    `build`."""
+    passes = [dict(p) for p in passes]
+    total = sum(len(p.get("findings") or []) for p in passes)
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "analysis",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "passes": passes,
+        "ok": all(p.get("ok", False) for p in passes),
+        "findings_total": total,
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def _check(cond: bool, errors: List[str], msg: str) -> None:
     if not cond:
         errors.append(msg)
@@ -145,20 +180,26 @@ def validate(record: dict) -> None:
     _check(isinstance(record, dict), errors, "record: not an object")
     if not isinstance(record, dict):
         raise ValueError("; ".join(errors))
-    _check_fields(record, SCHEMA, "record", errors)
+    _check_fields(record, _BASE_SCHEMA, "record", errors)
     if record.get("schema_version") not in (None, SCHEMA_VERSION):
         errors.append(f"record.schema_version: {record['schema_version']} "
                       f"!= supported {SCHEMA_VERSION}")
-    for i, st in enumerate(record.get("stages") or []):
-        _check_fields(st, _STAGE_FIELDS, f"record.stages[{i}]", errors)
-    if isinstance(record.get("solve"), dict):
-        _check_fields(record["solve"], _SOLVE_REQUIRED, "record.solve",
-                      errors)
-    tel = record.get("telemetry")
-    if tel is not None:
-        for i, ev in enumerate(tel):
-            _check_fields(ev, _EVENT_REQUIRED, f"record.telemetry[{i}]",
+    if record.get("kind") == "analysis":
+        _check_fields(record, _ANALYSIS_SCHEMA, "record", errors)
+        for i, p in enumerate(record.get("passes") or []):
+            _check_fields(p, _PASS_FIELDS, f"record.passes[{i}]", errors)
+    else:
+        _check_fields(record, _SOLVE_SCHEMA, "record", errors)
+        for i, st in enumerate(record.get("stages") or []):
+            _check_fields(st, _STAGE_FIELDS, f"record.stages[{i}]", errors)
+        if isinstance(record.get("solve"), dict):
+            _check_fields(record["solve"], _SOLVE_REQUIRED, "record.solve",
                           errors)
+        tel = record.get("telemetry")
+        if tel is not None:
+            for i, ev in enumerate(tel):
+                _check_fields(ev, _EVENT_REQUIRED, f"record.telemetry[{i}]",
+                              errors)
     if errors:
         raise ValueError("invalid manifest record: " + "; ".join(errors))
 
@@ -186,6 +227,21 @@ def load(path) -> List[dict]:
 
 def summarize(record: dict) -> str:
     """One human-readable block per record (telemetry_summary's renderer)."""
+    if record.get("kind") == "analysis":
+        env = record.get("environment", {})
+        lines = [
+            f"analysis run @ {record.get('timestamp', '?')}  "
+            f"backend={env.get('backend')} "
+            f"({env.get('device_count')}x {env.get('device_kind')})",
+        ]
+        for p in record.get("passes") or []:
+            n = len(p.get("findings") or [])
+            lines.append(f"  pass {p.get('name', '?'):<10} "
+                        f"{'ok' if p.get('ok') else 'FAIL':<4} "
+                        f"{n} finding(s)  {p.get('time_s', 0.0):7.2f} s")
+        lines.append(f"  overall: {'ok' if record.get('ok') else 'FAIL'} "
+                     f"({record.get('findings_total', 0)} findings)")
+        return "\n".join(lines)
     dim = record.get("dimension", {})
     env = record.get("environment", {})
     solve = record.get("solve", {})

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import _compat
+from ..obs.scopes import scope
 
 
 def _precision(name: str) -> jax.lax.Precision:
@@ -331,16 +332,19 @@ def orthogonalize_pairs(
         # nothing and the with_v=False branch never touches them.
         vtop = jnp.zeros((top.shape[0], 0, top.shape[2]), top.dtype)
         vbot = vtop
-    new_top, new_bot, new_vtop, new_vbot, max_rel, off2 = _orthogonalize_pairs_impl(
-        top, bot, vtop, vbot,
-        precision=precision,
-        gram_dtype_name=jnp.dtype(gram_dtype).name,
-        with_v=with_v,
-        method=method,
-        dmax2=dmax2,
-        criterion=criterion,
-        axis_name=axis_name,
-    )
+    # svdj/pair_solve: the XLA block-solver hot region of the PROFILE.md
+    # component map (obs/scopes.py) — coverage enforced by GRAFT005.
+    with scope("pair_solve"):
+        new_top, new_bot, new_vtop, new_vbot, max_rel, off2 = _orthogonalize_pairs_impl(
+            top, bot, vtop, vbot,
+            precision=precision,
+            gram_dtype_name=jnp.dtype(gram_dtype).name,
+            with_v=with_v,
+            method=method,
+            dmax2=dmax2,
+            criterion=criterion,
+            axis_name=axis_name,
+        )
     if not with_v:
         new_vtop = new_vbot = None
     return new_top, new_bot, new_vtop, new_vbot, max_rel, off2

@@ -330,8 +330,23 @@ def svd(
 
     if mesh is None:
         mesh = make_mesh()
+    kwargs = _plan_entry(a, mesh, config, compute_u=compute_u,
+                         compute_v=compute_v, full_matrices=full_matrices)
+    u, s, v, sweeps, off_rel = _svd_sharded_jit(a, **kwargs)
+    return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+
+
+def _plan_entry(a, mesh: Mesh, config: SVDConfig, *, compute_u: bool = True,
+                compute_v: bool = True, full_matrices: bool = False) -> dict:
+    """Resolve the kwargs of the ONE fused mesh entry point
+    (`_svd_sharded_jit(a, **kwargs)`) for this (input, mesh, config) —
+    exactly the call `svd()` makes. Shared with `svd_jacobi_tpu.analysis`
+    (entries.py): the collective-budget and telemetry-equivalence HLO
+    passes must lower the very program production dispatches, geometry
+    fix-ups (even-b kernel adjustment, per-device pair slots) included."""
     (axis_name,) = mesh.axis_names
     n_devices = mesh.size
+    n = a.shape[1]
     b, k = _single._plan(n, n_devices, config)
     tol, gram_dtype_name, method, criterion = _single._resolve_options(
         a, config, compute_uv=compute_u)
@@ -355,8 +370,8 @@ def svd(
 
     refine = (config.sigma_refine if config.sigma_refine is not None
               else (compute_u or compute_v))
-    u, s, v, sweeps, off_rel = _svd_sharded_jit(
-        a, mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
+    return dict(
+        mesh=mesh, axis_name=axis_name, n=n, n_pad=n_pad, nblocks=2 * k,
         n_devices=n_devices, compute_u=compute_u, compute_v=compute_v,
         full_u=full_matrices, tol=tol, max_sweeps=int(config.max_sweeps),
         precision=config.matmul_precision,
@@ -365,7 +380,6 @@ def svd(
         stall_detection=bool(config.stall_detection),
         kernel_polish=bool(config.kernel_polish),
         telemetry=bool(metrics.enabled()))
-    return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
 @partial(jax.jit, static_argnames=(

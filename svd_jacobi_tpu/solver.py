@@ -176,13 +176,12 @@ def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
 _global_dmax2 = rounds._global_dmax2
 
 
-def _host_scalar(x) -> float:
-    """Host value of a scalar that may be replicated over a multi-host
-    mesh (float()/np.asarray raise on non-fully-addressable arrays even
-    when every shard holds the same value)."""
-    if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        return float(np.asarray(x.addressable_shards[0].data))
-    return float(x)
+# THE sanctioned host read for (possibly mesh-replicated) device scalars —
+# one definition in utils/_exec.host_scalar, shared with utils.checkpoint
+# and the multi-process test worker; the ad-hoc addressable_shards[0]
+# pattern that used to live here is what analysis.ast_lint's GRAFT001 rule
+# now rejects.
+from .utils._exec import host_scalar as _host_scalar  # noqa: E402
 
 
 def _blockify(a: jax.Array, n_pad: int, nblocks: int):
@@ -673,38 +672,19 @@ _svd_pallas_donated = partial(jax.jit, static_argnames=_PALLAS_STATIC,
                               donate_argnums=(0,))(_svd_pallas_impl)
 
 
-def svd(
-    a,
-    *,
-    compute_u: bool = True,
-    compute_v: bool = True,
-    full_matrices: bool = False,
-    config: SVDConfig | None = None,
-) -> SVDResult:
-    """One-sided block-Jacobi SVD: ``a = u @ diag(s) @ v.T``.
-
-    Args:
-      a: (m, n) real matrix (any m/n; wide matrices are handled by solving
-        the transpose and swapping factors).
-      compute_u / compute_v: LAPACK-style job options — see lapack.gesvd for
-        the SVD_OPTIONS surface matching lib/JacobiMethods.cuh:25-29.
-      full_matrices: return U as (m, m) instead of economy (m, min(m, n)).
-      config: solver configuration (block size, tolerance, sweeps, dtypes).
-
-    Returns:
-      SVDResult(u, s, v, sweeps, off_rel) with s descending.
+def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
+                compute_v: bool = True, full_matrices: bool = False):
+    """Resolve the fused jitted entry point a (input, config) pair
+    dispatches to: ``(entry_name, jit_fn, prepared_input, kwargs)`` with
+    ``entry_name`` in ``("pallas", "padded")`` and
+    ``jit_fn(prepared_input, **kwargs)`` being exactly the call `svd()`
+    makes. This is the ONE place the jit-call contract is built — shared
+    with `svd_jacobi_tpu.analysis` (entries.py), whose jaxpr/HLO passes
+    must probe the very programs production dispatches, not hand-rebuilt
+    approximations that drift. Raises the same option-validation errors as
+    `svd()`; requires m >= n (`svd()` transposes wide inputs first).
     """
-    if config is None:
-        config = SVDConfig()
-    a = jnp.asarray(a)
-    if a.ndim != 2:
-        raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
     m, n = a.shape
-    if m < n:
-        r = svd(a.T, compute_u=compute_v, compute_v=compute_u,
-                full_matrices=full_matrices, config=config)
-        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps, off_rel=r.off_rel)
-
     b, k = _plan(n, 1, config)
     n_pad = 2 * k * b
     tol, gram_dtype_name, method, criterion = _resolve_options(
@@ -756,8 +736,8 @@ def svd(
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
         solve = _svd_pallas_donated if config.donate_input else _svd_pallas
-        u, s, v, sweeps, off_rel = solve(
-            a, n=n, compute_u=compute_u, compute_v=compute_v,
+        kwargs = dict(
+            n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
             max_sweeps=int(config.max_sweeps), precondition=precondition,
             polish=bool(config.kernel_polish), bulk_bf16=bool(bulk_bf16),
@@ -765,7 +745,7 @@ def svd(
             interpret=not pb.supported(),
             stall_detection=bool(config.stall_detection),
             refine=bool(refine), telemetry=bool(metrics.enabled()))
-        return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+        return "pallas", solve, a, kwargs
 
     if config.precondition in ("on", "double") or config.mixed_bulk:
         # Pallas-only modes explicitly requested on an XLA block-solver
@@ -779,22 +759,62 @@ def svd(
             f"(pair_solver='pallas'/'auto'); this solve resolved to "
             f"pair_solver={method!r}")
     a_pad = jnp.pad(a, ((0, 0), (0, n_pad - n))) if n_pad != n else a
-    u, s, v, sweeps, off_rel = _svd_padded(
-        a_pad, n=n, compute_u=compute_u, compute_v=compute_v,
+    kwargs = dict(
+        n=n, compute_u=compute_u, compute_v=compute_v,
         full_u=full_matrices, nblocks=2 * k, tol=tol,
         max_sweeps=int(config.max_sweeps), precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
         stall_detection=bool(config.stall_detection),
         telemetry=bool(metrics.enabled()))
-    refine = (config.sigma_refine if config.sigma_refine is not None
-              else (u is not None or v is not None))
-    if refine and (u is not None or v is not None):
-        # Parity with the Pallas path and the mesh solver: the XLA block
-        # solvers run on A directly, so the working matrix IS a.
-        u, s, v = _refine_xla_jit(a, u, s, v, n=n,
-                                  with_u=u is not None,
-                                  with_v=v is not None,
-                                  full_u=bool(full_matrices))
+    return "padded", _svd_padded, a_pad, kwargs
+
+
+def svd(
+    a,
+    *,
+    compute_u: bool = True,
+    compute_v: bool = True,
+    full_matrices: bool = False,
+    config: SVDConfig | None = None,
+) -> SVDResult:
+    """One-sided block-Jacobi SVD: ``a = u @ diag(s) @ v.T``.
+
+    Args:
+      a: (m, n) real matrix (any m/n; wide matrices are handled by solving
+        the transpose and swapping factors).
+      compute_u / compute_v: LAPACK-style job options — see lapack.gesvd for
+        the SVD_OPTIONS surface matching lib/JacobiMethods.cuh:25-29.
+      full_matrices: return U as (m, m) instead of economy (m, min(m, n)).
+      config: solver configuration (block size, tolerance, sweeps, dtypes).
+
+    Returns:
+      SVDResult(u, s, v, sweeps, off_rel) with s descending.
+    """
+    if config is None:
+        config = SVDConfig()
+    a = jnp.asarray(a)
+    if a.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+    m, n = a.shape
+    if m < n:
+        r = svd(a.T, compute_u=compute_v, compute_v=compute_u,
+                full_matrices=full_matrices, config=config)
+        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps, off_rel=r.off_rel)
+
+    entry, solve, a_in, kwargs = _plan_entry(
+        a, config, compute_u=compute_u, compute_v=compute_v,
+        full_matrices=full_matrices)
+    u, s, v, sweeps, off_rel = solve(a_in, **kwargs)
+    if entry == "padded":
+        refine = (config.sigma_refine if config.sigma_refine is not None
+                  else (u is not None or v is not None))
+        if refine and (u is not None or v is not None):
+            # Parity with the Pallas path and the mesh solver: the XLA
+            # block solvers run on A directly, so the working matrix IS a.
+            u, s, v = _refine_xla_jit(a, u, s, v, n=n,
+                                      with_u=u is not None,
+                                      with_v=v is not None,
+                                      full_u=bool(full_matrices))
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
 
 
@@ -978,7 +998,10 @@ class SweepStepper:
             import hashlib
             h = hashlib.sha256()
             if isinstance(self.a, jax.Array) and not self.a.is_fully_addressable:
-                shards = sorted(self.a.addressable_shards,
+                # Deliberate per-shard host read: the digest hashes the
+                # bytes this process can see (documented above); not a
+                # scalar readback, so _exec.host_scalar does not apply.
+                shards = sorted(self.a.addressable_shards,  # graftcheck: ok GRAFT001
                                 key=lambda s: str(s.index))
                 for sh in shards:
                     h.update(str(sh.index).encode())

@@ -19,6 +19,41 @@ def force(tree) -> float:
     return float(np.asarray(sum(jnp.sum(x) for x in leaves)))
 
 
+def host_scalar(x) -> float:
+    """THE sanctioned device->host scalar read for library code.
+
+    `float(x)` / `np.asarray(x)` on a `jax.Array` is a host sync, and on a
+    multi-host mesh it simply raises for non-fully-addressable arrays even
+    when every shard holds the same replicated value (pmax'd convergence
+    statistics, sweep counters). Reading a scalar correctly therefore needs
+    three cases, and scattering them across call sites is how
+    solver.py grew its ad-hoc `addressable_shards[0]` pattern — so they
+    live here once (the GRAFT001 lint points violators at this helper):
+
+      * plain Python/numpy scalars and fully-addressable arrays: `float()`;
+      * non-fully-addressable arrays with local shards: read this
+        process's first addressable shard (replicated by contract — the
+        caller must only pass mesh-replicated scalars, e.g. `P()` outputs);
+      * non-fully-addressable arrays with NO local shard (a coordinator
+        process outside the mesh, or an empty-shard process of an uneven
+        assignment): there is nothing to read locally — raise a diagnosable
+        error naming the fix instead of an opaque runtime failure.
+    """
+    import jax
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        shards = x.addressable_shards
+        if not shards:
+            raise RuntimeError(
+                "host_scalar: array owns no addressable shard on this "
+                "process, so its value cannot be read here. Replicate the "
+                "scalar across the mesh (shard_map out_specs=P()) or "
+                "gather it explicitly with "
+                "jax.experimental.multihost_utils.process_allgather before "
+                "reading.")
+        return float(np.asarray(shards[0].data))
+    return float(x)
+
+
 def probe_devices(timeout: float):
     """(devices, error) — `jax.devices()` behind a deadline.
 

@@ -22,6 +22,29 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_sessionstart(session):
+    """graftcheck fail-fast: run the cheap static passes (AST lint +
+    jaxpr contract checks over every entry point, mesh included — the
+    conftest backend already has 8 virtual devices) BEFORE any test, so a
+    contract violation aborts the tier-1 session in seconds instead of
+    surfacing as a mysterious failure 140 tests in. The HLO/recompile
+    passes run as ordinary tests (tests/test_analysis.py) and via
+    `python -m svd_jacobi_tpu.analysis`. Escape hatch (debugging the
+    analyzer itself): SVDJ_SKIP_GRAFTCHECK=1.
+    """
+    if os.environ.get("SVDJ_SKIP_GRAFTCHECK"):
+        return
+    from svd_jacobi_tpu.analysis import ast_lint, jaxpr_checks, render_findings
+    findings = ast_lint.lint_package()
+    findings += jaxpr_checks.check_default_entries(include_mesh=True)
+    if findings:
+        raise pytest.UsageError(render_findings(
+            findings,
+            header=(f"graftcheck: {len(findings)} contract violation(s) — "
+                    f"failing fast before the test run "
+                    f"(SVDJ_SKIP_GRAFTCHECK=1 to bypass):")))
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     assert len(jax.devices()) == 8

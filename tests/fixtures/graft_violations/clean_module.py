@@ -1,0 +1,29 @@
+"""Control fixture: idiomatic traced library code — zero findings even
+with the traced-module rules forced on."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from svd_jacobi_tpu.utils._exec import host_scalar
+
+
+@partial(jax.jit, static_argnames=("with_v",))
+def sweep_like(x, *, with_v=True):
+    y = jnp.dot(x, x.T)
+    if with_v:                            # static: fine
+        y = y + jnp.eye(y.shape[0], dtype=y.dtype)
+    m, n = y.shape                        # metadata: fine
+    if m > n:                             # host ints: fine
+        y = y.T
+    return jax.lax.cond(jnp.max(y) > 0, lambda v: v, lambda v: -v, y)
+
+
+def host_side_read(state):
+    # The sanctioned scalar readback.
+    return host_scalar(state)
+
+
+def eps_of(dtype):
+    return float(jnp.finfo(dtype).eps)    # metadata fn: fine

@@ -1,0 +1,33 @@
+"""Seeded GRAFT001 violations: host materialization of traced values.
+
+Never imported by the package — parsed by tests/test_analysis.py to prove
+the rule fires. Expected findings: float() on a traced value, np.asarray()
+on a traced value, .item(), and the ad-hoc .addressable_shards poke
+(the solver.py:184 pattern that utils._exec.host_scalar replaced).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_float_cast(x):
+    y = jnp.sum(x * x)
+    return float(y)                      # GRAFT001
+
+
+def bad_np_materialize(x):
+    g = jnp.dot(x, x)
+    return np.asarray(g)                 # GRAFT001
+
+
+def bad_item(x):
+    return x.item()                      # GRAFT001
+
+
+def bad_shard_poke(arr):
+    return float(np.asarray(arr.addressable_shards[0].data))  # GRAFT001
+
+
+def suppressed_cast(x):
+    y = jnp.max(x)
+    return float(y)  # graftcheck: ok GRAFT001

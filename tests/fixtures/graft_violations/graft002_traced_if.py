@@ -1,0 +1,25 @@
+"""Seeded GRAFT002 violations: Python control flow on traced booleans."""
+
+import jax.numpy as jnp
+
+
+def bad_if(x):
+    coupling = jnp.max(jnp.abs(x))
+    if coupling > 1e-6:                  # GRAFT002
+        return x * 2
+    return x
+
+
+def bad_while(x):
+    off = jnp.sum(x)
+    while off > 0:                       # GRAFT002
+        off = off - 1
+    return off
+
+
+def ok_structure_dispatch(v):
+    # `is None` on a maybe-tracer is static structure, not a traced bool.
+    z = jnp.zeros(()) if v is None else v
+    if v is None:
+        return z
+    return v

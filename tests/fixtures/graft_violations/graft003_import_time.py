@@ -1,0 +1,17 @@
+"""Seeded GRAFT003 violation: jax.numpy computation at module import."""
+
+import jax.numpy as jnp
+
+EYE = jnp.eye(8)                         # GRAFT003
+
+
+class Holder:
+    TABLE = jnp.arange(16)               # GRAFT003 (class body runs at import)
+
+
+def fine():
+    return jnp.ones(4)                   # inside a function: not flagged
+
+
+if __name__ == "__main__":
+    print(jnp.zeros(2))                  # __main__ guard: not flagged

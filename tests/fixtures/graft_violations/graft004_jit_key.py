@@ -1,0 +1,27 @@
+"""Seeded GRAFT004 violations: jit cache-key hygiene."""
+
+from functools import partial
+
+import jax
+
+_STATICS = ("mode", "missing_name")
+
+
+@partial(jax.jit, static_argnames=("schedule", "ghost"))
+def bad_static_default(x, schedule=[0, 1, 2], *, ghost_typo=None):
+    # "schedule" defaults to an UNHASHABLE list (raises at call time);
+    # "ghost" names no parameter (silently traced -> retrace per value).
+    return x
+
+
+@partial(jax.jit, static_argnames=_STATICS)
+def bad_via_module_const(x, mode="fast"):
+    # _STATICS resolves to ("mode", "missing_name"): the second is absent.
+    return x
+
+
+def _impl(y, *, width=4):
+    return y * width
+
+
+good_wrapped = partial(jax.jit, static_argnames=("width",))(_impl)

@@ -1,0 +1,19 @@
+"""Seeded GRAFT005 violation: a declared hot region with no named scope.
+
+tests/test_analysis.py checks it against the contract map
+{"gram": ("graft005_missing_scope.py", "hot_gram_panel")}: `hot_gram_panel`
+lost its scope annotation (caught); `covered_fn` keeps one (clean).
+"""
+
+import jax.numpy as jnp
+
+from svd_jacobi_tpu.obs.scopes import scope
+
+
+def hot_gram_panel(x):
+    return jnp.einsum("kmi,kmj->kij", x, x)   # no scope("gram"): GRAFT005
+
+
+def covered_fn(x):
+    with scope("rotations"):
+        return x * 2

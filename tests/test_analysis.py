@@ -1,0 +1,343 @@
+"""The analyzer analyzed: every graftcheck pass must (a) report ZERO
+findings on the real package and (b) demonstrably catch its seeded
+violation — a fixture corpus for the AST rules
+(tests/fixtures/graft_violations/), constructed bad programs for the
+jaxpr/HLO/retrace passes. A checker that cannot fail its fixture is
+decoration, not CI.
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu import config as sj_config
+from svd_jacobi_tpu.analysis import (Finding, ast_lint, entries, hlo_checks,
+                                     jaxpr_checks, recompile_guard)
+from svd_jacobi_tpu.obs import manifest, metrics
+
+FIXTURES = Path(__file__).parent / "fixtures" / "graft_violations"
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# AST lint: corpus caught, package clean.
+
+
+class TestAstLintCorpus:
+    @pytest.mark.parametrize("fixture,code,min_hits", [
+        ("graft001_host_cast.py", "GRAFT001", 4),
+        ("graft002_traced_if.py", "GRAFT002", 2),
+        ("graft003_import_time.py", "GRAFT003", 2),
+        ("graft004_jit_key.py", "GRAFT004", 3),
+    ])
+    def test_seeded_violation_caught(self, fixture, code, min_hits):
+        findings = ast_lint.lint_file(FIXTURES / fixture, rel=fixture,
+                                      traced=True)
+        hits = [f for f in findings if f.code == code]
+        assert len(hits) >= min_hits, findings
+        # ... and ONLY the seeded rule fires (no false positives from the
+        # other rules on the same file).
+        assert _codes(findings) == [code]
+
+    def test_graft005_missing_scope_caught(self):
+        findings = ast_lint.check_scope_coverage(
+            {"gram": ("graft005_missing_scope.py", "hot_gram_panel"),
+             "rotations": ("graft005_missing_scope.py", "covered_fn")},
+            root=FIXTURES)
+        assert _codes(findings) == ["GRAFT005"]
+        assert "hot_gram_panel" in findings[0].message
+
+    def test_graft001_suggests_host_scalar(self):
+        findings = ast_lint.lint_file(FIXTURES / "graft001_host_cast.py",
+                                      rel="x.py", traced=True)
+        shard = [f for f in findings if "addressable_shards" in f.message]
+        assert shard and "host_scalar" in shard[0].suggestion
+
+    def test_pragma_suppresses(self):
+        findings = ast_lint.lint_file(FIXTURES / "graft001_host_cast.py",
+                                      rel="x.py", traced=True)
+        lines = {f.where for f in findings}
+        # suppressed_cast's float() is pragma'd away: its line absent.
+        src = (FIXTURES / "graft001_host_cast.py").read_text().splitlines()
+        pragma_line = next(i + 1 for i, l in enumerate(src)
+                           if "graftcheck: ok" in l)
+        assert f"x.py:{pragma_line}" not in lines
+
+    def test_clean_control_fixture(self):
+        findings = ast_lint.lint_file(FIXTURES / "clean_module.py",
+                                      rel="clean.py", traced=True)
+        assert findings == []
+
+    def test_real_package_lints_clean(self):
+        assert ast_lint.lint_package() == []
+
+    def test_hot_scope_contract_is_current(self):
+        # Every declared hot region resolves and is covered (a refactor
+        # that renames a hot function must update config.HOT_SCOPES).
+        assert ast_lint.check_scope_coverage() == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr checks: entries clean, seeded violations caught.
+
+
+class TestJaxprChecks:
+    def test_default_entries_clean(self):
+        assert jaxpr_checks.check_default_entries(include_mesh=False) == []
+
+    def test_mesh_entries_clean(self, eight_devices):
+        probes = entries.mesh_probes()
+        assert probes, "mesh probes missing on the 8-device backend"
+        findings = []
+        for p in probes:
+            findings += jaxpr_checks.check_probe(p)
+        assert findings == []
+
+    def test_ungated_emit_is_flagged_when_statically_off(self):
+        """Satellite guard: an emit call site NOT behind the static
+        telemetry flag becomes a callback in the telemetry-off trace the
+        moment the module flag is on — JAXPR001 catches exactly that."""
+        def leaky(x):  # an "entry" whose emit forgot its static gate
+            metrics.emit("sweep", off_rel=jnp.max(x))
+            return x * 2
+
+        prev = metrics.enabled()
+        try:
+            metrics.enable()
+            closed = jax.make_jaxpr(leaky)(jnp.ones(4))
+        finally:
+            if not prev:
+                metrics.disable()
+        findings = jaxpr_checks.check_host_callbacks(closed, "leaky")
+        assert _codes(findings) == ["JAXPR001"]
+        assert "debug_callback" in findings[0].message
+
+    def test_ungated_emit_module_flag_off_is_noop(self):
+        """With the module flag off an ungated emit is a no-op: nothing
+        in the trace, nothing delivered to sinks."""
+        assert not metrics.enabled()
+        hits = []
+        remove = metrics.add_sink(hits.append)
+        try:
+            def leaky(x):
+                metrics.emit("sweep", off_rel=jnp.max(x))
+                return x * 2
+            closed = jax.make_jaxpr(leaky)(jnp.ones(4))
+            assert jaxpr_checks.check_host_callbacks(closed, "leaky") == []
+            jax.jit(leaky)(jnp.ones(4))
+            metrics.flush()
+        finally:
+            remove()
+        assert hits == []
+
+    def test_undeclared_upcast_caught(self):
+        def sneaky(x):
+            # f32 solve silently widening to f64: the classic violation.
+            return jnp.sum(x.astype(jnp.float64))
+
+        closed = jax.make_jaxpr(sneaky)(jnp.ones(4, jnp.float32))
+        findings = jaxpr_checks.check_dtype_boundaries(
+            closed, "sneaky", jnp.float32)
+        assert _codes(findings) == ["JAXPR002"]
+        assert "float64" in findings[0].message
+
+    def test_declared_boundary_allowed(self):
+        def mixed(x):
+            return jnp.sum(x.astype(jnp.float32))  # bf16 -> f32: declared
+
+        closed = jax.make_jaxpr(mixed)(jnp.ones(4, jnp.bfloat16))
+        assert jaxpr_checks.check_dtype_boundaries(
+            closed, "mixed", jnp.bfloat16) == []
+
+    def test_callback_inside_loop_caught(self):
+        def loopy(x):
+            def body(_, c):
+                jax.debug.callback(lambda v: None, jnp.max(c))
+                return c * 0.5
+            return jax.lax.fori_loop(0, 4, body, x)
+
+        closed = jax.make_jaxpr(loopy)(jnp.ones(4))
+        findings = jaxpr_checks.check_host_callbacks(closed, "loopy")
+        assert "JAXPR001" in _codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# HLO checks: budgets, donation, telemetry invariance.
+
+
+class TestHloChecks:
+    def test_collective_budget_matches_declaration(self, eight_devices):
+        for probe in entries.mesh_probes():
+            assert hlo_checks.check_collective_budget(probe) == [], probe.name
+
+    def test_collective_budget_violation_detected(self, eight_devices):
+        probe = entries.mesh_probes()[0]
+        tampered = dict(sj_config.COLLECTIVE_BUDGET[probe.name])
+        tampered["all_gather"] = 3       # declare gathers that don't exist
+        findings = hlo_checks.check_collective_budget(probe, tampered)
+        assert _codes(findings) == ["HLO001"]
+
+    def test_undeclared_entry_flagged(self, eight_devices):
+        probe = entries.mesh_probes()[0]
+        import dataclasses
+        unknown = dataclasses.replace(probe, name="never_declared")
+        findings = hlo_checks.check_collective_budget(unknown)
+        assert _codes(findings) == ["HLO001"]
+        assert "declare" in findings[0].message
+
+    def test_donation_survives(self):
+        singles = {p.name: p for p in entries.single_device_probes()}
+        assert hlo_checks.check_donation(
+            singles["pallas_donated"], singles["pallas"]) == []
+
+    def test_missing_donation_detected(self):
+        singles = {p.name: p for p in entries.single_device_probes()}
+        # Swap: the undonated entry presented as the donated one.
+        findings = hlo_checks.check_donation(
+            singles["pallas"], singles["pallas_donated"])
+        codes = _codes(findings)
+        assert codes == ["HLO002"] and len(findings) == 2
+
+    def test_telemetry_invariance_all_entries(self):
+        for probe in entries.single_device_probes():
+            assert hlo_checks.check_telemetry_invariance(probe) == [], \
+                probe.name
+
+    def test_telemetry_invariance_mesh(self, eight_devices):
+        probe = entries.mesh_probes()[0]
+        assert hlo_checks.check_telemetry_invariance(probe) == []
+
+    def test_dead_telemetry_flag_detected(self):
+        """An entry that ignores its telemetry flag must be flagged."""
+        import dataclasses
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("telemetry",))
+        def dead_flag(x, *, telemetry=False):
+            return x * 2  # flag unused: on == off
+
+        probe = entries.EntryProbe(
+            name="dead", fn=dead_flag, args=(jnp.ones(4),),
+            kwargs={"telemetry": False})
+        findings = hlo_checks.check_telemetry_invariance(probe)
+        assert _codes(findings) == ["HLO003"]
+        assert "dead" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Recompile guard.
+
+
+class TestRecompileGuard:
+    def test_repeat_solves_do_not_retrace(self):
+        from svd_jacobi_tpu.utils import matgen
+        cfg = SVDConfig(pair_solver="pallas", max_sweeps=8)
+        a = matgen.random_dense(32, 32, seed=0, dtype=jnp.float32)
+        sj.svd(a, config=cfg)                    # warm outside the guard
+        with recompile_guard.RecompileGuard() as guard:
+            guard.expect("solver._svd_pallas", problems=0)
+            for _ in range(3):
+                sj.svd(a, config=cfg)            # identical problem key
+            findings = guard.check()
+        assert findings == []
+        assert guard.new_traces()["solver._svd_pallas"] == 0
+
+    def test_seeded_retrace_caught(self):
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def leaky_key(x, *, k):
+            return x * k
+
+        with recompile_guard.RecompileGuard(
+                budgets={"leaky": 1}, entries={"leaky": leaky_key}) as guard:
+            guard.expect("leaky", problems=1)    # ONE problem declared...
+            for k in range(4):                   # ...but the key churns
+                leaky_key(jnp.ones(4), k=k)
+            findings = guard.check()
+        assert _codes(findings) == ["RETRACE001"]
+        assert guard.new_traces()["leaky"] == 4
+
+    def test_monitoring_hook_counts_compiles(self):
+        @jax.jit
+        def fresh(x):
+            return x + 1
+
+        with recompile_guard.RecompileGuard(entries={}) as guard:
+            fresh(jnp.ones(7))
+        assert guard.backend_compiles >= 1
+
+    def test_expect_unknown_entry_raises(self):
+        with pytest.raises(KeyError):
+            recompile_guard.RecompileGuard().expect("no_such_entry")
+
+
+# ---------------------------------------------------------------------------
+# Report plumbing: manifest records, CLI smoke.
+
+
+class TestAnalysisReport:
+    def test_manifest_round_trip(self, tmp_path):
+        f = Finding(code="GRAFT001", where="x.py:3", message="m",
+                    suggestion="s")
+        rec = manifest.build_analysis(passes=[
+            {"name": "ast", "ok": False, "findings": [f.as_dict()],
+             "time_s": 0.1},
+            {"name": "jaxpr", "ok": True, "findings": [], "time_s": 0.2},
+        ])
+        assert rec["ok"] is False and rec["findings_total"] == 1
+        path = tmp_path / "m.jsonl"
+        manifest.append(path, rec)
+        loaded = manifest.load(path)[0]
+        manifest.validate(loaded)
+        assert loaded["passes"][0]["findings"][0]["code"] == "GRAFT001"
+        assert "analysis" in manifest.summarize(loaded)
+
+    def test_validate_rejects_malformed_pass(self):
+        rec = manifest.build_analysis(passes=[
+            {"name": "ast", "ok": True, "findings": [], "time_s": 0.0}])
+        rec["passes"][0].pop("ok")
+        with pytest.raises(ValueError, match="passes"):
+            manifest.validate(rec)
+
+    def test_cli_fast_passes_exit_zero(self, tmp_path):
+        import os
+        import subprocess
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, "-m", "svd_jacobi_tpu.analysis",
+             "--passes", "ast,jaxpr", "--report-dir", str(tmp_path)],
+            capture_output=True, text=True, env=env,
+            cwd=Path(__file__).parent.parent, timeout=600)
+        assert p.returncode == 0, p.stderr[-800:]
+        rec = manifest.load(tmp_path / "manifest.jsonl")[0]
+        manifest.validate(rec)
+        assert rec["kind"] == "analysis" and rec["ok"] is True
+
+
+@pytest.mark.slow
+def test_cli_all_passes_exit_zero(tmp_path):
+    """The acceptance criterion end-to-end: the full graftcheck CLI is
+    clean on the repo (slow lane: compiles the mesh entries)."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, "-m", "svd_jacobi_tpu.analysis",
+         "--report-dir", str(tmp_path)],
+        capture_output=True, text=True, env=env,
+        cwd=Path(__file__).parent.parent, timeout=600)
+    assert p.returncode == 0, p.stderr[-1500:]

@@ -121,28 +121,36 @@ class TestHloEquivalence:
     telemetry-off program contains no callback and is byte-identical no
     matter what the host-side enable flag says (i.e. identical to the
     pre-telemetry seed program modulo scope names, which are metadata on
-    the same ops)."""
+    the same ops). The check itself is now a reusable graftcheck pass
+    (`analysis.hlo_checks.check_telemetry_invariance`, HLO003) run over
+    EVERY entry probe — this class pins the original single-entry form to
+    the pass and keeps the structural carry check."""
 
-    def _lower(self, telemetry):
+    def _probe(self):
+        from svd_jacobi_tpu.analysis.entries import EntryProbe
         a = jnp.zeros((16, 16), jnp.float32)
-        return solver._svd_padded.lower(
-            a, n=16, compute_u=True, compute_v=True, full_u=False,
-            nblocks=2, tol=1e-7, max_sweeps=4, precision="highest",
-            gram_dtype_name="float32", method="qr-svd", criterion="rel",
-            telemetry=telemetry).as_text()
+        return EntryProbe(
+            name="padded_qr", fn=solver._svd_padded, args=(a,),
+            kwargs=dict(n=16, compute_u=True, compute_v=True, full_u=False,
+                        nblocks=2, tol=1e-7, max_sweeps=4,
+                        precision="highest", gram_dtype_name="float32",
+                        method="qr-svd", criterion="rel", telemetry=False))
 
     def test_off_has_no_callback_and_ignores_host_flag(self):
-        text_off = self._lower(False)
-        try:
-            metrics.enable()
-            text_off_enabled = self._lower(False)
-            text_on = self._lower(True)
-        finally:
-            metrics.disable()
+        from svd_jacobi_tpu.analysis import hlo_checks
+        probe = self._probe()
+        assert hlo_checks.check_telemetry_invariance(probe) == []
+        # The raw invariants the pass encodes, asserted once directly.
+        text_off = probe.lower().as_text()
+        text_on = probe.with_kwargs(telemetry=True).lower().as_text()
         assert "callback" not in text_off
-        assert text_off == text_off_enabled
-        assert "callback" in text_on
-        assert text_on != text_off
+        assert "callback" in text_on and text_on != text_off
+
+    def test_pass_runs_on_every_entry(self):
+        from svd_jacobi_tpu.analysis import entries, hlo_checks
+        for probe in entries.single_device_probes(include_f64=False):
+            assert hlo_checks.check_telemetry_invariance(probe) == [], \
+                probe.name
 
     def test_fused_sweep_off_has_no_extra_carry(self):
         """rounds.sweep with telemetry off returns the seed's 5-tuple (no

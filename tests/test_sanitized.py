@@ -1,0 +1,116 @@
+"""The `-m sanitized` lane: the fused hot paths run under JAX's runtime
+sanitizers — jax_debug_nans + jax_debug_infs (NaN/Inf screening of jit
+outputs) and jax_transfer_guard="disallow" (implicit host<->device
+transfers raise) — combined with the retrace budget guard. This is the
+runtime half of graftcheck: the jaxpr/HLO passes prove the structure is
+right; this lane proves the structure EXECUTES without host syncs, NaNs,
+or cache-key churn on both the single-device and mesh hot paths.
+
+These tests are in the normal tier-1 selection too (not marked slow);
+``-m sanitized`` selects just this lane.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu.analysis import recompile_guard
+from svd_jacobi_tpu.analysis.sanitize import sanitized
+from svd_jacobi_tpu.utils import matgen
+
+pytestmark = pytest.mark.sanitized
+
+
+@pytest.fixture
+def sanitizers():
+    """Sanitizer context for the duration of one test. Restores config on
+    exit; sanitizer state is jit-cache-relevant, so entries touched here
+    compile fresh inside (expected, budgeted below)."""
+    with sanitized():
+        yield
+
+
+def _ref_sigma(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+def _check(r, a, rtol):
+    s = np.asarray(jax.device_get(r.s), np.float64)
+    np.testing.assert_allclose(s, _ref_sigma(a), rtol=rtol, atol=rtol)
+
+
+def test_single_device_pallas_path(sanitizers):
+    """Kernel path (QR-preconditioned, sigma refinement): solve + repeat
+    under sanitizers, zero retrace-budget violations."""
+    a = matgen.random_dense(96, 96, seed=11, dtype=jnp.float32)
+    cfg = SVDConfig(max_sweeps=24, pair_solver="pallas")
+    with recompile_guard.RecompileGuard() as guard:
+        guard.expect("solver._svd_pallas", problems=1)
+        r = sj.svd(a, config=cfg)
+        r2 = sj.svd(a, config=cfg)           # repeat: must be a cache hit
+        findings = guard.check()
+    assert findings == [], [f.render() for f in findings]
+    _check(r, a, 1e-4)
+    np.testing.assert_array_equal(np.asarray(r.s), np.asarray(r2.s))
+
+
+def test_single_device_hybrid_path(sanitizers):
+    a = matgen.random_dense(48, 48, seed=12, dtype=jnp.float32)
+    cfg = SVDConfig(max_sweeps=24, pair_solver="hybrid")
+    with recompile_guard.RecompileGuard() as guard:
+        guard.expect("solver._svd_padded", problems=1)
+        r = sj.svd(a, config=cfg)
+        sj.svd(a, config=cfg)
+        findings = guard.check()
+    assert findings == []
+    _check(r, a, 1e-4)
+
+
+def test_single_device_f64_path(sanitizers):
+    a = matgen.random_dense(48, 48, seed=13, dtype=jnp.float64)
+    r = sj.svd(a, config=SVDConfig(max_sweeps=24))
+    _check(r, a, 1e-8)
+
+
+def test_mesh_path(sanitizers, eight_devices):
+    """The sharded hot path under sanitizers + retrace budget: the
+    ppermute ring loop must run transfer-free and compile once."""
+    from svd_jacobi_tpu.parallel import sharded
+    a = matgen.random_dense(96, 96, seed=14, dtype=jnp.float32)
+    cfg = SVDConfig(max_sweeps=24)
+    with recompile_guard.RecompileGuard() as guard:
+        guard.expect("sharded._svd_sharded_jit", problems=1)
+        r = sharded.svd(a, config=cfg)
+        sharded.svd(a, config=cfg)
+        findings = guard.check()
+    assert findings == [], [f.render() for f in findings]
+    _check(r, a, 1e-4)
+
+
+def test_sigma_only_donated(sanitizers):
+    """NoVec + donated input: the aliased buffer solve is sanitizer-clean
+    (and the caller's array is consumed, as documented)."""
+    a = matgen.random_dense(64, 64, seed=15, dtype=jnp.float32)
+    a_host = np.asarray(a)
+    cfg = SVDConfig(max_sweeps=24, pair_solver="pallas", donate_input=True)
+    r = sj.svd(a, compute_u=False, compute_v=False, config=cfg)
+    s = np.asarray(jax.device_get(r.s), np.float64)
+    np.testing.assert_allclose(s, _ref_sigma(a_host), rtol=1e-4, atol=1e-4)
+
+
+def test_sanitize_context_restores_state():
+    prev_nans = jax.config.jax_debug_nans
+    prev_infs = jax.config.jax_debug_infs
+    with sanitized():
+        assert jax.config.jax_debug_nans and jax.config.jax_debug_infs
+    assert jax.config.jax_debug_nans == prev_nans
+    assert jax.config.jax_debug_infs == prev_infs
+
+
+def test_debug_nans_actually_fires(sanitizers):
+    """Prove the lane is armed: a NaN-producing jit raises here."""
+    with pytest.raises(FloatingPointError):
+        jax.jit(lambda x: x / 0.0 * 0.0)(jnp.zeros(4))
