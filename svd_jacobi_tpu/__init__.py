@@ -7,10 +7,11 @@ on the MXU, sharded over TPU meshes with ICI collectives, with a
 LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 """
 
-from . import obs
+from . import obs, resilience
 from .config import SVDConfig
-from .solver import SVDResult, svd
+from .solver import SolveStatus, SVDResult, svd
 
 __version__ = "0.1.0"
 
-__all__ = ["svd", "SVDConfig", "SVDResult", "obs", "__version__"]
+__all__ = ["svd", "SVDConfig", "SVDResult", "SolveStatus", "obs",
+           "resilience", "__version__"]

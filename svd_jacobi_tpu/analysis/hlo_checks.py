@@ -24,6 +24,12 @@ Three contracts (codes HLO001-003):
     call, is byte-identical whether or not the host-side enable flag is
     set, and differs from the telemetry-on lowering (proving the flag is
     real, not dead).
+  * HLO004 — chaos injection gate. The `resilience.chaos` NaN-injection
+    hook rides the fused entries as a static `chaos_nan_sweep` argument
+    (like the telemetry flag): the production planner must resolve it to
+    None (unarmed), and arming it must change the lowering (the gate is
+    real) — so fault injection can never ride a production program, and
+    the chaos lane cannot silently test a no-op.
 """
 
 from __future__ import annotations
@@ -161,16 +167,46 @@ def check_telemetry_invariance(probe) -> List[Finding]:
     return findings
 
 
+def check_chaos_gate(probe) -> List[Finding]:
+    """HLO004 for one entry carrying the `chaos_nan_sweep` static: the
+    production plan resolves it unarmed (None), and arming it changes the
+    lowering."""
+    if "chaos_nan_sweep" not in probe.kwargs:
+        return []
+    findings: List[Finding] = []
+    if probe.kwargs["chaos_nan_sweep"] is not None:
+        findings.append(Finding(
+            code="HLO004", where=probe.name,
+            message=("entry planner resolved chaos_nan_sweep="
+                     f"{probe.kwargs['chaos_nan_sweep']!r} — fault "
+                     "injection is ARMED in a production plan"),
+            suggestion=("never leave resilience.chaos.nan_at_sweep armed "
+                        "outside a chaos-lane test")))
+        return findings
+    off = probe.lower().as_text()
+    armed = probe.with_kwargs(chaos_nan_sweep=1).lower().as_text()
+    if armed == off:
+        findings.append(Finding(
+            code="HLO004", where=probe.name,
+            message=("arming chaos_nan_sweep does not change the lowering "
+                     "— the injection gate is dead and the chaos lane "
+                     "tests a no-op"),
+            suggestion=("thread chaos_nan_sweep into the entry's sweep "
+                        "loop (resilience.chaos.poison)")))
+    return findings
+
+
 def check_default_entries(include_mesh: bool = True) -> List[Finding]:
-    """The full HLO pass over the declared probes: telemetry invariance on
-    every entry, donation on the donated/plain pallas pair, collective
-    budgets on every mesh probe."""
+    """The full HLO pass over the declared probes: telemetry invariance
+    and the chaos-injection gate on every entry, donation on the
+    donated/plain pallas pair, collective budgets on every mesh probe."""
     from . import entries
 
     findings: List[Finding] = []
     singles = {p.name: p for p in entries.single_device_probes()}
     for probe in singles.values():
         findings += check_telemetry_invariance(probe)
+        findings += check_chaos_gate(probe)
     if "pallas_donated" in singles and "pallas" in singles:
         findings += check_donation(singles["pallas_donated"],
                                    singles["pallas"])
@@ -178,4 +214,5 @@ def check_default_entries(include_mesh: bool = True) -> List[Finding]:
         for probe in entries.mesh_probes():
             findings += check_collective_budget(probe)
             findings += check_telemetry_invariance(probe)
+            findings += check_chaos_gate(probe)
     return findings

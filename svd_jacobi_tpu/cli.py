@@ -307,11 +307,17 @@ def main(argv=None) -> int:
     if args.profile:
         extra["profile_dir"] = args.profile
 
+    from svd_jacobi_tpu.solver import SolveStatus  # noqa: F401 (decode)
+    status_name = r.status_enum().name
     rep = validation.validate(a, r).as_dict()
     solve = {
         "time_s": solve_time,
         "sweeps": int(r.sweeps),
         "off_norm": float(r.off_rel),
+        # The in-graph health word: anything but "OK" makes this run exit
+        # non-zero (a NaN-poisoned or non-converged solve must not look
+        # like a success to the harness driving this CLI).
+        "status": status_name,
         # None where the job options suppressed a factor (e.g. sigma-only);
         # jobu/jobv themselves ride at manifest top level with the other
         # CLI-surface options.
@@ -323,7 +329,7 @@ def main(argv=None) -> int:
     res_str = ("n/a (factor suppressed)" if rep["residual_rel"] is None
                else f"{rep['residual_rel']:.3e}")
     log(f"solve {m}x{n}: time={solve_time:.3f}s sweeps={int(r.sweeps)} "
-        f"residual={res_str}")
+        f"residual={res_str} status={status_name}")
 
     multiproc = ctx is not None and ctx.process_count > 1
     if args.oracle:
@@ -351,6 +357,16 @@ def main(argv=None) -> int:
             Path(args.report_dir) / "manifest.jsonl", record)
         log(f"manifest: {path}")
     print(json.dumps(solve))
+    # Exit code carries solve health (the reference exits 0 no matter
+    # what): non-zero when the warm-up self-test missed its tolerance or
+    # the timed solve's status is anything but OK.
+    selftest_ok = bool(extra.get("self_test", {"ok": True}).get("ok", True))
+    if not selftest_ok:
+        log("exit 1: warm-up self-test exceeded tolerance")
+        return 1
+    if status_name != "OK":
+        log(f"exit 1: solve status {status_name}")
+        return 1
     return 0
 
 

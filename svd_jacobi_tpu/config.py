@@ -192,7 +192,11 @@ MIXED_PRECISION_BOUNDARIES = frozenset({
 #     dmax2 (1) + sweep-end off-norm (1), plus the kernel path's round-skip
 #     gates (self round 1 + cross round 1; the XLA block solvers have no
 #     skip gate). The hybrid XLA path carries two phase loops (bulk +
-#     polish), so its static per-loop counts appear twice.
+#     polish), so its static per-loop counts appear twice. The in-graph
+#     HEALTH WORD (resilience PR: the while-carry nonfinite flag decoded
+#     into SVDResult.status) adds NO collectives by construction — it is
+#     `isfinite` of the already-pmax'd dmax2/off-norm scalars, so the
+#     counts below are unchanged from the pre-health derivation.
 #   * all_gather / all_to_all / reduce_scatter: the sweep loop must never
 #     materialize a gathered matrix — budget zero, always.
 # analysis.hlo_checks.check_collective_budget asserts EXACT equality so a
@@ -238,4 +242,8 @@ HOT_SCOPES = {
     "postprocess": ("solver.py", "_postprocess"),
     "sigma_refine": ("solver.py", "_refine_from_work"),
     "recombine": ("solver.py", "_recombine_precondition"),
+    # The in-graph health word's status decode (svdj/health): a handful of
+    # scalar ops, but keeping it scoped proves in any profile that the
+    # resilience layer costs ~nothing on the hot path (PROFILE.md).
+    "health": ("solver.py", "_status_word"),
 }

@@ -68,6 +68,16 @@ _ANALYSIS_SCHEMA: Dict[str, Any] = {
     "ok": bool,
     "findings_total": int,
 }
+# Escalation episodes ("retry", written by resilience.resilient_svd): one
+# record per guarded solve that walked the retry ladder, attempts inline.
+_RETRY_SCHEMA: Dict[str, Any] = {
+    "dimension": {"m": int, "n": int},
+    "dtype": str,
+    "config": dict,                   # the BASE config the episode started from
+    "config_sha256": str,
+    "attempts": list,                 # [{"rung", "status", "time_s", ...}]
+    "final_status": str,              # SolveStatus name of the last attempt
+}
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
 
@@ -75,6 +85,7 @@ _STAGE_FIELDS = {"name": str, "time_s": _NUM}
 _SOLVE_REQUIRED = {"time_s": _NUM, "sweeps": int, "off_norm": _NUM}
 _EVENT_REQUIRED = {"event": str}
 _PASS_FIELDS = {"name": str, "ok": bool, "findings": list, "time_s": _NUM}
+_ATTEMPT_FIELDS = {"rung": str, "status": str, "time_s": _NUM}
 
 
 def environment() -> dict:
@@ -154,6 +165,36 @@ def build_analysis(*, passes: List[dict], **extra) -> dict:
     return record
 
 
+def build_retry(*, m: int, n: int, dtype: str, config, attempts: List[dict],
+                final_status: str, **extra) -> dict:
+    """Assemble a schema-valid escalation-episode record
+    (`resilience.resilient_svd`). ``attempts``: one dict per ladder rung
+    actually run ({"rung", "status", "time_s", "sweeps", "off_norm",
+    "config_sha256"}); ``final_status`` is the last attempt's SolveStatus
+    name. ``extra`` rides along like in `build`."""
+    if dataclasses.is_dataclass(config):
+        config_dict = dataclasses.asdict(config)
+    else:
+        config_dict = dict(config)
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "retry",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "dimension": {"m": int(m), "n": int(n)},
+        "dtype": str(dtype),
+        "config": {k: (v if v is None or isinstance(v, (bool, int, float,
+                                                        str)) else str(v))
+                   for k, v in config_dict.items()},
+        "config_sha256": config_hash(config_dict),
+        "attempts": [dict(a) for a in attempts],
+        "final_status": str(final_status),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def _check(cond: bool, errors: List[str], msg: str) -> None:
     if not cond:
         errors.append(msg)
@@ -188,6 +229,11 @@ def validate(record: dict) -> None:
         _check_fields(record, _ANALYSIS_SCHEMA, "record", errors)
         for i, p in enumerate(record.get("passes") or []):
             _check_fields(p, _PASS_FIELDS, f"record.passes[{i}]", errors)
+    elif record.get("kind") == "retry":
+        _check_fields(record, _RETRY_SCHEMA, "record", errors)
+        for i, at in enumerate(record.get("attempts") or []):
+            _check_fields(at, _ATTEMPT_FIELDS, f"record.attempts[{i}]",
+                          errors)
     else:
         _check_fields(record, _SOLVE_SCHEMA, "record", errors)
         for i, st in enumerate(record.get("stages") or []):
@@ -242,6 +288,21 @@ def summarize(record: dict) -> str:
         lines.append(f"  overall: {'ok' if record.get('ok') else 'FAIL'} "
                      f"({record.get('findings_total', 0)} findings)")
         return "\n".join(lines)
+    if record.get("kind") == "retry":
+        dim = record.get("dimension", {})
+        lines = [
+            f"retry episode @ {record.get('timestamp', '?')}  "
+            f"matrix {dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
+            f"final={record.get('final_status')}",
+        ]
+        for at in record.get("attempts") or []:
+            off = at.get("off_norm")
+            off_s = f"{off:.3e}" if isinstance(off, float) else "n/a"
+            lines.append(f"  attempt {at.get('rung', '?'):<18} "
+                         f"{at.get('status', '?'):<11} "
+                         f"sweeps={at.get('sweeps', '?'):>3} off={off_s}  "
+                         f"{at.get('time_s', 0.0):7.2f} s")
+        return "\n".join(lines)
     dim = record.get("dimension", {})
     env = record.get("environment", {})
     solve = record.get("solve", {})
@@ -256,8 +317,8 @@ def summarize(record: dict) -> str:
     for st in record.get("stages") or []:
         lines.append(f"  stage {st.get('name', '?'):<12} "
                      f"{st.get('time_s', float('nan')):9.3f} s")
-    keys = ("time_s", "sweeps", "off_norm", "residual_rel", "u_orth",
-            "v_orth", "sigma_err", "gflops", "vs_baseline")
+    keys = ("time_s", "sweeps", "off_norm", "status", "residual_rel",
+            "u_orth", "v_orth", "sigma_err", "gflops", "vs_baseline")
     kv = [f"{k}={solve[k]:.4g}" if isinstance(solve.get(k), float)
           else f"{k}={solve[k]}" for k in keys if solve.get(k) is not None]
     lines.append("  solve " + "  ".join(kv))

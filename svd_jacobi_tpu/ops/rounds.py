@@ -450,7 +450,7 @@ def _global_dmax2(top, bot):
 
 def should_continue(off, prev_off, sweeps, *, tol, max_sweeps,
                     stall_detection=True, stall_gate=1e-4,
-                    stall_shrink=0.25):
+                    stall_shrink=0.25, nonfinite=None):
     """THE sweep-loop predicate — one definition shared by every iterate
     loop (solver._should_continue, `iterate_phase`, the mesh solver's
     while_loops): continue while the coupling is above ``tol``, the sweep
@@ -460,12 +460,16 @@ def should_continue(off, prev_off, sweeps, *, tol, max_sweeps,
     phase's roundoff floor is reached. The gate/shrink constants are the
     caller's — they are measured per criterion/regime, not derived (a
     mistuned threshold cost 100x sigma error; see solver._should_continue
-    for the per-criterion values)."""
+    for the per-criterion values). ``nonfinite``: the loop's health word —
+    stop immediately once non-finite state is detected (sweeping NaNs to
+    the budget is pure waste; the caller surfaces SolveStatus.NONFINITE)."""
     go = jnp.logical_and(sweeps < max_sweeps, off > tol)
     if stall_detection:
         stalled = jnp.logical_and(off < stall_gate,
                                   off > stall_shrink * prev_off)
         go = jnp.logical_and(go, jnp.logical_not(stalled))
+    if nonfinite is not None:
+        go = jnp.logical_and(go, jnp.logical_not(nonfinite))
     return go
 
 
@@ -480,19 +484,32 @@ MIXED_TOL = 1e-3
 def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
                   interpret, polish, bf16_gram, stall_detection=True,
                   stall_gate=1e-4, stall_shrink=0.25, start_sweeps=0,
-                  apply_x3=False, telemetry=False, stage="single"):
+                  apply_x3=False, telemetry=False, stage="single",
+                  nonfinite0=None, chaos_nan_sweep=None):
     """`lax.while_loop` of `sweep`s until the masked coupling drops below
     ``stop_tol`` (or the TOTAL sweep counter — which starts at
-    ``start_sweeps`` — hits ``max_sweeps``, or a stall). Stall: once the
-    coupling is below ``stall_gate`` (the phase's endgame) and a sweep
-    fails to shrink it by 1/``stall_shrink``, the phase's floor is reached.
-    Returns (top, bot, vtop, vbot, off, sweeps).
+    ``start_sweeps`` — hits ``max_sweeps``, or a stall, or non-finite
+    state is detected). Stall: once the coupling is below ``stall_gate``
+    (the phase's endgame) and a sweep fails to shrink it by
+    1/``stall_shrink``, the phase's floor is reached.
+    Returns (top, bot, vtop, vbot, off, sweeps, nonfinite).
+
+    The health word ``nonfinite`` rides the existing per-sweep reductions
+    (``isfinite`` of the dmax2 deflation scale — NaN AND Inf in the work
+    stacks both poison a max-of-squares — and of the sweep statistic);
+    the deflation mask alone would silently DROP NaN columns from the
+    masked stat, which is exactly the "poisoned solve reads converged"
+    failure this closes. ``nonfinite0`` seeds the flag from an earlier
+    phase. ``chaos_nan_sweep`` (static): fault-injection hook — poison
+    one work element at that sweep counter (`resilience.chaos`); None
+    (production) traces no injection code at all.
 
     ``telemetry`` (static): emit one `obs.metrics` "sweep" event per loop
     iteration — post-sweep off-norm and the rotation-round counters —
     tagged with ``stage``. Off by default; the disabled trace is the seed
     trace.
     """
+    from ..resilience import chaos as _chaos
     with_v = vtop is not None
     k = top.shape[0]
     if vtop is None:
@@ -508,21 +525,25 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
             else "kernel")
 
     def cond(st):
-        _, _, _, _, off, prev_off, sweeps = st
+        _, _, _, _, off, prev_off, sweeps, nonfinite = st
         return should_continue(off, prev_off, sweeps, tol=stop_tol,
                                max_sweeps=max_sweeps,
                                stall_detection=stall_detection,
                                stall_gate=stall_gate,
-                               stall_shrink=stall_shrink)
+                               stall_shrink=stall_shrink,
+                               nonfinite=nonfinite)
 
     def body(st):
-        top, bot, vtop, vbot, prev_off, _, sweeps = st
+        top, bot, vtop, vbot, prev_off, _, sweeps, nonfinite = st
+        if chaos_nan_sweep is not None:
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
         dmax2 = _global_dmax2(top, bot)
         out = sweep(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             dmax2, rtol, interpret=interpret, polish=polish,
             bf16_gram=bf16_gram, apply_x3=apply_x3, telemetry=telemetry)
         top, bot, nvt, nvb, off = out[:5]
+        nonfinite = nonfinite | ~jnp.isfinite(dmax2) | ~jnp.isfinite(off)
         if telemetry:
             metrics.emit("sweep",
                          meta={"path": path, "stage": stage,
@@ -531,40 +552,49 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
                          rounds_rotated=out[5])
         if not with_v:
             nvt, nvb = st[2], st[3]
-        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1)
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1, nonfinite)
 
     inf = jnp.float32(jnp.inf)
+    nf0 = (jnp.zeros((), jnp.bool_) if nonfinite0 is None
+           else jnp.asarray(nonfinite0, jnp.bool_))
     state = (top, bot, vtop, vbot, inf, inf,
-             jnp.asarray(start_sweeps, jnp.int32))
-    top, bot, vtop, vbot, off, _, sweeps = jax.lax.while_loop(
+             jnp.asarray(start_sweeps, jnp.int32), nf0)
+    top, bot, vtop, vbot, off, _, sweeps, nonfinite = jax.lax.while_loop(
         cond, body, state)
     return (top, bot, (vtop if with_v else None),
-            (vbot if with_v else None), off, sweeps)
+            (vbot if with_v else None), off, sweeps, nonfinite)
 
 
 def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,
             bulk_bf16, stall_detection=True, start_sweeps=0,
-            telemetry=False, stage="single"):
+            telemetry=False, stage="single", nonfinite0=None,
+            chaos_nan_sweep=None):
     """Sweep until the masked coupling drops below ``tol``.
 
     Two phases when ``bulk_bf16``: bf16-Gram sweeps down to BULK_TOL, then
     full-precision sweeps to ``tol``. ``max_sweeps`` is a TOTAL budget
     (including ``start_sweeps`` already spent by the caller — the mixed
     bulk phase). Stall constants are solver._should_continue's rel branch.
+    Returns (top, bot, vtop, vbot, off, sweeps, nonfinite) — the health
+    word chains through both phases (see `iterate_phase`).
     """
     kwargs = dict(max_sweeps=max_sweeps, interpret=interpret, polish=polish,
-                  stall_detection=stall_detection, telemetry=telemetry)
+                  stall_detection=stall_detection, telemetry=telemetry,
+                  chaos_nan_sweep=chaos_nan_sweep)
     bulk_off = jnp.float32(jnp.inf)
     bulk_sweeps = jnp.asarray(start_sweeps, jnp.int32)
+    nonfinite = nonfinite0
     if bulk_bf16:
-        top, bot, vtop, vbot, bulk_off, bulk_sweeps = iterate_phase(
-            top, bot, vtop, vbot, stop_tol=jnp.float32(BULK_TOL),
-            rtol=BULK_TOL, bf16_gram=True, start_sweeps=bulk_sweeps,
-            stage="bulk_bf16", **kwargs)
-    top, bot, vtop, vbot, off, sweeps = iterate_phase(
+        top, bot, vtop, vbot, bulk_off, bulk_sweeps, nonfinite = \
+            iterate_phase(
+                top, bot, vtop, vbot, stop_tol=jnp.float32(BULK_TOL),
+                rtol=BULK_TOL, bf16_gram=True, start_sweeps=bulk_sweeps,
+                stage="bulk_bf16", nonfinite0=nonfinite, **kwargs)
+    top, bot, vtop, vbot, off, sweeps, nonfinite = iterate_phase(
         top, bot, vtop, vbot, stop_tol=tol, rtol=tol, bf16_gram=False,
-        start_sweeps=bulk_sweeps, stage=stage, **kwargs)
+        start_sweeps=bulk_sweeps, stage=stage, nonfinite0=nonfinite,
+        **kwargs)
     # If the bulk phase consumed the whole budget, report its statistic
     # rather than the untouched inf carry (cf. solver._svd_padded hybrid).
     off = jnp.where(sweeps > bulk_sweeps, off, bulk_off)
-    return top, bot, vtop, vbot, off, sweeps
+    return top, bot, vtop, vbot, off, sweeps, nonfinite

@@ -29,12 +29,23 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Optional
 
 import jax
 
 from .. import _compat
 from ..utils import matgen
+
+# Monkeypatch seam for the retry tests (and anyone who wants virtual time).
+_sleep = time.sleep
+
+# RuntimeError texts worth retrying: transient coordinator bring-up races
+# (refused/unreachable/deadline). Anything else — wrong address, mismatched
+# process counts, plugin errors — is permanent and must surface immediately,
+# not after seconds of misleading backoff.
+_TRANSIENT_CONNECT = ("connect", "refused", "unavailable", "deadline",
+                      "timed out", "timeout")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +70,8 @@ def initialize(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[list] = None,
+    connect_retries: int = 4,
+    connect_backoff_s: float = 0.5,
 ) -> DistributedContext:
     """Bootstrap multi-host JAX; safe to call on a single process.
 
@@ -69,6 +82,13 @@ def initialize(
     present and no arguments are given, this is a no-op single-process
     context — the same code path then runs single-host, like the reference
     run with `mpiexec -np 1`.
+
+    Coordinator connection is retried with exponential backoff
+    (``connect_retries`` retries, delays ``connect_backoff_s * 2^k``): on
+    cold pod bring-up the coordinator process routinely comes up seconds
+    after its workers, and the first connect used to fail the whole job on
+    one transient refusal. "Already initialized" errors are never retried
+    — they are a programming-order problem, not a transient one.
     """
     explicit = (coordinator_address is not None
                 or num_processes is not None
@@ -77,32 +97,55 @@ def initialize(
     if ((explicit or _cluster_env_present())
             and not _compat.distributed_is_initialized()):
         _compat.enable_cpu_collectives()
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                local_device_ids=local_device_ids,
-            )
-        except RuntimeError as e:
-            # The backend was already initialized before we ran — the
-            # bootstrap cannot take effect and a multi-node job would
-            # degrade to independent single-host solves. Raise for explicit
-            # requests; warn LOUDLY for auto-detected cluster envs (which
-            # can also be false positives, e.g. a non-JAX SLURM
-            # allocation). Double-init is handled by the is_initialized()
-            # guard above, not exception sniffing.
-            if not explicit and "must be called before" in str(e):
+        attempt = 0
+        while True:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    local_device_ids=local_device_ids,
+                )
+                break
+            except RuntimeError as e:
+                # The backend was already initialized before we ran — the
+                # bootstrap cannot take effect and a multi-node job would
+                # degrade to independent single-host solves. Raise for
+                # explicit requests; warn LOUDLY for auto-detected cluster
+                # envs (which can also be false positives, e.g. a non-JAX
+                # SLURM allocation). Double-init is handled by the
+                # is_initialized() guard above, not exception sniffing.
+                if "must be called before" in str(e):
+                    if not explicit:
+                        import warnings
+                        warnings.warn(
+                            "jax.distributed.initialize was skipped because "
+                            "the XLA backend is already initialized (a JAX "
+                            "call ran before launch.initialize()). If this "
+                            "is a multi-process job, each process is now "
+                            "running an INDEPENDENT solve — call "
+                            "launch.initialize() before any other JAX use.",
+                            RuntimeWarning, stacklevel=2)
+                        break
+                    raise
+                # Transient coordinator-connect failure (refused/timed out
+                # during bring-up): bounded exponential backoff. Permanent
+                # errors (bad address, plugin failures) raise immediately.
+                msg = str(e).lower()
+                if not any(t in msg for t in _TRANSIENT_CONNECT):
+                    raise
+                if attempt >= connect_retries:
+                    raise RuntimeError(
+                        f"coordinator connect failed after {attempt + 1} "
+                        f"attempt(s): {e}") from e
+                delay = connect_backoff_s * (2.0 ** attempt)
                 import warnings
                 warnings.warn(
-                    "jax.distributed.initialize was skipped because the XLA "
-                    "backend is already initialized (a JAX call ran before "
-                    "launch.initialize()). If this is a multi-process job, "
-                    "each process is now running an INDEPENDENT solve — "
-                    "call launch.initialize() before any other JAX use.",
+                    f"coordinator connect attempt {attempt + 1} failed "
+                    f"({e}); retrying in {delay:.1f}s",
                     RuntimeWarning, stacklevel=2)
-            else:
-                raise
+                _sleep(delay)
+                attempt += 1
     return DistributedContext(
         process_index=jax.process_index(),
         process_count=jax.process_count(),

@@ -57,6 +57,7 @@ from .. import _compat
 from ..config import SVDConfig
 from ..obs import metrics
 from ..ops import blockwise
+from ..resilience import chaos as _chaos
 from . import schedule as sched
 from .. import solver as _single
 
@@ -135,7 +136,12 @@ def _sweep_sharded(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
                    precision, gram_dtype, method, criterion, with_v):
     """One full sharded sweep (runs under shard_map): scan over the ring
     tournament's rounds, pmax'd convergence statistic. Shared by the fused
-    solve (`_sharded_jacobi`) and the host-stepped `SweepStepper`."""
+    solve (`_sharded_jacobi`) and the host-stepped `SweepStepper`.
+
+    Also returns the sweep's health word ``nonfinite`` — derived from the
+    ALREADY pmax'd dmax2/off-norm reductions, so the in-graph health adds
+    zero collectives to the round loop (the HLO001 budget is unchanged;
+    see config.COLLECTIVE_BUDGET)."""
 
     def round_body(carry, _, *, dmax2):
         top, bot, vtop, vbot, max_rel = carry
@@ -166,7 +172,8 @@ def _sweep_sharded(top, bot, vtop, vbot, *, axis_name, n_devices, n_rounds,
     # form of the reduction the reference never does (its per-pair
     # convergence_value is computed and discarded, lib/JacobiMethods.cu:462).
     off_rel = lax.pmax(local_rel, axis_name)
-    return top, bot, vtop, vbot, off_rel
+    nonfinite = jnp.logical_or(~jnp.isfinite(dmax2), ~jnp.isfinite(off_rel))
+    return top, bot, vtop, vbot, off_rel, nonfinite
 
 
 def _sweep_sharded_pallas(top, bot, vtop, vbot, *, axis_name, n_devices,
@@ -185,14 +192,24 @@ def _sweep_sharded_pallas(top, bot, vtop, vbot, *, axis_name, n_devices,
         axis_name=axis_name, n_rounds=n_rounds, exchange=exchange)
     if with_v:
         vtop, vbot = nvt, nvb
-    return top, bot, vtop, vbot, off
+    # Health word off the reductions this sweep already pays for (cf.
+    # `_sweep_sharded`): zero extra collectives.
+    nonfinite = jnp.logical_or(~jnp.isfinite(dmax2), ~jnp.isfinite(off))
+    return top, bot, vtop, vbot, off, nonfinite
 
 
 def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                     tol, max_sweeps, precision, gram_dtype_name, method,
                     criterion, with_v, n_pad, nblocks, stall_detection=True,
-                    kernel_polish=True, telemetry=False, replicas=1):
+                    kernel_polish=True, telemetry=False, replicas=1,
+                    chaos_nan_sweep=None):
     """Body run under shard_map: while_loop(sweeps) of scan(rounds).
+
+    The while carry includes the in-graph health word ``nonfinite`` (see
+    `_sweep_sharded`) — the loop stops early on poisoned state and the
+    flag is returned so `_svd_sharded_jit` can decode `SolveStatus`.
+    ``chaos_nan_sweep`` (static): `resilience.chaos` NaN-injection hook;
+    None (production) traces no injection code.
 
     ``telemetry`` (static): emit one `obs.metrics` "sweep" event per loop
     iteration with the pmax'd (mesh-replicated) off-norm. The callback
@@ -219,18 +236,23 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                               precision=precision, gram_dtype=gram_dtype,
                               method=mth, criterion=crit, with_v=with_v)
 
-    def iterate(top, bot, vtop, vbot, mth, crit, t, budget, stage):
+    def iterate(top, bot, vtop, vbot, mth, crit, t, budget, stage,
+                nf0=None):
         def cond(state):
-            _, _, _, _, off_rel, prev_off, sweeps = state
+            _, _, _, _, off_rel, prev_off, sweeps, nonfinite = state
             return _single._should_continue(off_rel, prev_off, sweeps,
                                             tol=t, max_sweeps=budget,
                                             stall_detection=stall_detection,
-                                            criterion=crit)
+                                            criterion=crit,
+                                            nonfinite=nonfinite)
 
         def body(state):
-            top, bot, vtop, vbot, prev_off, _, sweeps = state
-            top, bot, vtop, vbot, off_rel = sweep(top, bot, vtop, vbot,
-                                                  mth, crit)
+            top, bot, vtop, vbot, prev_off, _, sweeps, nonfinite = state
+            if chaos_nan_sweep is not None:
+                top = _chaos.poison(top, sweeps, chaos_nan_sweep)
+            top, bot, vtop, vbot, off_rel, nf = sweep(top, bot, vtop, vbot,
+                                                      mth, crit)
+            nonfinite = nonfinite | nf
             if telemetry:
                 # off_rel is pmax'd -> identical on every device; the
                 # dispatcher collapses the per-device deliveries.
@@ -239,10 +261,12 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
                                    "method": mth, "devices": n_devices},
                              replicas=replicas,
                              sweep=sweeps + 1, off_rel=off_rel)
-            return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
+            return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1,
+                    nonfinite)
 
         inf = jnp.float32(jnp.inf)
-        state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
+        nf_init = jnp.zeros((), jnp.bool_) if nf0 is None else nf0
+        state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0), nf_init)
         return lax.while_loop(cond, body, state)
 
     if method == "pallas":
@@ -263,22 +287,22 @@ def _sharded_jacobi(top, bot, *, axis_name, n_devices, n_rounds,
     if method == "hybrid":
         # See solver._svd_padded: abs-converged bulk phase, then a short
         # relative-criterion polish phase for U orthogonality.
-        top, bot, vtop, vbot, off1, _, s1 = iterate(
+        top, bot, vtop, vbot, off1, _, s1, nf1 = iterate(
             top, bot, vtop, vbot, "gram-eigh", "abs",
             _single._abs_phase_tol(top.dtype), max_sweeps, "bulk")
         if telemetry:
             metrics.emit("stage",
                          meta={"path": "sharded", "stage": "bulk"},
                          replicas=replicas, sweeps=s1, off_rel=off1)
-        top, bot, vtop, vbot, off2, _, s2 = iterate(
+        top, bot, vtop, vbot, off2, _, s2, nf2 = iterate(
             top, bot, vtop, vbot, "qr-svd", criterion, tol, max_sweeps - s1,
-            "polish")
+            "polish", nf0=nf1)
         # Zero-iteration polish leaves its init off = inf; see solver.py.
         off_rel = jnp.where(s2 > 0, off2, off1)
-        return top, bot, vtop, vbot, off_rel, s1 + s2
-    top, bot, vtop, vbot, off_rel, _, sweeps = iterate(
+        return top, bot, vtop, vbot, off_rel, s1 + s2, nf2
+    top, bot, vtop, vbot, off_rel, _, sweeps, nonfinite = iterate(
         top, bot, vtop, vbot, method, criterion, tol, max_sweeps, "single")
-    return top, bot, vtop, vbot, off_rel, sweeps
+    return top, bot, vtop, vbot, off_rel, sweeps, nonfinite
 
 
 def svd(
@@ -326,14 +350,15 @@ def svd(
         r = svd(a.T, mesh=mesh, compute_u=compute_v, compute_v=compute_u,
                 full_matrices=full_matrices, config=config)
         return _single.SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
-                                 off_rel=r.off_rel)
+                                 off_rel=r.off_rel, status=r.status)
 
     if mesh is None:
         mesh = make_mesh()
     kwargs = _plan_entry(a, mesh, config, compute_u=compute_u,
                          compute_v=compute_v, full_matrices=full_matrices)
-    u, s, v, sweeps, off_rel = _svd_sharded_jit(a, **kwargs)
-    return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+    u, s, v, sweeps, off_rel, status = _svd_sharded_jit(a, **kwargs)
+    return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
+                             status=status)
 
 
 def _plan_entry(a, mesh: Mesh, config: SVDConfig, *, compute_u: bool = True,
@@ -379,19 +404,20 @@ def _plan_entry(a, mesh: Mesh, config: SVDConfig, *, compute_u: bool = True,
         precondition=bool(precondition), refine=bool(refine),
         stall_detection=bool(config.stall_detection),
         kernel_polish=bool(config.kernel_polish),
-        telemetry=bool(metrics.enabled()))
+        telemetry=bool(metrics.enabled()),
+        chaos_nan_sweep=_chaos.consume_nan_sweep())
 
 
 @partial(jax.jit, static_argnames=(
     "mesh", "axis_name", "n", "n_pad", "nblocks", "n_devices", "compute_u",
     "compute_v", "full_u", "tol", "max_sweeps", "precision",
     "gram_dtype_name", "method", "criterion", "precondition", "refine",
-    "stall_detection", "kernel_polish", "telemetry"))
+    "stall_detection", "kernel_polish", "telemetry", "chaos_nan_sweep"))
 def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                      compute_u, compute_v, full_u, tol, max_sweeps, precision,
                      gram_dtype_name, method, criterion, precondition=False,
                      refine=False, stall_detection=True, kernel_polish=True,
-                     telemetry=False):
+                     telemetry=False, chaos_nan_sweep=None):
     m = a.shape[0]
     dtype = a.dtype
     block_spec = P(axis_name, None, None)  # shard the pair-slot axis
@@ -424,12 +450,15 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
                 method=method, criterion=criterion, with_v=accumulate,
                 n_pad=n_pad, nblocks=nblocks,
                 stall_detection=stall_detection, kernel_polish=kernel_polish,
-                telemetry=telemetry, replicas=max(1, replicas)),
+                telemetry=telemetry, replicas=max(1, replicas),
+                chaos_nan_sweep=chaos_nan_sweep),
         mesh=mesh,
         in_specs=(block_spec,) * 2,
-        out_specs=(block_spec,) * 4 + (P(), P()),
+        out_specs=(block_spec,) * 4 + (P(), P(), P()),
     )
-    top, bot, vtop, vbot, off_rel, sweeps = jacobi(top, bot)
+    top, bot, vtop, vbot, off_rel, sweeps, nonfinite = jacobi(top, bot)
+    status = _single._status_word(off_rel, sweeps, nonfinite, tol=tol,
+                                  max_sweeps=max_sweeps)
 
     a_work = _single._deblockify(top, bot)
     v_work = _single._deblockify(vtop, vbot)[:n, :] if accumulate else None
@@ -443,7 +472,7 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
         u, v = _single._recombine_precondition(
             cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_u, dtype=dtype, q1=q1, order=order)
-        return u, s, v, sweeps, off_rel
+        return u, s, v, sweeps, off_rel, status
     cols, s, rot = _single._postprocess(a_work, v_work, n,
                                         compute_u=compute_u,
                                         full_u=False, dtype=dtype)
@@ -452,7 +481,7 @@ def _svd_sharded_jit(a, *, mesh, axis_name, n, n_pad, nblocks, n_devices,
     u, v = cols, rot
     if compute_u and full_u and m > n and u is not None:
         u = _single._complete_orthonormal(u, n, dtype)
-    return u, s, v, sweeps, off_rel
+    return u, s, v, sweeps, off_rel, status
 
 
 # ---------------------------------------------------------------------------
@@ -482,7 +511,10 @@ def _sweep_step_sharded_pallas_jit(top, bot, vtop, vbot, *, mesh, axis_name,
     vbot = lax.with_sharding_constraint(vbot, sharding)
 
     def body(top, bot, vtop, vbot):
-        t, b, nvt, nvb, off = _sweep_sharded_pallas(
+        # The trailing health word is dropped: the host-stepped path
+        # probes the final stacks once in finish() instead
+        # (solver._nonfinite_probe_jit).
+        t, b, nvt, nvb, off, _ = _sweep_sharded_pallas(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             axis_name=axis_name, n_devices=n_devices,
             n_rounds=sched.num_rounds(nblocks), rtol=rtol, with_v=with_v,
@@ -509,11 +541,17 @@ def _sweep_step_sharded_jit(top, bot, vtop, vbot, *, mesh, axis_name,
     bot = lax.with_sharding_constraint(bot, sharding)
     vtop = lax.with_sharding_constraint(vtop, sharding)
     vbot = lax.with_sharding_constraint(vbot, sharding)
+    def body(top, bot, vtop, vbot):
+        # Health word dropped here too — see the pallas step body above.
+        t, b, vt, vb, off, _ = _sweep_sharded(
+            top, bot, vtop, vbot, axis_name=axis_name, n_devices=n_devices,
+            n_rounds=sched.num_rounds(nblocks),
+            precision=precision, gram_dtype=jnp.dtype(gram_dtype_name),
+            method=method, criterion=criterion, with_v=with_v)
+        return t, b, vt, vb, off
+
     step = _compat.shard_map(
-        partial(_sweep_sharded, axis_name=axis_name, n_devices=n_devices,
-                n_rounds=sched.num_rounds(nblocks),
-                precision=precision, gram_dtype=jnp.dtype(gram_dtype_name),
-                method=method, criterion=criterion, with_v=with_v),
+        body,
         mesh=mesh,
         in_specs=(block_spec,) * 4,
         out_specs=(block_spec,) * 4 + (P(),),

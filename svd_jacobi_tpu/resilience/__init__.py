@@ -1,0 +1,53 @@
+"""svd_jacobi_tpu.resilience — fail loudly, degrade gracefully, survive.
+
+The resilience layer on top of the solver (PR 1 built the observability it
+reports through, PR 2 the contract checks that keep it honest):
+
+  * in-graph solve health — the fused sweep loops carry a cheap health
+    word (non-finite detection riding the existing dmax2/off-norm
+    reductions) that `solver._status_word` decodes into
+    `SVDResult.status` / `SolveStatus` (``OK | MAX_SWEEPS | STAGNATED |
+    NONFINITE``); a NaN-poisoned solve can no longer masquerade as a
+    converged one (the deflation mask silently drops NaN columns from the
+    convergence statistic — exactly the failure this closes);
+  * `guard` — pre-solve input screening + exact power-of-two pre-scaling
+    for extreme-scale inputs (the Gram path squares the data scale);
+  * `resilient_svd` (`escalate`) — bounded retry/escalation ladder
+    reacting to a bad status, recorded as ``"retry"`` manifest records;
+  * `chaos` — deterministic fault injection (in-graph NaN payloads,
+    checkpoint corruption, SIGTERM mid-solve) powering the ``-m chaos``
+    pytest lane that proves detection, recovery, and kill-then-resume
+    end-to-end.
+
+This module is import-light (the escalation orchestrator pulls the solver
+in lazily) because `solver` itself imports `chaos` to thread the
+fault-injection jit key.
+"""
+
+from __future__ import annotations
+
+from . import chaos  # noqa: F401  (import-light; solver depends on it)
+
+_LAZY = {
+    "resilient_svd": ("escalate", "resilient_svd"),
+    "DEFAULT_RUNGS": ("escalate", "DEFAULT_RUNGS"),
+    "screen": ("guard", "screen"),
+    "prescale": ("guard", "prescale"),
+    "unscale_sigma": ("guard", "unscale_sigma"),
+    "NonFiniteInputError": ("guard", "NonFiniteInputError"),
+}
+
+
+def __getattr__(name: str):
+    if name == "SolveStatus":
+        from ..solver import SolveStatus
+        return SolveStatus
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(f".{mod}", __package__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["chaos", "resilient_svd", "DEFAULT_RUNGS", "screen", "prescale",
+           "unscale_sigma", "NonFiniteInputError", "SolveStatus"]

@@ -1,0 +1,142 @@
+"""Deterministic fault injection — the chaos half of the resilience layer.
+
+The reference has no failure handling to test against (MPI errors are
+printed and execution carries on, reference lib/JacobiMethods.cu:359-370,
+614-616); this module provides the REPRODUCIBLE faults that prove the
+detection/recovery machinery of this repo actually works:
+
+  * `nan_at_sweep(k)` — arm an in-graph NaN payload: the next ``shots``
+    fused solve dispatches poison one element of the working block stacks
+    at the start of sweep ``k``. The hook is threaded through the fused
+    entry points as a STATIC jit argument (`chaos_nan_sweep`, resolved by
+    `solver._plan_entry` / `parallel.sharded._plan_entry` exactly like the
+    telemetry flag), so the unarmed trace contains no injection code at
+    all — `analysis.hlo_checks` rule HLO004 pins that property.
+  * `sigterm_at_sweep(k)` — arm a SIGTERM delivered to THIS process at the
+    end of checkpointed sweep ``k`` (`utils.checkpoint.svd_checkpointed`
+    consults the hook once per sweep), driving the kill-then-resume lane.
+  * `corrupt_checkpoint(path, mode)` — host-side snapshot corruption
+    (truncation, byte flip, zeroing) for the checkpoint-hardening tests.
+
+Everything here is deterministic: a hook fires at an exact sweep index /
+byte offset, never at random, so chaos-lane failures replay exactly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+from pathlib import Path
+from typing import Optional
+
+_lock = threading.Lock()
+# Armed state: {"sweep": int, "shots": int} or None. Shots bound how many
+# solve DISPATCHES consume the payload (an escalation retry of the same
+# matrix must be able to run clean — the point of the recovery test).
+_nan_state: Optional[dict] = None
+_sigterm_sweep: Optional[int] = None
+
+
+@contextlib.contextmanager
+def nan_at_sweep(sweep: int, shots: int = 1):
+    """Arm the in-graph NaN payload for the next ``shots`` fused solves.
+
+    ``sweep`` is the 0-based sweep-loop counter at whose body start the
+    payload lands (the hybrid XLA solver restarts its counter per phase
+    loop; the kernel path counts globally across bulk+polish). Detection
+    is the health word's job: a poisoned solve must surface
+    ``SolveStatus.NONFINITE``, never a silent ``OK``.
+    """
+    global _nan_state
+    with _lock:
+        prev = _nan_state
+        _nan_state = {"sweep": int(sweep), "shots": int(shots)}
+    try:
+        yield
+    finally:
+        with _lock:
+            _nan_state = prev
+
+
+def consume_nan_sweep() -> Optional[int]:
+    """One solve dispatch's view of the NaN hook: the armed sweep index
+    (decrementing the shot budget) or None. Called by the entry planners;
+    the returned value is part of the jit cache key, so an armed dispatch
+    compiles a distinct (instrumented) program."""
+    global _nan_state
+    with _lock:
+        st = _nan_state
+        if st is None or st["shots"] <= 0:
+            return None
+        st["shots"] -= 1
+        return st["sweep"]
+
+
+def poison(x, sweeps, sweep_index: int):
+    """Traced helper: overwrite one element of ``x`` with NaN when the
+    loop counter ``sweeps`` equals the armed ``sweep_index`` (identity on
+    every other sweep). Only ever traced when a dispatch consumed an armed
+    hook — production programs never contain this op."""
+    import jax.numpy as jnp
+    idx = (0,) * x.ndim
+    payload = jnp.where(sweeps == sweep_index,
+                        jnp.asarray(jnp.nan, x.dtype), x[idx])
+    return x.at[idx].set(payload)
+
+
+@contextlib.contextmanager
+def sigterm_at_sweep(sweep: int):
+    """Arm a SIGTERM to THIS process at the end of checkpointed sweep
+    ``sweep`` (1-based, matching `SweepState.sweeps`). One-shot."""
+    global _sigterm_sweep
+    with _lock:
+        prev = _sigterm_sweep
+        _sigterm_sweep = int(sweep)
+    try:
+        yield
+    finally:
+        with _lock:
+            _sigterm_sweep = prev
+
+
+def maybe_sigterm(sweeps_done: int) -> None:
+    """Deliver the armed SIGTERM when the checkpoint loop reaches the
+    armed sweep. Sends a REAL signal (os.kill to self) so the production
+    SIGTERM machinery — handler, final snapshot, re-raise — is what gets
+    exercised, not a shortcut."""
+    global _sigterm_sweep
+    with _lock:
+        armed = _sigterm_sweep
+        if armed is None or int(sweeps_done) != armed:
+            return
+        _sigterm_sweep = None
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def corrupt_checkpoint(path, mode: str = "truncate") -> Path:
+    """Deterministically corrupt a snapshot file in place.
+
+    ``mode``:
+      * "truncate" — keep only the first half of the file (torn write);
+      * "flip"     — XOR one byte in the middle (bit rot / bad sector;
+        defeats both the zip CRC and the payload checksum);
+      * "zero"     — zero out a 64-byte span in the middle.
+    Returns the path for chaining.
+    """
+    path = Path(path)
+    data = bytearray(path.read_bytes())
+    if not data:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    mid = len(data) // 2
+    if mode == "truncate":
+        data = data[:mid]
+    elif mode == "flip":
+        data[mid] ^= 0xFF
+    elif mode == "zero":
+        data[mid:mid + 64] = bytes(min(64, len(data) - mid))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    path.write_bytes(bytes(data))
+    return path

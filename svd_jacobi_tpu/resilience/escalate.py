@@ -1,0 +1,200 @@
+"""Retry/escalation: `resilient_svd`, the self-healing solve orchestrator.
+
+A solve that surfaces a bad health word (`SVDResult.status` other than
+``OK``) is re-run through a bounded, configurable escalation ladder of
+progressively more conservative configurations:
+
+    base config
+      -> matmul_precision="highest"   (kill bf16-pass matmul noise)
+      -> widened gram_dtype + hybrid  (f32 grams -> f64; the XLA block
+                                       solvers, where gram_dtype bites)
+      -> pair_solver="qr-svd"         (gesvj-class relative accuracy,
+                                       the most robust Jacobi regime)
+      -> lapack-class gesvd fallback  (jnp.linalg.svd — a DIFFERENT
+                                       algorithm entirely, the last word)
+
+Rungs that cannot apply (f64 gram widening without x64, a rung equal to a
+configuration already tried) are skipped, so the ladder is bounded by
+construction. Every attempt is recorded, and with ``manifest_path`` the
+whole episode is appended as one schema-versioned ``"retry"`` record via
+`obs.manifest` — solves that needed escalation are visible in the same
+stream as ordinary runs.
+
+Inputs are guarded before the first attempt (`resilience.guard`):
+non-finite inputs raise `NonFiniteInputError` immediately (no ladder can
+fix data), and extreme-scale inputs are power-of-two pre-scaled with the
+scale undone on the returned sigmas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Optional, Sequence, Tuple
+
+DEFAULT_RUNGS = ("precision_highest", "wide_gram", "qr_svd", "lapack_gesvd")
+
+
+def _rung_config(rung: str, cfg, dtype):
+    """The configuration a ladder rung escalates ``cfg`` to, or None when
+    the rung cannot apply (it is skipped). Transforms are cumulative: each
+    rung starts from the previous rung's configuration."""
+    import jax
+
+    if rung == "precision_highest":
+        return dataclasses.replace(cfg, matmul_precision="highest")
+    if rung == "wide_gram":
+        wide = {"bfloat16": "float32", "float16": "float32",
+                "float32": "float64"}.get(str(dtype))
+        if wide is None:
+            return None  # f64 input: no wider gram exists
+        if wide == "float64" and not jax.config.jax_enable_x64:
+            return None
+        # gram_dtype only bites on the XLA block solvers; route there and
+        # clear the Pallas-only modes that would be rejected.
+        return dataclasses.replace(
+            cfg, gram_dtype=wide, pair_solver="hybrid", precondition="auto",
+            mixed_bulk=None, bulk_bf16=None, mixed_store="auto")
+    if rung == "qr_svd":
+        return dataclasses.replace(
+            cfg, pair_solver="qr-svd", precondition="auto",
+            mixed_bulk=None, bulk_bf16=None, mixed_store="auto")
+    raise ValueError(f"unknown escalation rung {rung!r}")
+
+
+def _lapack_fallback(a, compute_u, compute_v, full_matrices):
+    """Final rung: LAPACK-class gesvd via `jnp.linalg.svd` — a different
+    algorithm (bidiagonalization-based), the strongest possible fallback
+    when every Jacobi regime failed. Health word computed from the
+    outputs (a NaN factor must still read NONFINITE, never OK). Wide
+    inputs go through the same transpose-and-swap as `solver.svd`, so the
+    factor shapes match whatever Jacobi rung might have succeeded."""
+    import jax.numpy as jnp
+
+    from ..solver import SolveStatus, SVDResult
+
+    if a.shape[0] < a.shape[1]:
+        r = _lapack_fallback(a.T, compute_v, compute_u, full_matrices)
+        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
+                         off_rel=r.off_rel, status=r.status)
+    k = min(a.shape)
+    if compute_u or compute_v:
+        u, s, vt = jnp.linalg.svd(a, full_matrices=bool(full_matrices))
+        v = vt[:k, :].T
+        finite = (jnp.isfinite(s).all() & jnp.isfinite(u).all()
+                  & jnp.isfinite(v).all())
+    else:
+        s = jnp.linalg.svd(a, compute_uv=False)
+        u = v = None
+        finite = jnp.isfinite(s).all()
+    status = jnp.where(finite, jnp.int32(int(SolveStatus.OK)),
+                       jnp.int32(int(SolveStatus.NONFINITE)))
+    return SVDResult(u=u if compute_u else None, s=s,
+                     v=v if compute_v else None, sweeps=jnp.int32(0),
+                     off_rel=jnp.float32(0.0), status=status)
+
+
+def resilient_svd(
+    a,
+    *,
+    compute_u: bool = True,
+    compute_v: bool = True,
+    full_matrices: bool = False,
+    config=None,
+    mesh=None,
+    rungs: Sequence[str] = DEFAULT_RUNGS,
+    max_attempts: Optional[int] = None,
+    manifest_path=None,
+    return_report: bool = False,
+):
+    """`svd()` with guarded inputs and a bounded escalation ladder.
+
+    Runs the base configuration first; on a non-``OK`` status walks the
+    ``rungs`` ladder (skipping inapplicable/duplicate configurations)
+    until a solve reports ``OK`` or the ladder is exhausted — the LAST
+    attempt's result is returned either way, its ``status`` telling the
+    caller the truth. ``max_attempts`` bounds the total attempt count
+    (base attempt included). ``mesh`` routes the Jacobi rungs through
+    `parallel.sharded.svd`.
+
+    ``manifest_path``: append one ``"retry"`` record (`obs.manifest`)
+    describing every attempt. ``return_report``: also return the episode
+    report dict ``{"attempts": [...], "final_status": ..., "scale_pow2"}``.
+    """
+    import jax.numpy as jnp
+
+    from .. import obs
+    from ..config import SVDConfig
+    from ..solver import SolveStatus
+    from ..utils._exec import host_scalar
+    from . import guard
+
+    if config is None:
+        config = SVDConfig()
+    a = jnp.asarray(a)
+    if a.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+    a_s, scale_p = guard.prescale(a)
+
+    def run(cfg):
+        if mesh is not None:
+            from ..parallel import sharded
+            return sharded.svd(a_s, mesh=mesh, compute_u=compute_u,
+                               compute_v=compute_v,
+                               full_matrices=full_matrices, config=cfg)
+        from ..solver import svd
+        return svd(a_s, compute_u=compute_u, compute_v=compute_v,
+                   full_matrices=full_matrices, config=cfg)
+
+    # Build the bounded attempt plan: base + applicable rungs, dedup'd.
+    plan = [("base", config)]
+    cfg = config
+    for rung in rungs:
+        if rung == "lapack_gesvd":
+            plan.append((rung, None))
+            continue
+        nxt = _rung_config(rung, cfg, a.dtype)
+        if nxt is None:
+            continue
+        cfg = nxt
+        if all(nxt != c for _, c in plan if c is not None):
+            plan.append((rung, nxt))
+    if max_attempts is not None:
+        plan = plan[:max(1, int(max_attempts))]
+
+    attempts = []
+    result = None
+    for rung, cfg_i in plan:
+        t0 = time.perf_counter()
+        if cfg_i is None:
+            result = _lapack_fallback(a_s, compute_u, compute_v,
+                                      full_matrices)
+        else:
+            result = run(cfg_i)
+        status = SolveStatus(int(host_scalar(result.status)))
+        off = float(host_scalar(result.off_rel))
+        attempts.append({
+            "rung": rung,
+            "status": status.name,
+            "time_s": time.perf_counter() - t0,
+            "sweeps": int(host_scalar(result.sweeps)),
+            "off_norm": off if math.isfinite(off) else None,
+            "config_sha256": (obs.manifest.config_hash(cfg_i)
+                              if cfg_i is not None else None),
+        })
+        if status == SolveStatus.OK:
+            break
+
+    if scale_p:
+        result = result._replace(s=guard.unscale_sigma(result.s, scale_p))
+    report = {"attempts": attempts,
+              "final_status": attempts[-1]["status"],
+              "scale_pow2": scale_p}
+    if manifest_path is not None:
+        record = obs.manifest.build_retry(
+            m=a.shape[0], n=a.shape[1], dtype=str(a.dtype), config=config,
+            attempts=attempts, final_status=report["final_status"],
+            scale_pow2=scale_p)
+        obs.manifest.append(manifest_path, record)
+    return (result, report) if return_report else result

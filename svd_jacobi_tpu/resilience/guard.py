@@ -1,0 +1,100 @@
+"""Guarded inputs: pre-solve screening and power-of-two pre-scaling.
+
+The solver's convergence statistics are built from Gram couplings, which
+are measured against sigma_max^2 — so an f32 input whose entries sit near
+2^60 overflows the Gram path (column norms square to inf) and an input
+near 2^-60 underflows the deflation floor (``dmax2 * (n*eps)^2`` rounds
+to zero and the null-column mask misfires). Both regimes are PERFECTLY
+conditioned problems that merely live at a bad absolute scale.
+
+The guard fixes scale without touching conditioning: multiply the input
+by an exact power of two chosen so ``max|a_ij|`` lands near 1.0, solve,
+and undo the scale on the returned sigmas. A power-of-two multiply is
+exact in every binary float format — U and V are bit-identical to the
+unscaled solve's factors and sigma is exactly ``2^p`` times off, so the
+undo is lossless.
+
+Screening rejects non-finite inputs up front (`NonFiniteInputError`): a
+NaN/Inf payload in the input can never be recovered by re-running, so the
+escalation ladder must fail fast instead of burning four solves.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+
+class NonFiniteInputError(ValueError):
+    """The input matrix contains NaN/Inf — no solver configuration can
+    recover this; fix the producer (the screening happens BEFORE any
+    solve is spent)."""
+
+
+def _safe_exp(dtype) -> int:
+    """|log2(max|a|)| above which the Gram path is at risk in ``dtype``:
+    couplings square the data scale and carry an ~n factor, so keep
+    sigma_max^2 comfortably inside the exponent range (one third of
+    maxexp leaves headroom for both the square and the deflation
+    floor's (n*eps)^2 factor)."""
+    import jax.numpy as jnp
+    return int(jnp.finfo(jnp.dtype(dtype)).maxexp) // 3
+
+
+def _pow2(p: int, dtype):
+    """2.0**p as an exact ``dtype`` scalar (p within the dtype's range)."""
+    import jax.numpy as jnp
+    return jnp.asarray(2.0, jnp.dtype(dtype)) ** p
+
+
+def _apply_pow2(x, p: int):
+    """x * 2^p in two half-steps so neither intermediate scalar leaves
+    the dtype's normal range (2^-127 is subnormal in f32; splitting the
+    exponent keeps every factor normal and the product exact)."""
+    if p == 0:
+        return x
+    h = p // 2
+    return (x * _pow2(h, x.dtype)) * _pow2(p - h, x.dtype)
+
+
+def screen(a) -> dict:
+    """Pre-solve health report of an input matrix (host-side, one pass):
+    ``{"finite": bool, "amax": float, "scale_pow2": int}`` where
+    ``scale_pow2`` is the exact power-of-two exponent `prescale` would
+    apply (0 when the input's scale is already safe)."""
+    import jax.numpy as jnp
+
+    from ..utils._exec import host_scalar
+
+    finite = bool(host_scalar(jnp.isfinite(a).all()))
+    amax = float(host_scalar(jnp.max(jnp.abs(a)))) if finite else math.inf
+    scale = 0
+    if finite and amax > 0.0:
+        # frexp: amax = frac * 2^e with frac in [0.5, 1) — e is the
+        # power-of-two bucket of the data scale.
+        e = math.frexp(amax)[1]
+        if abs(e) > _safe_exp(a.dtype):
+            scale = -e
+    return {"finite": finite, "amax": amax, "scale_pow2": scale}
+
+
+def prescale(a, *, require_finite: bool = True) -> Tuple[object, int]:
+    """Screen ``a`` and return ``(a_scaled, p)`` with
+    ``a_scaled = a * 2^p`` brought to a Gram-safe scale (``p = 0`` and
+    ``a`` returned untouched when already safe). Raises
+    `NonFiniteInputError` on NaN/Inf input unless ``require_finite`` is
+    False."""
+    rep = screen(a)
+    if require_finite and not rep["finite"]:
+        raise NonFiniteInputError(
+            "input matrix contains non-finite entries (NaN/Inf); no solver "
+            "escalation can recover this — screen or repair the input")
+    p = rep["scale_pow2"]
+    return (_apply_pow2(a, p) if p else a), p
+
+
+def unscale_sigma(s, p: int):
+    """Undo `prescale` on the returned singular values: the factors of
+    ``2^p * A`` equal those of ``A`` exactly, and sigma is exactly
+    ``2^p`` scaled — multiply by ``2^-p`` (exact)."""
+    return _apply_pow2(s, -p) if p else s

@@ -19,6 +19,7 @@ capability upgrades over the reference, per SURVEY.md section 7:
 
 from __future__ import annotations
 
+import enum
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -32,6 +33,34 @@ from .obs.scopes import scope
 from .ops import blockwise, rounds
 from .ops import pallas_blocks as pb
 from .parallel import schedule as sched
+from .resilience import chaos as _chaos
+
+
+class SolveStatus(enum.IntEnum):
+    """Health word of a solve — how the sweep loop exited.
+
+    The reference has no notion of solve health at all (its convergence
+    estimate is computed and discarded, lib/JacobiMethods.cu:462,234); here
+    every fused loop carries a cheap in-graph health word and decodes it
+    into this enum (`SVDResult.status`):
+
+      * OK          — converged to tolerance;
+      * MAX_SWEEPS  — the sweep budget ran out above tolerance;
+      * STAGNATED   — the stall detector stopped the loop above tolerance
+                      (an endgame sweep failed to keep shrinking the
+                      coupling — the criterion's roundoff floor sits above
+                      the requested tol) without exhausting the budget;
+      * NONFINITE   — NaN/Inf detected in the working state or the
+                      convergence statistic. The deflation mask silently
+                      DROPS NaN columns from the masked statistic, so
+                      without this word a NaN-poisoned solve is
+                      indistinguishable from a converged one.
+    """
+
+    OK = 0
+    MAX_SWEEPS = 1
+    STAGNATED = 2
+    NONFINITE = 3
 
 
 class SVDResult(NamedTuple):
@@ -39,7 +68,8 @@ class SVDResult(NamedTuple):
 
     ``sweeps``/``off_rel`` are the convergence diagnostics the reference
     computes but discards (lib/JacobiMethods.cu:462,234); the bench and
-    checkpoint subsystems report them.
+    checkpoint subsystems report them. ``status`` is the in-graph health
+    word (int32 `SolveStatus` code; `status_enum()` decodes it on host).
     """
 
     u: Optional[jax.Array]
@@ -47,6 +77,13 @@ class SVDResult(NamedTuple):
     v: Optional[jax.Array]
     sweeps: jax.Array
     off_rel: jax.Array
+    status: Optional[jax.Array] = None
+
+    def status_enum(self) -> SolveStatus:
+        """Host-side decode of ``status`` (one sanctioned scalar read)."""
+        if self.status is None:
+            raise ValueError("this SVDResult carries no status word")
+        return SolveStatus(int(_host_scalar(self.status)))
 
 
 def _default_tol(m: int, n: int, dtype, criterion: str = "rel") -> float:
@@ -148,7 +185,7 @@ def _resolve_xla_options(a, config: SVDConfig, compute_uv: bool = True):
 
 
 def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
-                     stall_detection=True, criterion="rel"):
+                     stall_detection=True, criterion="rel", nonfinite=None):
     """Criterion-aware wrapper over the ONE shared sweep-loop predicate
     (`ops.rounds.should_continue` — also used by `rounds.iterate_phase`
     and the mesh while_loops, so the stall logic cannot drift again):
@@ -167,7 +204,31 @@ def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
     return rounds.should_continue(off_rel, prev_off, sweeps, tol=tol,
                                   max_sweeps=max_sweeps,
                                   stall_detection=stall_detection,
-                                  stall_gate=gate, stall_shrink=shrink)
+                                  stall_gate=gate, stall_shrink=shrink,
+                                  nonfinite=nonfinite)
+
+
+def _status_word(off_rel, sweeps, nonfinite, *, tol, max_sweeps):
+    """Decode a finished sweep loop's exit into a `SolveStatus` code,
+    in-graph. The inputs are exactly the loop's final carry, so this costs
+    a handful of scalar ops — the health word rides the reductions the
+    loop already pays for (see PROFILE.md). Order matters: non-finite
+    trumps everything (a NaN off-norm can compare as "converged" through
+    the deflation mask), tolerance-convergence is OK, an exhausted budget
+    is MAX_SWEEPS, and the only remaining exit — the stall detector
+    firing above tolerance — is STAGNATED. Callers decide how hard to
+    react: `resilience.resilient_svd` escalates on any non-OK status, the
+    CLI exits non-zero."""
+    with scope("health"):
+        nf = jnp.logical_or(jnp.asarray(nonfinite, jnp.bool_),
+                            ~jnp.isfinite(off_rel))
+        code = jnp.where(
+            nf, jnp.int32(int(SolveStatus.NONFINITE)),
+            jnp.where(off_rel <= tol, jnp.int32(int(SolveStatus.OK)),
+                      jnp.where(sweeps >= max_sweeps,
+                                jnp.int32(int(SolveStatus.MAX_SWEEPS)),
+                                jnp.int32(int(SolveStatus.STAGNATED)))))
+        return code.astype(jnp.int32)
 
 
 # Max squared column norm over both stacks (the GLOBAL deflation scale; mesh
@@ -235,12 +296,19 @@ def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd",
 
 def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
                     gram_dtype, method, criterion, stall_detection=True,
-                    telemetry=False, stage="single"):
+                    telemetry=False, stage="single", chaos_nan_sweep=None):
     """while_loop over sweeps until the scaled coupling drops below tol.
 
-    Also stops on *stall* — see `_should_continue`. ``telemetry`` (static,
-    baked into the caller's jit key): emit an `obs.metrics` "sweep" event
-    per iteration; off keeps the trace identical to the untelemetered one.
+    Also stops on *stall* — see `_should_continue` — and on the health
+    word tripping: the carry's ``nonfinite`` flag rides the dmax2/off-norm
+    reductions each sweep already computes (NaN and Inf in the stacks both
+    poison the max-of-squares), because the deflation mask silently drops
+    NaN columns from the masked statistic. Returns the flag so the caller
+    can decode `SolveStatus`. ``telemetry`` (static, baked into the
+    caller's jit key): emit an `obs.metrics` "sweep" event per iteration;
+    off keeps the trace identical to the untelemetered one.
+    ``chaos_nan_sweep`` (static): `resilience.chaos` NaN injection hook;
+    None (production) traces no injection code.
     """
     with_v = vtop is not None
     k = top.shape[0]
@@ -248,19 +316,23 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
         vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
 
     def cond(state):
-        _, _, _, _, off_rel, prev_off, sweeps = state
+        _, _, _, _, off_rel, prev_off, sweeps, nonfinite = state
         return _should_continue(off_rel, prev_off, sweeps,
                                 tol=tol, max_sweeps=max_sweeps,
                                 stall_detection=stall_detection,
-                                criterion=criterion)
+                                criterion=criterion, nonfinite=nonfinite)
 
     def body(state):
-        top, bot, vtop, vbot, prev_off, _, sweeps = state
+        top, bot, vtop, vbot, prev_off, _, sweeps, nonfinite = state
+        if chaos_nan_sweep is not None:
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
         dmax2 = _global_dmax2(top, bot)
         top, bot, vtop, vbot, off_rel = _sweep(
             top, bot, vtop if with_v else None, vbot if with_v else None,
             precision=precision, gram_dtype=gram_dtype, method=method,
             criterion=criterion, dmax2=dmax2)
+        nonfinite = (nonfinite | ~jnp.isfinite(dmax2)
+                     | ~jnp.isfinite(off_rel))
         if telemetry:
             metrics.emit("sweep",
                          meta={"path": "xla", "stage": stage,
@@ -268,12 +340,16 @@ def _jacobi_iterate(top, bot, vtop, vbot, *, tol, max_sweeps, precision,
                          sweep=sweeps + 1, off_rel=off_rel)
         if not with_v:
             vtop, vbot = state[2], state[3]
-        return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1)
+        return (top, bot, vtop, vbot, off_rel, prev_off, sweeps + 1,
+                nonfinite)
 
     inf = jnp.float32(jnp.inf)
-    init = (top, bot, vtop, vbot, inf, inf, jnp.int32(0))
-    top, bot, vtop, vbot, off_rel, _, sweeps = jax.lax.while_loop(cond, body, init)
-    return top, bot, (vtop if with_v else None), (vbot if with_v else None), off_rel, sweeps
+    init = (top, bot, vtop, vbot, inf, inf, jnp.int32(0),
+            jnp.zeros((), jnp.bool_))
+    (top, bot, vtop, vbot, off_rel, _, sweeps,
+     nonfinite) = jax.lax.while_loop(cond, body, init)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off_rel, sweeps, nonfinite)
 
 
 def _complete_orthonormal(u, n, dtype):
@@ -333,10 +409,10 @@ def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
 @partial(jax.jit, static_argnames=(
     "n", "compute_u", "compute_v", "full_u", "nblocks", "tol", "max_sweeps",
     "precision", "gram_dtype_name", "method", "criterion", "stall_detection",
-    "telemetry"))
+    "telemetry", "chaos_nan_sweep"))
 def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
                 max_sweeps, precision, gram_dtype_name, method, criterion,
-                stall_detection=True, telemetry=False):
+                stall_detection=True, telemetry=False, chaos_nan_sweep=None):
     m, n_pad = a.shape
     dtype = a.dtype
     gram_dtype = jnp.dtype(gram_dtype_name)
@@ -352,17 +428,18 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
         # restoring U orthogonality / small-sigma relative accuracy. The
         # phase-2 loop starts from near-converged state, so it typically
         # adds only 1-3 sweeps.
-        top, bot, vtop, vbot, off1, s1 = _jacobi_iterate(
+        top, bot, vtop, vbot, off1, s1, nf1 = _jacobi_iterate(
             top, bot, vtop, vbot, tol=_abs_phase_tol(dtype),
             max_sweeps=max_sweeps,
             precision=precision, gram_dtype=gram_dtype, method="gram-eigh",
             criterion="abs", stall_detection=stall_detection,
-            telemetry=telemetry, stage="bulk")
+            telemetry=telemetry, stage="bulk",
+            chaos_nan_sweep=chaos_nan_sweep)
         if telemetry:
             metrics.emit("stage", meta={"path": "xla", "stage": "bulk"},
                          sweeps=s1, off_rel=off1)
         # max_sweeps stays a TOTAL budget across both phases.
-        top, bot, vtop, vbot, off2, s2 = _jacobi_iterate(
+        top, bot, vtop, vbot, off2, s2, nf2 = _jacobi_iterate(
             top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps - s1,
             precision=precision, gram_dtype=gram_dtype, method="qr-svd",
             criterion=criterion, stall_detection=stall_detection,
@@ -371,17 +448,21 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
         # off = inf; report the bulk statistic instead.
         off_rel = jnp.where(s2 > 0, off2, off1)
         sweeps = s1 + s2
+        nonfinite = nf1 | nf2
     else:
-        top, bot, vtop, vbot, off_rel, sweeps = _jacobi_iterate(
+        top, bot, vtop, vbot, off_rel, sweeps, nonfinite = _jacobi_iterate(
             top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
             precision=precision, gram_dtype=gram_dtype, method=method,
             criterion=criterion, stall_detection=stall_detection,
-            telemetry=telemetry, stage="single")
+            telemetry=telemetry, stage="single",
+            chaos_nan_sweep=chaos_nan_sweep)
+    status = _status_word(off_rel, sweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if compute_v else None
     u, s, v = _postprocess(a_work, v_work, n, compute_u=compute_u,
                            full_u=full_u, dtype=dtype)
-    return u, s, v, sweeps, off_rel
+    return u, s, v, sweeps, off_rel, status
 
 
 def _colnorms_compensated(w):
@@ -510,13 +591,14 @@ def _ns_orthogonalize(g, steps: int = 3):
 _PALLAS_STATIC = (
     "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
     "max_sweeps", "precondition", "polish", "bulk_bf16", "mixed",
-    "mixed_store", "interpret", "stall_detection", "refine", "telemetry")
+    "mixed_store", "interpret", "stall_detection", "refine", "telemetry",
+    "chaos_nan_sweep")
 
 
 def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
                      tol, max_sweeps, precondition, polish, bulk_bf16, mixed,
                      mixed_store="f32", interpret=False, stall_detection=True,
-                     refine=False, telemetry=False):
+                     refine=False, telemetry=False, chaos_nan_sweep=None):
     """The Pallas device-kernel solve (pair_solver="pallas"), m >= n.
 
     With preconditioning (Drmac-style, dgejsv's structure): norm-sort the
@@ -570,6 +652,7 @@ def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
 
     bulk_off = jnp.float32(jnp.inf)
     bulk_sweeps = jnp.int32(0)
+    bulk_nf = None
     if mixed:
         # Stage 1 (bulk): cheap sweeps down to the bf16 drift floor. G is
         # ALWAYS accumulated here — it is the reconstitution map — even
@@ -597,13 +680,14 @@ def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
             xt, xb = top.astype(bf16), bot.astype(bf16)
         if mixed_store == "bf16g":
             gvt, gvb = gvt.astype(bf16), gvb.astype(bf16)
-        _, _, gvt, gvb, bulk_off, bulk_sweeps = rounds.iterate_phase(
+        _, _, gvt, gvb, bulk_off, bulk_sweeps, bulk_nf = rounds.iterate_phase(
             xt, xb, gvt, gvb, stop_tol=jnp.float32(rounds.MIXED_TOL),
             rtol=rounds.MIXED_TOL, max_sweeps=max_sweeps,
             interpret=interpret, polish=polish, bf16_gram=True,
             apply_x3=True, stall_detection=stall_detection,
             stall_gate=10.0 * rounds.MIXED_TOL, stall_shrink=0.5,
-            telemetry=telemetry, stage="mixed_bulk")
+            telemetry=telemetry, stage="mixed_bulk",
+            chaos_nan_sweep=chaos_nan_sweep)
         if telemetry:
             # No "path" tag here: the stage's own sweep events carry the
             # exact fused/kernel label (rounds.iterate_phase computes the
@@ -627,14 +711,17 @@ def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
                 vtop, vbot = _blockify(g.astype(dtype), n_pad, nblocks)
 
     # f32 sweeps (stage 3 of the mixed regime, or the whole solve).
-    top, bot, vtop, vbot, off_rel, sweeps = rounds.iterate(
+    top, bot, vtop, vbot, off_rel, sweeps, nonfinite = rounds.iterate(
         top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
         interpret=interpret, polish=polish, bulk_bf16=bulk_bf16,
         stall_detection=stall_detection, start_sweeps=bulk_sweeps,
-        telemetry=telemetry, stage="polish" if mixed else "single")
+        telemetry=telemetry, stage="polish" if mixed else "single",
+        nonfinite0=bulk_nf, chaos_nan_sweep=chaos_nan_sweep)
     # Mixed budget-exhaustion: report the bulk statistic if the polish
     # never ran (cf. rounds.iterate's identical carry handling).
     off_rel = jnp.where(sweeps > bulk_sweeps, off_rel, bulk_off)
+    status = _status_word(off_rel, sweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
 
     a_work = _deblockify(top, bot)
     v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
@@ -651,16 +738,16 @@ def _svd_pallas_impl(a, *, n, compute_u, compute_v, full_u, nblocks, n_pad,
         if compute_v:
             v = jnp.matmul(q2, rot.astype(acc), precision=hi)
             v = jnp.zeros_like(v).at[order, :].set(v).astype(dtype)
-        return u, s, v, sweeps, off_rel
+        return u, s, v, sweeps, off_rel, status
     if precondition == "on":
         u, v = _recombine_precondition(
             cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_u, dtype=dtype, q1=q1, order=order)
-        return u, s, v, sweeps, off_rel
+        return u, s, v, sweeps, off_rel, status
     u = cols
     if compute_u and full_u and m > n and u is not None:
         u = _complete_orthonormal(u, n, dtype)
-    return u, s, rot, sweeps, off_rel
+    return u, s, rot, sweeps, off_rel, status
 
 
 _svd_pallas = partial(jax.jit, static_argnames=_PALLAS_STATIC)(
@@ -744,7 +831,8 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
             mixed=bool(mixed), mixed_store=mixed_store,
             interpret=not pb.supported(),
             stall_detection=bool(config.stall_detection),
-            refine=bool(refine), telemetry=bool(metrics.enabled()))
+            refine=bool(refine), telemetry=bool(metrics.enabled()),
+            chaos_nan_sweep=_chaos.consume_nan_sweep())
         return "pallas", solve, a, kwargs
 
     if config.precondition in ("on", "double") or config.mixed_bulk:
@@ -765,7 +853,8 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
         max_sweeps=int(config.max_sweeps), precision=config.matmul_precision,
         gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
         stall_detection=bool(config.stall_detection),
-        telemetry=bool(metrics.enabled()))
+        telemetry=bool(metrics.enabled()),
+        chaos_nan_sweep=_chaos.consume_nan_sweep())
     return "padded", _svd_padded, a_pad, kwargs
 
 
@@ -799,12 +888,13 @@ def svd(
     if m < n:
         r = svd(a.T, compute_u=compute_v, compute_v=compute_u,
                 full_matrices=full_matrices, config=config)
-        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps, off_rel=r.off_rel)
+        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
+                         off_rel=r.off_rel, status=r.status)
 
     entry, solve, a_in, kwargs = _plan_entry(
         a, config, compute_u=compute_u, compute_v=compute_v,
         full_matrices=full_matrices)
-    u, s, v, sweeps, off_rel = solve(a_in, **kwargs)
+    u, s, v, sweeps, off_rel, status = solve(a_in, **kwargs)
     if entry == "padded":
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (u is not None or v is not None))
@@ -815,7 +905,8 @@ def svd(
                                       with_u=u is not None,
                                       with_v=v is not None,
                                       full_u=bool(full_matrices))
-    return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel)
+    return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
+                     status=status)
 
 
 @partial(jax.jit, static_argnames=("n", "with_u", "with_v", "full_u"))
@@ -934,6 +1025,9 @@ class SweepStepper:
         self._stage = "bulk" if self.method == "hybrid" else "single"
         self._just_switched = False
         self._input_digest = None
+        # Why the host loop stopped ("tol" | "stall" | "max_sweeps" |
+        # "nonfinite"); decoded into SVDResult.status by finish().
+        self._stop_reason = None
 
     def _host_kernel_path(self) -> bool:
         """Whether this stepper runs the Pallas kernel sweeps directly
@@ -1102,14 +1196,26 @@ class SweepStepper:
         return SweepState(top, bot, vtop, vbot, off, state.sweeps + 1)
 
     def should_continue(self, state: SweepState) -> bool:
+        import math
         sweeps = int(_host_scalar(state.sweeps))
         if sweeps == 0:
             return True
-        if sweeps >= self.config.max_sweeps:
+        off = _host_scalar(state.off_rel)
+        if not math.isfinite(off):
+            # Fail fast on a poisoned statistic; finish() additionally
+            # probes the stacks themselves (the deflation mask can hide
+            # NaN columns from the masked stat).
+            self._stop_reason = "nonfinite"
             return False
         _, criterion, tol = self._phase()
+        if sweeps >= self.config.max_sweeps:
+            # Tolerance wins over budget exhaustion — a solve that
+            # converged exactly on its last budgeted sweep is OK, matching
+            # `_status_word`'s decode order on the fused paths.
+            self._stop_reason = "tol" if off <= tol else "max_sweeps"
+            return False
         go = bool(_should_continue(
-            _host_scalar(state.off_rel), self._prev_off, sweeps,
+            off, self._prev_off, sweeps,
             tol=tol, max_sweeps=self.config.max_sweeps,
             stall_detection=self.config.stall_detection, criterion=criterion))
         if not go and self._stage == "bulk":
@@ -1120,9 +1226,41 @@ class SweepStepper:
             self._prev_off = float("inf")
             self._just_switched = True
             return True
+        if not go:
+            self._stop_reason = "tol" if off <= tol else "stall"
         return go
 
+    def _status(self, state: SweepState) -> jax.Array:
+        """The host-stepped path's SolveStatus word: one device probe of
+        the final stacks (`_nonfinite_probe_jit` — the deflation mask can
+        hide NaN columns from off_rel, cf. `_status_word`) combined with
+        the recorded host-loop stop reason."""
+        import math
+        nf = bool(_host_scalar(_nonfinite_probe_jit(
+            state.top, state.bot, state.off_rel)))
+        if nf:
+            code = SolveStatus.NONFINITE
+        else:
+            reason = self._stop_reason
+            if reason is None:
+                # finish() before the loop ended (caller stopped early):
+                # derive from the visible state.
+                off = _host_scalar(state.off_rel)
+                sweeps = int(_host_scalar(state.sweeps))
+                if math.isfinite(off) and off <= self.tol:
+                    reason = "tol"
+                elif sweeps >= self.config.max_sweeps:
+                    reason = "max_sweeps"
+                else:
+                    reason = "stall"
+            code = {"tol": SolveStatus.OK,
+                    "max_sweeps": SolveStatus.MAX_SWEEPS,
+                    "stall": SolveStatus.STAGNATED,
+                    "nonfinite": SolveStatus.NONFINITE}[reason]
+        return jnp.int32(int(code))
+
     def finish(self, state: SweepState) -> SVDResult:
+        status = self._status(state)
         if self._kernel_path:
             q1, order, work = self._precond_state()
             refine = (self.config.sigma_refine
@@ -1134,13 +1272,24 @@ class SweepStepper:
                 compute_v=self.compute_v, full_u=self.full_matrices,
                 precondition=self._precondition, refine=bool(refine))
             return SVDResult(u=u, s=s, v=v, sweeps=state.sweeps,
-                             off_rel=state.off_rel)
+                             off_rel=state.off_rel, status=status)
         u, s, v = _finish_jit(
             state.top, state.bot, state.vtop, state.vbot, n=self.n,
             compute_u=self.compute_u, compute_v=self.compute_v,
             full_u=self.full_matrices)
         return SVDResult(u=u, s=s, v=(v if self.compute_v else None),
-                         sweeps=state.sweeps, off_rel=state.off_rel)
+                         sweeps=state.sweeps, off_rel=state.off_rel,
+                         status=status)
+
+
+@jax.jit
+def _nonfinite_probe_jit(top, bot, off_rel):
+    """One cheap reduction over the final stacks: the host-stepped paths'
+    equivalent of the fused loops' in-graph health word (NaN/Inf anywhere
+    in the work poisons the max-of-squares; the off-norm is checked too
+    because an all-dead deflation mask can leave it finite)."""
+    return jnp.logical_or(~jnp.isfinite(_global_dmax2(top, bot)),
+                          ~jnp.isfinite(off_rel))
 
 
 @partial(jax.jit, static_argnames=("with_v", "precision", "gram_dtype_name",

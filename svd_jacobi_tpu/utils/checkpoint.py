@@ -1,4 +1,4 @@
-"""Sweep-boundary checkpoint/resume.
+"""Sweep-boundary checkpoint/resume, hardened against real failure modes.
 
 The reference has NO failure handling or checkpointing: MPI errors are
 printed and execution carries on (reference: lib/JacobiMethods.cu:359-370,
@@ -8,6 +8,24 @@ are cheap: `.npz` via numpy, atomic rename, with solver configuration and a
 layout fingerprint stored alongside so a resume with mismatched shapes or
 options fails fast instead of corrupting the solve.
 
+Hardening (resilience PR; the `-m chaos` lane injects each failure):
+
+  * every snapshot carries a SHA-256 payload checksum verified on load
+    (zip CRCs catch torn files; the checksum additionally catches silent
+    payload corruption and any partial-write the container survives);
+  * writes are atomic AND durable: temp file fsync'd before the rename,
+    parent directory fsync'd after it, temp removed on every failure path;
+  * snapshots rotate (current + one previous generation): a corrupt or
+    mismatched current snapshot is QUARANTINED (renamed aside for
+    forensics, never deleted) and the resume falls back to the previous
+    generation; only when no generation is loadable does the resume raise;
+  * `svd_checkpointed` installs a SIGTERM handler for the duration of the
+    solve: a preemption signal triggers one final snapshot at the next
+    sweep boundary before the process dies (kill-then-resume loses at most
+    the in-flight sweep, not ``every`` sweeps);
+  * the multi-process save barrier has a TIMEOUT (a dead peer used to hang
+    the barrier — and the job — forever).
+
 Usage:
     r = svd_checkpointed(a, path="ckpt.npz", every=2)   # resumes if present
 """
@@ -15,9 +33,13 @@ Usage:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+import signal
 import tempfile
+import threading
+import warnings
 from pathlib import Path
 from typing import Optional
 
@@ -25,9 +47,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import SVDConfig
-from ..solver import SVDResult, SweepState, SweepStepper
+from ..resilience import chaos as _chaos
+from ..solver import SolveStatus, SVDResult, SweepState, SweepStepper
 
-_FORMAT = 2
+_FORMAT = 3  # 3: payload checksum + snapshot rotation
+
+# Multi-process save barrier deadline (seconds; SVDJ_CKPT_BARRIER_TIMEOUT_S
+# overrides). A dead peer must fail the save loudly, not hang it forever.
+_BARRIER_TIMEOUT_S = 300.0
+
+
+class CheckpointCorruptError(ValueError):
+    """A snapshot failed to load (torn/corrupt payload, checksum or
+    fingerprint mismatch) and no rotated generation could take over.
+    Subclasses ValueError: resume-validation failures have always raised
+    ValueError here and callers match on that."""
 
 
 def _proc_path(path) -> Path:
@@ -79,6 +113,38 @@ def _fingerprint(stepper: SweepStepper) -> dict:
     }
 
 
+def _fsync_dir(dirpath: Path) -> None:
+    """fsync a directory so a completed rename is durable (an fsync'd FILE
+    under a non-fsync'd directory entry can still vanish on power loss).
+    Best-effort: some filesystems/platforms reject directory fsync."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _payload_checksum(payload: dict) -> str:
+    """SHA-256 over every array's identity + bytes, key-sorted (stable
+    regardless of np.savez's internal member order). Hashes through a
+    zero-copy memoryview: the payload holds the FULL work stacks
+    (multi-GB at the sizes that need checkpointing) and `.tobytes()`
+    would transiently double host memory on every save."""
+    h = hashlib.sha256()
+    for key in sorted(payload):
+        arr = np.ascontiguousarray(payload[key])
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(memoryview(arr).cast("B"))
+    return h.hexdigest()
+
+
 def _write_npz_atomic(path: Path, payload: dict, pre_rename=None) -> None:
     fd, tmp = tempfile.mkstemp(dir=path.parent or Path("."),
                                suffix=".npz.tmp")
@@ -93,10 +159,74 @@ def _write_npz_atomic(path: Path, payload: dict, pre_rename=None) -> None:
         if pre_rename is not None:
             pre_rename()
         os.replace(tmp, path)
-    except BaseException:
+        # ... and make the rename itself durable: the new directory entry
+        # must reach stable storage too.
+        _fsync_dir(path.parent or Path("."))
+    finally:
+        # Remove the temp file on EVERY failure path (np.savez error,
+        # pre_rename/barrier failure, rename error) — a crash used to leak
+        # `*.npz.tmp` files beside the snapshot.
         if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _prev_path(path: Path) -> Path:
+    """The rotated previous-generation snapshot beside ``path``."""
+    return path.with_name(path.name + ".prev")
+
+
+def _quarantine(path: Path, why: str) -> Optional[Path]:
+    """Move an unusable snapshot aside (never delete — it is forensic
+    evidence) and warn. Destinations are uniquified so a later corruption
+    event cannot overwrite earlier evidence. Returns the quarantine path,
+    or None when the file was already gone."""
+    if not path.exists():
+        return None
+    dest = path.with_name(path.name + ".quarantined")
+    n = 1
+    while dest.exists():
+        dest = path.with_name(f"{path.name}.quarantined.{n}")
+        n += 1
+    os.replace(path, dest)
+    warnings.warn(f"checkpoint {path} quarantined to {dest}: {why}",
+                  RuntimeWarning, stacklevel=2)
+    return dest
+
+
+def _rotate(path: Path) -> None:
+    """Keep one previous generation: current -> ``<name>.prev`` right
+    before the fresh snapshot takes the final name."""
+    if path.exists():
+        os.replace(path, _prev_path(path))
+
+
+def _run_barrier(fn, timeout: float, what: str) -> None:
+    """Run a collective barrier with a deadline. The barrier itself cannot
+    be cancelled (it blocks in native code), but a timed-out save must
+    RAISE — an indefinitely hung save is strictly worse than a failed one
+    (the job looks alive while making no progress and holding its TPUs)."""
+    err = []
+
+    def target():
+        try:
+            fn()
+        except BaseException as e:  # re-raised on the caller thread
+            err.append(e)
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise RuntimeError(
+            f"{what} barrier timed out after {timeout:.0f}s — a peer "
+            "process is unreachable (dead or wedged); aborting the save "
+            "instead of hanging. Tune SVDJ_CKPT_BARRIER_TIMEOUT_S if the "
+            "cluster is just slow.")
+    if err:
+        raise err[0]
 
 
 def save_state(path, stepper: SweepStepper, state: SweepState) -> None:
@@ -116,7 +246,9 @@ def save_state(path, stepper: SweepStepper, state: SweepState) -> None:
         payload.update(top=np.asarray(state.top), bot=np.asarray(state.bot),
                        vtop=np.asarray(state.vtop),
                        vbot=np.asarray(state.vbot))
-        _write_npz_atomic(path, payload)
+        payload["checksum"] = np.frombuffer(
+            _payload_checksum(payload).encode(), dtype=np.uint8)
+        _write_npz_atomic(path, payload, pre_rename=lambda: _rotate(path))
         return
     for name in ("top", "bot", "vtop", "vbot"):
         arr = getattr(state, name)
@@ -127,18 +259,43 @@ def save_state(path, stepper: SweepStepper, state: SweepState) -> None:
         for shard in arr.addressable_shards:
             start = shard.index[0].start or 0
             payload[f"{name}_{start}"] = np.asarray(shard.data)
+    payload["checksum"] = np.frombuffer(
+        _payload_checksum(payload).encode(), dtype=np.uint8)
     # Narrow the torn-snapshot window: every process finishes writing +
     # fsyncing its temp file BEFORE any renames land (barrier between the
     # two), so a kill during the long write phase leaves the previous
     # snapshot generation intact everywhere. A kill during the rename
     # syscalls themselves can still tear; load_state allgathers the
-    # restored sweep counters and fails loudly on divergence.
+    # restored sweep counters and fails loudly on divergence. The barrier
+    # runs behind a deadline: a dead peer fails the save instead of
+    # hanging it (and the job) forever.
     from jax.experimental import multihost_utils
 
-    def barrier():
-        multihost_utils.sync_global_devices("svd_jacobi_ckpt_save")
+    ppath = _proc_path(path)
+    timeout = float(os.environ.get("SVDJ_CKPT_BARRIER_TIMEOUT_S",
+                                   _BARRIER_TIMEOUT_S))
 
-    _write_npz_atomic(_proc_path(path), payload, pre_rename=barrier)
+    def pre_rename():
+        _run_barrier(
+            lambda: multihost_utils.sync_global_devices("svd_jacobi_ckpt_save"),
+            timeout, "checkpoint save")
+        _rotate(ppath)
+
+    _write_npz_atomic(ppath, payload, pre_rename=pre_rename)
+
+
+def _verify_checksum(z, path) -> None:
+    """Recompute the payload checksum of an open npz and compare. Raises
+    ValueError on mismatch or on a pre-checksum (format < 3) snapshot."""
+    if "checksum" not in z.files:
+        raise ValueError(f"checkpoint {path} has no payload checksum "
+                         "(pre-format-3 snapshot)")
+    want = bytes(z["checksum"]).decode()
+    got = _payload_checksum({k: z[k] for k in z.files if k != "checksum"})
+    if got != want:
+        raise ValueError(
+            f"checkpoint {path} failed its payload checksum "
+            f"({got[:12]} != {want[:12]}): corrupt snapshot")
 
 
 def _validate_meta(z, stepper, path) -> str:
@@ -153,16 +310,12 @@ def _validate_meta(z, stepper, path) -> str:
     return stage
 
 
-def load_state(path, stepper: SweepStepper) -> SweepState:
-    """Load a snapshot, validating it matches this solve's layout/options.
-
-    Multi-process mesh solves: each process loads its own
-    ``<path>.procIofN`` shard file and the global arrays are reassembled
-    from per-device shards — the mirror of `save_state`'s per-process
-    dump."""
-    if _sharded_snapshot(stepper):
-        return _load_state_multiprocess(path, stepper)
+def _load_single(path, stepper) -> SweepState:
+    """Load + fully validate ONE single-process snapshot file (raises on
+    any corruption/mismatch; the candidate loop in `load_state` decides
+    what happens next)."""
     with np.load(path) as z:
+        _verify_checksum(z, path)
         stage = _validate_meta(z, stepper, path)
         dtype = stepper.input_dtype
         state = SweepState(
@@ -173,16 +326,45 @@ def load_state(path, stepper: SweepStepper) -> SweepState:
     return stepper.reshard(state)
 
 
-def _load_state_multiprocess(path, stepper) -> SweepState:
+def load_state(path, stepper: SweepStepper) -> SweepState:
+    """Load a snapshot, validating checksum + layout/options fingerprint.
+
+    A current snapshot that fails to load (torn file, checksum mismatch,
+    fingerprint from a different solve) is QUARANTINED and the rotated
+    previous generation takes over; only when no generation loads does
+    this raise (the first failure's error, chained).
+
+    Multi-process mesh solves: each process loads its own
+    ``<path>.procIofN`` shard file and the global arrays are reassembled
+    from per-device shards — the mirror of `save_state`'s per-process
+    dump; the generation fallback is decided collectively so every
+    process resumes the same sweep."""
+    if _sharded_snapshot(stepper):
+        return _load_state_multiprocess(path, stepper)
+    path = Path(path)
+    first_err = None
+    for cand in (path, _prev_path(path)):
+        if not cand.exists():
+            continue
+        try:
+            return _load_single(cand, stepper)
+        except Exception as e:  # noqa: BLE001 — any load failure is final
+            first_err = first_err or e
+            _quarantine(cand, f"{type(e).__name__}: {e}")
+    raise CheckpointCorruptError(
+        f"no loadable snapshot generation at {path} (unusable files were "
+        f"quarantined beside it); first failure: {first_err}") from first_err
+
+
+def _load_proc_file(ppath, stepper, sharding):
+    """Load + fully validate THIS process's shard file of one snapshot
+    generation; returns (SweepState, stage). Raises on any corruption."""
     import jax
 
-    sharding = getattr(stepper, "_sharding", None)
-    if sharding is None:
-        raise ValueError("multi-process resume requires a mesh SweepStepper")
-    ppath = _proc_path(path)
     dtype = stepper.input_dtype
     k = stepper.nblocks // 2
     with np.load(ppath) as z:
+        _verify_checksum(z, ppath)
         stage = _validate_meta(z, stepper, ppath)
 
         def shard_shape(name):
@@ -209,20 +391,54 @@ def _load_state_multiprocess(path, stepper) -> SweepState:
             top=state_arrays["top"], bot=state_arrays["bot"],
             vtop=state_arrays["vtop"], vbot=state_arrays["vbot"],
             off_rel=jnp.float32(z["off_rel"]), sweeps=jnp.int32(z["sweeps"]))
-    # Torn-snapshot guard: a kill during save's rename phase can leave
-    # processes holding files from DIFFERENT sweeps; resuming such a mix
-    # silently diverges the sharded state (and can deadlock the
-    # collectives once should_continue disagrees). Fail loudly instead.
+    return state, stage
+
+
+def _load_state_multiprocess(path, stepper) -> SweepState:
     from jax.experimental import multihost_utils
-    sweeps_all = multihost_utils.process_allgather(
-        np.asarray([int(state.sweeps)]))
-    if len(set(int(x) for x in sweeps_all.ravel())) != 1:
-        raise RuntimeError(
-            f"torn multi-process checkpoint {path}: per-process snapshots "
-            f"are from different sweeps {sweeps_all.ravel().tolist()}; "
-            "delete them and restart the solve")
-    stepper.restore_stage(stage)
-    return state
+
+    sharding = getattr(stepper, "_sharding", None)
+    if sharding is None:
+        raise ValueError("multi-process resume requires a mesh SweepStepper")
+    ppath = _proc_path(path)
+    first_err = None
+    for cand in (ppath, _prev_path(ppath)):
+        state = stage = err = None
+        if cand.exists():
+            try:
+                state, stage = _load_proc_file(cand, stepper, sharding)
+            except Exception as e:  # noqa: BLE001 — any load failure is final
+                err = e
+        # Generation fallback is a COLLECTIVE decision: every process must
+        # have loaded this generation, else all quarantine it and fall
+        # back together — a per-process fallback would mix generations and
+        # silently diverge the sharded state.
+        ok_all = bool(multihost_utils.process_allgather(
+            np.asarray([state is not None])).all())
+        if ok_all:
+            # Torn-snapshot guard: a kill during save's rename phase can
+            # leave processes holding files from DIFFERENT sweeps of the
+            # same generation; resuming such a mix silently diverges the
+            # sharded state (and can deadlock the collectives once
+            # should_continue disagrees). Fail loudly instead.
+            sweeps_all = multihost_utils.process_allgather(
+                np.asarray([int(state.sweeps)]))
+            if len(set(int(x) for x in sweeps_all.ravel())) != 1:
+                raise RuntimeError(
+                    f"torn multi-process checkpoint {path}: per-process "
+                    f"snapshots are from different sweeps "
+                    f"{sweeps_all.ravel().tolist()}; delete them and "
+                    "restart the solve")
+            stepper.restore_stage(stage)
+            return state
+        first_err = first_err or err
+        if cand.exists():
+            _quarantine(cand, "generation unusable on some process"
+                        + (f" (here: {err})" if err else ""))
+    raise CheckpointCorruptError(
+        f"no loadable snapshot generation at {path} on every process "
+        f"(unusable files were quarantined); first failure here: "
+        f"{first_err}") from first_err
 
 
 def svd_checkpointed(
@@ -239,9 +455,18 @@ def svd_checkpointed(
 ) -> SVDResult:
     """`svd()` with sweep-boundary checkpointing and automatic resume.
 
-    If ``path`` exists, the solve resumes from it (validating shape/config);
-    otherwise it starts fresh. A snapshot is written every ``every`` sweeps;
-    the file is removed on successful completion unless ``keep``.
+    If ``path`` (or its rotated ``.prev`` generation) exists, the solve
+    resumes from it (validating checksum + shape/config, quarantining
+    corrupt generations — see `load_state`); otherwise it starts fresh. A
+    snapshot is written every ``every`` sweeps, rotating the previous
+    generation aside; the files are removed on successful completion
+    unless ``keep``.
+
+    SIGTERM (preemption) during the solve is intercepted: the current
+    sweep finishes, ONE final snapshot is written, and the signal is
+    re-delivered — so a preempted job loses at most the in-flight sweep
+    and a plain re-run resumes where it died. (Handler installation is
+    skipped off the main thread, where CPython forbids it.)
 
     ``mesh``: run the solve sharded over the given device mesh (the sharded
     `parallel.sharded.SweepStepper`); snapshots validate the mesh shape on
@@ -255,7 +480,7 @@ def svd_checkpointed(
                              compute_v=compute_u, full_matrices=full_matrices,
                              config=config, keep=keep)
         return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
-                         off_rel=r.off_rel)
+                         off_rel=r.off_rel, status=r.status)
     if mesh is not None:
         from ..parallel import sharded as _sharded
         stepper = _sharded.SweepStepper(
@@ -267,7 +492,7 @@ def svd_checkpointed(
     path = Path(path)
     sharded_snap = _sharded_snapshot(stepper)
     local = _proc_path(path) if sharded_snap else path
-    have = local.exists()
+    have = local.exists() or _prev_path(local).exists()
     if sharded_snap:
         # All-or-nothing: one process resuming while another starts fresh
         # would silently diverge the sharded state. One tiny allgather
@@ -284,11 +509,56 @@ def svd_checkpointed(
         state = load_state(path, stepper)
     else:
         state = stepper.init()
-    while stepper.should_continue(state):
-        state = stepper.step(state)
-        if int(_local_scalar(state.sweeps)) % every == 0:
-            save_state(path, stepper, state)
-    result = stepper.finish(state)
-    if local.exists() and not keep:
-        local.unlink()
+
+    # Preemption guard: note a SIGTERM, finish the in-flight sweep, write
+    # one final snapshot, then re-deliver the signal with the previous
+    # disposition so the process still dies a SIGTERM death.
+    caught = {"sig": None}
+    prev_handler, installed = None, False
+    try:
+        prev_handler = signal.signal(
+            signal.SIGTERM, lambda sig, frame: caught.update(sig=sig))
+        installed = True
+    except ValueError:
+        pass  # not the main thread: run without the handler
+
+    def _restore_handler():
+        # prev_handler is None when the old disposition was installed
+        # from C (signal.signal cannot return it): fall back to SIG_DFL —
+        # leaving OUR dead lambda installed would swallow every later
+        # SIGTERM for the process lifetime.
+        nonlocal installed
+        if installed:
+            signal.signal(signal.SIGTERM,
+                          signal.SIG_DFL if prev_handler is None
+                          else prev_handler)
+            installed = False
+
+    try:
+        while stepper.should_continue(state):
+            state = stepper.step(state)
+            done = int(_local_scalar(state.sweeps))
+            if done % every == 0:
+                save_state(path, stepper, state)
+            _chaos.maybe_sigterm(done)  # fault-injection hook (no-op unarmed)
+            if caught["sig"] is not None:
+                save_state(path, stepper, state)
+                _restore_handler()
+                os.kill(os.getpid(), signal.SIGTERM)
+                # Only reached when the previous disposition ignored the
+                # signal — still stop, snapshot is on disk.
+                raise SystemExit(128 + int(caught["sig"]))
+        result = stepper.finish(state)
+    finally:
+        was_installed = installed
+        _restore_handler()
+        if was_installed and caught["sig"] is not None:
+            # SIGTERM landed in the final-sweep/finish window: the solve
+            # is done, but the process was told to die — honor it after
+            # restoring the previous disposition.
+            os.kill(os.getpid(), signal.SIGTERM)
+    if not keep:
+        for f in (local, _prev_path(local)):
+            if f.exists():
+                f.unlink()
     return result

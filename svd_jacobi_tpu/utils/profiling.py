@@ -91,7 +91,7 @@ def instrumented_svd(
                                   compute_v=compute_u,
                                   full_matrices=full_matrices, config=config)
         return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
-                         off_rel=r.off_rel), log
+                         off_rel=r.off_rel, status=r.status), log
     if mesh is not None:
         from ..parallel import sharded as _sharded
         stepper = _sharded.SweepStepper(

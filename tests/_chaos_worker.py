@@ -1,0 +1,40 @@
+"""Worker process for the chaos-lane kill-then-resume test — NOT a test
+module.
+
+Runs `utils.checkpoint.svd_checkpointed` with the `resilience.chaos`
+SIGTERM hook armed: the checkpoint loop delivers a REAL SIGTERM to this
+process at the end of the armed sweep, the production handler writes one
+final snapshot, and the process dies a signal death (the parent asserts
+returncode == -SIGTERM and that the snapshot holds exactly that sweep).
+The matrix is regenerated from the seed, so the parent can resume the
+identical solve in its own process.
+"""
+
+import sys
+
+
+def main():
+    ckpt, kill_sweep = sys.argv[1], int(sys.argv[2])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.resilience import chaos
+    from svd_jacobi_tpu.utils import checkpoint, matgen
+
+    a = matgen.random_dense(48, 48, seed=33, dtype=jnp.float64)
+    with chaos.sigterm_at_sweep(kill_sweep):
+        # `every` beyond the sweep count: the ONLY snapshot that can exist
+        # afterwards is the SIGTERM-triggered final one.
+        checkpoint.svd_checkpointed(a, path=ckpt, every=1000,
+                                    config=SVDConfig(block_size=4))
+    print("worker survived SIGTERM?!", flush=True)  # must be unreachable
+    sys.exit(99)
+
+
+if __name__ == "__main__":
+    main()

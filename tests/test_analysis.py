@@ -232,6 +232,35 @@ class TestHloChecks:
         assert _codes(findings) == ["HLO003"]
         assert "dead" in findings[0].message
 
+    def test_chaos_gate_clean_on_entries(self):
+        for probe in entries.single_device_probes():
+            assert hlo_checks.check_chaos_gate(probe) == [], probe.name
+
+    def test_chaos_gate_armed_plan_flagged(self):
+        """A production plan that resolved with fault injection armed must
+        be flagged — chaos can never ride a real solve."""
+        probe = entries.single_device_probes()[0]
+        findings = hlo_checks.check_chaos_gate(
+            probe.with_kwargs(chaos_nan_sweep=3))
+        assert _codes(findings) == ["HLO004"]
+        assert "ARMED" in findings[0].message
+
+    def test_chaos_dead_gate_flagged(self):
+        """An entry that ignores its chaos_nan_sweep static must be
+        flagged (the chaos lane would be testing a no-op)."""
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("chaos_nan_sweep",))
+        def dead_gate(x, *, chaos_nan_sweep=None):
+            return x * 2  # hook unused: armed == unarmed
+
+        probe = entries.EntryProbe(
+            name="dead_chaos", fn=dead_gate, args=(jnp.ones(4),),
+            kwargs={"chaos_nan_sweep": None})
+        findings = hlo_checks.check_chaos_gate(probe)
+        assert _codes(findings) == ["HLO004"]
+        assert "no-op" in findings[0].message
+
 
 # ---------------------------------------------------------------------------
 # Recompile guard.

@@ -1,0 +1,462 @@
+"""The resilience subsystem: in-graph solve health (SVDResult.status),
+guarded inputs, the retry/escalation ladder, hardened checkpointing, and
+the deterministic fault-injection (`-m chaos`) lane.
+
+What is actually being proven:
+
+  * the fused loops' health word detects NaN poisoning that the deflation
+    mask would otherwise hide — an injected NaN yields status=NONFINITE,
+    never a silent OK — on the single-device, hybrid-XLA, and mesh paths;
+  * `resilient_svd` walks the escalation ladder from a bad status back to
+    a residual-correct solve, records the episode as a schema-valid
+    ``retry`` manifest record, and fails fast on unrecoverable inputs;
+  * extreme-scale inputs (Gram-path overflow/underflow) are power-of-two
+    pre-scaled and the scale is undone exactly on sigma;
+  * corrupt snapshots (truncated, bit-flipped, wrong fingerprint) are
+    detected, QUARANTINED, and the solve resumes from the rotated
+    previous generation to the same sigmas as an uninterrupted run;
+  * a SIGTERM mid-solve triggers one final snapshot and a later plain
+    re-run resumes from exactly the killed sweep (subprocess, real
+    signal);
+  * the multi-process save barrier times out instead of hanging, and the
+    coordinator connect retries transient refusals with backoff.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import warnings
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SolveStatus, SVDConfig
+from svd_jacobi_tpu.resilience import chaos, guard
+from svd_jacobi_tpu.solver import SweepStepper
+from svd_jacobi_tpu.utils import checkpoint, matgen, validation
+
+
+def _ref(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+class TestStatusWord:
+    def test_ok_on_converged_paths(self, eight_devices):
+        from svd_jacobi_tpu.parallel import sharded
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        assert sj.svd(a).status_enum() == SolveStatus.OK          # pallas
+        assert sj.svd(a, config=SVDConfig(pair_solver="hybrid")
+                      ).status_enum() == SolveStatus.OK           # xla hybrid
+        assert sharded.svd(a).status_enum() == SolveStatus.OK     # mesh
+        a64 = matgen.random_dense(48, 48, seed=3, dtype=jnp.float64)
+        assert sj.svd(a64).status_enum() == SolveStatus.OK        # f64 qr-svd
+
+    def test_max_sweeps_exhaustion(self):
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        r = sj.svd(a, config=SVDConfig(max_sweeps=2))
+        assert r.status_enum() == SolveStatus.MAX_SWEEPS
+        assert int(r.sweeps) == 2
+
+    def test_status_rides_transpose(self):
+        a = matgen.random_dense(32, 64, seed=5, dtype=jnp.float32)
+        assert sj.svd(a).status is not None
+        assert sj.svd(a).status_enum() == SolveStatus.OK
+
+    def test_stepper_reports_status(self):
+        a = matgen.random_dense(48, 48, seed=9, dtype=jnp.float64)
+        st = SweepStepper(a, config=SVDConfig(block_size=4))
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        assert st.finish(state).status_enum() == SolveStatus.OK
+
+    def test_stepper_detects_nan_input(self):
+        """The deflation mask hides NaN columns from the masked off-norm;
+        the finish-time probe must catch the poisoned stacks anyway."""
+        bad = np.asarray(
+            matgen.random_dense(48, 48, seed=9, dtype=jnp.float64)).copy()
+        bad[5, 5] = np.nan
+        st = SweepStepper(jnp.asarray(bad), config=SVDConfig(block_size=4))
+        state, n = st.init(), 0
+        while st.should_continue(state) and n < 64:
+            state, n = st.step(state), n + 1
+        assert st.finish(state).status_enum() == SolveStatus.NONFINITE
+
+
+@pytest.mark.chaos
+class TestChaosNanInjection:
+    """Acceptance: injected NaN at sweep 3 yields NONFINITE — never OK."""
+
+    def test_fused_pallas_path(self):
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        with chaos.nan_at_sweep(3):
+            r = sj.svd(a)
+        assert r.status_enum() == SolveStatus.NONFINITE
+        # The loop also stops promptly instead of sweeping NaNs to budget.
+        assert int(r.sweeps) <= 5
+
+    def test_fused_xla_hybrid_path(self):
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        with chaos.nan_at_sweep(2):
+            r = sj.svd(a, config=SVDConfig(pair_solver="hybrid"))
+        assert r.status_enum() == SolveStatus.NONFINITE
+
+    def test_fused_mesh_path(self, eight_devices):
+        from svd_jacobi_tpu.parallel import sharded
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        with chaos.nan_at_sweep(3):
+            r = sharded.svd(a)
+        assert r.status_enum() == SolveStatus.NONFINITE
+
+    def test_unarmed_after_shots_consumed(self):
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        with chaos.nan_at_sweep(3, shots=1):
+            assert sj.svd(a).status_enum() == SolveStatus.NONFINITE
+            # Second dispatch inside the context: shot budget spent.
+            assert sj.svd(a).status_enum() == SolveStatus.OK
+        assert sj.svd(a).status_enum() == SolveStatus.OK
+
+
+class TestGuardedInputs:
+    def test_nonfinite_input_raises(self):
+        bad = np.ones((16, 16), np.float32)
+        bad[3, 4] = np.inf
+        with pytest.raises(guard.NonFiniteInputError):
+            sj.resilience.resilient_svd(jnp.asarray(bad))
+
+    def test_prescale_is_exact_power_of_two(self):
+        a = matgen.random_dense(32, 32, seed=4, dtype=jnp.float32)
+        scaled, p = guard.prescale(a * jnp.float32(1e30))
+        assert p != 0
+        back = guard.unscale_sigma(scaled, p)
+        np.testing.assert_array_equal(np.asarray(back),
+                                      np.asarray(a * jnp.float32(1e30)))
+
+    def test_safe_scale_untouched(self):
+        a = matgen.random_dense(32, 32, seed=4, dtype=jnp.float32)
+        scaled, p = guard.prescale(a)
+        assert p == 0 and scaled is a
+
+    def test_resilient_svd_recovers_gram_overflow(self):
+        """1e30-scale f32 input: sigma^2 overflows the Gram path (the raw
+        solve reads NONFINITE); the guard pre-scales and the sigmas match
+        the oracle after the exact undo."""
+        a = matgen.random_dense(64, 64, seed=11, dtype=jnp.float32)
+        big = a * jnp.float32(1e30)
+        assert sj.svd(big).status_enum() == SolveStatus.NONFINITE
+        r, rep = sj.resilience.resilient_svd(big, return_report=True)
+        assert rep["final_status"] == "OK" and rep["scale_pow2"] != 0
+        s_ref = _ref(a) * 1e30
+        assert (np.max(np.abs(np.asarray(r.s, np.float64) - s_ref))
+                / s_ref[0]) < 1e-5
+
+
+@pytest.mark.chaos
+class TestEscalation:
+    def test_recovers_injected_nan_to_residual(self, tmp_path):
+        """Acceptance: resilient_svd takes a NONFINITE first attempt back
+        to residual < tol via the ladder, and records the episode."""
+        a = matgen.random_dense(96, 96, seed=7, dtype=jnp.float32)
+        mpath = tmp_path / "manifest.jsonl"
+        with chaos.nan_at_sweep(3, shots=1):
+            r, rep = sj.resilience.resilient_svd(
+                a, return_report=True, manifest_path=mpath)
+        assert rep["attempts"][0]["status"] == "NONFINITE"
+        assert rep["final_status"] == "OK"
+        assert r.status_enum() == SolveStatus.OK
+        v = validation.validate(a, r)
+        assert float(v.residual_rel) < 1e-4
+        # Schema-valid "retry" record in the manifest stream.
+        from svd_jacobi_tpu.obs import manifest
+        recs = manifest.load(mpath)
+        assert [rec["kind"] for rec in recs] == ["retry"]
+        manifest.validate(recs[0])
+        assert recs[0]["final_status"] == "OK"
+        assert [at["rung"] for at in recs[0]["attempts"]
+                ][0] == "base"
+        assert "retry episode" in manifest.summarize(recs[0])
+
+    def test_no_retry_when_first_attempt_ok(self):
+        a = matgen.random_dense(64, 64, seed=2, dtype=jnp.float32)
+        r, rep = sj.resilience.resilient_svd(a, return_report=True)
+        assert len(rep["attempts"]) == 1
+        assert rep["attempts"][0]["rung"] == "base"
+
+    def test_ladder_is_bounded_and_ends_at_lapack(self):
+        """max_sweeps=1 starves every Jacobi rung (MAX_SWEEPS each); the
+        ladder must walk its full bounded length and land on the
+        LAPACK-class fallback, which succeeds."""
+        a = matgen.random_dense(64, 64, seed=2, dtype=jnp.float32)
+        r, rep = sj.resilience.resilient_svd(
+            a, config=SVDConfig(max_sweeps=1), return_report=True)
+        rungs = [at["rung"] for at in rep["attempts"]]
+        assert rungs[-1] == "lapack_gesvd"
+        assert all(at["status"] == "MAX_SWEEPS"
+                   for at in rep["attempts"][:-1])
+        assert rep["final_status"] == "OK"
+        s_ref = _ref(a)
+        assert (np.max(np.abs(np.asarray(r.s, np.float64) - s_ref))
+                / s_ref[0]) < 1e-5
+
+    def test_max_attempts_bounds_the_ladder(self):
+        a = matgen.random_dense(64, 64, seed=2, dtype=jnp.float32)
+        r, rep = sj.resilience.resilient_svd(
+            a, config=SVDConfig(max_sweeps=1), max_attempts=2,
+            return_report=True)
+        assert len(rep["attempts"]) == 2
+        assert rep["final_status"] == "MAX_SWEEPS"
+        assert r.status_enum() == SolveStatus.MAX_SWEEPS
+
+
+CKPT_CFG = SVDConfig(block_size=4)
+
+
+def _two_generations(a, path):
+    """Run two sweeps, snapshotting each — leaves current + rotated."""
+    st = SweepStepper(a, config=CKPT_CFG)
+    state = st.init()
+    state = st.step(state)
+    checkpoint.save_state(path, st, state)
+    state = st.step(state)
+    checkpoint.save_state(path, st, state)
+    assert path.exists() and checkpoint._prev_path(path).exists()
+
+
+@pytest.mark.chaos
+class TestCheckpointCorruption:
+    """Acceptance: truncated / bit-flipped / wrong-fingerprint snapshots
+    are detected, quarantined, and the solve resumes from the rotated
+    generation to the uninterrupted sigmas."""
+
+    @pytest.fixture()
+    def a64(self):
+        return matgen.random_dense(32, 32, seed=8, dtype=jnp.float64)
+
+    @pytest.fixture()
+    def s_ref(self, a64, tmp_path):
+        r = checkpoint.svd_checkpointed(a64, path=tmp_path / "ref.npz",
+                                        config=CKPT_CFG)
+        return np.asarray(r.s)
+
+    @pytest.mark.parametrize("mode", ["truncate", "flip", "zero"])
+    def test_corrupt_current_falls_back_to_rotated(self, a64, s_ref,
+                                                   tmp_path, mode):
+        path = tmp_path / "ck.npz"
+        _two_generations(a64, path)
+        chaos.corrupt_checkpoint(path, mode)
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            r = checkpoint.svd_checkpointed(a64, path=path, config=CKPT_CFG)
+        assert path.with_name(path.name + ".quarantined").exists()
+        np.testing.assert_allclose(np.asarray(r.s), s_ref, rtol=1e-10)
+
+    def test_mismatched_fingerprint_falls_back(self, a64, s_ref, tmp_path):
+        path = tmp_path / "ck.npz"
+        _two_generations(a64, path)
+        # Overwrite the current generation with a snapshot of a DIFFERENT
+        # matrix (same layout): fingerprint mismatch, not corruption.
+        b = matgen.random_dense(32, 32, seed=99, dtype=jnp.float64)
+        stb = SweepStepper(b, config=CKPT_CFG)
+        checkpoint.save_state(tmp_path / "other.npz", stb,
+                              stb.step(stb.init()))
+        shutil.copy(tmp_path / "other.npz", path)
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            r = checkpoint.svd_checkpointed(a64, path=path, config=CKPT_CFG)
+        np.testing.assert_allclose(np.asarray(r.s), s_ref, rtol=1e-10)
+
+    def test_every_generation_corrupt_raises(self, a64, tmp_path):
+        path = tmp_path / "ck.npz"
+        _two_generations(a64, path)
+        chaos.corrupt_checkpoint(path, "truncate")
+        chaos.corrupt_checkpoint(checkpoint._prev_path(path), "flip")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(checkpoint.CheckpointCorruptError):
+                checkpoint.svd_checkpointed(a64, path=path, config=CKPT_CFG)
+
+    def test_mismatch_without_fallback_still_rejected(self, a64, tmp_path):
+        """The pre-hardening contract: resuming a DIFFERENT solve from a
+        single (unrotated) snapshot raises the loud mismatch error."""
+        path = tmp_path / "ck.npz"
+        st = SweepStepper(a64, config=CKPT_CFG)
+        checkpoint.save_state(path, st, st.init())
+        b = matgen.random_dense(40, 40, seed=10, dtype=jnp.float64)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(ValueError, match="does not match"):
+                checkpoint.svd_checkpointed(b, path=path, config=CKPT_CFG)
+
+
+class TestCheckpointDurability:
+    def test_tmp_removed_on_failure_paths(self, tmp_path):
+        with pytest.raises(ZeroDivisionError):
+            checkpoint._write_npz_atomic(
+                tmp_path / "x.npz", {"a": np.zeros(4)},
+                pre_rename=lambda: 1 / 0)
+        assert not list(tmp_path.glob("*.tmp"))
+        assert not (tmp_path / "x.npz").exists()
+
+    def test_checksum_round_trip(self, tmp_path):
+        a = matgen.random_dense(16, 16, seed=1, dtype=jnp.float64)
+        st = SweepStepper(a, config=CKPT_CFG)
+        state = st.step(st.init())
+        path = tmp_path / "ck.npz"
+        checkpoint.save_state(path, st, state)
+        with np.load(path) as z:
+            assert "checksum" in z.files
+            checkpoint._verify_checksum(z, path)
+        loaded = checkpoint.load_state(
+            path, SweepStepper(a, config=CKPT_CFG))
+        np.testing.assert_array_equal(np.asarray(loaded.top),
+                                      np.asarray(state.top))
+
+    def test_barrier_timeout_raises(self):
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="timed out"):
+            checkpoint._run_barrier(lambda: time.sleep(30), 0.2, "test")
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_barrier_propagates_errors(self):
+        def boom():
+            raise RuntimeError("peer exploded")
+        with pytest.raises(RuntimeError, match="peer exploded"):
+            checkpoint._run_barrier(boom, 5.0, "test")
+
+
+@pytest.mark.chaos
+def test_sigterm_kill_then_resume(tmp_path):
+    """Acceptance: a SIGTERM-killed checkpointed solve wrote its final
+    snapshot (the production SIGTERM handler, driven by a real signal in a
+    subprocess), and a plain re-run resumes from exactly the killed sweep
+    to the sigmas of an uninterrupted solve."""
+    worker = Path(__file__).parent / "_chaos_worker.py"
+    ckpt = tmp_path / "state.npz"
+    kill_sweep = 3
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # worker pins cpu via the config API
+    env["PYTHONPATH"] = (str(Path(__file__).parent.parent) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    p = subprocess.run(
+        [sys.executable, str(worker), str(ckpt), str(kill_sweep)],
+        env=env, cwd=str(worker.parent.parent), timeout=280,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # Died a SIGTERM death (handler re-delivered the signal after the
+    # final snapshot), not a clean exit.
+    assert p.returncode == -signal.SIGTERM, p.stdout[-3000:]
+    assert ckpt.exists()
+    with np.load(ckpt) as z:
+        assert int(z["sweeps"]) == kill_sweep  # the SIGTERM-boundary state
+
+    # Resume in THIS process: same matrix from the same seed.
+    a = matgen.random_dense(48, 48, seed=33, dtype=jnp.float64)
+    r = checkpoint.svd_checkpointed(a, path=ckpt, every=1000,
+                                    config=SVDConfig(block_size=4))
+    assert int(r.sweeps) > kill_sweep
+    assert not ckpt.exists()  # removed on success
+    r_ref = checkpoint.svd_checkpointed(a, path=tmp_path / "ref.npz",
+                                        every=1000,
+                                        config=SVDConfig(block_size=4))
+    np.testing.assert_allclose(np.asarray(r.s), np.asarray(r_ref.s),
+                               rtol=1e-12, atol=1e-14)
+
+
+class TestLaunchRetry:
+    def test_transient_refusal_retried_with_backoff(self, monkeypatch):
+        from svd_jacobi_tpu import _compat
+        from svd_jacobi_tpu.parallel import launch
+        calls, sleeps = [], []
+
+        def fake_init(**kw):
+            calls.append(kw)
+            if len(calls) < 3:
+                raise RuntimeError(
+                    "failed to connect to coordinator: connection refused")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(_compat, "distributed_is_initialized",
+                            lambda: False)
+        monkeypatch.setattr(launch, "_sleep", sleeps.append)
+        with pytest.warns(RuntimeWarning, match="retrying"):
+            ctx = launch.initialize(coordinator_address="127.0.0.1:1",
+                                    num_processes=1, process_id=0)
+        assert len(calls) == 3
+        assert sleeps == [0.5, 1.0]  # exponential backoff
+        assert ctx.process_count >= 1
+
+    def test_retries_are_bounded(self, monkeypatch):
+        from svd_jacobi_tpu import _compat
+        from svd_jacobi_tpu.parallel import launch
+
+        def always_refused(**kw):
+            raise RuntimeError("connection refused")
+
+        monkeypatch.setattr(jax.distributed, "initialize", always_refused)
+        monkeypatch.setattr(_compat, "distributed_is_initialized",
+                            lambda: False)
+        monkeypatch.setattr(launch, "_sleep", lambda s: None)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(RuntimeError, match="after 3 attempt"):
+                launch.initialize(coordinator_address="127.0.0.1:1",
+                                  num_processes=1, process_id=0,
+                                  connect_retries=2)
+
+    def test_order_error_never_retried(self, monkeypatch):
+        from svd_jacobi_tpu import _compat
+        from svd_jacobi_tpu.parallel import launch
+        calls = []
+
+        def order_error(**kw):
+            calls.append(kw)
+            raise RuntimeError(
+                "jax.distributed.initialize must be called before any JAX "
+                "computations")
+
+        monkeypatch.setattr(jax.distributed, "initialize", order_error)
+        monkeypatch.setattr(_compat, "distributed_is_initialized",
+                            lambda: False)
+        with pytest.raises(RuntimeError, match="must be called before"):
+            launch.initialize(coordinator_address="127.0.0.1:1",
+                              num_processes=1, process_id=0)
+        assert len(calls) == 1
+
+
+class TestCliStatus:
+    def test_status_in_report_and_exit_zero(self, tmp_path, capsys):
+        from svd_jacobi_tpu import cli
+        rc = cli.main(["48", "--dtype", "float64", "--selftest-n", "16",
+                       "--report-dir", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        solve = json.loads(out)
+        assert solve["status"] == "OK"
+        # The manifest record carries it too.
+        from svd_jacobi_tpu.obs import manifest
+        recs = manifest.load(tmp_path / "manifest.jsonl")
+        assert recs[-1]["solve"]["status"] == "OK"
+
+    @pytest.mark.chaos
+    def test_nonfinite_solve_exits_nonzero(self, tmp_path, capsys):
+        from svd_jacobi_tpu import cli
+        with chaos.nan_at_sweep(1, shots=16):
+            rc = cli.main(["48", "--matrix", "dense", "--no-selftest",
+                           "--report-dir", str(tmp_path)])
+        assert rc != 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(out)["status"] == "NONFINITE"
+
+    @pytest.mark.chaos
+    def test_failed_selftest_exits_nonzero(self, tmp_path, capsys):
+        from svd_jacobi_tpu import cli
+        with chaos.nan_at_sweep(1, shots=16):
+            rc = cli.main(["48", "--matrix", "dense", "--selftest-n", "16",
+                           "--report-dir", str(tmp_path)])
+        assert rc != 0
