@@ -7,11 +7,11 @@ on the MXU, sharded over TPU meshes with ICI collectives, with a
 LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 """
 
-from . import obs, resilience
+from . import obs, resilience, serve
 from .config import SVDConfig
 from .solver import SolveStatus, SVDResult, svd
 
 __version__ = "0.1.0"
 
 __all__ = ["svd", "SVDConfig", "SVDResult", "SolveStatus", "obs",
-           "resilience", "__version__"]
+           "resilience", "serve", "__version__"]

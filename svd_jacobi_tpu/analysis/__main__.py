@@ -20,7 +20,7 @@ import sys
 import time
 from pathlib import Path
 
-PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile")
+PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve")
 
 
 def _parse_args(argv):
@@ -69,6 +69,11 @@ def main(argv=None) -> int:
             return jaxpr_checks.check_default_entries(), None
         if name == "hlo":
             return hlo_checks.check_default_entries(), None
+        if name == "serve":
+            # The serving layer's compile-cache contract: the bucket set
+            # compiles once per bucket, never per request (RETRACE001).
+            findings, report = recompile_guard.run_serve_sequence()
+            return findings, report
         findings, report = recompile_guard.run_default_sequence()
         return findings, report
 

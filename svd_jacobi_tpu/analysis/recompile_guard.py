@@ -48,6 +48,11 @@ def default_entries() -> Dict[str, object]:
         "solver._svd_pallas": solver._svd_pallas,
         "solver._svd_pallas_donated": solver._svd_pallas_donated,
         "sharded._svd_sharded_jit": sharded._svd_sharded_jit,
+        # Serving-path entries (host-stepped; see run_serve_sequence).
+        "solver._precondition_qr_jit": solver._precondition_qr_jit,
+        "solver._sweep_step_pallas_jit": solver._sweep_step_pallas_jit,
+        "solver._finish_pallas_jit": solver._finish_pallas_jit,
+        "solver._nonfinite_probe_jit": solver._nonfinite_probe_jit,
     }
 
 
@@ -169,4 +174,64 @@ def run_default_sequence() -> tuple:
                 sharded.svd(am, config=SVDConfig(max_sweeps=8))
         findings = guard.check()
         report = guard.report()
+    return findings, report
+
+
+# The serving layer's compile-cache contract: requests pad to a static
+# bucket set, so the stepper-path entries compile once per BUCKET and
+# never per request. The sequence feeds several DISTINCT request shapes
+# into each bucket — a leak of the request shape (instead of the bucket
+# shape) into any jit key blows the budget immediately.
+_SERVE_SEQUENCE_BUCKETS = ((64, 48, "float32"), (96, 64, "float32"))
+_SERVE_SEQUENCE_SHAPES = (
+    # bucket (64, 48): exact fit, strictly smaller, wide (service
+    # transposes to tall before routing).
+    (64, 48), (60, 40), (33, 50),
+    # bucket (96, 64): exact fit, smaller, taller-than-the-first.
+    (96, 64), (90, 50), (70, 60),
+)
+_SERVE_ENTRIES = ("solver._precondition_qr_jit",
+                  "solver._sweep_step_pallas_jit",
+                  "solver._finish_pallas_jit",
+                  "solver._nonfinite_probe_jit")
+
+
+def run_serve_sequence() -> tuple:
+    """The CLI's serve retrace pass: a two-bucket `serve.SVDService` fed
+    three distinct request shapes per bucket; every serving-path entry
+    must compile once per bucket (RETRACE001 otherwise). Returns
+    (findings, report)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..serve import ServeConfig, SVDService
+    from ..utils import matgen
+
+    cfg = ServeConfig(
+        buckets=_SERVE_SEQUENCE_BUCKETS,
+        solver=SVDConfig(pair_solver="pallas"),
+        max_queue_depth=len(_SERVE_SEQUENCE_SHAPES) + 2,
+        # Brownout pinned OFF (>1 disables a rung): a sigma-only-degraded
+        # submit flips STATIC compute flags and would add a legitimate
+        # extra trace, turning the measurement into a false RETRACE001.
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    with RecompileGuard() as guard:
+        for entry in _SERVE_ENTRIES:
+            guard.expect(entry, problems=len(_SERVE_SEQUENCE_BUCKETS))
+        with SVDService(cfg) as svc:
+            tickets = [
+                svc.submit(matgen.random_dense(m, n, seed=m * 1000 + n,
+                                               dtype=jnp.float32))
+                for m, n in _SERVE_SEQUENCE_SHAPES]
+            statuses = [t.result(timeout=600.0).status for t in tickets]
+        findings = guard.check()
+        report = guard.report()
+    report["serve_statuses"] = [getattr(s, "name", None) for s in statuses]
+    if any(s is None or s.name != "OK" for s in statuses):
+        findings.append(Finding(
+            code="RETRACE001", where="serve.run_serve_sequence",
+            message=(f"serve sequence produced non-OK statuses "
+                     f"{report['serve_statuses']} — the retrace "
+                     f"measurement is not trustworthy on a failing solve"),
+            suggestion="fix the serving solve path first"))
     return findings, report

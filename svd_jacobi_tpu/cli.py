@@ -21,6 +21,12 @@ Usage:
     python -m svd_jacobi_tpu.cli N [M] [--dtype f32] [--distributed]
         [--matrix triangular|dense] [--no-selftest] [--report-dir DIR]
         [--profile DIR] [--oracle] [--telemetry]
+
+    python -m svd_jacobi_tpu.cli serve-demo [--requests N] [--clients K]
+        [--seed S] [--bucket MxN:dtype ...] [--tight-frac F] ...
+        — seeded closed-loop clients against a live `serve.SVDService`
+        (deadlines, admission control, brownout; one "serve" manifest
+        record per request).
 """
 
 from __future__ import annotations
@@ -153,8 +159,167 @@ def _self_test(args, config, log) -> dict:
             "sweeps": int(r.sweeps), "ok": ok}
 
 
+def _parse_serve_args(argv):
+    p = argparse.ArgumentParser(
+        prog="svd-serve-demo",
+        description="Seeded closed-loop client demo against an in-process "
+                    "deadline-aware SVD service (serve.SVDService).")
+    p.add_argument("--requests", type=int, default=24,
+                   help="total requests across all clients")
+    p.add_argument("--clients", type=int, default=4,
+                   help="closed-loop client threads (each waits for its "
+                        "result before submitting the next request)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--bucket", action="append", default=None,
+                   metavar="MxN:dtype",
+                   help="declared shape bucket (repeatable); default: "
+                        "64x48:float32 + 96x64:float32 (CPU-friendly)")
+    p.add_argument("--deadline-s", type=float, default=60.0,
+                   help="per-request deadline for ordinary requests")
+    p.add_argument("--tight-frac", type=float, default=0.2,
+                   help="fraction of requests given a deliberately "
+                        "unmeetable deadline (they must return DEADLINE, "
+                        "loudly, not hang)")
+    p.add_argument("--tight-ms", type=float, default=1.0,
+                   help="the unmeetable deadline, in milliseconds")
+    p.add_argument("--queue-depth", type=int, default=16)
+    p.add_argument("--report-dir", default="reports",
+                   help="manifest directory (per-request 'serve' JSONL "
+                        "records appended to <dir>/manifest.jsonl); "
+                        "'off' disables")
+    return p.parse_args(argv)
+
+
+def serve_demo(argv) -> int:
+    """`serve-demo` subcommand: run a seeded closed-loop client fleet
+    against a live service and report aggregate behavior. Exit 0 iff
+    every request reached a terminal outcome and none errored — DEADLINE
+    and admission rejections are EXPECTED outcomes here (the demo
+    deliberately provokes them), not failures."""
+    args = _parse_serve_args(argv)
+
+    import os
+    import threading
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.serve import AdmissionError, ServeConfig, SVDService
+    from svd_jacobi_tpu.utils import matgen
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    from svd_jacobi_tpu.serve import as_bucket
+    buckets = tuple(args.bucket or ("64x48:float32", "96x64:float32"))
+    bucket_set = [as_bucket(b) for b in buckets]
+    if any(b.dtype == "float64" for b in bucket_set):
+        # Declared f64 buckets (under any dtype spelling — as_bucket
+        # normalizes) need x64 BEFORE any array is built, or matgen
+        # silently truncates to f32 and nothing routes.
+        jax.config.update("jax_enable_x64", True)
+    manifest_path = (None if args.report_dir == "off"
+                     else str(Path(args.report_dir) / "manifest.jsonl"))
+    cfg = ServeConfig(buckets=buckets, solver=SVDConfig(),
+                      max_queue_depth=args.queue_depth,
+                      manifest_path=manifest_path)
+    svc = SVDService(cfg)
+
+    # Seeded request plan, built up front so the run is reproducible: a
+    # shape drawn within a random bucket, plus the deadline class.
+    rng = np.random.default_rng(args.seed)
+    bs = bucket_set
+    plan = []
+    for i in range(args.requests):
+        b = bs[int(rng.integers(len(bs)))]
+        m = int(rng.integers(max(2, b.m // 2), b.m + 1))
+        n = int(rng.integers(max(1, min(m, b.n) // 2), min(m, b.n) + 1))
+        tight = bool(rng.random() < args.tight_frac)
+        plan.append((m, n, b.dtype, tight, int(rng.integers(2 ** 31))))
+
+    outcomes = []
+    out_lock = threading.Lock()
+    next_i = [0]
+
+    def client(cid):
+        while True:
+            with out_lock:
+                if next_i[0] >= len(plan):
+                    return
+                i = next_i[0]
+                next_i[0] += 1
+            m, n, dtype, tight, seed = plan[i]
+            a = matgen.random_dense(m, n, seed=seed, dtype=jnp.dtype(dtype))
+            deadline = (args.tight_ms / 1e3) if tight else args.deadline_s
+            try:
+                t = svc.submit(a, deadline_s=deadline)
+            except AdmissionError as e:
+                with out_lock:
+                    outcomes.append({"i": i, "terminal": True,
+                                     "status": f"REJECTED_{e.reason.name}"})
+                continue
+            try:
+                res = t.result(timeout=600.0)
+                out = {"i": i, "terminal": True,
+                       "status": ("ERROR" if res.error else res.status.name),
+                       "queue_wait_s": res.queue_wait_s,
+                       "solve_time_s": res.solve_time_s,
+                       "error": res.error}
+            except TimeoutError:
+                out = {"i": i, "terminal": False, "status": "HUNG"}
+            with out_lock:
+                outcomes.append(out)
+
+    t0 = time.perf_counter()
+    svc.start()
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(max(1, args.clients))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=900.0)
+    health = svc.healthz()   # live snapshot, BEFORE the shutdown flips it
+    svc.stop(drain=True, timeout=60.0)
+    wall = time.perf_counter() - t0
+
+    by_status = {}
+    for o in outcomes:
+        by_status[o["status"]] = by_status.get(o["status"], 0) + 1
+    waits = sorted(o["queue_wait_s"] for o in outcomes
+                   if o.get("queue_wait_s") is not None)
+    solves = sorted(o["solve_time_s"] for o in outcomes
+                    if o.get("solve_time_s") is not None)
+    p50 = lambda xs: xs[len(xs) // 2] if xs else None
+    summary = {
+        "requests": len(plan),
+        "outcomes": by_status,
+        "terminal": sum(1 for o in outcomes if o["terminal"]),
+        "errors": sum(1 for o in outcomes if o.get("error")),
+        "queue_wait_p50_s": p50(waits),
+        "solve_time_p50_s": p50(solves),
+        "wall_s": wall,
+        "health": health,
+    }
+    if manifest_path:
+        log(f"manifest: {manifest_path}")
+    print(json.dumps(summary))
+    ok = (summary["terminal"] == len(plan) and summary["errors"] == 0
+          and len(outcomes) == len(plan))
+    if not ok:
+        log("exit 1: non-terminal or errored requests "
+            f"({len(plan) - summary['terminal']} non-terminal, "
+            f"{summary['errors']} errors)")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
-    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve-demo":
+        return serve_demo(argv[1:])
+    args = _parse_args(argv)
 
     import os
 

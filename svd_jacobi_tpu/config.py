@@ -222,7 +222,32 @@ RETRACE_BUDGETS = {
     "solver._svd_pallas": 1,
     "solver._svd_pallas_donated": 1,
     "sharded._svd_sharded_jit": 1,
+    # Serving-layer entries — the host-stepped kernel sweeps that
+    # `serve.SVDService` drives. Every request is padded to one of the
+    # declared (m, n, dtype) buckets BEFORE the stepper is built, so the
+    # problem key is the bucket, not the request: these entries must
+    # compile once per BUCKET and never per request (the invariant
+    # analysis.recompile_guard.run_serve_sequence proves — a per-request
+    # retrace would put a multi-second compile on the serving hot path).
+    "solver._precondition_qr_jit": 1,
+    "solver._sweep_step_pallas_jit": 1,
+    "solver._finish_pallas_jit": 1,
+    "solver._nonfinite_probe_jit": 1,
 }
+
+# Default shape buckets of the serving layer (`serve.ServeConfig.buckets`):
+# the small static set of tall (m >= n) padded shapes requests are rounded
+# up to so the jit caches above are hit after one warmup per bucket.
+# Zero-padding is exact for the SVD — padded columns deflate (exactly-zero
+# sigma, sorted to the back) and padded rows are preserved zero by the
+# column rotations — so factors of the original shape are recovered by
+# slicing. Deployments declare their own set; these defaults cover the
+# bench's small/medium regimes. Entries are (m, n, dtype-name).
+DEFAULT_SERVE_BUCKETS = (
+    (256, 256, "float32"),
+    (1024, 512, "float32"),
+    (2048, 2048, "float32"),
+)
 
 # PROFILE.md hot-region coverage: every component row of the cost tables
 # must keep its `jax.named_scope` annotation (obs.scopes) so profiler

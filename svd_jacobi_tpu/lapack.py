@@ -17,9 +17,12 @@ Differences from the reference, by design:
   * AllVec returns full square U (m, m) / Vt (n, n); SomeVec the economy
     factors — matching LAPACK jobu='A'/'S'. The reference treats AllVec ==
     SomeVec (its SomeVec branch is commented out, lib/JacobiMethods.cu:1165).
-  * layout: arrays are row-major jax arrays; the reference's col-major
-    MATRIX_LAYOUT enum (lib/Utils.cuh:18-21) is unnecessary — pass `a.T`
-    for a col-major buffer.
+  * layout: the reference's col-major MATRIX_LAYOUT enum
+    (lib/Utils.cuh:18-21) maps to the ``layout=`` kwarg: "row" (default)
+    takes/returns ordinary row-major jax arrays; "col" makes the dgesvd
+    drop-in literal — `a` is then the column-major IMAGE of the logical
+    (m, n) matrix (i.e. the (n, m) array a col-major buffer reinterprets
+    to), and the returned u / vt are themselves col-major images.
 """
 
 from __future__ import annotations
@@ -46,6 +49,7 @@ def gesvd(
     jobv: SVD_OPTIONS,
     a,
     *,
+    layout: str = "row",
     config: Optional[SVDConfig] = None,
     mesh=None,
 ) -> Tuple[Optional[jax.Array], jax.Array, Optional[jax.Array]]:
@@ -53,7 +57,13 @@ def gesvd(
 
     Args:
       jobu/jobv: SVD_OPTIONS for the left/right factors.
-      a: (m, n) real matrix.
+      a: (m, n) real matrix ("row" layout) — or, with ``layout="col"``,
+        the (n, m) column-major image of the logical (m, n) matrix.
+      layout: "row" (default) or "col" — the reference's MATRIX_LAYOUT
+        enum (lib/Utils.cuh:18-21). LAPACK dgesvd is col-major native;
+        with "col" both the input AND the returned u/vt are col-major
+        images (transposes of the row-major factors), so a dgesvd caller
+        can hand over its buffers unchanged.
       config: solver configuration.
       mesh: optional `jax.sharding.Mesh` — routes to the distributed solver
         (the reference's `omp_mpi_cuda_dgesvd_local_matrices` equivalent);
@@ -62,10 +72,23 @@ def gesvd(
     Returns:
       (u, s, vt); u/vt are None under NoVec. s is descending, length
       min(m, n). AllVec: u is (m, m), vt is (n, n); SomeVec: u is
-      (m, min(m, n)), vt is (min(m, n), n).
+      (m, min(m, n)), vt is (min(m, n), n) — each transposed under
+      layout="col".
     """
+    if layout not in ("row", "col"):
+        raise ValueError(f"unknown layout {layout!r}; expected 'row'/'col'")
     if not isinstance(jobu, SVD_OPTIONS) or not isinstance(jobv, SVD_OPTIONS):
         raise TypeError("jobu/jobv must be SVD_OPTIONS members")
+    if layout == "col":
+        # The array is B = A^T (the col-major image). With
+        # B = U_B S V_B^T, A = V_B S U_B^T — so U_A = V_B and
+        # V_A^T = U_B^T: solve B row-major with the JOBS SWAPPED (jobu
+        # governs U_A = V_B, i.e. B's V job), then the col-major images of
+        # A's factors are exactly the row-major factors of B crosswise:
+        # image(U_A) = U_A^T = V_B^T = vt_B and image(V_A^T) = V_A = u_B.
+        u_b, s, vt_b = gesvd(jobv, jobu, a, layout="row", config=config,
+                             mesh=mesh)
+        return vt_b, s, u_b
     full = (jobu == SVD_OPTIONS.AllVec) or (jobv == SVD_OPTIONS.AllVec)
     r = _solve(a, jobu != SVD_OPTIONS.NoVec, jobv != SVD_OPTIONS.NoVec,
                full, config, mesh)

@@ -78,6 +78,20 @@ _RETRY_SCHEMA: Dict[str, Any] = {
     "attempts": list,                 # [{"rung", "status", "time_s", ...}]
     "final_status": str,              # SolveStatus name of the last attempt
 }
+# Per-request serving records ("serve", written by serve.SVDService):
+# one record per request — served, degraded, timed out, or REJECTED at
+# admission — so the whole service history (breaker trips, brownout
+# steps, shed load) reconstructs from the manifest stream alone.
+_SERVE_SCHEMA: Dict[str, Any] = {
+    "request": {"id": str, "m": int, "n": int, "dtype": str},
+    "bucket": (str, type(None)),      # padded-shape bucket; None = rejected
+    "queue_wait_s": _NUM,
+    "solve_time_s": (*_NUM, type(None)),  # None = never solved
+    "status": str,                    # SolveStatus name | ERROR | REJECTED_*
+    "path": str,                      # "base" | "ladder" | "rejected"
+    "breaker": str,                   # BreakerState value after the outcome
+    "brownout": str,                  # Brownout level name at admission
+}
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
 
@@ -195,6 +209,33 @@ def build_retry(*, m: int, n: int, dtype: str, config, attempts: List[dict],
     return record
 
 
+def build_serve(*, request_id: str, m: int, n: int, dtype: str,
+                bucket: Optional[str], queue_wait_s: float,
+                solve_time_s: Optional[float], status: str, path: str,
+                breaker: str, brownout: str, **extra) -> dict:
+    """Assemble a schema-valid per-request serving record
+    (`serve.SVDService`). ``extra`` (degraded, deadline_s, sweeps, error,
+    ...) rides along like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "serve",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "request": {"id": str(request_id), "m": int(m), "n": int(n),
+                    "dtype": str(dtype)},
+        "bucket": None if bucket is None else str(bucket),
+        "queue_wait_s": float(queue_wait_s),
+        "solve_time_s": None if solve_time_s is None else float(solve_time_s),
+        "status": str(status),
+        "path": str(path),
+        "breaker": str(breaker),
+        "brownout": str(brownout),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def _check(cond: bool, errors: List[str], msg: str) -> None:
     if not cond:
         errors.append(msg)
@@ -234,6 +275,8 @@ def validate(record: dict) -> None:
         for i, at in enumerate(record.get("attempts") or []):
             _check_fields(at, _ATTEMPT_FIELDS, f"record.attempts[{i}]",
                           errors)
+    elif record.get("kind") == "serve":
+        _check_fields(record, _SERVE_SCHEMA, "record", errors)
     else:
         _check_fields(record, _SOLVE_SCHEMA, "record", errors)
         for i, st in enumerate(record.get("stages") or []):
@@ -303,6 +346,22 @@ def summarize(record: dict) -> str:
                          f"sweeps={at.get('sweeps', '?'):>3} off={off_s}  "
                          f"{at.get('time_s', 0.0):7.2f} s")
         return "\n".join(lines)
+    if record.get("kind") == "serve":
+        req = record.get("request", {})
+        wait = record.get("queue_wait_s", float("nan"))
+        solve_t = record.get("solve_time_s")
+        solve_s = "n/a" if solve_t is None else f"{solve_t * 1e3:.1f}ms"
+        line = (f"serve {req.get('id', '?')} @ {record.get('timestamp', '?')}"
+                f"  {req.get('m')}x{req.get('n')} {req.get('dtype')}"
+                f" -> {record.get('bucket') or 'no bucket'}"
+                f" [{record.get('path', '?')}]"
+                f" status={record.get('status', '?')}"
+                f" breaker={record.get('breaker', '?')}"
+                f" brownout={record.get('brownout', '?')}"
+                f" wait={wait * 1e3:.1f}ms solve={solve_s}")
+        if record.get("error"):
+            line += f"\n  error: {record['error']}"
+        return line
     dim = record.get("dimension", {})
     env = record.get("environment", {})
     solve = record.get("solve", {})

@@ -17,6 +17,19 @@ detection/recovery machinery of this repo actually works:
     consults the hook once per sweep), driving the kill-then-resume lane.
   * `corrupt_checkpoint(path, mode)` — host-side snapshot corruption
     (truncation, byte flip, zeroing) for the checkpoint-hardening tests.
+  * `slow_solve(per_sweep_s)` — arm a deterministic host-side delay per
+    sweep for the next ``shots`` SERVED solve dispatches
+    (`serve.SVDService` consults the hook once per dispatch and sleeps
+    between sweeps), driving the deadline/brownout lanes: a slowed solve
+    must cross its deadline at a sweep boundary and surface
+    ``SolveStatus.DEADLINE``, never hang.
+  * `stuck_backend()` — arm a wedged-backend stall: the next ``shots``
+    served dispatches BLOCK before their first sweep, polling the
+    request's cooperative deadline/cancel control, bounded by
+    ``max_stall_s`` (a chaos hook must never be able to hang an
+    un-deadlined test forever). Drives the circuit-breaker lane: stuck
+    requests time out, consecutive timeouts trip the breaker, and
+    recovery runs through the escalation ladder.
 
 Everything here is deterministic: a hook fires at an exact sweep index /
 byte offset, never at random, so chaos-lane failures replay exactly.
@@ -37,6 +50,11 @@ _lock = threading.Lock()
 # matrix must be able to run clean — the point of the recovery test).
 _nan_state: Optional[dict] = None
 _sigterm_sweep: Optional[int] = None
+# Serving-layer faults, one {"value": float, "shots": int} slot per kind
+# ("slow": per-sweep delay seconds; "stuck": stall bound seconds) — both
+# follow the same arm-context-manager / consume-one-shot protocol
+# (`_armed` / `_consume`).
+_serve_faults: dict = {"slow": None, "stuck": None}
 
 
 @contextlib.contextmanager
@@ -84,6 +102,61 @@ def poison(x, sweeps, sweep_index: int):
     payload = jnp.where(sweeps == sweep_index,
                         jnp.asarray(jnp.nan, x.dtype), x[idx])
     return x.at[idx].set(payload)
+
+
+@contextlib.contextmanager
+def _armed(kind: str, value: float, shots: int):
+    """Shared arm/restore protocol of the serving-layer fault slots."""
+    with _lock:
+        prev = _serve_faults[kind]
+        _serve_faults[kind] = {"value": float(value), "shots": int(shots)}
+    try:
+        yield
+    finally:
+        with _lock:
+            _serve_faults[kind] = prev
+
+
+def _consume(kind: str) -> Optional[float]:
+    """One served dispatch's view of a fault slot: the armed value
+    (decrementing the shot budget) or None."""
+    with _lock:
+        st = _serve_faults[kind]
+        if st is None or st["shots"] <= 0:
+            return None
+        st["shots"] -= 1
+        return st["value"]
+
+
+def slow_solve(per_sweep_s: float, shots: int = 1):
+    """Arm a deterministic per-sweep host delay for the next ``shots``
+    served solve dispatches. The serving worker consumes the hook once
+    per dispatch (`consume_slow`) and sleeps ``per_sweep_s`` before each
+    sweep of that dispatch — so the solve crosses any deadline at a sweep
+    boundary, exactly where the cooperative control checks run. Pure
+    host-side: the compiled program is untouched."""
+    return _armed("slow", per_sweep_s, shots)
+
+
+def consume_slow() -> Optional[float]:
+    """The slow-solve hook's per-sweep delay in seconds, or None."""
+    return _consume("slow")
+
+
+def stuck_backend(shots: int = 1, max_stall_s: float = 30.0):
+    """Arm a wedged-backend stall for the next ``shots`` served solve
+    dispatches: each armed dispatch blocks before its first sweep,
+    cooperatively polling the request's deadline/cancel control, for at
+    most ``max_stall_s`` seconds (the bound exists so an un-deadlined
+    test cannot hang forever — a real wedged backend has no such mercy,
+    which is what deadlines are for). A deadlined stuck request surfaces
+    ``SolveStatus.DEADLINE`` through the production control path."""
+    return _armed("stuck", max_stall_s, shots)
+
+
+def consume_stuck() -> Optional[float]:
+    """The stuck-backend hook's stall bound in seconds, or None."""
+    return _consume("stuck")
 
 
 @contextlib.contextmanager

@@ -1,0 +1,46 @@
+"""svd_jacobi_tpu.serve — deadline-aware batched SVD serving.
+
+The request-level robustness layer (PR 4) on top of the solve-level one
+(PR 3, `resilience`): an in-process, thread-safe SVD service with
+
+  * bounded admission + load shedding (`queue`): reject-with-reason,
+    never silent drops;
+  * shape-bucketed dispatch (`buckets`): requests pad to a small static
+    (m, n, dtype) bucket set so the jit caches hit after one warmup per
+    bucket (`config.RETRACE_BUDGETS`);
+  * per-request deadlines and cooperative cancellation, enforced between
+    sweeps by `solver.SweepStepper.set_control` and surfaced as
+    `SolveStatus.DEADLINE` / `SolveStatus.CANCELLED`;
+  * a circuit breaker over consecutive solve failures that routes
+    dispatches through `resilience.resilient_svd`'s escalation ladder,
+    plus queue-pressure brownout (full SVD -> sigma-only -> shed)
+    (`breaker`);
+  * health/readiness probes and per-request schema-versioned ``"serve"``
+    manifest records (`obs.manifest.build_serve`) (`service`).
+
+Quickstart::
+
+    from svd_jacobi_tpu.serve import ServeConfig, SVDService
+
+    with SVDService(ServeConfig(buckets=((256, 256, "float32"),))) as svc:
+        t = svc.submit(a, deadline_s=2.0)
+        res = t.result(timeout=30.0)
+        if res.status is not None and res.status.name == "OK":
+            u, s, v = res.u, res.s, res.v
+
+`python -m svd_jacobi_tpu.cli serve-demo` runs a seeded closed-loop
+client against a live service.
+"""
+
+from __future__ import annotations
+
+from .breaker import BreakerState, Brownout, CircuitBreaker
+from .buckets import Bucket, BucketSet, as_bucket
+from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
+from .service import ServeConfig, ServeResult, SVDService, Ticket
+
+__all__ = [
+    "AdmissionError", "AdmissionQueue", "AdmissionReason", "Bucket",
+    "BucketSet", "BreakerState", "Brownout", "CircuitBreaker", "Request",
+    "ServeConfig", "ServeResult", "SVDService", "Ticket", "as_bucket",
+]

@@ -1,0 +1,122 @@
+"""Circuit breaker + brownout ladder — overload and failure degradation.
+
+Two independent axes of degradation, both DECLARED (enumerated states a
+test can assert and a manifest record can carry), never improvised:
+
+**Circuit breaker** (failure axis): counts CONSECUTIVE solve failures —
+exceptions and non-OK statuses of DISPATCHED solves, excluding
+client-initiated CANCELLED and queue-expired deadlines (those never
+reach a solve: they are overload symptoms, and feeding them to the
+breaker would let overload trip it onto the slower ladder path and
+amplify itself). A deadline that expires MID-solve does count: at the
+solve level a wedged backend (`chaos.stuck_backend`) and a merely-slow
+one are indistinguishable, and missing the wedged case means never
+recovering. The cost of the occasional false trip is bounded by the
+state machine below — one ladder success plus one probe and the breaker
+is closed again, and queue-expired requests are finalized before
+dispatch on either path, so an open breaker never serves already-dead
+work. State machine, advanced at dispatch (`begin`) and outcome
+(`record`):
+
+    CLOSED ──(streak >= failure_threshold)──> OPEN
+    OPEN:      dispatches route through the escalation ladder
+               (`resilience.resilient_svd` — more conservative, self-
+               healing) instead of the plain stepper path; a ladder
+               success ──> HALF_OPEN (ladder failure: stays OPEN)
+    HALF_OPEN: the next dispatch PROBES the base path;
+               success ──> CLOSED, failure ──> OPEN
+
+The breaker never rejects on its own — an OPEN breaker degrades the
+solve path; shedding is the brownout ladder's last rung. Deterministic
+by construction (no wall-clock cooldown): every transition is caused by
+a recorded dispatch outcome, so the whole sequence reconstructs from the
+per-request ``"serve"`` manifest records.
+
+**Brownout** (overload axis, computed by the service from queue fill):
+
+    FULL ──> SIGMA_ONLY ──> SHED
+
+FULL serves what was asked; SIGMA_ONLY admits but drops the factor
+computation (``compute_u = compute_v = False``: no rotation-product
+accumulation, no factor postprocessing/recombination, no sigma
+refinement — at kernel-path bucket sizes the sweeps themselves still
+run, so this sheds the factor-side cost, not the whole solve; the result
+says ``degraded=True``); SHED rejects at admission
+(`AdmissionReason.BROWNOUT_SHED`). Levels are decided at ADMISSION time
+so a request's service class is fixed (and recorded) the moment it is
+accepted.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import List, Tuple
+
+
+class BreakerState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class Brownout(enum.IntEnum):
+    """Ordered degradation ladder (higher = more degraded)."""
+
+    FULL = 0
+    SIGMA_ONLY = 1
+    SHED = 2
+
+
+class CircuitBreaker:
+    """Thread-safe consecutive-failure breaker (see module docstring)."""
+
+    def __init__(self, failure_threshold: int = 3):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = int(failure_threshold)
+        self._state = BreakerState.CLOSED
+        self._streak = 0
+        self._lock = threading.Lock()
+        # (from, to, cause) transition log for healthz / debugging; the
+        # authoritative reconstruction source is the manifest records.
+        self.transitions: List[Tuple[str, str, str]] = []
+
+    def state(self) -> BreakerState:
+        with self._lock:
+            return self._state
+
+    def begin(self) -> Tuple[str, BreakerState]:
+        """(dispatch path, state at dispatch): "base" when CLOSED or
+        probing HALF_OPEN, "ladder" when OPEN."""
+        with self._lock:
+            path = "ladder" if self._state is BreakerState.OPEN else "base"
+            return path, self._state
+
+    def _move(self, to: BreakerState, cause: str) -> None:
+        if self._state is not to:
+            self.transitions.append((self._state.value, to.value, cause))
+            self._state = to
+
+    def record(self, ok: bool) -> BreakerState:
+        """Record a dispatch outcome; returns the state after."""
+        with self._lock:
+            if self._state is BreakerState.CLOSED:
+                if ok:
+                    self._streak = 0
+                else:
+                    self._streak += 1
+                    if self._streak >= self.failure_threshold:
+                        self._move(BreakerState.OPEN,
+                                   f"{self._streak} consecutive failures")
+            elif self._state is BreakerState.OPEN:
+                if ok:  # the ladder healed a solve — try the base path next
+                    self._move(BreakerState.HALF_OPEN, "ladder success")
+            else:  # HALF_OPEN: this outcome IS the base-path probe
+                if ok:
+                    self._streak = 0
+                    self._move(BreakerState.CLOSED, "probe success")
+                else:
+                    self._move(BreakerState.OPEN, "probe failure")
+            return self._state

@@ -1,0 +1,120 @@
+"""Shape buckets — the compile-cache contract of the serving layer.
+
+A jit-compiled solver retraces per (shape, dtype); an open-ended request
+stream would therefore pay a multi-second compile per novel shape, which
+no deadline survives. The service instead declares a SMALL STATIC set of
+tall (m >= n, dtype) buckets; every admitted request is zero-padded up to
+the cheapest bucket that holds it, so after one warmup per bucket every
+dispatch is a cache hit (`config.RETRACE_BUDGETS` entries
+``solver._sweep_step_pallas_jit`` etc.; proven by
+`analysis.recompile_guard.run_serve_sequence`). A request that fits no
+bucket is REJECTED at admission (`AdmissionReason.NO_BUCKET`) — loudly,
+never solved off-bucket.
+
+Zero-padding is exact for the SVD, not an approximation: padded columns
+are exactly zero, so they deflate (sigma 0, sorted to the back by the
+descending sort) and never rotate against live columns; padded ROWS stay
+exactly zero through every column rotation (a rotation forms linear
+combinations of columns, and both combined entries in a padded row are
+zero). The original factors are therefore recovered by slicing:
+``u[:m, :k], s[:k], v[:n, :k]`` with ``k = min(m, n)``.
+
+Rank-deficiency caveat: a request with EXACT-zero singular values ties
+with the padding's zero sigmas in the descending sort, so its null-space
+slots may come back as zero columns in the sliced factors. This matches
+the unpadded solver's own rank-deficiency guard (`solver._normalize_cols`
+returns zero columns for zero sigmas rather than arbitrary vectors;
+`utils.validation.live_orthogonality_error` deflates them), so serving
+changes nothing about the contract: null-space columns of U/V are zero,
+not orthonormal completions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
+
+
+class Bucket(NamedTuple):
+    """One declared padded shape: tall (m >= n) plus the dtype name."""
+
+    m: int
+    n: int
+    dtype: str
+
+    @property
+    def name(self) -> str:
+        return f"{self.m}x{self.n}:{self.dtype}"
+
+    @property
+    def cost(self) -> int:
+        # One-sided Jacobi cost proxy (O(m n^2) per sweep) — routing picks
+        # the cheapest bucket that holds the request, not the smallest
+        # area, so a tall-skinny request never lands in a huge square
+        # bucket when a cheaper tall one fits.
+        return self.m * self.n * self.n
+
+
+BucketSpec = Union[Bucket, Tuple[int, int, str], str]
+
+
+def as_bucket(spec: BucketSpec) -> Bucket:
+    """Coerce a (m, n, dtype) tuple / "MxN:dtype" string / Bucket."""
+    if isinstance(spec, Bucket):
+        b = spec
+    elif isinstance(spec, str):
+        try:
+            dims, dtype = spec.split(":")
+            m, n = dims.split("x")
+            b = Bucket(int(m), int(n), dtype)
+        except ValueError:
+            raise ValueError(
+                f"bucket spec {spec!r} is not of the form 'MxN:dtype'")
+    else:
+        m, n, dtype = spec
+        b = Bucket(int(m), int(n), str(dtype))
+    import jax.numpy as jnp
+    b = Bucket(b.m, b.n, str(jnp.dtype(b.dtype).name))
+    if b.n < 1 or b.m < b.n:
+        raise ValueError(
+            f"bucket {b.name}: buckets are tall, need m >= n >= 1 "
+            f"(the service orients wide requests by transposition)")
+    return b
+
+
+class BucketSet:
+    """The declared bucket set, sorted by routing cost."""
+
+    def __init__(self, buckets: Sequence[BucketSpec]):
+        bs = [as_bucket(b) for b in buckets]
+        if not bs:
+            raise ValueError("a serving bucket set cannot be empty")
+        if len(set(bs)) != len(bs):
+            raise ValueError(f"duplicate buckets in {bs}")
+        self.buckets: Tuple[Bucket, ...] = tuple(
+            sorted(bs, key=lambda b: (b.cost, b.m, b.n, b.dtype)))
+
+    def __iter__(self):
+        return iter(self.buckets)
+
+    def __len__(self):
+        return len(self.buckets)
+
+    def route(self, m: int, n: int, dtype: str) -> Optional[Bucket]:
+        """Cheapest bucket holding a TALL-oriented (m >= n) request of
+        exact dtype, or None (-> admission rejects with NO_BUCKET)."""
+        import jax.numpy as jnp
+        dtype = str(jnp.dtype(dtype).name)
+        for b in self.buckets:
+            if b.dtype == dtype and b.m >= m and b.n >= n:
+                return b
+        return None
+
+    @staticmethod
+    def pad(a, bucket: Bucket):
+        """Zero-pad a tall (m, n) array up to the bucket shape (exact for
+        the SVD — see the module docstring)."""
+        import jax.numpy as jnp
+        m, n = a.shape
+        if (m, n) == (bucket.m, bucket.n):
+            return a
+        return jnp.pad(a, ((0, bucket.m - m), (0, bucket.n - n)))

@@ -1,0 +1,161 @@
+"""Bounded admission queue — loud load shedding, never silent drops.
+
+The reference staged oversized runs through a SLURM queue with wall-clock
+limits (`build/runSVDMPICUDA.slurm`); this is the in-process equivalent:
+a bounded FIFO whose `admit` either enqueues the request or raises
+`AdmissionError` with a machine-readable `AdmissionReason` — a rejected
+request is a REPLY, not a drop. Two limits live here (the queue's own
+state); the service layers the bucket-routing / brownout / shutdown
+rejections on top before calling `admit`:
+
+  * ``QUEUE_FULL`` — depth has reached ``max_depth``;
+  * ``DEADLINE_BUDGET`` — the aggregate remaining deadline budget of the
+    QUEUED requests (sum of ``max(0, deadline_i - now)``) would exceed
+    ``max_deadline_budget_s``. This caps how much future work the service
+    may promise: every queued deadline is a promise to answer by then,
+    and a service that keeps promising past its throughput converts every
+    deadline into a DEADLINE status — better to reject at the door.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import threading
+import time
+from typing import Any, List, Optional
+
+from .buckets import Bucket
+
+
+class AdmissionReason(enum.Enum):
+    """Why a request was rejected at admission (AdmissionError.reason)."""
+
+    QUEUE_FULL = "queue_full"
+    DEADLINE_BUDGET = "deadline_budget"
+    NO_BUCKET = "no_bucket"
+    NONFINITE_INPUT = "nonfinite_input"
+    BROWNOUT_SHED = "brownout_shed"
+    SHUTDOWN = "shutdown"
+
+
+class AdmissionError(RuntimeError):
+    """Loud admission rejection: carries the reason and a human detail."""
+
+    def __init__(self, reason: AdmissionReason, detail: str):
+        super().__init__(f"request rejected ({reason.value}): {detail}")
+        self.reason = reason
+        self.detail = detail
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted unit of work (tall-oriented; the service transposes
+    wide inputs at submit and swaps the factors back on completion)."""
+
+    id: str
+    a: Any                        # tall-oriented (m, n) device array
+    m: int                        # oriented logical rows (pre-padding)
+    n: int                        # oriented logical cols (pre-padding)
+    orig_shape: tuple             # shape exactly as submitted
+    transposed: bool
+    bucket: Bucket
+    compute_u: bool
+    compute_v: bool
+    degraded: bool                # factors dropped by SIGMA_ONLY brownout
+    deadline: Optional[float]     # absolute time.monotonic() second
+    deadline_s: Optional[float]   # as requested (relative, for records)
+    submitted: float              # time.monotonic() at admission
+    brownout: str = "FULL"        # Brownout level NAME at admission
+    cancel: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    ticket: Any = None
+
+
+class AdmissionQueue:
+    """Thread-safe bounded FIFO with the two queue-level admission rules."""
+
+    def __init__(self, max_depth: int,
+                 max_deadline_budget_s: float = float("inf")):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = int(max_depth)
+        self.max_deadline_budget_s = float(max_deadline_budget_s)
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def close(self) -> None:
+        """Stop admitting — atomically with `admit` (same lock), so every
+        request is EITHER enqueued before the close (and therefore seen by
+        a worker draining to `closed_and_empty`) OR rejected with
+        SHUTDOWN. Closes the submit-vs-stop race that could otherwise
+        strand an admitted request on a queue nobody will ever pop."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def closed_and_empty(self) -> bool:
+        """Atomic worker-exit predicate: once this is True no admitted
+        request can still be queued (admit and close share the lock)."""
+        with self._cond:
+            return self._closed and not self._q
+
+    def deadline_budget(self, now: Optional[float] = None) -> float:
+        """Aggregate remaining deadline budget of the queued requests."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            return sum(max(0.0, r.deadline - now) for r in self._q
+                       if r.deadline is not None)
+
+    def admit(self, req: Request) -> None:
+        """Enqueue or raise AdmissionError — the only two outcomes."""
+        now = time.monotonic()
+        with self._cond:
+            if self._closed:
+                raise AdmissionError(AdmissionReason.SHUTDOWN,
+                                     "queue is closed")
+            if len(self._q) >= self.max_depth:
+                raise AdmissionError(
+                    AdmissionReason.QUEUE_FULL,
+                    f"queue depth {len(self._q)} at max_depth "
+                    f"{self.max_depth}")
+            if req.deadline is not None:
+                # Condition's default lock is an RLock, so the re-entrant
+                # read of the one budget definition is safe.
+                budget = self.deadline_budget(now)
+                add = max(0.0, req.deadline - now)
+                if budget + add > self.max_deadline_budget_s:
+                    raise AdmissionError(
+                        AdmissionReason.DEADLINE_BUDGET,
+                        f"aggregate queued deadline budget "
+                        f"{budget + add:.3f}s would exceed "
+                        f"{self.max_deadline_budget_s:.3f}s")
+            self._q.append(req)
+            self._cond.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Request]:
+        """Oldest request; blocks until one arrives or the queue closes
+        (``timeout=None`` — no idle polling: `admit` and `close` notify
+        the condition). Returns None when closed-and-empty, or after an
+        explicit ``timeout`` expires."""
+        with self._cond:
+            while not self._q and not self._closed:
+                if not self._cond.wait(timeout):
+                    return None
+            if not self._q:
+                return None          # closed and drained
+            return self._q.popleft()
+
+    def drain(self) -> List[Request]:
+        """Remove and return everything queued (shutdown without drain:
+        the service finalizes each with CANCELLED — still not silent)."""
+        with self._cond:
+            out = list(self._q)
+            self._q.clear()
+            return out

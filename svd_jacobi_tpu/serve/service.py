@@ -1,0 +1,649 @@
+"""The deadline-aware in-process SVD service.
+
+`SVDService` turns the one-shot `svd()` entry points into a request
+server with production overload behavior — the request-level robustness
+layer on top of PR 3's solve-level one:
+
+  * **admission control** (`queue.AdmissionQueue` + bucket routing +
+    brownout): `submit` either returns a `Ticket` or raises
+    `AdmissionError` with a machine-readable reason — never a silent
+    drop;
+  * **shape-bucketed dispatch** (`buckets.BucketSet`): every request is
+    zero-padded to a declared (m, n, dtype) bucket BEFORE the solver
+    sees it, so the stepper's jit entries compile once per bucket and
+    every later dispatch is a cache hit (`config.RETRACE_BUDGETS`,
+    proven by `analysis.recompile_guard.run_serve_sequence`);
+  * **deadlines & cancellation**: per-request deadlines are enforced by
+    the host-stepped `SweepStepper`'s cooperative control
+    (`set_control` — checked between sweeps, no thread kills), decoded
+    into `SolveStatus.DEADLINE` / `SolveStatus.CANCELLED`. A timed-out
+    request returns a loud PARTIAL result within one sweep of its
+    deadline while its queue neighbors are untouched;
+  * **circuit breaker + brownout** (`breaker`): consecutive solve
+    failures trip the breaker OPEN, routing dispatches through
+    `resilience.resilient_svd`'s escalation ladder until a success
+    probes the base path closed; queue-pressure brownout degrades
+    full SVD -> sigma-only -> shed, in that declared order;
+  * **observability**: every request (served OR rejected) appends one
+    schema-versioned ``"serve"`` record (`obs.manifest.build_serve`) —
+    bucket, queue wait, solve time, status, breaker state — so the whole
+    service history reconstructs from the same manifest stream the rest
+    of the tooling reads; `healthz`/`ready` expose live probes.
+
+The worker is a single thread: the device executes one solve at a time
+anyway, and a serial worker makes every breaker/brownout transition
+deterministic. Clients are free-threaded; `Ticket.result` blocks with a
+timeout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import sys
+import threading
+import time
+from typing import Any, NamedTuple, Optional, Tuple
+
+from ..config import DEFAULT_SERVE_BUCKETS, SVDConfig
+from .breaker import BreakerState, Brownout, CircuitBreaker
+from .buckets import BucketSet
+from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
+
+
+class ServeResult(NamedTuple):
+    """Terminal outcome of one served request.
+
+    ``status`` is the solver's `SolveStatus` (DEADLINE/CANCELLED for
+    control stops) or None when the dispatch died with ``error``;
+    exactly one of the two is informative. ``degraded`` marks a
+    sigma-only brownout response (u/v None even if requested)."""
+
+    u: Any
+    s: Any
+    v: Any
+    status: Any                   # Optional[SolveStatus]
+    error: Optional[str]
+    sweeps: int
+    bucket: Optional[str]
+    queue_wait_s: float
+    solve_time_s: Optional[float]
+    path: str                     # "base" | "ladder"
+    degraded: bool
+    request_id: str
+
+
+class Ticket:
+    """Client handle: blocks on `result`, requests cancellation with
+    `cancel` (cooperative — takes effect at the next sweep boundary, or
+    at dispatch when still queued)."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._done = threading.Event()
+        self._result: Optional[ServeResult] = None
+        self._cancel = threading.Event()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not terminal after {timeout}s")
+        return self._result
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-layer configuration (the solver's own knobs ride in
+    ``solver``)."""
+
+    buckets: tuple = DEFAULT_SERVE_BUCKETS
+    solver: SVDConfig = SVDConfig()
+    max_queue_depth: int = 16
+    # Cap on the aggregate remaining deadline budget of queued requests
+    # (see queue.AdmissionQueue); inf = disabled.
+    max_deadline_budget_s: float = float("inf")
+    # Deadline applied to requests submitted without one; None = none.
+    default_deadline_s: Optional[float] = None
+    breaker_threshold: int = 3
+    # Brownout thresholds on queue fill (depth / max_queue_depth) at
+    # admission: fill >= sigma_only_at degrades to sigma-only, fill >=
+    # shed_at rejects. Values > 1 disable a rung.
+    brownout_sigma_only_at: float = 0.75
+    brownout_shed_at: float = 0.95
+    # JSONL manifest the per-request "serve" records append to; None
+    # keeps them in memory only (`SVDService.records`).
+    manifest_path: Optional[str] = None
+    max_records: int = 1024
+
+
+class SVDService:
+    """Thread-safe in-process SVD server (see module docstring)."""
+
+    def __init__(self, config: ServeConfig = ServeConfig()):
+        if not (0.0 < config.brownout_sigma_only_at
+                <= config.brownout_shed_at):
+            raise ValueError(
+                "brownout thresholds must satisfy 0 < sigma_only_at <= "
+                f"shed_at, got {config.brownout_sigma_only_at} / "
+                f"{config.brownout_shed_at}")
+        self.config = config
+        self.buckets = BucketSet(config.buckets)
+        self.queue = AdmissionQueue(config.max_queue_depth,
+                                    config.max_deadline_budget_s)
+        self.breaker = CircuitBreaker(config.breaker_threshold)
+        self._records: list = []
+        self._stats: dict = {}
+        self._lock = threading.Lock()
+        self._accepting = False
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+        self._in_flight: Optional[Request] = None
+        self._seq = itertools.count()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "SVDService":
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                raise RuntimeError("service already started")
+            if self.queue.closed_and_empty():
+                raise RuntimeError(
+                    "service was stopped; a stopped SVDService is not "
+                    "restartable — build a new one")
+            self._accepting = True
+            self._drain = True
+            self._thread = threading.Thread(target=self._worker,
+                                            name="svdj-serve", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None
+             ) -> None:
+        """Stop accepting; drain the queue (default) or finalize every
+        queued request with CANCELLED — either way every admitted request
+        reaches a terminal status."""
+        with self._lock:
+            self._accepting = False
+            self._drain = bool(drain)
+            thread = self._thread
+        # Close BEFORE draining: admit and close share the queue lock, so
+        # every submit either enqueued before this point (and is drained
+        # below or served by the worker) or raises SHUTDOWN — no request
+        # can be admitted onto a queue nobody will pop.
+        self.queue.close()
+        if not drain:
+            self._cancel_queued()
+            # Also cancel the IN-FLIGHT solve (cooperatively — it stops at
+            # the next sweep boundary and finalizes CANCELLED), so a
+            # no-drain stop is not blocked behind a long solve and the
+            # running request still reaches a terminal status. The ladder
+            # path cannot be interrupted mid-fused-solve; join() rides it
+            # out up to ``timeout``.
+            with self._lock:
+                inflight = self._in_flight
+            if inflight is not None:
+                inflight.cancel.set()
+        if thread is not None:
+            thread.join(timeout)
+            if not thread.is_alive():
+                # Belt-and-braces: the worker is gone, so anything still
+                # queued (it cannot be, by the close/drain protocol, short
+                # of a worker crash) is finalized, never stranded.
+                self._cancel_queued()
+
+    def _cancel_queued(self) -> None:
+        for req in self.queue.drain():
+            wait = time.monotonic() - req.submitted
+            self._finalize(req, status_name="CANCELLED",
+                           result=self._control_result(
+                               req, "CANCELLED", wait),
+                           queue_wait=wait, solve_time=None, path="base",
+                           breaker_state=self.breaker.state())
+
+    def warmup(self, *, sigma_only: bool = True,
+               timeout: float = 600.0) -> None:
+        """Compile every bucket's solve variants before real traffic: one
+        zeros solve per bucket and (default) per compute variant. Zeros
+        deflate immediately — the solve itself is one sweep — so the cost
+        is essentially the compiles. This matters for the SIGMA_ONLY
+        brownout: its compute flags are STATIC jit arguments, so without
+        warmup the first degraded dispatch per bucket pays a fresh
+        compile mid-overload, exactly when the worker can least afford
+        it. Call after `start()`; the warmup requests flow through the
+        normal path and appear in the manifest like any other. Raises
+        RuntimeError on any non-OK warmup outcome — a warmup that
+        silently failed would mean serving real traffic uncompiled (and,
+        worse, with warmup failures already counted into the breaker)."""
+        import jax.numpy as jnp
+        from ..solver import SolveStatus
+        variants = [(True, True)] + ([(False, False)] if sigma_only else [])
+        # Sequential (one in flight at a time): a burst of warmup submits
+        # would itself raise the queue fill into the brownout rungs and
+        # get the full-SVD variant degraded to sigma-only before it ever
+        # compiled. deadline_s=inf: NO deadline, overriding any
+        # default_deadline_s and exempt from the budget cap — neither a
+        # short default nor a small max_deadline_budget_s may be allowed
+        # to expire or refuse the compile warmup exists to front-load
+        # (client-side `result(timeout)` still bounds the wait).
+        for b in self.buckets:
+            for cu, cv in variants:
+                rid = f"warmup-{b.name}-{'vec' if cu else 'novec'}"
+                res = self.submit(jnp.zeros((b.m, b.n), jnp.dtype(b.dtype)),
+                                  compute_u=cu, compute_v=cv,
+                                  deadline_s=float("inf"),
+                                  request_id=rid).result(timeout)
+                if (res.status is not SolveStatus.OK or res.degraded
+                        or res.path != "base"):
+                    # A degraded or ladder-routed warmup solved SOMETHING,
+                    # but not the stepper variant it exists to compile —
+                    # that is a failure too (warm up before traffic, with
+                    # a closed breaker).
+                    status = (res.error if res.error
+                              else res.status.name if res.status else "?")
+                    raise RuntimeError(
+                        f"warmup request {rid} did not compile its "
+                        f"variant (status={status}, degraded="
+                        f"{res.degraded}, path={res.path}, breaker now "
+                        f"{self.breaker.state().value})")
+
+    def __enter__(self) -> "SVDService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=False, timeout=10.0)
+
+    # -- probes -------------------------------------------------------------
+
+    def ready(self) -> bool:
+        """Readiness: accepting work with a live worker."""
+        with self._lock:
+            return bool(self._accepting and self._thread is not None
+                        and self._thread.is_alive())
+
+    def healthz(self) -> dict:
+        """Liveness + load snapshot (cheap; safe to poll)."""
+        with self._lock:
+            alive = self._thread is not None and self._thread.is_alive()
+            in_flight = (self._in_flight.id
+                         if self._in_flight is not None else None)
+            stats = dict(self._stats)
+        return {
+            "ok": alive,
+            "ready": self.ready(),
+            "breaker": self.breaker.state().value,
+            "brownout": self._brownout().name,
+            "queue_depth": self.queue.depth(),
+            "deadline_budget_s": self.queue.deadline_budget(),
+            "in_flight": in_flight,
+            "stats": stats,
+        }
+
+    def records(self) -> list:
+        """The in-memory per-request "serve" records (newest last)."""
+        with self._lock:
+            return list(self._records)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- admission ----------------------------------------------------------
+
+    def _brownout(self) -> Brownout:
+        fill = self.queue.depth() / self.queue.max_depth
+        if fill >= self.config.brownout_shed_at:
+            return Brownout.SHED
+        if fill >= self.config.brownout_sigma_only_at:
+            return Brownout.SIGMA_ONLY
+        return Brownout.FULL
+
+    def submit(self, a, *, compute_u: bool = True, compute_v: bool = True,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> Ticket:
+        """Admit one request: returns a `Ticket` or raises
+        `AdmissionError` (reason: SHUTDOWN | NO_BUCKET | BROWNOUT_SHED |
+        QUEUE_FULL | DEADLINE_BUDGET). ``deadline_s`` is relative to now;
+        the solve stops cooperatively within one sweep of it. None
+        inherits ``default_deadline_s``; an explicit ``float("inf")``
+        means NO deadline even when a default is configured (exempt from
+        the deadline budget — `warmup` uses this so a compile can never
+        expire the deadline that exists to front-load it)."""
+        import math
+
+        import jax.numpy as jnp
+        in_dtype = getattr(a, "dtype", None)
+        a = jnp.asarray(a)
+        if a.ndim != 2:
+            raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+        rid = request_id or f"r{next(self._seq):05d}"
+        orig_shape = tuple(int(d) for d in a.shape)
+        transposed = a.shape[0] < a.shape[1]
+        if transposed:
+            a = a.T
+            compute_u, compute_v = compute_v, compute_u
+        m, n = (int(d) for d in a.shape)
+        dtype = str(a.dtype)
+        # Normalize the deadline BEFORE any rejection path: a rejected
+        # inf-deadline submit must not leak a non-JSON Infinity token
+        # into its manifest record.
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        if deadline_s is not None and math.isinf(deadline_s):
+            deadline_s = None
+        brown = self._brownout()
+        try:
+            if not self.ready():
+                raise AdmissionError(AdmissionReason.SHUTDOWN,
+                                     "service is not accepting requests")
+            if (in_dtype is not None
+                    and jnp.dtype(a.dtype) != jnp.dtype(in_dtype)):
+                # jnp.asarray silently downcasts (e.g. f64 -> f32 with
+                # x64 disabled); serving a precision-degraded result
+                # UNDECLARED would violate the layer's reject-or-record
+                # policy, so refuse loudly instead.
+                raise AdmissionError(
+                    AdmissionReason.NO_BUCKET,
+                    f"input dtype {jnp.dtype(in_dtype).name} is not "
+                    f"representable in this runtime (jnp.asarray produced "
+                    f"{a.dtype}; jax_enable_x64?) — refusing to silently "
+                    f"downcast")
+            bucket = self.buckets.route(m, n, dtype)
+            if bucket is None:
+                raise AdmissionError(
+                    AdmissionReason.NO_BUCKET,
+                    f"shape {orig_shape} dtype {dtype} fits no declared "
+                    f"bucket {[b.name for b in self.buckets]}")
+            if not bool(jnp.isfinite(a).all()):
+                # resilience.guard's policy, enforced at the door: no
+                # ladder can fix data, and solving NaN input would read
+                # NONFINITE and feed the breaker — one buggy client must
+                # not be able to trip every other client onto the
+                # degraded ladder path.
+                raise AdmissionError(
+                    AdmissionReason.NONFINITE_INPUT,
+                    "input contains NaN/Inf — rejected before any solve "
+                    "is spent (resilience.guard policy)")
+            if brown is Brownout.SHED:
+                raise AdmissionError(
+                    AdmissionReason.BROWNOUT_SHED,
+                    f"queue fill {self.queue.depth()}/"
+                    f"{self.queue.max_depth} at shed threshold")
+            now = time.monotonic()
+            ticket = Ticket(rid)
+            req = Request(
+                id=rid, a=a, m=m, n=n, orig_shape=orig_shape,
+                transposed=transposed, bucket=bucket,
+                compute_u=compute_u, compute_v=compute_v,
+                degraded=(brown is Brownout.SIGMA_ONLY
+                          and (compute_u or compute_v)),
+                brownout=brown.name,
+                deadline=(None if deadline_s is None
+                          else now + float(deadline_s)),
+                deadline_s=deadline_s, submitted=now,
+                cancel=ticket._cancel, ticket=ticket)
+            self.queue.admit(req)
+        except AdmissionError as e:
+            self._bump("rejected", f"rejected:{e.reason.value}")
+            self._record(request_id=rid, orig_shape=orig_shape, dtype=dtype,
+                         bucket=None, queue_wait_s=0.0, solve_time_s=None,
+                         status=f"REJECTED_{e.reason.name}", path="rejected",
+                         breaker=self.breaker.state().value,
+                         brownout=brown.name, degraded=False,
+                         deadline_s=deadline_s, error=e.detail)
+            raise
+        self._bump("submitted")
+        return ticket
+
+    # -- worker -------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            # Blocking pop — no idle polling; `admit` and `close` notify.
+            req = self.queue.pop(None)
+            if req is None:
+                # Exit only when the queue is closed AND empty — atomic
+                # with admission, so no admitted request is left behind.
+                if self.queue.closed_and_empty():
+                    break
+                continue
+            with self._lock:
+                drain = self._drain or self._accepting
+            try:
+                if not drain:
+                    # stop(drain=False) raced the pop: finalize, don't solve.
+                    wait = time.monotonic() - req.submitted
+                    self._finalize(
+                        req, status_name="CANCELLED",
+                        result=self._control_result(req, "CANCELLED", wait),
+                        queue_wait=wait, solve_time=None, path="base",
+                        breaker_state=self.breaker.state())
+                else:
+                    self._serve_one(req)
+            except BaseException as e:  # last ditch: no undone tickets
+                if not req.ticket._done.is_set():
+                    self._finalize(
+                        req, status_name="ERROR",
+                        result=self._error_result(
+                            req, f"{type(e).__name__}: {e}", 0.0, "base"),
+                        queue_wait=time.monotonic() - req.submitted,
+                        solve_time=None, path="base",
+                        breaker_state=self.breaker.record(False))
+
+    def _serve_one(self, req: Request) -> None:
+        from ..solver import SolveStatus
+        t_pop = time.monotonic()
+        queue_wait = t_pop - req.submitted
+        with self._lock:
+            self._in_flight = req
+            if not self._accepting and not self._drain:
+                # stop(drain=False) raced the pop before _in_flight was
+                # published (it could not see this request to cancel it);
+                # publish-and-check shares stop()'s lock, so one side
+                # always sets the cancel event.
+                req.cancel.set()
+        try:
+            if req.cancel.is_set():
+                # Cancelled while queued: terminal without spending a solve.
+                self._finalize(req, status_name="CANCELLED",
+                               result=self._control_result(
+                                   req, "CANCELLED", queue_wait),
+                               queue_wait=queue_wait, solve_time=None,
+                               path="base",
+                               breaker_state=self.breaker.state())
+                return
+            if req.deadline is not None and time.monotonic() >= req.deadline:
+                # Deadline expired while QUEUED: terminal without spending
+                # a sweep — on EITHER breaker path (the ladder runs fused
+                # solves that cannot stop mid-flight, so dispatching an
+                # already-dead request there would serve it long after the
+                # client gave up). A queue-expired deadline is an OVERLOAD
+                # symptom, not a backend failure, so it does not feed the
+                # breaker — otherwise overload would trip the breaker onto
+                # the slower ladder path and amplify itself.
+                self._finalize(req, status_name="DEADLINE",
+                               result=self._control_result(
+                                   req, "DEADLINE", queue_wait),
+                               queue_wait=queue_wait, solve_time=None,
+                               path="base",
+                               breaker_state=self.breaker.state())
+                return
+            path, _ = self.breaker.begin()
+            cu = req.compute_u and not req.degraded
+            cv = req.compute_v and not req.degraded
+            t0 = time.monotonic()
+            error = None
+            r = None
+            try:
+                if path == "ladder":
+                    r = self._solve_ladder(req, cu, cv)
+                else:
+                    r = self._solve_base(req, cu, cv)
+                status = r.status_enum()
+            except Exception as e:
+                error = f"{type(e).__name__}: {e}"
+                status = None
+            solve_time = time.monotonic() - t0
+            if status is SolveStatus.CANCELLED:
+                # Client-initiated: neither a success nor a backend failure.
+                breaker_state = self.breaker.state()
+            else:
+                breaker_state = self.breaker.record(
+                    error is None and status is SolveStatus.OK)
+            if error is not None:
+                result = self._error_result(req, error, queue_wait, path,
+                                            solve_time_s=solve_time)
+                status_name = "ERROR"
+            else:
+                u, s, v, sweeps = self._slice(req, r, cu, cv)
+                result = ServeResult(
+                    u=u, s=s, v=v, status=status, error=None, sweeps=sweeps,
+                    bucket=req.bucket.name, queue_wait_s=queue_wait,
+                    solve_time_s=solve_time, path=path,
+                    degraded=req.degraded, request_id=req.id)
+                status_name = status.name
+            self._finalize(req, status_name=status_name, result=result,
+                           queue_wait=queue_wait, solve_time=solve_time,
+                           path=path, breaker_state=breaker_state)
+        finally:
+            with self._lock:
+                self._in_flight = None
+
+    # -- solve paths --------------------------------------------------------
+
+    def _solve_base(self, req: Request, cu: bool, cv: bool):
+        """The normal path: pad to the bucket, run the host-stepped solver
+        under cooperative control, one control check per sweep."""
+        from ..resilience import chaos
+        from ..solver import SweepStepper
+        a_pad = self.buckets.pad(req.a, req.bucket)
+        stall = chaos.consume_stuck()
+        if stall is not None:
+            self._stall(req, stall)
+        slow = chaos.consume_slow()
+        st = SweepStepper(a_pad, compute_u=cu, compute_v=cv,
+                          config=self.config.solver)
+        st.set_control(deadline=req.deadline,
+                       should_cancel=req.cancel.is_set)
+        state = st.init()
+        while st.should_continue(state):
+            if slow is not None:
+                time.sleep(slow)
+            state = st.step(state)
+        return st.finish(state)
+
+    def _solve_ladder(self, req: Request, cu: bool, cv: bool):
+        """The OPEN-breaker path: route through the escalation ladder.
+        The ladder runs the FUSED entry points, so the deadline cannot be
+        checked mid-solve — acceptable for the recovery path (bounded by
+        the ladder's own attempt cap), and the manifest records it as
+        path="ladder"."""
+        from ..resilience import resilient_svd
+        a_pad = self.buckets.pad(req.a, req.bucket)
+        return resilient_svd(a_pad, compute_u=cu, compute_v=cv,
+                             config=self.config.solver,
+                             manifest_path=self.config.manifest_path)
+
+    @staticmethod
+    def _stall(req: Request, stall_s: float) -> None:
+        """chaos.stuck_backend: block cooperatively (polling the request's
+        deadline/cancel control) for at most ``stall_s``; the stepper's
+        own control check then turns an expired deadline into DEADLINE."""
+        t_end = time.monotonic() + stall_s
+        while time.monotonic() < t_end:
+            if req.cancel.is_set():
+                return
+            if req.deadline is not None and time.monotonic() >= req.deadline:
+                return
+            time.sleep(0.002)
+
+    def _slice(self, req: Request, r, cu: bool, cv: bool):
+        """Recover the original-shape factors from the bucket-padded solve
+        (exact — see buckets module docstring) and undo the tall
+        orientation."""
+        k = min(req.m, req.n)
+        u = r.u[:req.m, :k] if (cu and r.u is not None) else None
+        s = r.s[:k]
+        v = r.v[:req.n, :k] if (cv and r.v is not None) else None
+        if req.transposed:
+            u, v = v, u
+        return u, s, v, int(r.sweeps)
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _control_result(self, req: Request, status_name: str,
+                        queue_wait: float) -> ServeResult:
+        from ..solver import SolveStatus
+        return ServeResult(
+            u=None, s=None, v=None, status=SolveStatus[status_name],
+            error=None, sweeps=0, bucket=req.bucket.name,
+            queue_wait_s=queue_wait, solve_time_s=None, path="base",
+            degraded=req.degraded, request_id=req.id)
+
+    def _error_result(self, req: Request, error: str, queue_wait: float,
+                      path: str, solve_time_s: Optional[float] = None
+                      ) -> ServeResult:
+        return ServeResult(
+            u=None, s=None, v=None, status=None, error=error, sweeps=0,
+            bucket=req.bucket.name, queue_wait_s=queue_wait,
+            solve_time_s=solve_time_s, path=path, degraded=req.degraded,
+            request_id=req.id)
+
+    def _finalize(self, req: Request, *, status_name: str,
+                  result: ServeResult, queue_wait: float,
+                  solve_time: Optional[float], path: str,
+                  breaker_state: BreakerState) -> None:
+        req.ticket._result = result
+        req.ticket._done.set()
+        self._bump("served", f"status:{status_name}",
+                   *(["path:ladder"] if path == "ladder" else []),
+                   *(["degraded"] if req.degraded else []))
+        self._record(
+            request_id=req.id, orig_shape=req.orig_shape,
+            dtype=req.bucket.dtype, bucket=req.bucket.name,
+            queue_wait_s=queue_wait, solve_time_s=solve_time,
+            status=status_name, path=path, breaker=breaker_state.value,
+            brownout=req.brownout,
+            degraded=req.degraded, deadline_s=req.deadline_s,
+            sweeps=result.sweeps, error=result.error)
+
+    def _bump(self, *keys: str) -> None:
+        with self._lock:
+            for k in keys:
+                self._stats[k] = self._stats.get(k, 0) + 1
+
+    def _record(self, *, request_id: str, orig_shape: Tuple[int, int],
+                dtype: str, bucket: Optional[str], queue_wait_s: float,
+                solve_time_s: Optional[float], status: str, path: str,
+                breaker: str, brownout: str, degraded: bool,
+                deadline_s: Optional[float], error: Optional[str] = None,
+                sweeps: Optional[int] = None) -> None:
+        from .. import obs
+        record = obs.manifest.build_serve(
+            request_id=request_id, m=orig_shape[0], n=orig_shape[1],
+            dtype=dtype, bucket=bucket, queue_wait_s=float(queue_wait_s),
+            solve_time_s=(None if solve_time_s is None
+                          else float(solve_time_s)),
+            status=status, path=path, breaker=breaker, brownout=brownout,
+            degraded=bool(degraded),
+            deadline_s=(None if deadline_s is None else float(deadline_s)),
+            sweeps=sweeps, error=error)
+        with self._lock:
+            # max_records <= 0 means "manifest only, keep none in memory"
+            # (the naive del lst[:-0] would silently invert the cap into
+            # unbounded growth).
+            if self.config.max_records > 0:
+                self._records.append(record)
+                del self._records[:-self.config.max_records]
+        if self.config.manifest_path is not None:
+            try:
+                obs.manifest.append(self.config.manifest_path, record)
+            except Exception as e:  # manifest I/O must not kill the worker
+                self._bump("manifest_errors")
+                print(f"svdj-serve: manifest append failed: {e}",
+                      file=sys.stderr)

@@ -20,8 +20,9 @@ capability upgrades over the reference, per SURVEY.md section 7:
 from __future__ import annotations
 
 import enum
+import time
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,13 +55,26 @@ class SolveStatus(enum.IntEnum):
                       convergence statistic. The deflation mask silently
                       DROPS NaN columns from the masked statistic, so
                       without this word a NaN-poisoned solve is
-                      indistinguishable from a converged one.
+                      indistinguishable from a converged one;
+      * DEADLINE    — the host-stepped loop stopped because the request's
+                      deadline expired (cooperative check between sweeps:
+                      `SweepStepper.set_control`). The result is a LOUD
+                      partial — the factors reflect the sweeps that ran;
+      * CANCELLED   — the host-stepped loop stopped because the caller
+                      cancelled the request (same cooperative check).
+
+    DEADLINE/CANCELLED are host-loop statuses (the serving layer's
+    request control, `svd_jacobi_tpu.serve`): the fused while_loop entry
+    points never produce them — a fused solve cannot be interrupted
+    between sweeps.
     """
 
     OK = 0
     MAX_SWEEPS = 1
     STAGNATED = 2
     NONFINITE = 3
+    DEADLINE = 4
+    CANCELLED = 5
 
 
 class SVDResult(NamedTuple):
@@ -141,9 +155,11 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     if method == "auto":
         if a.dtype == jnp.float64:
             method = "qr-svd"
-        elif min(m, n) >= 64:
+        elif min(m, n) >= 64 and config.criterion != "abs":
             # The Pallas device-kernel path (TPU fast path; interpreter on
-            # CPU backends).
+            # CPU backends). An explicit abs criterion routes to the XLA
+            # block solvers instead — the kernel measures only the rel
+            # statistic, and "auto" means "pick a compatible solver".
             method = "pallas"
         else:
             method = "hybrid" if compute_uv else "gram-eigh"
@@ -156,10 +172,20 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     if criterion == "auto":
         criterion = "abs" if method == "gram-eigh" else "rel"
     if method == "pallas":
-        # The kernel path measures only the rel (dgesvj scaled-coupling)
-        # statistic; an abs-scale tolerance would be compared against the
-        # wrong quantity and could never be reached.
-        criterion = "rel"
+        if criterion == "abs":
+            # The kernel path measures only the rel (dgesvj scaled-coupling)
+            # statistic; an abs-scale tolerance would be compared against
+            # the wrong quantity and could never be reached. An explicit
+            # abs request on the explicit kernel path is unsatisfiable —
+            # reject it loudly (this file's policy for precondition /
+            # mixed_bulk) instead of silently rewriting it to "rel".
+            raise ValueError(
+                "criterion='abs' is not measurable on the Pallas kernel "
+                "path (pair_solver='pallas' measures only the dgesvj "
+                "scaled-coupling 'rel' statistic); use criterion='rel' or "
+                "an XLA pair solver ('gram-eigh'/'hybrid'/'qr-svd')")
+        # (here criterion can only be "rel": "auto" resolved above, "abs"
+        # just raised)
     if criterion not in ("rel", "abs"):
         raise ValueError(f"unknown convergence criterion: {criterion!r}")
     # For "hybrid", tol/criterion describe the FINAL (polish) phase; the abs
@@ -549,6 +575,15 @@ def _precondition_qr(a):
         acc = jnp.promote_types(a.dtype, jnp.float32)
         q1, r = jnp.linalg.qr(jnp.take(a, order, axis=1).astype(acc))
         return q1, r, order, r.T.astype(a.dtype)
+
+
+# Module-level jit of the preconditioning factorization: the host-stepped
+# path (SweepStepper._precond_state) used to wrap it ad hoc per stepper,
+# which compiled a fresh executable per REQUEST — death for the serving
+# layer, where hundreds of steppers are built for the same bucket shape.
+# One shared wrapper means one compile per (shape, dtype) problem key
+# (config.RETRACE_BUDGETS entry "solver._precondition_qr_jit").
+_precondition_qr_jit = jax.jit(_precondition_qr)
 
 
 def _recombine_precondition(cols, rot, *, m, n, compute_u, compute_v,
@@ -1026,8 +1061,42 @@ class SweepStepper:
         self._just_switched = False
         self._input_digest = None
         # Why the host loop stopped ("tol" | "stall" | "max_sweeps" |
-        # "nonfinite"); decoded into SVDResult.status by finish().
+        # "nonfinite" | "deadline" | "cancelled"); decoded into
+        # SVDResult.status by finish().
         self._stop_reason = None
+        # Request-level cooperative control (set_control): an absolute
+        # monotonic deadline and a cancellation predicate, both checked
+        # BETWEEN sweeps — never mid-kernel, never via thread kills.
+        self._deadline: Optional[float] = None
+        self._should_cancel: Optional[Callable[[], bool]] = None
+
+    def set_control(self, *, deadline: Optional[float] = None,
+                    should_cancel: Optional[Callable[[], bool]] = None
+                    ) -> None:
+        """Install cooperative request control for this solve.
+
+        ``deadline``: absolute `time.monotonic()` second past which
+        `should_continue` returns False with stop reason "deadline"
+        (-> `SolveStatus.DEADLINE`). The check runs between sweeps, so a
+        request stops within one sweep of its deadline — the in-flight
+        sweep always completes (no thread kills, device state stays
+        consistent, `finish()` returns a loud PARTIAL result).
+        ``should_cancel``: zero-arg predicate polled between sweeps
+        (e.g. a `threading.Event.is_set` from the serving layer); True
+        stops the loop with `SolveStatus.CANCELLED`. Cancellation wins
+        over the deadline when both hold at the same boundary (the caller
+        asked first). Pass None to clear either hook.
+        """
+        self._deadline = None if deadline is None else float(deadline)
+        self._should_cancel = should_cancel
+
+    def _control_stop(self) -> Optional[str]:
+        """The cooperative-control stop reason, or None to keep going."""
+        if self._should_cancel is not None and self._should_cancel():
+            return "cancelled"
+        if self._deadline is not None and time.monotonic() >= self._deadline:
+            return "deadline"
+        return None
 
     def _host_kernel_path(self) -> bool:
         """Whether this stepper runs the Pallas kernel sweeps directly
@@ -1040,7 +1109,7 @@ class SweepStepper:
         still recombines with the deterministic QR of the same input."""
         if self._pc is None:
             if self._precondition:
-                q1, _, order, work = jax.jit(_precondition_qr)(self.a)
+                q1, _, order, work = _precondition_qr_jit(self.a)
                 self._pc = (q1, order, work)
             else:
                 self._pc = (None, None, self.a)
@@ -1197,8 +1266,15 @@ class SweepStepper:
 
     def should_continue(self, state: SweepState) -> bool:
         import math
+        # Cooperative control — an expired deadline or a cancelled request
+        # stops the loop even before the first sweep (a request popped off
+        # a queue already past its deadline must not spend a single sweep).
+        ctl = self._control_stop()
         sweeps = int(_host_scalar(state.sweeps))
         if sweeps == 0:
+            if ctl is not None:
+                self._stop_reason = ctl
+                return False
             return True
         off = _host_scalar(state.off_rel)
         if not math.isfinite(off):
@@ -1208,6 +1284,18 @@ class SweepStepper:
             self._stop_reason = "nonfinite"
             return False
         _, criterion, tol = self._phase()
+        if ctl is not None:
+            # Tolerance wins over an expiring control, matching the
+            # max_sweeps decode below: a solve that reached its FINAL
+            # tolerance before the control fired is OK, not
+            # DEADLINE/CANCELLED. The bulk stage of a hybrid solve is
+            # excluded — its abs-phase tolerance is not the requested
+            # convergence, so stopping there is still a partial result.
+            if self._stage != "bulk" and off <= tol:
+                self._stop_reason = "tol"
+            else:
+                self._stop_reason = ctl
+            return False
         if sweeps >= self.config.max_sweeps:
             # Tolerance wins over budget exhaustion — a solve that
             # converged exactly on its last budgeted sweep is OK, matching
@@ -1236,8 +1324,13 @@ class SweepStepper:
         hide NaN columns from off_rel, cf. `_status_word`) combined with
         the recorded host-loop stop reason."""
         import math
+        sweeps = int(_host_scalar(state.sweeps))
+        # Zero sweeps ran (a deadline/cancel stop before the first sweep):
+        # off_rel still holds the init sentinel inf — probe only the
+        # stacks, not the sentinel, or an untouched solve reads NONFINITE.
+        off_probe = state.off_rel if sweeps > 0 else jnp.float32(0.0)
         nf = bool(_host_scalar(_nonfinite_probe_jit(
-            state.top, state.bot, state.off_rel)))
+            state.top, state.bot, off_probe)))
         if nf:
             code = SolveStatus.NONFINITE
         else:
@@ -1246,7 +1339,6 @@ class SweepStepper:
                 # finish() before the loop ended (caller stopped early):
                 # derive from the visible state.
                 off = _host_scalar(state.off_rel)
-                sweeps = int(_host_scalar(state.sweeps))
                 if math.isfinite(off) and off <= self.tol:
                     reason = "tol"
                 elif sweeps >= self.config.max_sweeps:
@@ -1256,7 +1348,9 @@ class SweepStepper:
             code = {"tol": SolveStatus.OK,
                     "max_sweeps": SolveStatus.MAX_SWEEPS,
                     "stall": SolveStatus.STAGNATED,
-                    "nonfinite": SolveStatus.NONFINITE}[reason]
+                    "nonfinite": SolveStatus.NONFINITE,
+                    "deadline": SolveStatus.DEADLINE,
+                    "cancelled": SolveStatus.CANCELLED}[reason]
         return jnp.int32(int(code))
 
     def finish(self, state: SweepState) -> SVDResult:

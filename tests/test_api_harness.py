@@ -63,6 +63,67 @@ class TestGesvd:
             gesvd("AllVec", SVD_OPTIONS.NoVec, a)
 
 
+class TestGesvdColLayout:
+    """layout="col" makes the dgesvd drop-in literal (the reference's
+    MATRIX_LAYOUT enum, lib/Utils.cuh:18-21): the input is the col-major
+    image (transpose) of the logical matrix and the returned u/vt are
+    col-major images too — mirroring TestGesvd case by case."""
+
+    def _col(self, a):
+        return jnp.asarray(np.asarray(a).T)
+
+    def test_somevec_matches_row(self):
+        a = matgen.random_dense(24, 16, dtype=jnp.float64, seed=1)
+        u, s, vt = gesvd(SVD_OPTIONS.SomeVec, SVD_OPTIONS.SomeVec, a,
+                         config=CFG)
+        uc, sc, vtc = gesvd(SVD_OPTIONS.SomeVec, SVD_OPTIONS.SomeVec,
+                            self._col(a), layout="col", config=CFG)
+        assert uc.shape == (16, 24) and vtc.shape == (16, 16)
+        np.testing.assert_allclose(np.asarray(sc), np.asarray(s))
+        np.testing.assert_allclose(np.asarray(uc), np.asarray(u).T,
+                                   atol=1e-12)
+        np.testing.assert_allclose(np.asarray(vtc), np.asarray(vt).T,
+                                   atol=1e-12)
+        # The drop-in reconstruction, entirely in col-major images:
+        # image(A) = image(V^T)^T? no — A = (uc^T) S (vtc^T).
+        np.testing.assert_allclose(
+            np.asarray(uc).T * np.asarray(sc)[None, :] @ np.asarray(vtc).T,
+            np.asarray(a), atol=1e-12)
+
+    def test_novec(self):
+        a = matgen.random_dense(16, 16, dtype=jnp.float64, seed=2)
+        u, s, vt = gesvd(SVD_OPTIONS.NoVec, SVD_OPTIONS.NoVec,
+                         self._col(a), layout="col", config=CFG)
+        assert u is None and vt is None
+        np.testing.assert_allclose(np.asarray(s), _ref(a), rtol=1e-10,
+                                   atol=1e-12)
+
+    def test_mixed_jobs_swap(self):
+        """jobu governs the LOGICAL U even under col layout (the job swap
+        is internal)."""
+        a = matgen.random_dense(12, 12, dtype=jnp.float64, seed=5)
+        u, s, vt = gesvd(SVD_OPTIONS.SomeVec, SVD_OPTIONS.NoVec,
+                         self._col(a), layout="col", config=CFG)
+        assert u is not None and vt is None
+
+    def test_allvec_tall(self):
+        a = matgen.random_dense(20, 8, dtype=jnp.float64, seed=3)
+        u, s, vt = gesvd(SVD_OPTIONS.AllVec, SVD_OPTIONS.AllVec, a,
+                         config=CFG)
+        uc, sc, vtc = gesvd(SVD_OPTIONS.AllVec, SVD_OPTIONS.AllVec,
+                            self._col(a), layout="col", config=CFG)
+        assert uc.shape == (20, 20) and vtc.shape == (8, 8)
+        np.testing.assert_allclose(np.asarray(uc), np.asarray(u).T,
+                                   atol=1e-12)
+        np.testing.assert_allclose(np.asarray(vtc), np.asarray(vt).T,
+                                   atol=1e-12)
+
+    def test_unknown_layout_rejected(self):
+        with pytest.raises(ValueError, match="layout"):
+            gesvd(SVD_OPTIONS.NoVec, SVD_OPTIONS.NoVec, jnp.zeros((4, 4)),
+                  layout="fortran")
+
+
 class TestStepperAndCheckpoint:
     def test_stepper_matches_svd(self):
         a = matgen.random_dense(32, 32, dtype=jnp.float64, seed=6)

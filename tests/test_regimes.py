@@ -263,6 +263,23 @@ def test_mixed_bulk_mode_validation():
     assert np.isfinite(np.asarray(r.s)).all()
 
 
+def test_abs_criterion_pallas_validation():
+    """Loud rejection of criterion="abs" + pair_solver="pallas" (the
+    kernel measures only the rel statistic; this used to silently rewrite
+    to "rel" — VERDICT weak #5). pair_solver="auto" must instead route an
+    abs request to a compatible XLA solver, not raise."""
+    rng = np.random.default_rng(22)
+    a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+    with pytest.raises(ValueError, match="criterion='abs'"):
+        sj.svd(a, config=SVDConfig(pair_solver="pallas", criterion="abs"))
+    with pytest.raises(ValueError, match="criterion='abs'"):
+        solver.SweepStepper(a, config=SVDConfig(pair_solver="pallas",
+                                                criterion="abs"))
+    # auto + abs: picks an abs-capable solver and converges.
+    r = sj.svd(a, config=SVDConfig(criterion="abs"))
+    assert r.status_enum().name == "OK"
+
+
 def test_split_bf16_not_folded():
     """The x3 split must survive XLA: the naive cast-round-trip form was
     constant-folded to zero (verified on-chip), silently degrading every

@@ -1,0 +1,700 @@
+"""Serving layer (`svd_jacobi_tpu.serve`): admission control, shape
+buckets, deadlines/cancellation, circuit breaker + brownout, "serve"
+manifest records, and the threaded soak lane.
+
+All CPU, all threads — no TPU required. Most tests share one f64 bucket
+set (`BUCKETS`) and solver config so the stepper jit entries compile once
+for the whole module (which is itself the serving claim under test).
+"""
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu.obs import manifest
+from svd_jacobi_tpu.resilience import chaos
+from svd_jacobi_tpu.serve import (AdmissionError, AdmissionQueue,
+                                  AdmissionReason, Bucket, BucketSet,
+                                  BreakerState, Brownout, CircuitBreaker,
+                                  ServeConfig, SVDService, as_bucket)
+from svd_jacobi_tpu.solver import SolveStatus, SweepStepper
+from svd_jacobi_tpu.utils import matgen
+
+pytestmark = pytest.mark.serve
+
+BUCKETS = ((32, 32, "float64"), (48, 32, "float64"))
+SOLVER = SVDConfig(block_size=4)
+
+
+def _cfg(**over):
+    base = dict(buckets=BUCKETS, solver=SOLVER, max_queue_depth=8)
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _mat(m, n, seed):
+    return matgen.random_dense(m, n, seed=seed, dtype=jnp.float64)
+
+
+def _sref(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+class TestBuckets:
+    def test_as_bucket_forms(self):
+        assert as_bucket((64, 48, "float32")) == Bucket(64, 48, "float32")
+        assert as_bucket("64x48:float32") == Bucket(64, 48, "float32")
+        assert as_bucket(Bucket(8, 8, "float64")).name == "8x8:float64"
+
+    def test_invalid_specs(self):
+        with pytest.raises(ValueError, match="MxN:dtype"):
+            as_bucket("64-48-float32")
+        with pytest.raises(ValueError, match="m >= n"):
+            as_bucket((48, 64, "float32"))  # wide buckets are rejected
+        with pytest.raises(ValueError, match="empty"):
+            BucketSet(())
+        with pytest.raises(ValueError, match="duplicate"):
+            BucketSet(((8, 8, "float32"), "8x8:float32"))
+
+    def test_route_cheapest_and_dtype(self):
+        bs = BucketSet(((128, 32, "float32"), (64, 64, "float32"),
+                        (64, 64, "float64")))
+        # Tall-skinny request: the (128, 32) bucket is cheaper (m n^2)
+        # than the square one even though its area is larger.
+        assert bs.route(100, 20, "float32") == Bucket(128, 32, "float32")
+        assert bs.route(60, 60, "float32") == Bucket(64, 64, "float32")
+        assert bs.route(60, 60, "float64") == Bucket(64, 64, "float64")
+        assert bs.route(200, 200, "float32") is None      # nothing fits
+        assert bs.route(60, 60, "bfloat16") is None       # dtype mismatch
+
+    def test_pad_shape(self):
+        b = Bucket(8, 6, "float64")
+        a = jnp.ones((5, 4), jnp.float64)
+        p = BucketSet.pad(a, b)
+        assert p.shape == (8, 6)
+        assert float(jnp.sum(p)) == 20.0  # zero padding, data untouched
+
+
+class TestAdmissionQueue:
+    def _req(self, deadline=None, now=0.0):
+        from svd_jacobi_tpu.serve.queue import Request
+        return Request(id="x", a=None, m=4, n=4, orig_shape=(4, 4),
+                       transposed=False, bucket=Bucket(4, 4, "float64"),
+                       compute_u=True, compute_v=True, degraded=False,
+                       deadline=deadline, deadline_s=None, submitted=now)
+
+    def test_fifo_and_depth(self):
+        q = AdmissionQueue(max_depth=2)
+        q.admit(self._req())
+        assert q.depth() == 1
+        assert q.pop(0.01).id == "x"
+        assert q.pop(0.01) is None
+
+    def test_queue_full_rejects_loudly(self):
+        q = AdmissionQueue(max_depth=2)
+        q.admit(self._req())
+        q.admit(self._req())
+        with pytest.raises(AdmissionError) as ei:
+            q.admit(self._req())
+        assert ei.value.reason is AdmissionReason.QUEUE_FULL
+
+    def test_deadline_budget_rejects(self):
+        q = AdmissionQueue(max_depth=8, max_deadline_budget_s=1.0)
+        now = time.monotonic()
+        q.admit(self._req(deadline=now + 0.6))
+        with pytest.raises(AdmissionError) as ei:
+            q.admit(self._req(deadline=now + 0.6))
+        assert ei.value.reason is AdmissionReason.DEADLINE_BUDGET
+        # Requests without a deadline don't consume budget.
+        q.admit(self._req())
+        assert q.depth() == 2
+
+
+class TestBreaker:
+    def test_state_machine(self):
+        br = CircuitBreaker(failure_threshold=2)
+        assert br.begin() == ("base", BreakerState.CLOSED)
+        assert br.record(False) is BreakerState.CLOSED
+        assert br.record(True) is BreakerState.CLOSED    # streak resets
+        br.record(False)
+        assert br.record(False) is BreakerState.OPEN     # threshold hit
+        assert br.begin()[0] == "ladder"
+        assert br.record(False) is BreakerState.OPEN     # ladder failed
+        assert br.record(True) is BreakerState.HALF_OPEN  # ladder healed
+        assert br.begin()[0] == "base"                   # probe
+        assert br.record(False) is BreakerState.OPEN     # probe failed
+        br.record(True)
+        assert br.record(True) is BreakerState.CLOSED    # probe succeeded
+        assert ("closed", "open", "2 consecutive failures") \
+            in br.transitions
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError, match="failure_threshold"):
+            CircuitBreaker(failure_threshold=0)
+
+
+class TestStepperControl:
+    """The cooperative deadline/cancel hooks on the host-stepped solver
+    (the mechanism the service builds on), exercised without a service."""
+
+    def test_deadline_before_first_sweep(self):
+        a = _mat(24, 24, seed=30)
+        st = SweepStepper(a, config=SOLVER)
+        st.set_control(deadline=time.monotonic() - 1.0)
+        state = st.init()
+        assert not st.should_continue(state)
+        r = st.finish(state)
+        assert r.status_enum() is SolveStatus.DEADLINE
+        assert int(r.sweeps) == 0
+
+    def test_deadline_mid_solve_partial(self):
+        a = _mat(32, 32, seed=31)
+        st = SweepStepper(a, config=SOLVER)
+        state = st.init()
+        state = st.step(state)  # one sweep, then the deadline "expires"
+        st.set_control(deadline=time.monotonic() - 1.0)
+        assert not st.should_continue(state)
+        r = st.finish(state)
+        assert r.status_enum() is SolveStatus.DEADLINE
+        assert int(r.sweeps) == 1
+        # Loud PARTIAL result: factors exist and are finite.
+        assert np.isfinite(np.asarray(r.s)).all()
+
+    def test_cancel_wins_over_deadline(self):
+        a = _mat(24, 24, seed=32)
+        st = SweepStepper(a, config=SOLVER)
+        st.set_control(deadline=time.monotonic() - 1.0,
+                       should_cancel=lambda: True)
+        state = st.init()
+        assert not st.should_continue(state)
+        assert st.finish(state).status_enum() is SolveStatus.CANCELLED
+
+    def test_tolerance_wins_over_deadline(self):
+        """A solve that reached its final tolerance before the control
+        fired is OK, not DEADLINE — matching the decode policy for
+        max_sweeps (tolerance wins over budget exhaustion)."""
+        a = _mat(24, 24, seed=34)
+        st = SweepStepper(a, config=SOLVER)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        assert st.finish(state).status_enum() is SolveStatus.OK
+        # Re-evaluate the FINISHED (converged) state with an expired
+        # deadline installed: still OK.
+        st2 = SweepStepper(a, config=SOLVER)
+        st2.set_control(deadline=time.monotonic() - 1.0)
+        assert not st2.should_continue(state)
+        assert st2.finish(state).status_enum() is SolveStatus.OK
+
+    def test_control_clear(self):
+        a = _mat(24, 24, seed=33)
+        st = SweepStepper(a, config=SOLVER)
+        st.set_control(deadline=time.monotonic() - 1.0)
+        st.set_control(deadline=None)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        assert st.finish(state).status_enum() is SolveStatus.OK
+
+
+class TestServiceBasics:
+    def test_padded_buckets_match_oracle(self):
+        """Requests of assorted shapes (exact-fit, strictly smaller, wide)
+        pad to buckets and come back with ORIGINAL-shape factors matching
+        the host oracle — padding is exact, not approximate."""
+        with SVDService(_cfg()) as svc:
+            cases = [(32, 32, 40), (28, 20, 41), (20, 30, 42), (48, 31, 43)]
+            tickets = [(m, n, svc.submit(_mat(m, n, seed=s)))
+                       for m, n, s in cases]
+            for m, n, t in tickets:
+                res = t.result(timeout=180.0)
+                assert res.status is SolveStatus.OK, res
+                k = min(m, n)
+                assert res.u.shape == (m, k) and res.v.shape == (n, k)
+                a = _mat(m, n, seed=dict(
+                    (c[:2], c[2]) for c in cases)[(m, n)])
+                np.testing.assert_allclose(np.asarray(res.s), _sref(a),
+                                           rtol=1e-10, atol=1e-12)
+                rec = (np.asarray(res.u) * np.asarray(res.s)[None, :]
+                       @ np.asarray(res.v).T)
+                assert (np.linalg.norm(rec - np.asarray(a))
+                        / np.linalg.norm(np.asarray(a))) < 1e-13
+
+    def test_sigma_only_request(self):
+        with SVDService(_cfg()) as svc:
+            res = svc.submit(_mat(24, 24, seed=44), compute_u=False,
+                             compute_v=False).result(timeout=120.0)
+        assert res.status is SolveStatus.OK
+        assert res.u is None and res.v is None
+        np.testing.assert_allclose(np.asarray(res.s),
+                                   _sref(_mat(24, 24, seed=44)),
+                                   rtol=1e-10, atol=1e-12)
+
+    def test_no_bucket_rejection(self):
+        with SVDService(_cfg()) as svc:
+            with pytest.raises(AdmissionError) as ei:
+                svc.submit(_mat(64, 64, seed=45))
+            assert ei.value.reason is AdmissionReason.NO_BUCKET
+            # f32 input, f64 buckets: dtype must match exactly.
+            with pytest.raises(AdmissionError) as ei2:
+                svc.submit(matgen.random_dense(16, 16, seed=46,
+                                               dtype=jnp.float32))
+            assert ei2.value.reason is AdmissionReason.NO_BUCKET
+            recs = svc.records()
+        assert [r["status"] for r in recs] == ["REJECTED_NO_BUCKET"] * 2
+        assert all(r["bucket"] is None and r["path"] == "rejected"
+                   for r in recs)
+
+    def test_nonfinite_input_rejected_at_admission(self):
+        """NaN input is screened at the door (resilience.guard policy):
+        loud rejection, no solve spent, breaker untouched — one buggy
+        client cannot trip the breaker for everyone."""
+        with SVDService(_cfg()) as svc:
+            bad = np.zeros((16, 16))
+            bad[3, 4] = np.nan
+            for _ in range(3):   # > breaker_threshold
+                with pytest.raises(AdmissionError) as ei:
+                    svc.submit(jnp.asarray(bad, jnp.float64))
+                assert (ei.value.reason
+                        is AdmissionReason.NONFINITE_INPUT)
+            assert svc.breaker.state() is BreakerState.CLOSED
+            rec = svc.records()[-1]
+        assert rec["status"] == "REJECTED_NONFINITE_INPUT"
+
+    def test_submit_after_stop_rejected(self):
+        svc = SVDService(_cfg()).start()
+        svc.stop()
+        with pytest.raises(AdmissionError) as ei:
+            svc.submit(_mat(16, 16, seed=47))
+        assert ei.value.reason is AdmissionReason.SHUTDOWN
+        # A stopped service is single-use, loudly (its queue is closed;
+        # silently restarting would strand the closed-queue contract).
+        with pytest.raises(RuntimeError, match="not restartable"):
+            svc.start()
+
+    def test_stop_race_admission_is_loud(self):
+        """The submit-vs-stop race: admission is atomic with queue
+        closure, so a submit racing stop() either lands in the queue
+        (and is finalized/served) or raises SHUTDOWN — it can never
+        return a ticket that silently never becomes terminal."""
+        svc = SVDService(_cfg()).start()
+        outcomes = []
+
+        def hammer():
+            for i in range(50):
+                try:
+                    outcomes.append(svc.submit(_mat(8, 8, seed=500 + i),
+                                               compute_u=False,
+                                               compute_v=False))
+                except AdmissionError as e:
+                    outcomes.append(e.reason)
+        th = threading.Thread(target=hammer)
+        th.start()
+        time.sleep(0.05)
+        svc.stop(drain=False, timeout=60.0)
+        th.join(timeout=60.0)
+        assert not th.is_alive()
+        for o in outcomes:
+            if isinstance(o, AdmissionReason):
+                continue
+            # Every ticket handed out MUST reach a terminal state.
+            res = o.result(timeout=30.0)
+            assert res.status is not None or res.error is not None
+
+    def test_health_probes(self):
+        svc = SVDService(_cfg())
+        assert not svc.ready()
+        svc.start()
+        try:
+            assert svc.ready()
+            h = svc.healthz()
+            assert h["ok"] and h["ready"]
+            assert h["breaker"] == "closed" and h["brownout"] == "FULL"
+            assert h["queue_depth"] == 0
+        finally:
+            svc.stop()
+        assert not svc.ready()
+        assert svc.healthz()["ok"] is False
+
+    def test_stop_without_drain_cancels_queued(self):
+        svc = SVDService(_cfg()).start()
+        with chaos.stuck_backend(shots=1, max_stall_s=30.0):
+            t1 = svc.submit(_mat(24, 24, seed=48))   # occupies the worker
+            t2 = svc.submit(_mat(24, 24, seed=49))   # stays queued
+            time.sleep(0.1)                          # t1 reaches dispatch
+            svc.stop(drain=False, timeout=30.0)
+        # Queued request finalized without a solve, and the IN-FLIGHT one
+        # is cancelled cooperatively too (stop must not ride out the
+        # 30 s stall) — both terminal.
+        assert t2.result(timeout=5.0).status is SolveStatus.CANCELLED
+        assert t1.result(timeout=5.0).status is SolveStatus.CANCELLED
+
+    def test_inf_deadline_overrides_default(self):
+        """deadline_s=inf means NO deadline even with a hostile default
+        configured, and is exempt from the deadline budget — the warmup
+        contract."""
+        cfg = _cfg(default_deadline_s=0.0001, max_deadline_budget_s=1.0)
+        with SVDService(cfg) as svc:
+            res = svc.submit(_mat(16, 16, seed=96),
+                             deadline_s=float("inf")).result(timeout=120.0)
+            assert res.status is SolveStatus.OK
+            # ...while the default still bites requests that don't opt out.
+            r2 = svc.submit(_mat(16, 16, seed=97)).result(timeout=120.0)
+        assert r2.status is SolveStatus.DEADLINE
+
+    def test_warmup_compiles_degraded_variant(self):
+        """`warmup(sigma_only=True)` pre-compiles the sigma-only variant
+        per bucket, so a degraded dispatch never pays a compile
+        mid-overload; warmup requests are ordinary manifest records."""
+        with SVDService(_cfg()) as svc:
+            svc.warmup(timeout=300.0)
+            recs = svc.records()
+            assert len(recs) == 2 * len(BUCKETS)
+            assert all(r["status"] == "OK" for r in recs)
+            ids = [r["request"]["id"] for r in recs]
+            assert any(i.endswith("novec") for i in ids)
+            # The degraded variant is now a cache hit: a sigma-only solve
+            # completes fast and clean.
+            res = svc.submit(_mat(20, 20, seed=95), compute_u=False,
+                             compute_v=False).result(timeout=60.0)
+            assert res.status is SolveStatus.OK and res.u is None
+
+
+class TestDeadlinesAndCancellation:
+    def test_deadline_mid_solve_neighbors_ok(self):
+        """The acceptance scenario: a slowed request whose deadline
+        expires mid-solve returns DEADLINE within one sweep of it, while
+        the in-flight neighbors complete OK."""
+        with SVDService(_cfg()) as svc:
+            a = _mat(32, 32, seed=50)
+            assert svc.submit(a).result(120.0).status is SolveStatus.OK
+            # Wide margins: the deadline must comfortably outlive dispatch
+            # + one slowed sweep (so sweeps >= 1) yet expire well before
+            # convergence (~6 sweeps) — observed pre-sweep jitter under a
+            # loaded suite is ~0.2 s.
+            with chaos.slow_solve(0.7, shots=1):
+                t_slow = svc.submit(a, deadline_s=1.0)
+                t_n1 = svc.submit(_mat(28, 24, seed=51))
+                t_n2 = svc.submit(a)
+                r_slow = t_slow.result(timeout=60.0)
+                r_n1 = t_n1.result(timeout=60.0)
+                r_n2 = t_n2.result(timeout=60.0)
+        assert r_slow.status is SolveStatus.DEADLINE
+        # Partial: stopped at a sweep boundary before convergence.
+        assert 1 <= r_slow.sweeps < 30
+        assert r_n1.status is SolveStatus.OK
+        assert r_n2.status is SolveStatus.OK
+
+    def test_deadline_expired_in_queue(self):
+        """A request whose deadline passes while QUEUED returns DEADLINE
+        without spending a single sweep — and does NOT feed the breaker
+        (queue-expired deadlines are overload symptoms; counting them
+        would let overload trip the breaker onto the slower ladder path
+        and amplify itself)."""
+        with SVDService(_cfg()) as svc:
+            with chaos.slow_solve(0.3, shots=1):
+                t1 = svc.submit(_mat(32, 32, seed=52))       # slow occupier
+                t2 = svc.submit(_mat(24, 24, seed=53), deadline_s=0.05)
+                r2 = t2.result(timeout=60.0)
+                assert t1.result(timeout=60.0).status is SolveStatus.OK
+            assert svc.breaker.state() is BreakerState.CLOSED
+        assert r2.status is SolveStatus.DEADLINE
+        assert r2.sweeps == 0
+        assert r2.solve_time_s is None          # never dispatched to a solve
+
+    def test_cancel_while_queued(self):
+        with SVDService(_cfg()) as svc:
+            with chaos.slow_solve(0.3, shots=1):
+                t1 = svc.submit(_mat(32, 32, seed=54))
+                t2 = svc.submit(_mat(24, 24, seed=55))
+                t2.cancel()
+                r2 = t2.result(timeout=60.0)
+                assert t1.result(timeout=60.0).status is SolveStatus.OK
+        assert r2.status is SolveStatus.CANCELLED
+        assert r2.solve_time_s is None          # never dispatched to a solve
+
+    def test_cancel_mid_solve(self):
+        with SVDService(_cfg()) as svc:
+            with chaos.slow_solve(0.2, shots=1):
+                t = svc.submit(_mat(32, 32, seed=56))
+                time.sleep(0.3)                  # worker is mid-solve
+                t.cancel()
+                r = t.result(timeout=60.0)
+        assert r.status is SolveStatus.CANCELLED
+
+
+class TestBreakerAndBrownout:
+    def test_stuck_backend_trips_breaker_ladder_recovers(self):
+        """The acceptance scenario: chaos stuck_backend trips the breaker
+        OPEN, the escalation ladder serves (and heals) the next request,
+        a base-path probe closes it — and the WHOLE sequence is
+        reconstructable from validated "serve" manifest records."""
+        with SVDService(_cfg(breaker_threshold=2)) as svc:
+            a = _mat(32, 32, seed=60)
+            assert svc.submit(a).result(120.0).status is SolveStatus.OK
+            with chaos.stuck_backend(shots=2, max_stall_s=10.0):
+                # Deadlines comfortably longer than the dispatch latency
+                # (the pre-dispatch expiry check must NOT fire — a stall
+                # DURING the dispatch is a backend failure and must feed
+                # the breaker) but far shorter than the stall.
+                r1 = svc.submit(a, deadline_s=0.2).result(60.0)
+                r2 = svc.submit(a, deadline_s=0.2).result(60.0)
+            assert r1.status is SolveStatus.DEADLINE
+            assert r2.status is SolveStatus.DEADLINE
+            r3 = svc.submit(a).result(120.0)     # OPEN -> ladder
+            r4 = svc.submit(a).result(120.0)     # HALF_OPEN -> base probe
+            recs = svc.records()
+        assert r3.status is SolveStatus.OK and r3.path == "ladder"
+        assert r4.status is SolveStatus.OK and r4.path == "base"
+        np.testing.assert_allclose(np.asarray(r3.s), _sref(a),
+                                   rtol=1e-10, atol=1e-12)
+        for r in recs:
+            manifest.validate(r)
+        seq = [(r["status"], r["path"], r["breaker"]) for r in recs]
+        assert seq == [("OK", "base", "closed"),
+                       ("DEADLINE", "base", "closed"),
+                       ("DEADLINE", "base", "open"),
+                       ("OK", "ladder", "half_open"),
+                       ("OK", "base", "closed")]
+
+    def test_brownout_sigma_only_then_shed(self):
+        """Queue pressure walks the declared ladder in order: full SVD ->
+        sigma-only (admitted, factors dropped, flagged degraded) -> shed
+        (loud rejection) — decided at admission."""
+        cfg = _cfg(max_queue_depth=10, brownout_sigma_only_at=0.3,
+                   brownout_shed_at=0.6)
+        with SVDService(cfg) as svc:
+            with chaos.stuck_backend(shots=1, max_stall_s=3.0):
+                first = svc.submit(_mat(16, 16, seed=61))  # stalls worker
+                time.sleep(0.1)  # let it dispatch so depth is queue-only
+                full, degraded = [], []
+                # depth 0..2 -> FULL; depth 3..5 -> SIGMA_ONLY
+                for i in range(6):
+                    t = svc.submit(_mat(16, 16, seed=70 + i))
+                    (degraded if svc.queue.depth() > 3 else full).append(t)
+                with pytest.raises(AdmissionError) as ei:  # depth 6 -> SHED
+                    svc.submit(_mat(16, 16, seed=80))
+                assert ei.value.reason is AdmissionReason.BROWNOUT_SHED
+                results = [t.result(timeout=120.0)
+                           for t in [first] + full + degraded]
+        assert all(r.status is SolveStatus.OK for r in results)
+        assert not results[0].degraded
+        # At least the LAST admitted request was admitted under
+        # SIGMA_ONLY: factors dropped despite being requested.
+        last = degraded[-1].result(0.0) if degraded else results[-1]
+        assert last.degraded and last.u is None and last.v is None
+        assert np.isfinite(np.asarray(last.s)).all()
+        shed_recs = [r for r in svc.records()
+                     if r["status"] == "REJECTED_BROWNOUT_SHED"]
+        assert len(shed_recs) == 1 and shed_recs[0]["brownout"] == "SHED"
+        # The ADMISSION-TIME level is what the records carry, so the
+        # SIGMA_ONLY episode reconstructs from the manifest stream.
+        assert sum(1 for r in svc.records()
+                   if r["brownout"] == "SIGMA_ONLY") == len(degraded)
+
+
+class TestServeManifest:
+    def test_build_and_validate(self):
+        rec = manifest.build_serve(
+            request_id="r1", m=100, n=80, dtype="float32",
+            bucket="128x96:float32", queue_wait_s=0.01, solve_time_s=0.5,
+            status="OK", path="base", breaker="closed", brownout="FULL",
+            degraded=False, sweeps=9)
+        manifest.validate(rec)
+        assert rec["kind"] == "serve"
+        text = manifest.summarize(rec)
+        assert "r1" in text and "128x96:float32" in text and "OK" in text
+
+    def test_rejected_record_shape(self):
+        rec = manifest.build_serve(
+            request_id="r2", m=9999, n=9999, dtype="float32", bucket=None,
+            queue_wait_s=0.0, solve_time_s=None,
+            status="REJECTED_NO_BUCKET", path="rejected", breaker="closed",
+            brownout="FULL", error="fits no declared bucket")
+        manifest.validate(rec)
+        assert "no bucket" in manifest.summarize(rec)
+
+    def test_invalid_record_rejected(self):
+        rec = manifest.build_serve(
+            request_id="r3", m=8, n=8, dtype="float64", bucket="8x8:float64",
+            queue_wait_s=0.0, solve_time_s=0.1, status="OK", path="base",
+            breaker="closed", brownout="FULL")
+        rec.pop("breaker")
+        with pytest.raises(ValueError, match="breaker"):
+            manifest.validate(rec)
+
+    def test_service_appends_jsonl(self, tmp_path):
+        path = tmp_path / "manifest.jsonl"
+        with SVDService(_cfg(manifest_path=str(path))) as svc:
+            svc.submit(_mat(16, 16, seed=90)).result(timeout=120.0)
+        recs = manifest.load(path)
+        assert len(recs) == 1
+        manifest.validate(recs[0])
+        assert recs[0]["kind"] == "serve" and recs[0]["status"] == "OK"
+
+
+class TestServeRetraceContract:
+    """The compile-cache contract: stepper entries compile once per
+    BUCKET, never per request — and the guard demonstrably catches the
+    violation when the budget is under-declared (a checker that cannot
+    fail its fixture is decoration)."""
+
+    ENTRIES = ("solver._sweep_step_jit", "solver._finish_jit")
+
+    def _entries(self):
+        from svd_jacobi_tpu import solver
+        return {"solver._sweep_step_jit": solver._sweep_step_jit,
+                "solver._finish_jit": solver._finish_jit}
+
+    def _serve(self, buckets, shapes, seed0):
+        cfg = ServeConfig(buckets=buckets, solver=SOLVER,
+                          max_queue_depth=len(shapes) + 1)
+        with SVDService(cfg) as svc:
+            tickets = [svc.submit(_mat(m, n, seed=seed0 + i))
+                       for i, (m, n) in enumerate(shapes)]
+            for t in tickets:
+                assert t.result(timeout=180.0).status is SolveStatus.OK
+
+    def test_once_per_bucket_not_per_request(self):
+        from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+        buckets = ((40, 24, "float64"), (44, 44, "float64"))
+        shapes = [(40, 24), (35, 20), (17, 38), (44, 44), (41, 30)]
+        with RecompileGuard(budgets={e: 1 for e in self.ENTRIES},
+                            entries=self._entries()) as guard:
+            for e in self.ENTRIES:
+                guard.expect(e, problems=len(buckets))
+            self._serve(buckets, shapes, seed0=100)
+            findings = guard.check()
+        assert findings == [], [f.message for f in findings]
+
+    def test_guard_catches_per_request_blowup(self):
+        """Fixture: declare ONE problem but serve two buckets — the guard
+        must flag the extra compilation (this is exactly what a request
+        shape leaking past the bucket padding would look like)."""
+        from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+        buckets = ((28, 20, "float64"), (30, 30, "float64"))
+        with RecompileGuard(budgets={e: 1 for e in self.ENTRIES},
+                            entries=self._entries()) as guard:
+            for e in self.ENTRIES:
+                guard.expect(e, problems=1)   # under-declared on purpose
+            self._serve(buckets, [(28, 20), (30, 30)], seed0=120)
+            findings = guard.check()
+        assert findings, "under-declared budget must produce RETRACE001"
+        assert all(f.code == "RETRACE001" for f in findings)
+
+
+@pytest.mark.soak
+class TestSoak:
+    def test_threaded_soak(self):
+        """Satellite: N client threads, mixed bucket shapes, tight
+        deadlines, one chaos-stuck request — no deadlock, every request
+        terminal, the stuck request trips the breaker without poisoning
+        its neighbors."""
+        cfg = _cfg(max_queue_depth=64, breaker_threshold=1)
+        svc = SVDService(cfg).start()
+        a_warm = _mat(32, 32, seed=200)
+        assert svc.submit(a_warm).result(180.0).status is SolveStatus.OK
+
+        results = {}
+        res_lock = threading.Lock()
+
+        def put(key, res):
+            with res_lock:
+                results[key] = res
+
+        # The designated victim goes FIRST (FIFO: first dispatch consumes
+        # the armed stall) with a deadline far below the stall.
+        with chaos.stuck_backend(shots=1, max_stall_s=10.0):
+            victim = svc.submit(_mat(24, 24, seed=201), deadline_s=0.1)
+
+            def client(cid):
+                rng = np.random.default_rng(300 + cid)
+                for j in range(4):
+                    m = int(rng.integers(8, 49))
+                    n = int(rng.integers(4, 33))
+                    tight = (j == 2)   # one tight deadline per client
+                    try:
+                        t = svc.submit(
+                            _mat(m, n, seed=1000 * cid + j),
+                            deadline_s=(0.001 if tight else 120.0))
+                    except AdmissionError as e:
+                        put((cid, j), e.reason)
+                        continue
+                    try:
+                        put((cid, j), t.result(timeout=240.0))
+                    except TimeoutError:
+                        put((cid, j), None)
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(5)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=300.0)
+            assert not any(th.is_alive() for th in threads), "client hung"
+            r_victim = victim.result(timeout=60.0)
+        # Drive recovery to completion: with threshold=1 a late tight
+        # request may have re-tripped the breaker; at most two healthy
+        # requests walk OPEN -> (ladder) HALF_OPEN -> (probe) CLOSED.
+        for i in range(3):
+            if svc.breaker.state() is BreakerState.CLOSED:
+                break
+            assert svc.submit(_mat(16, 16, seed=400 + i)).result(
+                timeout=180.0).status is SolveStatus.OK
+        svc.stop(drain=True, timeout=120.0)
+
+        # Every request reached a terminal outcome (result, rejection —
+        # never a hang).
+        assert len(results) == 20
+        assert all(v is not None for v in results.values()), results
+        # The stuck request timed out loudly and tripped the breaker...
+        assert r_victim.status is SolveStatus.DEADLINE
+        recs = svc.records()
+        for r in recs:
+            manifest.validate(r)
+        assert any(r["breaker"] == "open" for r in recs)
+        # ...recovery ran through the ladder...
+        assert any(r["path"] == "ladder" and r["status"] == "OK"
+                   for r in recs)
+        assert svc.breaker.state() is BreakerState.CLOSED
+        # ...and it poisoned no neighbors: every non-tight client request
+        # succeeded; tight ones are DEADLINE (or shed, loudly).
+        for (cid, j), v in results.items():
+            if isinstance(v, AdmissionReason):
+                continue
+            if j == 2:
+                assert v.status in (SolveStatus.DEADLINE, SolveStatus.OK)
+            else:
+                assert v.status is SolveStatus.OK, (cid, j, v)
+
+
+class TestServeDemoCli:
+    def test_serve_demo_end_to_end(self, tmp_path, capsys, monkeypatch):
+        """The `serve-demo` subcommand: seeded closed-loop clients, every
+        request terminal, per-request records in the manifest."""
+        import json
+        # cli re-applies JAX_PLATFORMS from the environment, which would
+        # flip the suite's forced-CPU backend onto a real attached TPU.
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        from svd_jacobi_tpu import cli
+        rc = cli.main(["serve-demo", "--requests", "6", "--clients", "2",
+                       "--bucket", "32x24:float64", "--tight-frac", "0",
+                       "--seed", "7", "--report-dir", str(tmp_path)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["requests"] == 6 and out["terminal"] == 6
+        assert out["errors"] == 0
+        assert out["outcomes"].get("OK", 0) >= 1
+        recs = manifest.load(tmp_path / "manifest.jsonl")
+        assert len(recs) == 6
+        for r in recs:
+            manifest.validate(r)
+            assert r["kind"] == "serve"
+
+
+def test_brownout_enum_order():
+    assert Brownout.FULL < Brownout.SIGMA_ONLY < Brownout.SHED
