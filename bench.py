@@ -41,6 +41,13 @@ Usage:
                                   the timing loop — the timed repetitions
                                   stay on the zero-telemetry jit, so the
                                   reported numbers are unperturbed)
+         --serve-throughput      (closed-loop serve benchmark of the
+                                  request-coalescing lane: one JSON row
+                                  of requests/s + p50/p99 latency per
+                                  --tiers batch tier over the same
+                                  seeded mix, plus the coalesced-over-
+                                  serial speedup row; see
+                                  bench._serve_throughput for its flags)
 """
 
 from __future__ import annotations
@@ -152,6 +159,140 @@ SWEEP_CONFIGS = [
 _BACKEND_DOWN_RC = 3
 
 
+def _serve_throughput(flags) -> None:
+    """--serve-throughput: closed-loop serve benchmark of the coalescing
+    win. A fleet of client threads drives one bucket's request mix
+    through a live `serve.SVDService`, once per configured batch tier
+    (same mix, same fleet), and each tier emits one parseable JSON row:
+    requests/s + p50/p99 end-to-end latency. A final row reports the
+    coalesced-over-serial speedup — the number the micro-batched solve
+    lane exists for (PROFILE.md item 22).
+
+    Flags: --bucket=MxN:dtype (default 64x64:float32)
+           --tiers=1,16       (max_batch values to measure, in order)
+           --requests=N --clients=C --batch-window-ms=W --deadline-s=D
+    """
+    import os
+    import threading
+
+    import jax
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from svd_jacobi_tpu.serve import as_bucket
+    bucket = as_bucket(flags.get("bucket", "64x64:float32"))
+    if bucket.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.serve import ServeConfig, SVDService
+    from svd_jacobi_tpu.utils import matgen
+
+    requests = int(flags.get("requests", "64"))
+    clients = int(flags.get("clients", "32"))
+    window_ms = float(flags.get("batch-window-ms", "25"))
+    deadline_s = float(flags.get("deadline-s", "600"))
+    tiers = [int(t) for t in flags.get("tiers", "1,16").split(",")]
+    # --pair-solver=pallas pins the stacked kernel lane for buckets below
+    # the auto threshold (n < 64) — tiny buckets are exactly where
+    # coalescing pays most, and the stacked lane amortizes where the
+    # vmapped XLA lane cannot.
+    solver_cfg = SVDConfig(pair_solver=flags.get("pair-solver", "auto"))
+
+    # One shared request mix (seeded) so every tier serves the same work.
+    # Held as HOST arrays: client threads then submit numpy, whose
+    # admission screen is a free host check instead of a per-submit
+    # device op contending with the worker's solve.
+    mats = [np.asarray(matgen.random_dense(bucket.m, bucket.n,
+                                           seed=1000 + i,
+                                           dtype=jnp.dtype(bucket.dtype)))
+            for i in range(min(requests, 16))]
+
+    rows = []
+    for max_batch in tiers:
+        cfg = ServeConfig(
+            buckets=(bucket,), solver=solver_cfg,
+            max_queue_depth=max(64, 4 * max_batch),
+            max_batch=max_batch,
+            batch_window_s=(window_ms / 1e3 if max_batch > 1 else 0.0),
+            batch_tiers=((1, max_batch) if max_batch > 1 else (1,)),
+            # Brownout off: a degraded response would change the work mix
+            # between tiers and poison the comparison.
+            brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+        svc = SVDService(cfg).start()
+        svc.warmup(timeout=1800.0)
+
+        outcomes = []
+        lock = threading.Lock()
+        counter = [0]
+
+        def client(_cid):
+            while True:
+                with lock:
+                    i = counter[0]
+                    if i >= requests:
+                        return
+                    counter[0] += 1
+                a = mats[i % len(mats)]
+                t0 = time.perf_counter()
+                try:
+                    res = svc.submit(a, deadline_s=deadline_s).result(
+                        timeout=1800.0)
+                    ok = (res.error is None and res.status is not None
+                          and res.status.name == "OK")
+                except Exception:
+                    ok = False
+                dt = time.perf_counter() - t0
+                with lock:
+                    outcomes.append((dt, ok))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(max(1, clients))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=1800.0)
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+        svc.stop(drain=True, timeout=60.0)
+
+        lat = sorted(d for d, _ in outcomes)
+        q = (lambda p: round(lat[min(len(lat) - 1,
+                                     int(p * len(lat)))] * 1e3, 2)
+             if lat else None)
+        row = {
+            "metric": f"serve_throughput_{bucket.name}_b{max_batch}",
+            "value": round(len(outcomes) / wall, 2),
+            "unit": "requests/s",
+            "max_batch": max_batch,
+            "batch_window_ms": window_ms,
+            "clients": clients,
+            "requests": len(outcomes),
+            "ok": sum(1 for _, ok in outcomes if ok),
+            "p50_ms": q(0.50), "p99_ms": q(0.99),
+            "wall_s": round(wall, 3),
+            "batched_dispatches": stats.get("batched_dispatches", 0),
+            "device": str(jax.devices()[0]),
+        }
+        print(json.dumps(row))
+        rows.append(row)
+    if len(rows) >= 2 and rows[0]["max_batch"] == 1 and rows[0]["value"]:
+        base = rows[0]["value"]
+        for r in rows[1:]:
+            print(json.dumps({
+                "metric": (f"serve_coalescing_speedup_{bucket.name}"
+                           f"_b{r['max_batch']}"),
+                "value": round(r["value"] / base, 3),
+                "unit": "x vs batch-1",
+                "ok": (r["ok"] == r["requests"]
+                       and rows[0]["ok"] == rows[0]["requests"]),
+            }))
+
+
 def _sweep(passthrough) -> None:
     """Run every SWEEP_CONFIGS row in a fresh subprocess, forwarding all
     other flags verbatim (--reps, --oracle, --baseline keep their
@@ -176,6 +317,9 @@ def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
                  for f in sys.argv[1:] if f.startswith("--"))
+    if "serve-throughput" in flags:
+        _serve_throughput(flags)
+        return
     if "sweep" in flags:
         _sweep([f for f in sys.argv[1:]
                 if f.startswith("--")

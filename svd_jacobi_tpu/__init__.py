@@ -9,9 +9,9 @@ LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 
 from . import obs, resilience, serve
 from .config import SVDConfig
-from .solver import SolveStatus, SVDResult, svd
+from .solver import SolveStatus, SVDResult, svd, svd_batched
 
 __version__ = "0.1.0"
 
-__all__ = ["svd", "SVDConfig", "SVDResult", "SolveStatus", "obs",
+__all__ = ["svd", "svd_batched", "SVDConfig", "SVDResult", "SolveStatus", "obs",
            "resilience", "serve", "__version__"]

@@ -72,6 +72,17 @@ def _single_probe(name: str, a, config: SVDConfig, *, compute_u=True,
                       entry_id=entry_id)
 
 
+def _batched_probe(name: str, a, config: SVDConfig, *, compute_u=True,
+                   compute_v=True) -> EntryProbe:
+    from .. import solver
+    entry, fn, a_in, kwargs = solver._plan_entry_batched(
+        a, config, compute_u=compute_u, compute_v=compute_v)
+    entry_id = {"pallas_batched": "solver._svd_pallas_batched",
+                "padded_batched": "solver._svd_padded_batched"}[entry]
+    return EntryProbe(name=name, fn=fn, args=(a_in,), kwargs=kwargs,
+                      entry_id=entry_id, telemetry_key=None)
+
+
 def single_device_probes(include_f64: Optional[bool] = None) -> List[EntryProbe]:
     """Probes for every single-device fused entry/regime. ``include_f64``
     defaults to whether x64 is enabled (the f64 qr-svd path needs it)."""
@@ -95,6 +106,14 @@ def single_device_probes(include_f64: Optional[bool] = None) -> List[EntryProbe]
                       compute_u=False, compute_v=False),
         # XLA block-solver path (hybrid: bulk + polish phase loops).
         _single_probe("padded_hybrid", a32, SVDConfig(pair_solver="hybrid")),
+        # The batched (coalesced-dispatch) fused entry: 3 matrices stacked
+        # along the pair axis with the block-diagonal tournament. Its
+        # collective budget is declared ZERO everywhere
+        # (config.COLLECTIVE_BUDGET["pallas_batched"]) — pure data layout
+        # must introduce no collectives. No telemetry flag (the batched
+        # lane emits no in-graph events).
+        _batched_probe("pallas_batched", jnp.zeros((3, 48, 32), jnp.float32),
+                       SVDConfig(pair_solver="pallas")),
     ]
     if include_f64:
         a64 = jnp.zeros((48, 32), jnp.float64)

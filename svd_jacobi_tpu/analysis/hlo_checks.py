@@ -210,6 +210,11 @@ def check_default_entries(include_mesh: bool = True) -> List[Finding]:
     if "pallas_donated" in singles and "pallas" in singles:
         findings += check_donation(singles["pallas_donated"],
                                    singles["pallas"])
+    if "pallas_batched" in singles:
+        # The batched entry's zero-collective budget: stacking B matrices
+        # along the pair axis is pure data layout and must add NO
+        # collectives of any kind to the single-device lowering.
+        findings += check_collective_budget(singles["pallas_batched"])
     if include_mesh:
         for probe in entries.mesh_probes():
             findings += check_collective_budget(probe)

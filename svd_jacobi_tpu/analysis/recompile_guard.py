@@ -53,6 +53,20 @@ def default_entries() -> Dict[str, object]:
         "solver._sweep_step_pallas_jit": solver._sweep_step_pallas_jit,
         "solver._finish_pallas_jit": solver._finish_pallas_jit,
         "solver._nonfinite_probe_jit": solver._nonfinite_probe_jit,
+        # Batched (coalesced-dispatch) lane entries: fused + stepper.
+        "solver._svd_pallas_batched": solver._svd_pallas_batched,
+        "solver._svd_padded_batched": solver._svd_padded_batched,
+        "solver._precondition_qr_batched_jit":
+            solver._precondition_qr_batched_jit,
+        "solver._sweep_step_pallas_batched_jit":
+            solver._sweep_step_pallas_batched_jit,
+        "solver._sweep_step_xla_batched_jit":
+            solver._sweep_step_xla_batched_jit,
+        "solver._finish_pallas_batched_jit":
+            solver._finish_pallas_batched_jit,
+        "solver._finish_xla_batched_jit": solver._finish_xla_batched_jit,
+        "solver._nonfinite_probe_batched_jit":
+            solver._nonfinite_probe_batched_jit,
     }
 
 
@@ -196,10 +210,33 @@ _SERVE_ENTRIES = ("solver._precondition_qr_jit",
                   "solver._nonfinite_probe_jit")
 
 
+# Batched (coalesced-dispatch) contract: batch sizes snap to the static
+# tier set, so the batched entries compile once per (bucket, tier) — a
+# MIXED batch-size sequence (a full tier-4 batch, then a 2-member batch
+# that pads to the same tier) must be one compile per bucket. Bucket
+# (64, 48) routes the XLA batched stepper (n < 64 -> hybrid), (96, 64)
+# the Pallas stacked stepper, so both lanes are under contract.
+_SERVE_BATCH_SHAPES = {
+    (64, 48): ((64, 48), (60, 40), (33, 50), (50, 44), (58, 30), (40, 40)),
+    (96, 64): ((96, 64), (90, 50), (70, 60), (64, 66), (80, 44), (96, 30)),
+}
+_SERVE_BATCH_ENTRIES_XLA = ("solver._sweep_step_xla_batched_jit",
+                            "solver._finish_xla_batched_jit",
+                            "solver._nonfinite_probe_batched_jit")
+_SERVE_BATCH_ENTRIES_PALLAS = ("solver._precondition_qr_batched_jit",
+                               "solver._sweep_step_pallas_batched_jit",
+                               "solver._finish_pallas_batched_jit",
+                               "solver._nonfinite_probe_batched_jit")
+
+
 def run_serve_sequence() -> tuple:
     """The CLI's serve retrace pass: a two-bucket `serve.SVDService` fed
     three distinct request shapes per bucket; every serving-path entry
-    must compile once per bucket (RETRACE001 otherwise). Returns
+    must compile once per bucket (RETRACE001 otherwise). Then the BATCHED
+    lane: a coalescing service (max_batch=4, tiers (1, 4)) dispatches a
+    full tier-4 batch followed by a 2-member batch padding to the SAME
+    tier per bucket — the batched stepper entries must compile once per
+    (bucket, tier), never per observed batch size. Returns
     (findings, report)."""
     import jax.numpy as jnp
 
@@ -234,4 +271,65 @@ def run_serve_sequence() -> tuple:
                      f"{report['serve_statuses']} — the retrace "
                      f"measurement is not trustworthy on a failing solve"),
             suggestion="fix the serving solve path first"))
+    b_findings, b_report = _run_serve_batched_case()
+    findings += b_findings
+    report["batched"] = b_report
+    return findings, report
+
+
+def _run_serve_batched_case() -> tuple:
+    """The mixed batch-size half of the serve pass (see
+    `run_serve_sequence`)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..serve import ServeConfig, SVDService
+    from ..utils import matgen
+
+    buckets = tuple(_SERVE_BATCH_SHAPES)
+    # pair_solver left on "auto" so bucket (64, 48) resolves to the
+    # hybrid XLA batched stepper and (96, 64) to the Pallas stacked one —
+    # both batched lanes under one contract.
+    cfg = ServeConfig(
+        buckets=tuple(b + ("float32",) for b in buckets),
+        solver=SVDConfig(),
+        max_queue_depth=16, max_batch=4, batch_window_s=2.0,
+        batch_tiers=(1, 4),
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    statuses = []
+    with RecompileGuard() as guard:
+        # Bucket (64, 48), hybrid: the sweep step compiles once per
+        # STAGE (gram-eigh/abs bulk + qr-svd/rel polish are distinct
+        # static method keys), the finish once.
+        guard.expect("solver._sweep_step_xla_batched_jit", problems=2)
+        guard.expect("solver._finish_xla_batched_jit", problems=1)
+        # Bucket (96, 64), Pallas stacked lane.
+        for entry in ("solver._precondition_qr_batched_jit",
+                      "solver._sweep_step_pallas_batched_jit",
+                      "solver._finish_pallas_batched_jit"):
+            guard.expect(entry, problems=1)
+        # The per-member nonfinite probe runs at finish on BOTH buckets.
+        guard.expect("solver._nonfinite_probe_batched_jit", problems=2)
+        with SVDService(cfg) as svc:
+            for bucket in buckets:
+                shapes = _SERVE_BATCH_SHAPES[bucket]
+                # One full tier-4 batch, then a 2-member batch that pads
+                # to the SAME tier (must be pure cache hits).
+                for group in (shapes[:4], shapes[4:]):
+                    mats = [matgen.random_dense(m, n, seed=m * 997 + n,
+                                                dtype=jnp.float32)
+                            for m, n in group]
+                    tickets = [svc.submit(a) for a in mats]
+                    statuses += [t.result(timeout=600.0).status
+                                 for t in tickets]
+        findings = guard.check()
+        report = guard.report()
+    report["serve_statuses"] = [getattr(s, "name", None) for s in statuses]
+    if any(s is None or s.name != "OK" for s in statuses):
+        findings.append(Finding(
+            code="RETRACE001", where="serve.run_serve_batched_case",
+            message=(f"batched serve sequence produced non-OK statuses "
+                     f"{report['serve_statuses']} — the retrace "
+                     f"measurement is not trustworthy on a failing solve"),
+            suggestion="fix the batched serving solve path first"))
     return findings, report

@@ -183,6 +183,13 @@ def _parse_serve_args(argv):
     p.add_argument("--tight-ms", type=float, default=1.0,
                    help="the unmeetable deadline, in milliseconds")
     p.add_argument("--queue-depth", type=int, default=16)
+    p.add_argument("--max-batch", type=int, default=1,
+                   help="coalesce up to this many same-bucket requests "
+                        "into one batched solve dispatch (1 = serial)")
+    p.add_argument("--batch-window-ms", type=float, default=20.0,
+                   help="bounded batching window: max wait for same-"
+                        "bucket followers after the first pop (only with "
+                        "--max-batch > 1)")
     p.add_argument("--report-dir", default="reports",
                    help="manifest directory (per-request 'serve' JSONL "
                         "records appended to <dir>/manifest.jsonl); "
@@ -225,7 +232,9 @@ def serve_demo(argv) -> int:
                      else str(Path(args.report_dir) / "manifest.jsonl"))
     cfg = ServeConfig(buckets=buckets, solver=SVDConfig(),
                       max_queue_depth=args.queue_depth,
-                      manifest_path=manifest_path)
+                      manifest_path=manifest_path,
+                      max_batch=max(1, args.max_batch),
+                      batch_window_s=max(0.0, args.batch_window_ms) / 1e3)
     svc = SVDService(cfg)
 
     # Seeded request plan, built up front so the run is reproducible: a

@@ -202,6 +202,15 @@ MIXED_PRECISION_BOUNDARIES = frozenset({
 # analysis.hlo_checks.check_collective_budget asserts EXACT equality so a
 # new collective cannot ride in silently.
 COLLECTIVE_BUDGET = {
+    # The single-device BATCHED entry (solver._svd_pallas_batched, the
+    # serving layer's coalesced-dispatch lane): stacking B matrices along
+    # the pair axis is pure data layout — it must introduce NO collectives
+    # of any kind (a collective sneaking into the batched sweep loop would
+    # mean the block-diagonal schedule leaked across members). Asserted on
+    # the lowered module like the mesh budgets.
+    "pallas_batched": {"collective_permute": 0, "all_reduce": 0,
+                       "all_gather": 0, "all_to_all": 0,
+                       "reduce_scatter": 0},
     "sharded_pallas": {"collective_permute": 4, "all_reduce": 4,
                        "all_gather": 0, "all_to_all": 0, "reduce_scatter": 0},
     "sharded_pallas_novec": {"collective_permute": 2, "all_reduce": 4,
@@ -233,7 +242,30 @@ RETRACE_BUDGETS = {
     "solver._sweep_step_pallas_jit": 1,
     "solver._finish_pallas_jit": 1,
     "solver._nonfinite_probe_jit": 1,
+    # Batched (coalesced-dispatch) lane: the fused entry and the stepper
+    # entries `serve.SVDService` drives when max_batch > 1. The problem
+    # key is (bucket x batch TIER) — batch sizes snap to the small static
+    # `ServeConfig.batch_tiers` set with zero-padded tail slots, so the
+    # compile cache stays bounded at |buckets| x |tiers| x variants and a
+    # request-count leak into any jit key blows the budget immediately
+    # (analysis.recompile_guard.run_serve_sequence's batched case).
+    "solver._svd_pallas_batched": 1,
+    "solver._svd_padded_batched": 1,
+    "solver._precondition_qr_batched_jit": 1,
+    "solver._sweep_step_pallas_batched_jit": 1,
+    "solver._sweep_step_xla_batched_jit": 1,
+    "solver._finish_pallas_batched_jit": 1,
+    "solver._finish_xla_batched_jit": 1,
+    "solver._nonfinite_probe_batched_jit": 1,
 }
+
+# Batch-size tiers of the serving layer's coalesced dispatch
+# (`serve.ServeConfig.batch_tiers`): a popped same-bucket batch snaps UP to
+# the smallest tier holding it (zero-padding the tail slots — exact for the
+# SVD, an all-zero member deflates in one sweep), so the batched stepper
+# entries compile once per (bucket, tier) instead of once per observed
+# batch size. Small and static by design — every tier is a compile.
+DEFAULT_BATCH_TIERS = (1, 4, 16)
 
 # Default shape buckets of the serving layer (`serve.ServeConfig.buckets`):
 # the small static set of tall (m >= n) padded shapes requests are rounded

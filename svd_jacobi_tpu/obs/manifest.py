@@ -91,6 +91,11 @@ _SERVE_SCHEMA: Dict[str, Any] = {
     "path": str,                      # "base" | "ladder" | "rejected"
     "breaker": str,                   # BreakerState value after the outcome
     "brownout": str,                  # Brownout level name at admission
+    # batch_id/batch_size/batch_tier additionally identify a COALESCED
+    # dispatch (micro-batched solve lane; all None on a single dispatch).
+    # Optional-by-forward-compatibility: records written before the
+    # batching lane lack them, so they ride as extra keys rather than
+    # required schema fields.
 }
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
@@ -212,10 +217,18 @@ def build_retry(*, m: int, n: int, dtype: str, config, attempts: List[dict],
 def build_serve(*, request_id: str, m: int, n: int, dtype: str,
                 bucket: Optional[str], queue_wait_s: float,
                 solve_time_s: Optional[float], status: str, path: str,
-                breaker: str, brownout: str, **extra) -> dict:
+                breaker: str, brownout: str,
+                batch_id: Optional[str] = None,
+                batch_size: Optional[int] = None,
+                batch_tier: Optional[int] = None, **extra) -> dict:
     """Assemble a schema-valid per-request serving record
-    (`serve.SVDService`). ``extra`` (degraded, deadline_s, sweeps, error,
-    ...) rides along like in `build`."""
+    (`serve.SVDService`). ``batch_id``/``batch_size``/``batch_tier``
+    identify a COALESCED dispatch (micro-batched solve lane): every
+    member of one batched solve shares the batch_id, ``batch_size`` is
+    the real member count and ``batch_tier`` the padded static tier it
+    snapped to; all None for a single (uncoalesced) dispatch. ``extra``
+    (degraded, deadline_s, sweeps, error, ...) rides along like in
+    `build`."""
     record = {
         "schema_version": SCHEMA_VERSION,
         "kind": "serve",
@@ -230,6 +243,9 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
         "path": str(path),
         "breaker": str(breaker),
         "brownout": str(brownout),
+        "batch_id": None if batch_id is None else str(batch_id),
+        "batch_size": None if batch_size is None else int(batch_size),
+        "batch_tier": None if batch_tier is None else int(batch_tier),
     }
     record.update(extra)
     validate(record)
@@ -359,6 +375,10 @@ def summarize(record: dict) -> str:
                 f" breaker={record.get('breaker', '?')}"
                 f" brownout={record.get('brownout', '?')}"
                 f" wait={wait * 1e3:.1f}ms solve={solve_s}")
+        if record.get("batch_id"):
+            line += (f" batch={record['batch_id']}"
+                     f"[{record.get('batch_size', '?')}"
+                     f"/{record.get('batch_tier', '?')}]")
         if record.get("error"):
             line += f"\n  error: {record['error']}"
         return line

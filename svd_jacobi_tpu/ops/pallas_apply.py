@@ -46,7 +46,7 @@ import numpy as np
 HI = jax.lax.Precision.HIGHEST
 
 
-def _perm_maps(k: int, exchange: bool):
+def _perm_maps(k: int, exchange: bool, batch: int = 1):
     """(pair_t, top_half_t, pair_b, top_half_b) for output slots i in [0, k).
 
     With ``exchange``, output slot maps encode one tournament rotation
@@ -54,14 +54,22 @@ def _perm_maps(k: int, exchange: bool):
     new_top[1] = old pair 0's bottom result, new_top[i>=2] = pair i-1's top,
     new_bot[i<=k-2] = pair i+1's bottom, new_bot[k-1] = pair k-1's top.
     Without it, slot i is just pair i's (top, bottom) result.
+
+    ``batch``: the stack holds ``batch`` matrices' slots back to back
+    (``k = batch * k_per``) and the rotation is block-diagonal per matrix
+    — each segment rotates within itself, exactly
+    `schedule.rotate_blocks(..., batch)`. The ``batch == 1`` maps are the
+    same formulas with a single segment.
     """
     idx = np.arange(k)
-    if not exchange or k == 1:
+    kp = k // batch
+    if not exchange or kp == 1:
         return idx, np.ones(k, bool), idx, np.zeros(k, bool)
-    pair_t = np.where(idx <= 1, 0, idx - 1)
-    top_half_t = idx != 1
-    pair_b = np.where(idx <= k - 2, idx + 1, k - 1)
-    top_half_b = idx == k - 1
+    j = idx % kp
+    pair_t = np.where(j <= 1, idx - j, idx - 1)
+    top_half_t = j != 1
+    pair_b = np.where(j <= kp - 2, idx + 1, idx)
+    top_half_b = j == kp - 1
     return pair_t, top_half_t, pair_b, top_half_b
 
 
@@ -194,14 +202,23 @@ def supported(m: int, b: int) -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=("exchange", "interpret", "vma",
-                                             "x3", "with_gram", "gram_bf16"))
+                                             "x3", "with_gram", "gram_bf16",
+                                             "batch"))
 def apply_exchange(top, bot, q, *, exchange: bool = True,
                    interpret: bool = False, vma=None, x3: bool = False,
-                   with_gram: bool = False, gram_bf16: bool = False):
+                   with_gram: bool = False, gram_bf16: bool = False,
+                   batch: int = 1):
     """(new_top, new_bot[, g]) = post-exchange stacks of ([top|bot] @ q).
 
     top/bot: (k, m, b) column stacks; q: (k, 2b, 2b) orthogonal panels.
     Equivalent (tested) to the concat/matmul/slice + rotate_blocks chain.
+
+    ``batch`` (static): the stacks hold ``batch`` matrices back to back
+    (``k = batch * k_per``) and the in-kernel exchange is block-diagonal
+    per matrix (the batched-solve lane) — same kernel body, the index
+    maps pick the per-segment sources. NO new grid dimension: the pairs of
+    every matrix ride the existing pair axis, so B matrices cost one
+    kernel launch and one latency chain, not B.
 
     ``with_gram`` (requires ``exchange``): additionally return the
     (k, 2b, 2b) Gram panels of the POST-exchange pairs, accumulated in the
@@ -221,6 +238,9 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
         raise ValueError("with_gram accumulates the post-EXCHANGE pairs' "
                          "panels; it requires exchange=True")
     k, m, b = top.shape
+    if batch < 1 or k % batch:
+        raise ValueError(f"stack of {k} pair slots does not divide into "
+                         f"batch={batch} equal segments")
     mc = _pick_chunk(m, b, 6,
                      _gram_fixed_bytes(b) if with_gram else None)
     if mc == 0:
@@ -229,7 +249,7 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
             f"({m}, {b}) with_gram={with_gram} — the per-step footprint "
             f"exceeds the scoped-VMEM budget; gate callers on "
             f"pallas_apply.supported()")
-    pair_t, top_half_t, pair_b, top_half_b = _perm_maps(k, exchange)
+    pair_t, top_half_t, pair_b, top_half_b = _perm_maps(k, exchange, batch)
     # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
     # (q is (k, 2b, 2b) — tiny next to the stacks).
     ql, qr = q[..., :b], q[..., b:]
@@ -248,10 +268,18 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
 
     # Closed-form slot maps (index maps run as scalar-core programs; no
     # table gathers): with exchange, pt(i) = 0 for i <= 1 else i - 1 and
-    # pb(i) = min(i + 1, k - 1); identity otherwise.
-    if exchange and k > 1:
-        pt_fn = lambda i: jnp.where(i <= 1, 0, i - 1)
-        pb_fn = lambda i: jnp.minimum(i + 1, k - 1)
+    # pb(i) = min(i + 1, k - 1); identity otherwise. Batched stacks use
+    # the segment-local forms (j = i mod k_per picks the position inside
+    # the slot's own matrix; the batch == 1 branch keeps the original
+    # spelling so existing lowerings are untouched).
+    kp = k // batch
+    if exchange and kp > 1:
+        if batch == 1:
+            pt_fn = lambda i: jnp.where(i <= 1, 0, i - 1)
+            pb_fn = lambda i: jnp.minimum(i + 1, k - 1)
+        else:
+            pt_fn = lambda i: jnp.where(i % kp <= 1, (i // kp) * kp, i - 1)
+            pb_fn = lambda i: jnp.where(i % kp == kp - 1, i, i + 1)
     else:
         pt_fn = pb_fn = lambda i: i
     x_spec = lambda pair_fn: pl.BlockSpec(

@@ -102,7 +102,8 @@ def _einsum(a, b, spec, bf16=False, x3=False):
                       preferred_element_type=jnp.float32)
 
 
-def panel_stats(g: jax.Array, dmax2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def panel_stats(g: jax.Array, dmax2: jax.Array,
+                members=None) -> Tuple[jax.Array, jax.Array]:
     """(masked, unmasked) max scaled coupling of a Gram panel stack.
 
     ``masked`` deflates columns whose squared norm is below
@@ -110,6 +111,12 @@ def panel_stats(g: jax.Array, dmax2: jax.Array) -> Tuple[jax.Array, jax.Array]:
     cosines are noise and can never converge) — it drives the sweep loop.
     ``unmasked`` keeps them — it gates round skipping. Exactly-zero
     (padding) columns contribute 0 to both.
+
+    ``members`` ((panel->matrix index array, num_matrices) pair, the
+    batched-solve lane — see `_members`): panel j of the stack belongs to
+    matrix ``members[0][j]``; ``dmax2`` is then a per-matrix vector and
+    BOTH returned statistics are per-matrix segment maxima — one matrix's
+    couplings (or NaNs) never enter a neighbor's statistic.
     """
     f32 = jnp.float32
     g = g.astype(f32)
@@ -119,12 +126,43 @@ def panel_stats(g: jax.Array, dmax2: jax.Array) -> Tuple[jax.Array, jax.Array]:
     inv = 1.0 / jnp.maximum(d2, jnp.finfo(f32).tiny)
     r2 = (g * g) * inv[:, :, None] * inv[:, None, :]
     r2 = r2 * (1.0 - jnp.eye(n2, dtype=f32))[None]
-    unmasked = jnp.sqrt(jnp.max(r2))
-    null2 = dmax2.astype(f32) * (n2 * eps) ** 2
-    live = d2 > null2
+    if members is None:
+        unmasked = jnp.sqrt(jnp.max(r2))
+        null2 = dmax2.astype(f32) * (n2 * eps) ** 2
+        live = d2 > null2
+        pair = live[:, :, None] & live[:, None, :]
+        masked = jnp.sqrt(jnp.max(jnp.where(pair, r2, 0.0)))
+        return masked, unmasked
+    seg, nseg = members
+    unmasked = jnp.sqrt(jax.ops.segment_max(
+        jnp.max(r2, axis=(1, 2)), seg, num_segments=nseg))
+    null2 = dmax2.astype(f32)[seg] * (n2 * eps) ** 2
+    live = d2 > null2[:, None]
     pair = live[:, :, None] & live[:, None, :]
-    masked = jnp.sqrt(jnp.max(jnp.where(pair, r2, 0.0)))
+    masked = jnp.sqrt(jax.ops.segment_max(
+        jnp.max(jnp.where(pair, r2, 0.0), axis=(1, 2)), seg,
+        num_segments=nseg))
     return masked, unmasked
+
+
+def _members(batch: int, k_per: int, halves: int = 1):
+    """(panel->matrix map, batch) of a batched stack: ``halves`` repeats
+    of ``batch`` back-to-back segments of ``k_per`` panels each (the self
+    round concatenates the top and bot stacks, hence halves=2). Built
+    from iota primitives — NOT a host constant — so no `device_put`
+    lands inside the sweep loop bodies (JAXPR003)."""
+    seg = jnp.repeat(jnp.arange(batch, dtype=jnp.int32), k_per,
+                     total_repeat_length=batch * k_per)
+    if halves > 1:
+        seg = jnp.concatenate([seg] * halves)
+    return seg, batch
+
+
+def _skip_stat(stat):
+    """Scalar round-skip gate over a per-matrix stat vector: NaN (a
+    poisoned member) must force the rotations ON for its neighbors'
+    sake, so NaN maps to +inf, never to a skipped round."""
+    return jnp.max(jnp.where(jnp.isnan(stat), jnp.inf, stat))
 
 
 def _rotations(g, kind, *, interpret, polish, axis_name):
@@ -151,7 +189,8 @@ def _mesh_max(x, axis_name):
 
 
 def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
-               axis_name=None, apply_x3=False, return_rotated=False):
+               axis_name=None, apply_x3=False, return_rotated=False,
+               batch=1):
     """Annihilate every within-block pair once (full tournament kernel).
 
     ``axis_name``: when run under shard_map, the mesh axis — the round-skip
@@ -159,11 +198,20 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
     stat stays LOCAL (the sweep pmax's its running max once, not once per
     round). ``return_rotated``: also return the skip decision as an int32
     0/1 (telemetry's rotation-round counter; only computed when asked so
-    the zero-telemetry trace is unchanged).
+    the zero-telemetry trace is unchanged). ``batch`` (static): the stack
+    holds ``batch`` matrices' blocks back to back; ``dmax2`` and the
+    returned stat are then per-matrix vectors (the block pair-solves are
+    per-panel and need no change — only the statistics segment).
     """
     with scope("gram"):
         g = _einsum(blocks, blocks, "kmi,kmj->kij", bf16_gram)
-    stat, skip = panel_stats(g, dmax2)
+    if batch > 1:
+        stat, skip = panel_stats(
+            g, dmax2, members=_members(batch, blocks.shape[0] // (2 * batch),
+                                       halves=2))
+        skip = _skip_stat(skip)
+    else:
+        stat, skip = panel_stats(g, dmax2)
     skip = _mesh_max(skip, axis_name)
 
     def do(args):
@@ -187,9 +235,12 @@ def self_round(blocks, vblocks, dmax2, rtol, *, interpret, polish, bf16_gram,
 
 def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
                 bf16_gram, axis_name=None, fused_exchange=False,
-                fused_apply=False, apply_x3=False, return_rotated=False):
+                fused_apply=False, apply_x3=False, return_rotated=False,
+                batch=1):
     """Annihilate every cross pair of each (top[i], bot[i]) block pair.
-    ``axis_name``: see `self_round`.
+    ``axis_name``: see `self_round`. ``batch``: see `self_round` — the
+    fused-exchange form additionally makes the in-kernel exchange
+    block-diagonal per matrix (ops/pallas_apply.py index maps).
 
     ``fused_exchange`` (single-device compiled path): the rotation apply AND
     the inter-round tournament exchange run as ONE Pallas kernel
@@ -215,7 +266,12 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
         else:
             x = jnp.concatenate([top, bot], axis=-1)
             g = _einsum(x, x, "kmi,kmj->kij", bf16_gram)
-    stat, skip = panel_stats(g, dmax2)
+    if batch > 1:
+        stat, skip = panel_stats(
+            g, dmax2, members=_members(batch, top.shape[0] // batch))
+        skip = _skip_stat(skip)
+    else:
+        stat, skip = panel_stats(g, dmax2)
     skip = _mesh_max(skip, axis_name)
 
     if fused_exchange:
@@ -224,18 +280,19 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
             q = _rotations(g, "cross", interpret=interpret, polish=polish,
                            axis_name=axis_name)
             with scope("apply_exchange"):
-                top, bot = pa.apply_exchange(top, bot, q, x3=apply_x3)
+                top, bot = pa.apply_exchange(top, bot, q, x3=apply_x3,
+                                             batch=batch)
                 if vtop is not None:
                     vtop, vbot = pa.apply_exchange(vtop, vbot, q,
-                                                   x3=apply_x3)
+                                                   x3=apply_x3, batch=batch)
             return top, bot, vtop, vbot
 
         def skip_branch(args):
             top, bot, vtop, vbot = args
             with scope("exchange"):
-                top, bot = sched.rotate_blocks(top, bot)
+                top, bot = sched.rotate_blocks(top, bot, batch)
                 if vtop is not None:
-                    vtop, vbot = sched.rotate_blocks(vtop, vbot)
+                    vtop, vbot = sched.rotate_blocks(vtop, vbot, batch)
             return top, bot, vtop, vbot
 
         top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, skip_branch,
@@ -283,7 +340,7 @@ def cross_round(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish,
 
 def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
                       bf16_gram, apply_x3=False, interpret=False,
-                      return_rotated=False):
+                      return_rotated=False, batch=1):
     """Cross round for the single-device COMPILED path, with the Gram
     panel as loop-carried state: ``g`` is the CURRENT pairs' panel
     (produced by the previous round's fused apply+exchange+gram kernel, or
@@ -291,9 +348,15 @@ def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
     the post-exchange pairs — so the whole round is rotation kernel + ONE
     apply kernel per stack, with zero standalone gram reads on the rotate
     path. The skip branch pays a plain exchange + gram kernel (late
-    sweeps, where rounds are cheap anyway)."""
+    sweeps, where rounds are cheap anyway). ``batch``: see
+    `cross_round`."""
     with_v = vtop is not None
-    stat, skip = panel_stats(g, dmax2)
+    if batch > 1:
+        stat, skip = panel_stats(
+            g, dmax2, members=_members(batch, top.shape[0] // batch))
+        skip = _skip_stat(skip)
+    else:
+        stat, skip = panel_stats(g, dmax2)
 
     def do(args):
         top, bot, vtop, vbot, _ = args
@@ -303,18 +366,20 @@ def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
             top, bot, g2 = pa.apply_exchange(top, bot, q, x3=apply_x3,
                                              with_gram=True,
                                              gram_bf16=bf16_gram,
-                                             interpret=interpret)
+                                             interpret=interpret,
+                                             batch=batch)
             if with_v:
                 vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3,
-                                               interpret=interpret)
+                                               interpret=interpret,
+                                               batch=batch)
         return top, bot, vtop, vbot, g2
 
     def skip_branch(args):
         top, bot, vtop, vbot, _ = args
         with scope("exchange"):
-            top, bot = sched.rotate_blocks(top, bot)
+            top, bot = sched.rotate_blocks(top, bot, batch)
             if with_v:
-                vtop, vbot = sched.rotate_blocks(vtop, vbot)
+                vtop, vbot = sched.rotate_blocks(vtop, vbot, batch)
         with scope("gram"):
             g2 = pg.gram_pairs(top, bot, bf16=bf16_gram,
                                interpret=interpret)
@@ -329,7 +394,7 @@ def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
 
 def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
           axis_name=None, n_rounds=None, exchange=None, apply_x3=False,
-          telemetry=False):
+          telemetry=False, batch=1):
     """One full sweep: self round + cross tournament rounds.
 
     Every pair of the n columns is annihilated exactly once: n-1 sequential
@@ -346,9 +411,22 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     counter) as a trailing int32 — the counter rides the scan carry, so
     the flag must be OFF on the zero-telemetry path to keep its HLO
     byte-identical.
+
+    ``batch`` (static): the batched-solve lane — the stacks hold ``batch``
+    matrices back to back along the pair axis (``k = batch * k_per``), the
+    tournament exchange is block-diagonal per matrix, ``dmax2`` and the
+    returned coupling are per-matrix ``(batch,)`` vectors, and the round
+    count is the PER-MATRIX ``2*k_per - 1`` (the schedule is identical per
+    matrix, so one scan drives them all — the whole point: B matrices cost
+    one latency chain, not B). Single-device only (no ``axis_name`` /
+    custom ``exchange``).
     """
     k, m, b = top.shape
     with_v = vtop is not None
+    if batch > 1 and (axis_name is not None or exchange is not None):
+        raise ValueError("batched sweeps are single-device only (no mesh "
+                         "axis / ring exchange)")
+    k_per = k // batch
     # Fused apply+exchange(+gram) kernels: single-device compiled path
     # with lane-sized panels and kernel-usable row chunks for every stack
     # (the gram-carried loop also needs the standalone gram kernel for its
@@ -360,15 +438,18 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     # ppermute ring hop).
     mesh_fused = axis_name is not None and not interpret
     if exchange is None:
-        exchange = sched.rotate_blocks
+        if batch > 1:
+            exchange = lambda t, b_: sched.rotate_blocks(t, b_, batch)
+        else:
+            exchange = sched.rotate_blocks
     if n_rounds is None:
-        n_rounds = sched.num_rounds(2 * k)
+        n_rounds = sched.num_rounds(2 * k_per)
     blocks = jnp.concatenate([top, bot], axis=0)
     vblocks = jnp.concatenate([vtop, vbot], axis=0) if with_v else None
     self_out = self_round(
         blocks, vblocks, dmax2, rtol, interpret=interpret, polish=polish,
         bf16_gram=bf16_gram, axis_name=axis_name, apply_x3=apply_x3,
-        return_rotated=telemetry)
+        return_rotated=telemetry, batch=batch)
     if telemetry:
         blocks, vblocks, rel_self, cnt0 = self_out
     else:
@@ -393,7 +474,7 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
                 top, bot, vtop if with_v else None,
                 vbot if with_v else None, g, dmax2, rtol, polish=polish,
                 bf16_gram=bf16_gram, apply_x3=apply_x3,
-                return_rotated=telemetry)
+                return_rotated=telemetry, batch=batch)
             top, bot, nvt, nvb, g, stat = out[:6]
             if with_v:
                 vtop, vbot = nvt, nvb
@@ -418,7 +499,7 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
             dmax2, rtol, interpret=interpret,
             polish=polish, bf16_gram=bf16_gram, axis_name=axis_name,
             fused_exchange=False, fused_apply=mesh_fused, apply_x3=apply_x3,
-            return_rotated=telemetry)
+            return_rotated=telemetry, batch=batch)
         top, bot, nvt, nvb, stat = out[:5]
         if with_v:
             vtop, vbot = nvt, nvb
@@ -442,8 +523,14 @@ def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
     return out + (carry[5],) if telemetry else out
 
 
-def _global_dmax2(top, bot):
+def _global_dmax2(top, bot, batch: int = 1):
     acc = jnp.promote_types(top.dtype, jnp.float32)
+    if batch > 1:
+        # Per-matrix deflation scales of a batched stack: one matrix's
+        # huge columns must not deflate a small-normed neighbor.
+        t2 = jnp.sum(top.astype(acc) ** 2, axis=1).reshape(batch, -1)
+        b2 = jnp.sum(bot.astype(acc) ** 2, axis=1).reshape(batch, -1)
+        return jnp.maximum(jnp.max(t2, axis=1), jnp.max(b2, axis=1))
     return jnp.maximum(jnp.max(jnp.sum(top.astype(acc) ** 2, axis=1)),
                        jnp.max(jnp.sum(bot.astype(acc) ** 2, axis=1)))
 
@@ -563,6 +650,76 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
         cond, body, state)
     return (top, bot, (vtop if with_v else None),
             (vbot if with_v else None), off, sweeps, nonfinite)
+
+
+def iterate_batched(top, bot, vtop, vbot, *, batch, tol, max_sweeps,
+                    interpret, polish, stall_detection=True,
+                    chaos_nan_sweep=None):
+    """Batched sweep loop (the `solver.svd_batched` lane): the stacks hold
+    ``batch`` matrices back to back along the pair axis and ONE fused
+    while_loop sweeps them all — for the latency-bound rotation kernel
+    this is the whole win (B matrices ~ one latency chain, PROFILE.md
+    item 1).
+
+    Convergence bookkeeping is per matrix: the carry's off-norm /
+    prev-off / nonfinite are ``(batch,)`` vectors plus a per-matrix sweep
+    counter, the predicate is `should_continue` elementwise, and the loop
+    runs while ANY member wants another sweep. A member that converged /
+    stalled / went non-finite keeps riding the stacked sweeps (its
+    rotations are near-identity; a poisoned member's NaNs stay inside its
+    own block-diagonal segment) but its statistics freeze at its stopping
+    sweep, so one slow or NaN-poisoned member never perturbs a neighbor's
+    reported convergence. Returns
+    (top, bot, vtop, vbot, off (batch,), sweeps (batch,),
+    nonfinite (batch,)).
+    """
+    from ..resilience import chaos as _chaos
+    with_v = vtop is not None
+    kb = top.shape[0]
+    if vtop is None:
+        vtop = vbot = jnp.zeros((kb, 0, top.shape[2]), top.dtype)
+
+    def go_mask(off, prev_off, sweeps, nonfinite):
+        return should_continue(off, prev_off, sweeps, tol=tol,
+                               max_sweeps=max_sweeps,
+                               stall_detection=stall_detection,
+                               nonfinite=nonfinite)
+
+    def cond(st):
+        _, _, _, _, off, prev_off, sweeps, _, nonfinite = st
+        return jnp.any(go_mask(off, prev_off, sweeps, nonfinite))
+
+    def body(st):
+        top, bot, vtop, vbot, off, prev_off, sweeps, msweeps, nonfinite = st
+        go = go_mask(off, prev_off, sweeps, nonfinite)
+        if chaos_nan_sweep is not None:
+            # Poisons element [0, 0, 0] — member 0's first block — so the
+            # chaos lane can assert a NONFINITE member with OK neighbors.
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
+        dmax2 = _global_dmax2(top, bot, batch=batch)
+        out = sweep(top, bot, vtop if with_v else None,
+                    vbot if with_v else None, dmax2, tol,
+                    interpret=interpret, polish=polish, bf16_gram=False,
+                    batch=batch)
+        top, bot, nvt, nvb, off_new = out[:5]
+        nf_new = ~jnp.isfinite(dmax2) | ~jnp.isfinite(off_new)
+        nonfinite = nonfinite | (go & nf_new)
+        prev_off = jnp.where(go, off, prev_off)
+        off = jnp.where(go, off_new, off)
+        msweeps = msweeps + go.astype(jnp.int32)
+        if not with_v:
+            nvt, nvb = st[2], st[3]
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1, msweeps,
+                nonfinite)
+
+    inf = jnp.full((batch,), jnp.inf, jnp.float32)
+    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0),
+             jnp.zeros((batch,), jnp.int32),
+             jnp.zeros((batch,), jnp.bool_))
+    (top, bot, vtop, vbot, off, _, _, msweeps,
+     nonfinite) = jax.lax.while_loop(cond, body, state)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off, msweeps, nonfinite)
 
 
 def iterate(top, bot, vtop, vbot, *, tol, max_sweeps, interpret, polish,

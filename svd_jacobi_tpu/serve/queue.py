@@ -107,11 +107,19 @@ class AdmissionQueue:
             return self._closed and not self._q
 
     def deadline_budget(self, now: Optional[float] = None) -> float:
-        """Aggregate remaining deadline budget of the queued requests."""
+        """Aggregate remaining deadline budget of the queued requests.
+
+        A request cancelled WHILE QUEUED no longer promises an answer, so
+        its deadline is released the moment `Ticket.cancel()` sets the
+        request's cancel event — not held until the worker pops it (the
+        pre-fix behavior: a full-budget queue stayed full-budget after
+        every queued client gave up, rejecting new admissions against
+        promises nobody was waiting on)."""
         now = time.monotonic() if now is None else now
         with self._cond:
             return sum(max(0.0, r.deadline - now) for r in self._q
-                       if r.deadline is not None)
+                       if r.deadline is not None
+                       and not r.cancel.is_set())
 
     def admit(self, req: Request) -> None:
         """Enqueue or raise AdmissionError — the only two outcomes."""
@@ -151,6 +159,37 @@ class AdmissionQueue:
             if not self._q:
                 return None          # closed and drained
             return self._q.popleft()
+
+    def pop_same_bucket(self, bucket: Bucket, limit: int,
+                        deadline: Optional[float] = None) -> List[Request]:
+        """Pop up to ``limit`` queued requests routed to ``bucket`` — the
+        coalescing window pop of the batched serving lane. Blocks until
+        ``limit`` are collected, the absolute `time.monotonic()`
+        ``deadline`` passes (None = take only what is queued NOW), or the
+        queue closes; returns the (possibly empty) batch tail in FIFO
+        order. Requests of OTHER buckets stay queued in order — a
+        coalesced same-bucket request can therefore be served ahead of an
+        earlier other-bucket one, the documented reordering the batching
+        window trades for the coalescing win."""
+        out: List[Request] = []
+        if limit <= 0:
+            return out
+        with self._cond:
+            while True:
+                for r in list(self._q):
+                    if len(out) >= limit:
+                        break
+                    if r.bucket == bucket:
+                        self._q.remove(r)
+                        out.append(r)
+                if len(out) >= limit or self._closed:
+                    return out
+                timeout = (None if deadline is None
+                           else deadline - time.monotonic())
+                if timeout is None or timeout <= 0:
+                    return out
+                if not self._cond.wait(timeout):
+                    return out
 
     def drain(self) -> List[Request]:
         """Remove and return everything queued (shutdown without drain:

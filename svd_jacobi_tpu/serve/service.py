@@ -45,7 +45,7 @@ import threading
 import time
 from typing import Any, NamedTuple, Optional, Tuple
 
-from ..config import DEFAULT_SERVE_BUCKETS, SVDConfig
+from ..config import DEFAULT_BATCH_TIERS, DEFAULT_SERVE_BUCKETS, SVDConfig
 from .breaker import BreakerState, Brownout, CircuitBreaker
 from .buckets import BucketSet
 from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
@@ -120,6 +120,25 @@ class ServeConfig:
     # keeps them in memory only (`SVDService.records`).
     manifest_path: Optional[str] = None
     max_records: int = 1024
+    # --- request coalescing (the micro-batched solve lane) ---
+    # Up to ``max_batch`` same-bucket requests are popped per dispatch and
+    # solved as ONE batched solve (`solver.BatchedSweepStepper`): the
+    # rotation kernel is latency-bound, so B small same-bucket solves
+    # stacked along the pair axis cost close to one — a near-B× throughput
+    # win on a small/medium-bucket request mix. 1 = the pre-batching
+    # strictly-serial behavior.
+    max_batch: int = 1
+    # Bounded batching window: after popping the FIRST request of a
+    # dispatch the worker waits at most this long for same-bucket
+    # followers (never past the first request's own deadline). 0 = only
+    # coalesce what is already queued.
+    batch_window_s: float = 0.0
+    # Static batch-size tiers: a popped batch snaps UP to the smallest
+    # tier holding it, zero-padding the tail slots (exact for the SVD —
+    # an all-zero member deflates in one sweep), so the batched stepper
+    # jits compile once per (bucket, tier) and the compile cache stays
+    # bounded. Tiers above ``max_batch`` are simply never used.
+    batch_tiers: tuple = DEFAULT_BATCH_TIERS
 
 
 class SVDService:
@@ -132,6 +151,16 @@ class SVDService:
                 "brownout thresholds must satisfy 0 < sigma_only_at <= "
                 f"shed_at, got {config.brownout_sigma_only_at} / "
                 f"{config.brownout_shed_at}")
+        if config.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got "
+                             f"{config.max_batch}")
+        tiers = tuple(sorted(set(int(t) for t in config.batch_tiers)))
+        if not tiers or tiers[0] < 1:
+            raise ValueError(f"batch_tiers must be a non-empty set of "
+                             f"positive ints, got {config.batch_tiers!r}")
+        if config.batch_window_s < 0:
+            raise ValueError("batch_window_s must be >= 0")
+        self._tiers = tiers
         self.config = config
         self.buckets = BucketSet(config.buckets)
         self.queue = AdmissionQueue(config.max_queue_depth,
@@ -144,7 +173,12 @@ class SVDService:
         self._drain = True
         self._thread: Optional[threading.Thread] = None
         self._in_flight: Optional[Request] = None
+        # Every member of the in-flight dispatch (== [_in_flight] for a
+        # single solve): stop(drain=False) must cancel them ALL — the
+        # batched control only fires when every member cancelled.
+        self._in_flight_all: list = []
         self._seq = itertools.count()
+        self._batch_seq = itertools.count()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -186,9 +220,9 @@ class SVDService:
             # path cannot be interrupted mid-fused-solve; join() rides it
             # out up to ``timeout``.
             with self._lock:
-                inflight = self._in_flight
-            if inflight is not None:
-                inflight.cancel.set()
+                inflight = list(self._in_flight_all)
+            for req in inflight:
+                req.cancel.set()
         if thread is not None:
             thread.join(timeout)
             if not thread.is_alive():
@@ -251,6 +285,38 @@ class SVDService:
                         f"variant (status={status}, degraded="
                         f"{res.degraded}, path={res.path}, breaker now "
                         f"{self.breaker.state().value})")
+        # Batched tiers: pre-compile every (bucket, tier, variant) the
+        # coalescing worker can dispatch — incl. the sigma-only brownout
+        # variants — so the FIRST coalesced dispatch is not a compile
+        # stall mid-traffic. Direct zero-stack solves (a deterministic
+        # tier-T dispatch cannot be forced through the admission queue
+        # without racing the batching window); all-zero members deflate in
+        # one sweep, so the cost is the compiles.
+        if self.config.max_batch > 1:
+            import numpy as _np
+
+            from ..solver import BatchedSweepStepper
+            cap = min(self.config.max_batch, self._tiers[-1])
+            reachable = sorted({min(t for t in self._tiers if t >= c)
+                                for c in range(2, cap + 1)})
+            for b in self.buckets:
+                for cu, cv in variants:
+                    for tier in reachable:
+                        a = jnp.zeros((tier, b.m, b.n),
+                                      jnp.dtype(b.dtype))
+                        st = BatchedSweepStepper(
+                            a, compute_u=cu, compute_v=cv,
+                            config=self.config.solver)
+                        state = st.init()
+                        while st.should_continue(state):
+                            state = st.step(state)
+                        res = st.finish(state)
+                        codes = [int(c) for c in _np.asarray(res.status)]
+                        if any(c != int(SolveStatus.OK) for c in codes):
+                            raise RuntimeError(
+                                f"batched warmup (bucket {b.name}, tier "
+                                f"{tier}, vec={cu}/{cv}) did not solve "
+                                f"OK: statuses {codes}")
 
     def __enter__(self) -> "SVDService":
         return self.start()
@@ -316,9 +382,28 @@ class SVDService:
         expire the deadline that exists to front-load it)."""
         import math
 
+        import jax
         import jax.numpy as jnp
+        import numpy as _np
         in_dtype = getattr(a, "dtype", None)
-        a = jnp.asarray(a)
+        # numpy input STAYS on host through admission: the screen is a
+        # free host check and device placement happens at dispatch —
+        # where a coalesced batch pays ONE transfer for all members
+        # instead of a per-submit device_put on the client thread (those
+        # ops concentrate into the worker's solve window and were a
+        # measurable throughput tax at small buckets). The effective
+        # dtype is what asarray WOULD produce under the current x64
+        # setting — a mismatch (e.g. f64 with x64 off) takes the same
+        # loud silent-downcast refusal below. Device/other input keeps
+        # the original asarray + device-screen path.
+        host_finite = None
+        if (isinstance(a, _np.ndarray)
+                and _np.issubdtype(a.dtype, _np.floating)):
+            host_finite = bool(_np.isfinite(a).all())
+            eff_dtype = jnp.dtype(jax.dtypes.canonicalize_dtype(a.dtype))
+        else:
+            a = jnp.asarray(a)
+            eff_dtype = jnp.dtype(a.dtype)
         if a.ndim != 2:
             raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
         rid = request_id or f"r{next(self._seq):05d}"
@@ -328,7 +413,7 @@ class SVDService:
             a = a.T
             compute_u, compute_v = compute_v, compute_u
         m, n = (int(d) for d in a.shape)
-        dtype = str(a.dtype)
+        dtype = str(eff_dtype)
         # Normalize the deadline BEFORE any rejection path: a rejected
         # inf-deadline submit must not leak a non-JSON Infinity token
         # into its manifest record.
@@ -342,7 +427,7 @@ class SVDService:
                 raise AdmissionError(AdmissionReason.SHUTDOWN,
                                      "service is not accepting requests")
             if (in_dtype is not None
-                    and jnp.dtype(a.dtype) != jnp.dtype(in_dtype)):
+                    and eff_dtype != jnp.dtype(in_dtype)):
                 # jnp.asarray silently downcasts (e.g. f64 -> f32 with
                 # x64 disabled); serving a precision-degraded result
                 # UNDECLARED would violate the layer's reject-or-record
@@ -350,16 +435,18 @@ class SVDService:
                 raise AdmissionError(
                     AdmissionReason.NO_BUCKET,
                     f"input dtype {jnp.dtype(in_dtype).name} is not "
-                    f"representable in this runtime (jnp.asarray produced "
-                    f"{a.dtype}; jax_enable_x64?) — refusing to silently "
-                    f"downcast")
+                    f"representable in this runtime (jnp.asarray produces "
+                    f"{eff_dtype}; jax_enable_x64?) — refusing to "
+                    f"silently downcast")
             bucket = self.buckets.route(m, n, dtype)
             if bucket is None:
                 raise AdmissionError(
                     AdmissionReason.NO_BUCKET,
                     f"shape {orig_shape} dtype {dtype} fits no declared "
                     f"bucket {[b.name for b in self.buckets]}")
-            if not bool(jnp.isfinite(a).all()):
+            finite = (host_finite if host_finite is not None
+                      else bool(jnp.isfinite(a).all()))
+            if not finite:
                 # resilience.guard's policy, enforced at the door: no
                 # ladder can fix data, and solving NaN input would read
                 # NONFINITE and feed the breaker — one buggy client must
@@ -412,28 +499,47 @@ class SVDService:
                 if self.queue.closed_and_empty():
                     break
                 continue
+            batch = [req]
+            if self.config.max_batch > 1:
+                # Coalesce same-bucket followers under the bounded
+                # batching window: first-request wait <= batch_window_s,
+                # never past the first request's own deadline (members
+                # that expire DURING the window finalize pre-dispatch
+                # without spending a sweep, as today).
+                limit = min(self.config.max_batch, self._tiers[-1]) - 1
+                window = time.monotonic() + self.config.batch_window_s
+                if req.deadline is not None:
+                    window = min(window, req.deadline)
+                batch += self.queue.pop_same_bucket(req.bucket, limit,
+                                                    window)
             with self._lock:
                 drain = self._drain or self._accepting
             try:
                 if not drain:
                     # stop(drain=False) raced the pop: finalize, don't solve.
-                    wait = time.monotonic() - req.submitted
-                    self._finalize(
-                        req, status_name="CANCELLED",
-                        result=self._control_result(req, "CANCELLED", wait),
-                        queue_wait=wait, solve_time=None, path="base",
-                        breaker_state=self.breaker.state())
-                else:
+                    for r in batch:
+                        wait = time.monotonic() - r.submitted
+                        self._finalize(
+                            r, status_name="CANCELLED",
+                            result=self._control_result(r, "CANCELLED",
+                                                        wait),
+                            queue_wait=wait, solve_time=None, path="base",
+                            breaker_state=self.breaker.state())
+                elif len(batch) == 1:
                     self._serve_one(req)
+                else:
+                    self._serve_batch(batch)
             except BaseException as e:  # last ditch: no undone tickets
-                if not req.ticket._done.is_set():
-                    self._finalize(
-                        req, status_name="ERROR",
-                        result=self._error_result(
-                            req, f"{type(e).__name__}: {e}", 0.0, "base"),
-                        queue_wait=time.monotonic() - req.submitted,
-                        solve_time=None, path="base",
-                        breaker_state=self.breaker.record(False))
+                for r in batch:
+                    if not r.ticket._done.is_set():
+                        self._finalize(
+                            r, status_name="ERROR",
+                            result=self._error_result(
+                                r, f"{type(e).__name__}: {e}", 0.0,
+                                "base"),
+                            queue_wait=time.monotonic() - r.submitted,
+                            solve_time=None, path="base",
+                            breaker_state=self.breaker.record(False))
 
     def _serve_one(self, req: Request) -> None:
         from ..solver import SolveStatus
@@ -441,6 +547,7 @@ class SVDService:
         queue_wait = t_pop - req.submitted
         with self._lock:
             self._in_flight = req
+            self._in_flight_all = [req]
             if not self._accepting and not self._drain:
                 # stop(drain=False) raced the pop before _in_flight was
                 # published (it could not see this request to cancel it);
@@ -513,6 +620,198 @@ class SVDService:
         finally:
             with self._lock:
                 self._in_flight = None
+                self._in_flight_all = []
+
+    def _serve_batch(self, reqs) -> None:
+        """Serve a coalesced same-bucket batch as ONE batched dispatch.
+
+        Pre-dispatch, each member gets the same queued-cancel /
+        queued-deadline finalization as a single request. The dispatch
+        runs under the BATCH control: effective deadline = min over
+        members (no member is served past its own promise — the whole
+        batch stops within one sweep of the earliest deadline; members
+        already at tolerance decode OK, the rest DEADLINE), cancellation
+        fires only when every member cancelled. An OPEN breaker disables
+        coalescing — the escalation ladder is a single-solve recovery
+        path, so members dispatch sequentially through it. The breaker
+        records ONE outcome per batched dispatch (all non-cancelled
+        members OK)."""
+        from ..solver import SolveStatus
+        t_pop = time.monotonic()
+        live = []
+        for req in reqs:
+            wait = t_pop - req.submitted
+            if req.cancel.is_set():
+                self._finalize(req, status_name="CANCELLED",
+                               result=self._control_result(
+                                   req, "CANCELLED", wait),
+                               queue_wait=wait, solve_time=None,
+                               path="base",
+                               breaker_state=self.breaker.state())
+            elif req.deadline is not None and t_pop >= req.deadline:
+                # Queue-expired: overload symptom, not backend failure —
+                # never fed to the breaker (cf. _serve_one).
+                self._finalize(req, status_name="DEADLINE",
+                               result=self._control_result(
+                                   req, "DEADLINE", wait),
+                               queue_wait=wait, solve_time=None,
+                               path="base",
+                               breaker_state=self.breaker.state())
+            else:
+                live.append(req)
+        if not live:
+            return
+        path, _ = self.breaker.begin()
+        if path == "ladder" or len(live) == 1:
+            # Recovery path (or a batch that collapsed to one member):
+            # strictly sequential single dispatches.
+            for req in live:
+                self._serve_one(req)
+            return
+        batch_id = f"b{next(self._batch_seq):05d}"
+        batch_size = len(live)
+        tier = min((t for t in self._tiers if t >= batch_size),
+                   default=batch_size)
+        with self._lock:
+            self._in_flight = live[0]
+            self._in_flight_all = list(live)
+        try:
+            bucket = live[0].bucket
+            cu = any(r.compute_u and not r.degraded for r in live)
+            cv = any(r.compute_v and not r.degraded for r in live)
+            deadlines = [r.deadline for r in live if r.deadline is not None]
+            deadline = min(deadlines) if deadlines else None
+            should_cancel = lambda: all(r.cancel.is_set() for r in live)
+            t0 = time.monotonic()
+            error = None
+            r = None
+            try:
+                r = self._solve_batched(live, bucket, tier, cu, cv,
+                                        deadline, should_cancel)
+            except Exception as e:
+                error = f"{type(e).__name__}: {e}"
+            solve_time = time.monotonic() - t0
+            if error is not None:
+                breaker_state = self.breaker.record(False)
+                for req in live:
+                    wait = t0 - req.submitted
+                    self._finalize(
+                        req, status_name="ERROR",
+                        result=self._error_result(req, error, wait, "base",
+                                                  solve_time_s=solve_time),
+                        queue_wait=wait, solve_time=solve_time,
+                        path="base", breaker_state=breaker_state,
+                        batch_id=batch_id, batch_size=batch_size,
+                        batch_tier=tier)
+                return
+            import numpy as np
+            # One host pull of the whole batched result: per-member
+            # factor slicing then costs numpy views instead of 2-3 tiny
+            # device ops + a scalar sync PER MEMBER (measured ~tens of ms
+            # per dispatch at small buckets — real throughput).
+            r = r._replace(
+                u=None if r.u is None else np.asarray(r.u),
+                s=np.asarray(r.s),
+                v=None if r.v is None else np.asarray(r.v),
+                sweeps=np.asarray(r.sweeps),
+                status=np.asarray(r.status))
+            statuses = []
+            for j, req in enumerate(live):
+                status_j = SolveStatus(int(r.status[j]))
+                if (req.cancel.is_set()
+                        and status_j is not SolveStatus.OK):
+                    # Individual mid-solve cancel: the batch rightly kept
+                    # sweeping for the neighbors, but THIS member's
+                    # terminal status honors the cancel — unless it
+                    # reached tolerance first (tolerance wins, matching
+                    # the single lane's decode order).
+                    status_j = SolveStatus.CANCELLED
+                statuses.append(status_j)
+            if all(st is SolveStatus.CANCELLED for st in statuses):
+                breaker_state = self.breaker.state()
+            else:
+                breaker_state = self.breaker.record(all(
+                    st is SolveStatus.OK for st in statuses
+                    if st is not SolveStatus.CANCELLED))
+            for j, req in enumerate(live):
+                wait = t0 - req.submitted
+                status_j = statuses[j]
+                # Factors are returned even for DEADLINE/CANCELLED
+                # members — the same loud PARTIAL result the serial
+                # lane's mid-solve control stops produce.
+                u, s, v, sweeps_j = self._slice_member(req, r, j, cu, cv)
+                result = ServeResult(
+                    u=u, s=s, v=v, status=status_j, error=None,
+                    sweeps=sweeps_j, bucket=req.bucket.name,
+                    queue_wait_s=wait, solve_time_s=solve_time,
+                    path="base", degraded=req.degraded, request_id=req.id)
+                self._finalize(req, status_name=status_j.name,
+                               result=result, queue_wait=wait,
+                               solve_time=solve_time, path="base",
+                               breaker_state=breaker_state,
+                               batch_id=batch_id, batch_size=batch_size,
+                               batch_tier=tier)
+            self._bump("batched_dispatches", f"batch_tier:{tier}")
+        finally:
+            with self._lock:
+                self._in_flight = None
+                self._in_flight_all = []
+
+    def _solve_batched(self, live, bucket, tier, cu, cv, deadline,
+                       should_cancel):
+        """One coalesced dispatch: pad each member to the bucket, stack,
+        zero-pad the tail slots to the batch tier (exact — an all-zero
+        member deflates in one sweep), and run the batched host-stepped
+        solve under the composed control."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..resilience import chaos
+        from ..solver import BatchedSweepStepper
+        if all(isinstance(r.a, np.ndarray) for r in live):
+            # Host-admitted members: build the padded tier stack in one
+            # host buffer and pay ONE device transfer for the whole batch.
+            buf = np.zeros((tier, bucket.m, bucket.n),
+                           np.dtype(bucket.dtype))
+            for j, r in enumerate(live):
+                buf[j, :r.a.shape[0], :r.a.shape[1]] = r.a
+            a = jnp.asarray(buf)
+        else:
+            stack = [self.buckets.pad(r.a, bucket) for r in live]
+            if tier > len(stack):
+                pad = jnp.zeros((bucket.m, bucket.n),
+                                jnp.dtype(bucket.dtype))
+                stack += [pad] * (tier - len(stack))
+            a = jnp.stack(stack)
+        stall = chaos.consume_stuck()
+        if stall is not None:
+            self._stall(live[0], stall)
+        slow = chaos.consume_slow()
+        st = BatchedSweepStepper(a, compute_u=cu, compute_v=cv,
+                                 config=self.config.solver)
+        st.set_control(deadline=deadline, should_cancel=should_cancel)
+        state = st.init()
+        while st.should_continue(state):
+            if slow is not None:
+                time.sleep(slow)
+            state = st.step(state)
+        return st.finish(state)
+
+    def _slice_member(self, req: Request, r, j: int, cu: bool, cv: bool):
+        """Member ``j``'s original-shape factors out of a batched result
+        (slice the bucket padding, undo the tall orientation, drop
+        factors the member did not ask for or was degraded out of)."""
+        k = min(req.m, req.n)
+        want_u = req.compute_u and not req.degraded
+        want_v = req.compute_v and not req.degraded
+        u = (r.u[j][:req.m, :k]
+             if (cu and want_u and r.u is not None) else None)
+        s = r.s[j][:k]
+        v = (r.v[j][:req.n, :k]
+             if (cv and want_v and r.v is not None) else None)
+        if req.transposed:
+            u, v = v, u
+        return u, s, v, int(r.sweeps[j])
 
     # -- solve paths --------------------------------------------------------
 
@@ -597,7 +896,10 @@ class SVDService:
     def _finalize(self, req: Request, *, status_name: str,
                   result: ServeResult, queue_wait: float,
                   solve_time: Optional[float], path: str,
-                  breaker_state: BreakerState) -> None:
+                  breaker_state: BreakerState,
+                  batch_id: Optional[str] = None,
+                  batch_size: Optional[int] = None,
+                  batch_tier: Optional[int] = None) -> None:
         req.ticket._result = result
         req.ticket._done.set()
         self._bump("served", f"status:{status_name}",
@@ -610,7 +912,9 @@ class SVDService:
             status=status_name, path=path, breaker=breaker_state.value,
             brownout=req.brownout,
             degraded=req.degraded, deadline_s=req.deadline_s,
-            sweeps=result.sweeps, error=result.error)
+            sweeps=result.sweeps, error=result.error,
+            batch_id=batch_id, batch_size=batch_size,
+            batch_tier=batch_tier)
 
     def _bump(self, *keys: str) -> None:
         with self._lock:
@@ -622,7 +926,10 @@ class SVDService:
                 solve_time_s: Optional[float], status: str, path: str,
                 breaker: str, brownout: str, degraded: bool,
                 deadline_s: Optional[float], error: Optional[str] = None,
-                sweeps: Optional[int] = None) -> None:
+                sweeps: Optional[int] = None,
+                batch_id: Optional[str] = None,
+                batch_size: Optional[int] = None,
+                batch_tier: Optional[int] = None) -> None:
         from .. import obs
         record = obs.manifest.build_serve(
             request_id=request_id, m=orig_shape[0], n=orig_shape[1],
@@ -632,7 +939,8 @@ class SVDService:
             status=status, path=path, breaker=breaker, brownout=brownout,
             degraded=bool(degraded),
             deadline_s=(None if deadline_s is None else float(deadline_s)),
-            sweeps=sweeps, error=error)
+            sweeps=sweeps, error=error, batch_id=batch_id,
+            batch_size=batch_size, batch_tier=batch_tier)
         with self._lock:
             # max_records <= 0 means "manifest only, keep none in memory"
             # (the naive del lst[:-0] would silently invert the cap into

@@ -289,6 +289,38 @@ def _deblockify(top: jax.Array, bot: jax.Array) -> jax.Array:
     return blocks.transpose(1, 0, 2).reshape(m, nblocks * b)
 
 
+def _blockify_batched(a: jax.Array, n_pad: int, nblocks: int):
+    """(B, m, n) -> per-member top/bot stacks (B, k, m, b): member s's
+    blocks are exactly `_blockify(a[s])`. The Pallas batched lane reshapes
+    the leading two axes flat to the stacked (B*k, m, b) layout (member-
+    major segments, the layout `ops.rounds.sweep(batch=B)` pairs and
+    rotates block-diagonally); the vmap XLA lane keeps them separate."""
+    bsz, m, n = a.shape
+    if n_pad != n:
+        a = jnp.pad(a, ((0, 0), (0, 0), (0, n_pad - n)))
+    b = n_pad // nblocks
+    blocks = a.reshape(bsz, m, nblocks, b).transpose(0, 2, 1, 3)
+    k = nblocks // 2
+    return blocks[:, :k], blocks[:, k:]
+
+
+def _stack_members(x: jax.Array) -> jax.Array:
+    """(B, k, m, b) -> the stacked (B*k, m, b) pair-axis layout."""
+    return x.reshape((-1,) + x.shape[2:])
+
+
+def _deblockify_batched(top: jax.Array, bot: jax.Array,
+                        batch: int) -> jax.Array:
+    """Stacked (B*k, m, b) pairs -> (B, m, n_pad) (inverse of the
+    blockify+stack composition; keeps padded columns)."""
+    k = top.shape[0] // batch
+    m, b = top.shape[1], top.shape[2]
+    t = top.reshape(batch, k, m, b)
+    bo = bot.reshape(batch, k, m, b)
+    blocks = jnp.concatenate([t, bo], axis=1)       # (B, 2k, m, b)
+    return blocks.transpose(0, 2, 1, 3).reshape(batch, m, 2 * k * b)
+
+
 def _sweep(top, bot, vtop, vbot, *, precision, gram_dtype, method="qr-svd",
            criterion="rel", dmax2=None):
     """One full sweep: 2k-1 tournament rounds via lax.scan."""
@@ -432,13 +464,16 @@ def _postprocess(a_work, v_work, n, *, compute_u, full_u, dtype):
         return u, s.astype(dtype), v
 
 
-@partial(jax.jit, static_argnames=(
+_PADDED_STATIC = (
     "n", "compute_u", "compute_v", "full_u", "nblocks", "tol", "max_sweeps",
     "precision", "gram_dtype_name", "method", "criterion", "stall_detection",
-    "telemetry", "chaos_nan_sweep"))
-def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
-                max_sweeps, precision, gram_dtype_name, method, criterion,
-                stall_detection=True, telemetry=False, chaos_nan_sweep=None):
+    "telemetry", "chaos_nan_sweep")
+
+
+def _svd_padded_impl(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
+                     max_sweeps, precision, gram_dtype_name, method,
+                     criterion, stall_detection=True, telemetry=False,
+                     chaos_nan_sweep=None):
     m, n_pad = a.shape
     dtype = a.dtype
     gram_dtype = jnp.dtype(gram_dtype_name)
@@ -489,6 +524,32 @@ def _svd_padded(a, *, n, compute_u, compute_v, full_u, nblocks, tol,
     u, s, v = _postprocess(a_work, v_work, n, compute_u=compute_u,
                            full_u=full_u, dtype=dtype)
     return u, s, v, sweeps, off_rel, status
+
+
+_svd_padded = partial(jax.jit, static_argnames=_PADDED_STATIC)(
+    _svd_padded_impl)
+
+
+def _svd_padded_batched_impl(a, *, n, compute_u, compute_v, full_u, nblocks,
+                             tol, max_sweeps, precision, gram_dtype_name,
+                             method, criterion, stall_detection=True,
+                             telemetry=False, chaos_nan_sweep=None):
+    """vmap twin of `_svd_padded` over a (B, m, n_pad) stack: under vmap
+    the sweep while_loops run until every member's predicate clears with
+    per-member carry masking, so sweeps/off/status come out per member —
+    the XLA block solvers' batched-solve lane (f64 and tiny-n buckets,
+    where the Pallas stacked lane does not apply)."""
+    return jax.vmap(lambda x: _svd_padded_impl(
+        x, n=n, compute_u=compute_u, compute_v=compute_v, full_u=full_u,
+        nblocks=nblocks, tol=tol, max_sweeps=max_sweeps,
+        precision=precision, gram_dtype_name=gram_dtype_name,
+        method=method, criterion=criterion,
+        stall_detection=stall_detection, telemetry=telemetry,
+        chaos_nan_sweep=chaos_nan_sweep))(a)
+
+
+_svd_padded_batched = partial(jax.jit, static_argnames=_PADDED_STATIC)(
+    _svd_padded_batched_impl)
 
 
 def _colnorms_compensated(w):
@@ -794,6 +855,89 @@ _svd_pallas_donated = partial(jax.jit, static_argnames=_PALLAS_STATIC,
                               donate_argnums=(0,))(_svd_pallas_impl)
 
 
+_PALLAS_BATCHED_STATIC = (
+    "n", "compute_u", "compute_v", "nblocks", "n_pad", "tol", "max_sweeps",
+    "precondition", "polish", "interpret", "stall_detection", "refine",
+    "chaos_nan_sweep")
+
+
+def _svd_pallas_batched_impl(a, *, n, compute_u, compute_v, nblocks, n_pad,
+                             tol, max_sweeps, precondition, polish,
+                             interpret=False, stall_detection=True,
+                             refine=False, chaos_nan_sweep=None):
+    """Batched Pallas kernel solve: B same-shaped (m, n) matrices in ONE
+    fused sweep loop (`svd_batched`'s kernel lane). The matrices stack
+    along the existing pair axis — (B, m, n) blockifies to (B*k, m, b)
+    stacks with the tournament pairing block-diagonal per matrix
+    (`ops.rounds.sweep(batch=B)`), so B matrices ride the SAME kernel
+    launches and latency chain as one: the rotation kernel is
+    latency-bound, not FLOP-bound (PROFILE.md item 1), which makes a
+    batch of small solves cost close to one. Convergence bookkeeping,
+    deflation scales, and the health word are all per member (one
+    NaN-poisoned member reports NONFINITE without perturbing its
+    neighbors' statistics — their blocks never meet).
+
+    Per-member bookkeeping of `_svd_pallas_impl` minus the bulk regimes
+    (mixed_bulk / bulk_bf16 / precondition="double" are fused-single-solve
+    modes; the planner rejects them on the batched lane). Preconditioning
+    and postprocessing vmap over members. Returns batched factors plus
+    (B,) sweeps/off/status vectors.
+    """
+    batch, m = a.shape[0], a.shape[1]
+    dtype = a.dtype
+    if precondition:
+        q1, _, order, work = jax.vmap(_precondition_qr)(a)
+        accumulate = compute_u       # rotations -> U (per member)
+        want_cols = compute_v        # normalized columns -> V
+    else:
+        q1 = order = None
+        work = a
+        accumulate = compute_v
+        want_cols = compute_u
+
+    top, bot = map(_stack_members,
+                   _blockify_batched(work, n_pad, nblocks))
+    if accumulate:
+        eye = jnp.broadcast_to(jnp.eye(n_pad, dtype=dtype),
+                               (batch, n_pad, n_pad))
+        vtop, vbot = map(_stack_members,
+                         _blockify_batched(eye, n_pad, nblocks))
+    else:
+        vtop = vbot = None
+
+    top, bot, vtop, vbot, off, msweeps, nonfinite = rounds.iterate_batched(
+        top, bot, vtop, vbot, batch=batch, tol=tol, max_sweeps=max_sweeps,
+        interpret=interpret, polish=polish,
+        stall_detection=stall_detection, chaos_nan_sweep=chaos_nan_sweep)
+    status = _status_word(off, msweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
+
+    a_work = _deblockify_batched(top, bot, batch)
+    v_work = (_deblockify_batched(vtop, vbot, batch)[:, :n, :]
+              if accumulate else None)
+
+    def post_one(aw, vw, wk):
+        cols, s, rot = _postprocess(aw, vw, n, compute_u=want_cols,
+                                    full_u=False, dtype=dtype)
+        if refine:
+            cols, s, rot = _refine_from_work(wk, cols, s, rot)
+        return cols, s, rot
+
+    cols, s, rot = jax.vmap(post_one)(a_work, v_work, work)
+    if precondition:
+        u, v = jax.vmap(lambda c, r, qq, oo: _recombine_precondition(
+            c, r, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=False, dtype=dtype, q1=qq, order=oo))(cols, rot, q1,
+                                                         order)
+        return u, s, v, msweeps, off, status
+    return cols, s, rot, msweeps, off, status
+
+
+_svd_pallas_batched = partial(jax.jit,
+                              static_argnames=_PALLAS_BATCHED_STATIC)(
+    _svd_pallas_batched_impl)
+
+
 def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
                 compute_v: bool = True, full_matrices: bool = False):
     """Resolve the fused jitted entry point a (input, config) pair
@@ -893,6 +1037,124 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
     return "padded", _svd_padded, a_pad, kwargs
 
 
+def _plan_entry_batched(a, config: SVDConfig, *, compute_u: bool = True,
+                        compute_v: bool = True):
+    """Batched twin of `_plan_entry` for a (B, m, n) same-shape stack:
+    ``(entry_name, jit_fn, prepared_input, kwargs)`` with ``entry_name``
+    in ``("pallas_batched", "padded_batched")``. Shared with
+    `svd_jacobi_tpu.analysis` so the batched lane's contract checks probe
+    exactly what `svd_batched` dispatches. Requires m >= n (the public
+    entry transposes wide stacks first)."""
+    bsz, m, n = a.shape
+    b, k = _plan(n, 1, config)
+    n_pad = 2 * k * b
+    tol, gram_dtype_name, method, criterion = _resolve_options(
+        a[0], config, compute_uv=compute_u)
+    if config.precondition not in ("auto", "on", "off", "double"):
+        raise ValueError(f"unknown precondition mode: {config.precondition!r}")
+    if config.donate_input:
+        raise ValueError("donate_input is not supported on the batched "
+                         "entry points (the stacked working set aliases "
+                         "no single member's buffer)")
+    if method == "pallas":
+        if b % 2:
+            b += 1
+            k = max(1, -(-n // (2 * b)))
+            n_pad = 2 * k * b
+        if config.precondition == "double":
+            raise ValueError("precondition='double' is a fused single-"
+                             "solve mode; the batched lane supports "
+                             "'auto'/'on'/'off'")
+        if config.mixed_bulk or config.bulk_bf16:
+            raise ValueError("mixed_bulk/bulk_bf16 are fused single-solve "
+                             "bulk regimes; the batched lane runs plain "
+                             "f32 kernel sweeps")
+        precondition = config.precondition in ("auto", "on")
+        refine = (config.sigma_refine if config.sigma_refine is not None
+                  else (compute_u or compute_v))
+        kwargs = dict(
+            n=n, compute_u=compute_u, compute_v=compute_v, nblocks=2 * k,
+            n_pad=n_pad, tol=tol, max_sweeps=int(config.max_sweeps),
+            precondition=bool(precondition),
+            polish=bool(config.kernel_polish),
+            interpret=not pb.supported(),
+            stall_detection=bool(config.stall_detection),
+            refine=bool(refine),
+            chaos_nan_sweep=_chaos.consume_nan_sweep())
+        return "pallas_batched", _svd_pallas_batched, a, kwargs
+    if config.precondition in ("on", "double") or config.mixed_bulk:
+        bad = ("mixed_bulk=True" if config.mixed_bulk
+               else f"precondition={config.precondition!r}")
+        raise ValueError(
+            f"{bad} requires the Pallas kernel path "
+            f"(pair_solver='pallas'/'auto'); this solve resolved to "
+            f"pair_solver={method!r}")
+    a_pad = (jnp.pad(a, ((0, 0), (0, 0), (0, n_pad - n)))
+             if n_pad != n else a)
+    kwargs = dict(
+        n=n, compute_u=compute_u, compute_v=compute_v, full_u=False,
+        nblocks=2 * k, tol=tol, max_sweeps=int(config.max_sweeps),
+        precision=config.matmul_precision,
+        gram_dtype_name=gram_dtype_name, method=method, criterion=criterion,
+        stall_detection=bool(config.stall_detection), telemetry=False,
+        chaos_nan_sweep=_chaos.consume_nan_sweep())
+    return "padded_batched", _svd_padded_batched, a_pad, kwargs
+
+
+def svd_batched(
+    a,
+    *,
+    compute_u: bool = True,
+    compute_v: bool = True,
+    config: SVDConfig | None = None,
+) -> SVDResult:
+    """Batched SVD: B same-shaped matrices solved as ONE fused dispatch.
+
+    ``a`` is (B, m, n); returns an `SVDResult` whose fields carry a
+    leading batch axis — ``u (B, m, min(m,n))``, ``s (B, min(m,n))``,
+    ``v (B, n, min(m,n))`` — plus PER-MEMBER ``sweeps``/``off_rel``/
+    ``status`` vectors (decode member i with
+    ``SolveStatus(int(r.status[i]))``; one poisoned member reports
+    NONFINITE while its neighbors stay OK).
+
+    Why not a loop of `svd` calls: the rotation kernel is latency-bound
+    (PROFILE.md item 1 — ~constant µs/step regardless of panel count), so
+    B small matrices stacked along the pair axis cost close to ONE solve
+    — the cuSOLVER `gesvdjBatched` design point, and the unit of work the
+    serving layer's request coalescing dispatches
+    (`serve.SVDService` with ``max_batch > 1``). On the kernel path the
+    stack rides the block-diagonal tournament of
+    `ops.rounds.sweep(batch=B)`; XLA-block-solver configs (f64, tiny n)
+    run the vmapped `_svd_padded` twin instead — same per-member
+    semantics, minus the shared latency chain.
+
+    Fused single-solve-only modes (mixed_bulk, bulk_bf16,
+    precondition="double", donate_input, full_matrices) are rejected or
+    unavailable. The loop exits when every member converged or stopped;
+    members that finish early ride the remaining sweeps unchanged in
+    status (their extra rotations are near-identity).
+    """
+    if config is None:
+        config = SVDConfig()
+    a = jnp.asarray(a)
+    if a.ndim != 3:
+        raise ValueError(f"expected a (B, m, n) matrix stack, got shape "
+                         f"{a.shape}")
+    if a.shape[0] < 1:
+        raise ValueError("empty batch")
+    _, m, n = a.shape
+    if m < n:
+        r = svd_batched(a.transpose(0, 2, 1), compute_u=compute_v,
+                        compute_v=compute_u, config=config)
+        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
+                         off_rel=r.off_rel, status=r.status)
+    _, solve, a_in, kwargs = _plan_entry_batched(
+        a, config, compute_u=compute_u, compute_v=compute_v)
+    u, s, v, sweeps, off_rel, status = solve(a_in, **kwargs)
+    return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
+                     status=status)
+
+
 def svd(
     a,
     *,
@@ -987,7 +1249,82 @@ class PhaseInfo(NamedTuple):
     tol: float       # tolerance the next should_continue tests against
 
 
-class SweepStepper:
+# Host-loop stop reason -> SolveStatus code: ONE decode table shared by
+# the single and batched steppers (the two host loops must not drift).
+_STATUS_BY_REASON = {
+    "tol": SolveStatus.OK,
+    "max_sweeps": SolveStatus.MAX_SWEEPS,
+    "stall": SolveStatus.STAGNATED,
+    "nonfinite": SolveStatus.NONFINITE,
+    "deadline": SolveStatus.DEADLINE,
+    "cancelled": SolveStatus.CANCELLED,
+}
+
+
+class _SweepControlMixin:
+    """Host-side machinery shared by `SweepStepper` and
+    `BatchedSweepStepper`: the cooperative request control and the hybrid
+    stage -> (method, criterion, tol) phase map. Both steppers provide
+    ``_stage``/``method``/``criterion``/``tol``/``abs_tol``."""
+
+    _deadline: Optional[float]
+    _should_cancel: Optional[Callable[[], bool]]
+
+    def set_control(self, *, deadline: Optional[float] = None,
+                    should_cancel: Optional[Callable[[], bool]] = None
+                    ) -> None:
+        """Install cooperative request control for this solve.
+
+        ``deadline``: absolute `time.monotonic()` second past which
+        `should_continue` returns False with stop reason "deadline"
+        (-> `SolveStatus.DEADLINE`). The check runs between sweeps, so a
+        request stops within one sweep of its deadline — the in-flight
+        sweep always completes (no thread kills, device state stays
+        consistent, `finish()` returns a loud PARTIAL result).
+        ``should_cancel``: zero-arg predicate polled between sweeps
+        (e.g. a `threading.Event.is_set` from the serving layer); True
+        stops the loop with `SolveStatus.CANCELLED`. Cancellation wins
+        over the deadline when both hold at the same boundary (the caller
+        asked first). Pass None to clear either hook. On a BATCHED
+        stepper the control is batch-level: the serving layer composes
+        deadline = min over members and should_cancel = every member
+        cancelled.
+        """
+        self._deadline = None if deadline is None else float(deadline)
+        self._should_cancel = should_cancel
+
+    def _control_stop(self) -> Optional[str]:
+        """The cooperative-control stop reason, or None to keep going."""
+        if self._should_cancel is not None and self._should_cancel():
+            return "cancelled"
+        if self._deadline is not None and time.monotonic() >= self._deadline:
+            return "deadline"
+        return None
+
+    def _phase(self):
+        """(method, criterion, tol) for the next sweep, per current stage."""
+        if self._stage == "bulk":
+            return "gram-eigh", "abs", self.abs_tol
+        if self._stage == "polish":
+            return "qr-svd", self.criterion, self.tol
+        return self.method, self.criterion, self.tol
+
+    def phase_info(self, state=None) -> "PhaseInfo":
+        """Public view of the phase the next `step` will run.
+
+        The stage machinery is host-side (it advances in `should_continue`),
+        so ``state`` is accepted for call-site symmetry but unused today.
+        This is the supported surface for instrumentation/checkpointing
+        (`utils.profiling`, `utils.checkpoint`) — `_phase`/`_stage` are
+        internals.
+        """
+        del state
+        method, criterion, tol = self._phase()
+        return PhaseInfo(stage=self._stage, method=method,
+                         criterion=criterion, tol=float(tol))
+
+
+class SweepStepper(_SweepControlMixin):
     """Run the solve one sweep at a time under host control.
 
     Usage:
@@ -1069,34 +1406,6 @@ class SweepStepper:
         # BETWEEN sweeps — never mid-kernel, never via thread kills.
         self._deadline: Optional[float] = None
         self._should_cancel: Optional[Callable[[], bool]] = None
-
-    def set_control(self, *, deadline: Optional[float] = None,
-                    should_cancel: Optional[Callable[[], bool]] = None
-                    ) -> None:
-        """Install cooperative request control for this solve.
-
-        ``deadline``: absolute `time.monotonic()` second past which
-        `should_continue` returns False with stop reason "deadline"
-        (-> `SolveStatus.DEADLINE`). The check runs between sweeps, so a
-        request stops within one sweep of its deadline — the in-flight
-        sweep always completes (no thread kills, device state stays
-        consistent, `finish()` returns a loud PARTIAL result).
-        ``should_cancel``: zero-arg predicate polled between sweeps
-        (e.g. a `threading.Event.is_set` from the serving layer); True
-        stops the loop with `SolveStatus.CANCELLED`. Cancellation wins
-        over the deadline when both hold at the same boundary (the caller
-        asked first). Pass None to clear either hook.
-        """
-        self._deadline = None if deadline is None else float(deadline)
-        self._should_cancel = should_cancel
-
-    def _control_stop(self) -> Optional[str]:
-        """The cooperative-control stop reason, or None to keep going."""
-        if self._should_cancel is not None and self._should_cancel():
-            return "cancelled"
-        if self._deadline is not None and time.monotonic() >= self._deadline:
-            return "deadline"
-        return None
 
     def _host_kernel_path(self) -> bool:
         """Whether this stepper runs the Pallas kernel sweeps directly
@@ -1203,28 +1512,6 @@ class SweepStepper:
             self._release_input()
         return SweepState(top, bot, vtop, vbot,
                           jnp.float32(jnp.inf), jnp.int32(0))
-
-    def _phase(self):
-        """(method, criterion, tol) for the next sweep, per current stage."""
-        if self._stage == "bulk":
-            return "gram-eigh", "abs", self.abs_tol
-        if self._stage == "polish":
-            return "qr-svd", self.criterion, self.tol
-        return self.method, self.criterion, self.tol
-
-    def phase_info(self, state: "SweepState | None" = None) -> PhaseInfo:
-        """Public view of the phase the next `step` will run.
-
-        The stage machinery is host-side (it advances in `should_continue`),
-        so ``state`` is accepted for call-site symmetry but unused today.
-        This is the supported surface for instrumentation/checkpointing
-        (`utils.profiling`, `utils.checkpoint`) — `_phase`/`_stage` are
-        internals.
-        """
-        del state
-        method, criterion, tol = self._phase()
-        return PhaseInfo(stage=self._stage, method=method,
-                         criterion=criterion, tol=float(tol))
 
     def restore_stage(self, stage: str) -> None:
         """Restore the host-side stage machinery to a snapshotted stage
@@ -1345,12 +1632,7 @@ class SweepStepper:
                     reason = "max_sweeps"
                 else:
                     reason = "stall"
-            code = {"tol": SolveStatus.OK,
-                    "max_sweeps": SolveStatus.MAX_SWEEPS,
-                    "stall": SolveStatus.STAGNATED,
-                    "nonfinite": SolveStatus.NONFINITE,
-                    "deadline": SolveStatus.DEADLINE,
-                    "cancelled": SolveStatus.CANCELLED}[reason]
+            code = _STATUS_BY_REASON[reason]
         return jnp.int32(int(code))
 
     def finish(self, state: SweepState) -> SVDResult:
@@ -1424,13 +1706,12 @@ def _sweep_step_pallas_jit(top, bot, vtop, vbot, rtol, *, with_v, polish,
     return top, bot, vtop, vbot, off
 
 
-@partial(jax.jit, static_argnames=("n", "compute_u", "compute_v", "full_u",
-                                   "precondition", "refine"))
-def _finish_pallas_jit(top, bot, vtop, vbot, work, q1, order, *, n,
+def _finish_pallas_one(top, bot, vtop, vbot, work, q1, order, *, n,
                        compute_u, compute_v, full_u, precondition, refine):
-    """Kernel-path postprocessing + recombination (+ sigma refinement) in
-    one jit — identical factor bookkeeping to `_svd_pallas` (including the
-    work-matrix-based refinement)."""
+    """Kernel-path postprocessing + recombination (+ sigma refinement) for
+    one member's stacks — identical factor bookkeeping to `_svd_pallas`
+    (including the work-matrix-based refinement). Shared by the single
+    and batched (vmapped) finish jits."""
     m = q1.shape[0] if precondition else work.shape[0]
     dtype = work.dtype
     accumulate = compute_u if precondition else compute_v
@@ -1450,3 +1731,400 @@ def _finish_pallas_jit(top, bot, vtop, vbot, work, q1, order, *, n,
         if compute_u and full_u and m > n and u is not None:
             u = _complete_orthonormal(u, n, dtype)
     return u, s, v
+
+
+@partial(jax.jit, static_argnames=("n", "compute_u", "compute_v", "full_u",
+                                   "precondition", "refine"))
+def _finish_pallas_jit(top, bot, vtop, vbot, work, q1, order, *, n,
+                       compute_u, compute_v, full_u, precondition, refine):
+    return _finish_pallas_one(top, bot, vtop, vbot, work, q1, order, n=n,
+                              compute_u=compute_u, compute_v=compute_v,
+                              full_u=full_u, precondition=precondition,
+                              refine=refine)
+
+
+# ---------------------------------------------------------------------------
+# Batched host-controlled stepping — the serving layer's coalesced-dispatch
+# lane (`serve.SVDService` with max_batch > 1): B same-bucket requests stack
+# into ONE solve whose sweeps the host steps, so per-request deadlines /
+# cancellation stay cooperative (checked between sweeps) while the device
+# work amortizes across the batch. Mirrors `SweepStepper`'s API with
+# per-MEMBER convergence bookkeeping on the host.
+
+
+_precondition_qr_batched_jit = jax.jit(jax.vmap(_precondition_qr))
+
+
+@partial(jax.jit, static_argnames=("batch", "with_v", "polish", "interpret"))
+def _sweep_step_pallas_batched_jit(top, bot, vtop, vbot, rtol, *, batch,
+                                   with_v, polish, interpret):
+    """One kernel-path sweep of a stacked (B*k, m, b) batch: the same
+    `ops.rounds.sweep` as the single stepper with the block-diagonal
+    batched schedule; per-member (B,) dmax2/off vectors."""
+    dmax2 = _global_dmax2(top, bot, batch=batch)
+    top, bot, nvt, nvb, off = rounds.sweep(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        dmax2, rtol, interpret=interpret, polish=polish, bf16_gram=False,
+        batch=batch)
+    if with_v:
+        vtop, vbot = nvt, nvb
+    return top, bot, vtop, vbot, off
+
+
+@partial(jax.jit, static_argnames=("with_v", "precision", "gram_dtype_name",
+                                   "method", "criterion"))
+def _sweep_step_xla_batched_jit(top, bot, vtop, vbot, *, with_v, precision,
+                                gram_dtype_name, method, criterion):
+    """One XLA-block-solver sweep vmapped over (B, k, m, b) member stacks
+    (the f64 / tiny-n serving buckets); per-member (B,) off vector."""
+    def one(t, b, vt, vb):
+        dmax2 = _global_dmax2(t, b)
+        t, b, nvt, nvb, off = _sweep(
+            t, b, vt if with_v else None, vb if with_v else None,
+            precision=precision, gram_dtype=jnp.dtype(gram_dtype_name),
+            method=method, criterion=criterion, dmax2=dmax2)
+        if not with_v:
+            nvt, nvb = vt, vb
+        return t, b, nvt, nvb, off
+
+    return jax.vmap(one)(top, bot, vtop, vbot)
+
+
+@partial(jax.jit, static_argnames=("batch", "n", "compute_u", "compute_v",
+                                   "precondition", "refine"))
+def _finish_pallas_batched_jit(top, bot, vtop, vbot, work, q1, order, *,
+                               batch, n, compute_u, compute_v, precondition,
+                               refine):
+    """Kernel-path finish vmapped over the members of a stacked batch —
+    the exact single-member bookkeeping (`_finish_pallas_one`) per
+    member. full_u is not offered on the batched lane."""
+    def seg(x):
+        return x.reshape((batch, x.shape[0] // batch) + x.shape[1:])
+
+    def one(t, b, vt, vb, wk, qq, oo):
+        return _finish_pallas_one(t, b, vt, vb, wk, qq, oo, n=n,
+                                  compute_u=compute_u, compute_v=compute_v,
+                                  full_u=False, precondition=precondition,
+                                  refine=refine)
+
+    return jax.vmap(one)(seg(top), seg(bot), seg(vtop), seg(vbot), work,
+                         q1, order)
+
+
+@partial(jax.jit, static_argnames=("n", "compute_u", "compute_v"))
+def _finish_xla_batched_jit(top, bot, vtop, vbot, *, n, compute_u,
+                            compute_v):
+    def one(t, b, vt, vb):
+        a_work = _deblockify(t, b)
+        v_work = _deblockify(vt, vb)[:n, :] if compute_v else None
+        return _postprocess(a_work, v_work, n, compute_u=compute_u,
+                            full_u=False, dtype=t.dtype)
+
+    return jax.vmap(one)(top, bot, vtop, vbot)
+
+
+@jax.jit
+def _nonfinite_probe_batched_jit(top, bot, off_rel):
+    """(B,) per-member nonfinite probe over (B, k, m, b) member stacks —
+    the batched twin of `_nonfinite_probe_jit` (a poisoned member's NaNs
+    stay inside its own segment, so the probe is per-member exact)."""
+    def one(t, b, o):
+        return jnp.logical_or(~jnp.isfinite(_global_dmax2(t, b)),
+                              ~jnp.isfinite(o))
+
+    return jax.vmap(one)(top, bot, off_rel)
+
+
+class BatchSweepState(NamedTuple):
+    """Device state of a batched host-stepped solve. The stacks are
+    (B*k, m, b) member-major on the kernel path and (B, k, m, b) on the
+    XLA path; ``off_rel`` is the per-member (B,) coupling after the last
+    sweep and ``sweeps`` the scalar count of sweeps run on the stack."""
+
+    top: jax.Array
+    bot: jax.Array
+    vtop: jax.Array
+    vbot: jax.Array
+    off_rel: jax.Array
+    sweeps: jax.Array
+
+
+class BatchedSweepStepper(_SweepControlMixin):
+    """Run B same-shaped solves as one host-stepped batch.
+
+    Usage matches `SweepStepper` with ``a`` of shape (B, m, n):
+
+        st = BatchedSweepStepper(a, config=cfg)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        result = st.finish(state)     # batched factors + (B,) vectors
+
+    Convergence is tracked PER MEMBER on the host: each sweep's (B,)
+    off-norm vector is decoded with the same criterion/stall logic as the
+    single stepper, a member that converges / stalls / goes non-finite
+    freezes its off/sweeps at that boundary (its blocks keep riding the
+    stacked sweeps — near-identity rotations — so neighbors lose
+    nothing), and the loop ends when every member is done. `set_control`
+    carries the BATCH-level cooperative control the serving layer
+    composes for a coalesced dispatch: the effective deadline is the min
+    over members (no member may be served past its own promise — the
+    whole batch stops within one sweep of the earliest deadline, members
+    already converged decode OK, the rest DEADLINE) and cancellation
+    fires when every member cancelled (an individual member's cancel is
+    the service's finalize-time concern, not the solve's).
+
+    The hybrid XLA method's bulk->polish stage switch is batch-level: the
+    polish begins once NO live member's bulk phase wants another sweep
+    (early finishers run extra bulk sweeps, which only tighten them).
+    """
+
+    def __init__(self, a, *, compute_u: bool = True, compute_v: bool = True,
+                 config: SVDConfig | None = None):
+        if config is None:
+            config = SVDConfig()
+        a = jnp.asarray(a)
+        if a.ndim != 3:
+            raise ValueError(f"expected a (B, m, n) stack, got {a.shape}")
+        bsz, m, n = (int(d) for d in a.shape)
+        if m < n:
+            raise ValueError("BatchedSweepStepper requires m >= n; pass "
+                             "the transposed stack and swap u/v (as "
+                             "svd_batched() does)")
+        if config.donate_input:
+            raise ValueError("donate_input is not supported on the "
+                             "batched stepper")
+        self.a, self.batch, self.m, self.n = a, bsz, m, n
+        self.input_dtype = a.dtype
+        self.compute_u, self.compute_v = compute_u, compute_v
+        self.config = config
+        b, k = _plan(n, 1, config)
+        (self.tol, self.gram_dtype_name, self.method,
+         self.criterion) = _resolve_options(a[0], config,
+                                            compute_uv=compute_u)
+        self._kernel_path = self.method == "pallas"
+        if self._kernel_path:
+            if config.mixed_bulk or config.bulk_bf16:
+                raise ValueError("mixed_bulk/bulk_bf16 are fused-solver "
+                                 "modes; the batched stepper runs plain "
+                                 "f32 kernel sweeps")
+            if config.precondition == "double":
+                raise ValueError("precondition='double' is not supported "
+                                 "by the batched stepper; use "
+                                 "'on'/'auto'/'off'")
+            if b % 2:   # the self kernel splits blocks in half
+                b += 1
+                k = max(1, -(-n // (2 * b)))
+            self._precondition = config.precondition in ("auto", "on")
+            self._accumulate = (compute_u if self._precondition
+                                else compute_v)
+            self._pc = None
+        else:
+            (self.tol, self.gram_dtype_name, self.method,
+             self.criterion) = _resolve_xla_options(a[0], config,
+                                                    compute_uv=compute_u)
+        self.nblocks, self.n_pad = 2 * k, 2 * k * b
+        self.abs_tol = _abs_phase_tol(a.dtype)
+        self._stage = "bulk" if self.method == "hybrid" else "single"
+        self._just_switched = False
+        # Per-member host bookkeeping: stop reason (None = live), frozen
+        # sweep count and off-norm at the member's stopping boundary.
+        # The stack's sweep count is ALSO tracked host-side (_sweeps_host
+        # increments per step()) so the per-sweep loop never pays a
+        # device->host scalar sync for it — at tiny buckets that sync was
+        # a measurable slice of the whole dispatch.
+        self._prev_off = np.full(bsz, np.inf)
+        self._done: list = [None] * bsz
+        self._done_sweeps = np.zeros(bsz, np.int64)
+        self._sweeps_host = 0
+        self._stop_reason: Optional[str] = None   # batch-level control
+        self._deadline: Optional[float] = None
+        self._should_cancel: Optional[Callable[[], bool]] = None
+
+    # -- state (control + phase machinery: _SweepControlMixin) --------------
+
+    def _precond_state(self):
+        if self._pc is None:
+            if self._precondition:
+                q1, _, order, work = _precondition_qr_batched_jit(self.a)
+                self._pc = (q1, order, work)
+            else:
+                self._pc = (None, None, self.a)
+        return self._pc
+
+    def init(self) -> BatchSweepState:
+        k = self.nblocks // 2
+        if self._kernel_path:
+            _, _, work = self._precond_state()
+            top, bot = map(_stack_members,
+                           _blockify_batched(work, self.n_pad,
+                                             self.nblocks))
+            if self._accumulate:
+                eye = jnp.broadcast_to(
+                    jnp.eye(self.n_pad, dtype=self.input_dtype),
+                    (self.batch, self.n_pad, self.n_pad))
+                vtop, vbot = map(_stack_members,
+                                 _blockify_batched(eye, self.n_pad,
+                                                   self.nblocks))
+            else:
+                vtop = vbot = jnp.zeros((self.batch * k, 0, top.shape[2]),
+                                        self.input_dtype)
+        else:
+            top, bot = _blockify_batched(self.a, self.n_pad, self.nblocks)
+            if self.compute_v:
+                eye = jnp.broadcast_to(
+                    jnp.eye(self.n_pad, dtype=self.input_dtype),
+                    (self.batch, self.n_pad, self.n_pad))
+                vtop, vbot = _blockify_batched(eye, self.n_pad,
+                                               self.nblocks)
+            else:
+                vtop = vbot = jnp.zeros((self.batch, k, 0, top.shape[3]),
+                                        self.input_dtype)
+        return BatchSweepState(top, bot, vtop, vbot,
+                               jnp.full((self.batch,), jnp.inf,
+                                        jnp.float32), jnp.int32(0))
+
+    def step(self, state: BatchSweepState) -> BatchSweepState:
+        method, criterion, _ = self._phase()
+        if self._just_switched:
+            self._prev_off = np.full(self.batch, np.inf)
+            self._just_switched = False
+        else:
+            off = np.asarray(state.off_rel, np.float64)
+            live = np.array([r is None for r in self._done])
+            self._prev_off = np.where(live, off, self._prev_off)
+        if self._kernel_path:
+            top, bot, vtop, vbot, off = _sweep_step_pallas_batched_jit(
+                state.top, state.bot, state.vtop, state.vbot,
+                jnp.float32(self.tol), batch=self.batch,
+                with_v=self._accumulate,
+                polish=bool(self.config.kernel_polish),
+                interpret=not pb.supported())
+        else:
+            top, bot, vtop, vbot, off = _sweep_step_xla_batched_jit(
+                state.top, state.bot, state.vtop, state.vbot,
+                with_v=self.compute_v,
+                precision=self.config.matmul_precision,
+                gram_dtype_name=self.gram_dtype_name, method=method,
+                criterion=criterion)
+        self._sweeps_host += 1
+        return BatchSweepState(top, bot, vtop, vbot, off, state.sweeps + 1)
+
+    def _mark(self, i: int, reason: str, sweeps: int) -> None:
+        self._done[i] = reason
+        self._done_sweeps[i] = sweeps
+
+    def should_continue(self, state: BatchSweepState) -> bool:
+        import math
+        ctl = self._control_stop()
+        sweeps = self._sweeps_host
+        if sweeps == 0:
+            if ctl is not None:
+                self._stop_reason = ctl
+                return False
+            return True
+        off = np.asarray(state.off_rel, np.float64)
+        _, criterion, tol = self._phase()
+        bulk_pending = False
+        for i in range(self.batch):
+            if self._done[i] is not None:
+                continue
+            o = float(off[i])
+            if not math.isfinite(o):
+                self._mark(i, "nonfinite", sweeps)
+                continue
+            if self._stage != "bulk" and o <= tol:
+                # Tolerance wins over budget/stall/control, matching the
+                # single stepper's decode order.
+                self._mark(i, "tol", sweeps)
+                continue
+            if sweeps >= self.config.max_sweeps:
+                # Same decode as the single stepper's budget-exhaustion
+                # branch: the PHASE tolerance wins even mid-bulk.
+                self._mark(i, "tol" if o <= tol else "max_sweeps", sweeps)
+                continue
+            go = bool(_should_continue(
+                o, float(self._prev_off[i]), sweeps, tol=tol,
+                max_sweeps=self.config.max_sweeps,
+                stall_detection=self.config.stall_detection,
+                criterion=criterion))
+            if self._stage == "bulk":
+                # Bulk members are never marked done by convergence/stall
+                # — the whole batch switches to polish once no live
+                # member's bulk wants another sweep.
+                bulk_pending = bulk_pending or go
+                continue
+            if not go:
+                self._mark(i, "stall", sweeps)
+        if ctl is not None:
+            self._stop_reason = ctl
+            return False
+        live = any(r is None for r in self._done)
+        if self._stage == "bulk" and live and not bulk_pending:
+            self._stage = "polish"
+            self._prev_off = np.full(self.batch, np.inf)
+            self._just_switched = True
+        return live
+
+    def _member_statuses(self, state: BatchSweepState) -> np.ndarray:
+        import math
+        sweeps = self._sweeps_host
+        off_probe = (state.off_rel if sweeps > 0
+                     else jnp.zeros((self.batch,), jnp.float32))
+        top, bot = state.top, state.bot
+        if self._kernel_path:
+            kp = top.shape[0] // self.batch
+            top = top.reshape((self.batch, kp) + top.shape[1:])
+            bot = bot.reshape((self.batch, kp) + bot.shape[1:])
+        nf = np.asarray(_nonfinite_probe_batched_jit(top, bot, off_probe))
+        off = np.asarray(state.off_rel, np.float64)
+        codes = np.zeros(self.batch, np.int32)
+        for i in range(self.batch):
+            if bool(nf[i]):
+                codes[i] = int(SolveStatus.NONFINITE)
+                continue
+            reason = self._done[i]
+            if reason is None:
+                # The batch stopped before this member did: control stop,
+                # or finish() called early. Tolerance wins.
+                o = off[i]
+                if sweeps > 0 and math.isfinite(o) and o <= self.tol:
+                    reason = "tol"
+                elif self._stop_reason is not None:
+                    reason = self._stop_reason
+                elif sweeps >= self.config.max_sweeps:
+                    reason = "max_sweeps"
+                else:
+                    reason = "stall"
+            codes[i] = int(_STATUS_BY_REASON[reason])
+        return codes
+
+    def member_sweeps(self, state: BatchSweepState) -> np.ndarray:
+        """Per-member sweep counts: frozen at each member's stopping
+        boundary, the stack count for members that rode to the end."""
+        del state
+        done = np.array([r is not None for r in self._done])
+        return np.where(done, self._done_sweeps,
+                        self._sweeps_host).astype(np.int64)
+
+    def finish(self, state: BatchSweepState) -> SVDResult:
+        status = jnp.asarray(self._member_statuses(state))
+        sweeps_vec = jnp.asarray(self.member_sweeps(state), jnp.int32)
+        if self._kernel_path:
+            q1, order, work = self._precond_state()
+            refine = (self.config.sigma_refine
+                      if self.config.sigma_refine is not None
+                      else (self.compute_u or self.compute_v))
+            u, s, v = _finish_pallas_batched_jit(
+                state.top, state.bot, state.vtop, state.vbot, work, q1,
+                order, batch=self.batch, n=self.n,
+                compute_u=self.compute_u, compute_v=self.compute_v,
+                precondition=self._precondition, refine=bool(refine))
+            return SVDResult(u=u, s=s, v=v, sweeps=sweeps_vec,
+                             off_rel=state.off_rel, status=status)
+        u, s, v = _finish_xla_batched_jit(
+            state.top, state.bot, state.vtop, state.vbot, n=self.n,
+            compute_u=self.compute_u, compute_v=self.compute_v)
+        return SVDResult(u=u, s=s, v=(v if self.compute_v else None),
+                         sweeps=sweeps_vec, off_rel=state.off_rel,
+                         status=status)

@@ -1,0 +1,371 @@
+"""Batched solve lane (`solver.svd_batched` / `BatchedSweepStepper`) and
+the serving layer's request coalescing (`SVDService` with max_batch > 1).
+
+The claims under test, per member of a batch:
+
+  * ORACLE EQUALITY — a batched solve's factors/sigmas/residuals match the
+    sequential path to tolerance, across both lanes (Pallas stacked f32,
+    vmapped XLA f64) and with zero-padded tail slots;
+  * STATUS ISOLATION — one chaos-NaN member reports NONFINITE while its
+    neighbors stay OK with in-tolerance residuals (statistics are
+    per-member segments, blocks never meet across members);
+  * DEADLINE DECODE — a coalesced dispatch's effective deadline is the
+    min over members; members at tolerance decode OK (tolerance wins),
+    the rest DEADLINE;
+  * ADMISSION — a queued request's deadline promise is released the
+    moment it is cancelled (the PR-5 satellite bugfix), and the batched
+    retrace contract catches a tier leak (failing fixture).
+
+All CPU, all in tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from svd_jacobi_tpu import SVDConfig, svd, svd_batched
+from svd_jacobi_tpu.resilience import chaos
+from svd_jacobi_tpu.serve import (AdmissionError, AdmissionQueue,
+                                  AdmissionReason, Bucket, ServeConfig,
+                                  SVDService, Ticket)
+from svd_jacobi_tpu.serve.queue import Request
+from svd_jacobi_tpu.solver import (BatchedSweepStepper, SolveStatus,
+                                   SweepStepper)
+from svd_jacobi_tpu.utils import matgen, validation
+
+
+def _stack(shapes_seed, m, n, dtype, count):
+    mats = [matgen.random_dense(m, n, seed=shapes_seed + i, dtype=dtype)
+            for i in range(count)]
+    return mats, jnp.stack(mats)
+
+
+def _residual(a, u, s, v):
+    return float(np.asarray(validation.relative_residual(a, u, s, v)))
+
+
+class TestSvdBatchedOracle:
+    def test_pallas_lane_matches_sequential(self):
+        mats, a = _stack(100, 80, 64, jnp.float32, 3)
+        r = svd_batched(a)
+        assert [SolveStatus(int(c)) for c in np.asarray(r.status)] == \
+            [SolveStatus.OK] * 3
+        for i, m_i in enumerate(mats):
+            ri = svd(m_i)
+            np.testing.assert_allclose(np.asarray(r.s[i]),
+                                       np.asarray(ri.s), rtol=1e-5)
+            assert _residual(m_i, r.u[i], r.s[i], r.v[i]) < 1e-5
+
+    def test_xla_lane_matches_sequential_f64(self):
+        cfg = SVDConfig(block_size=4)
+        mats, a = _stack(200, 32, 24, jnp.float64, 4)
+        r = svd_batched(a, config=cfg)
+        assert [SolveStatus(int(c)) for c in np.asarray(r.status)] == \
+            [SolveStatus.OK] * 4
+        for i, m_i in enumerate(mats):
+            ri = svd(m_i, config=cfg)
+            np.testing.assert_allclose(np.asarray(r.s[i]),
+                                       np.asarray(ri.s), rtol=1e-12)
+            assert _residual(m_i, r.u[i], r.s[i], r.v[i]) < 1e-13
+
+    def test_zero_tail_slots_are_exact(self):
+        # The service's tier padding: all-zero members ride along without
+        # perturbing real members, and report OK themselves.
+        mats, _ = _stack(300, 64, 64, jnp.float32, 2)
+        a = jnp.stack(mats + [jnp.zeros((64, 64), jnp.float32)] * 2)
+        r = svd_batched(a)
+        assert [SolveStatus(int(c)) for c in np.asarray(r.status)] == \
+            [SolveStatus.OK] * 4
+        for i, m_i in enumerate(mats):
+            ri = svd(m_i)
+            np.testing.assert_allclose(np.asarray(r.s[i]),
+                                       np.asarray(ri.s), rtol=1e-5)
+            assert _residual(m_i, r.u[i], r.s[i], r.v[i]) < 1e-5
+        assert float(jnp.max(jnp.abs(r.s[2:]))) == 0.0
+
+    def test_wide_stack_transposes(self):
+        mats, a = _stack(400, 24, 32, jnp.float64, 2)
+        cfg = SVDConfig(block_size=4)
+        r = svd_batched(a, config=cfg)
+        for i, m_i in enumerate(mats):
+            assert r.u[i].shape == (24, 24) and r.v[i].shape == (32, 24)
+            assert _residual(m_i, r.u[i], r.s[i], r.v[i]) < 1e-13
+
+    def test_batched_rejects_fused_only_modes(self):
+        _, a = _stack(500, 64, 64, jnp.float32, 2)
+        with pytest.raises(ValueError, match="mixed_bulk"):
+            svd_batched(a, config=SVDConfig(mixed_bulk=True))
+        with pytest.raises(ValueError, match="donate_input"):
+            svd_batched(a, config=SVDConfig(donate_input=True))
+        with pytest.raises(ValueError, match="double"):
+            svd_batched(a, config=SVDConfig(precondition="double"))
+
+
+class TestMixedStatusBatch:
+    def test_chaos_nan_member_isolated(self):
+        """One chaos-NaN member -> NONFINITE; neighbors OK with
+        in-tolerance residuals (the per-member health-word claim)."""
+        mats, a = _stack(600, 64, 64, jnp.float32, 3)
+        with chaos.nan_at_sweep(1):
+            r = svd_batched(a)
+        names = [SolveStatus(int(c)).name for c in np.asarray(r.status)]
+        assert names[0] == "NONFINITE", names
+        assert names[1:] == ["OK", "OK"], names
+        for i in (1, 2):
+            assert _residual(mats[i], r.u[i], r.s[i], r.v[i]) < 1e-5
+
+    def test_stepper_nan_member_isolated(self):
+        mats, a = _stack(700, 64, 64, jnp.float32, 3)
+        st = BatchedSweepStepper(a, config=SVDConfig())
+        state = st.init()
+        steps = 0
+        while st.should_continue(state):
+            if steps == 1:
+                state = state._replace(
+                    top=state.top.at[0, 0, 0].set(jnp.nan))
+            state = st.step(state)
+            steps += 1
+        r = st.finish(state)
+        names = [SolveStatus(int(c)).name for c in np.asarray(r.status)]
+        assert names[0] == "NONFINITE" and names[1:] == ["OK", "OK"]
+        for i in (1, 2):
+            assert _residual(mats[i], r.u[i], r.s[i], r.v[i]) < 1e-5
+
+
+class TestBatchedDeadlineDecode:
+    def test_min_deadline_stops_batch_tolerance_wins(self):
+        """An already-expired batch deadline stops the stack before the
+        first sweep: every member decodes DEADLINE (none is at
+        tolerance)."""
+        _, a = _stack(800, 32, 32, jnp.float64, 3)
+        st = BatchedSweepStepper(a, config=SVDConfig(block_size=4))
+        st.set_control(deadline=time.monotonic() - 1.0)
+        state = st.init()
+        assert not st.should_continue(state)
+        r = st.finish(state)
+        assert [SolveStatus(int(c)) for c in np.asarray(r.status)] == \
+            [SolveStatus.DEADLINE] * 3
+        assert list(np.asarray(r.sweeps)) == [0, 0, 0]
+
+    def test_converged_members_decode_ok_at_deadline(self):
+        """Deadline fires AFTER convergence: tolerance wins — OK, not
+        DEADLINE (matching the single stepper's decode order)."""
+        _, a = _stack(900, 32, 32, jnp.float64, 2)
+        st = BatchedSweepStepper(a, config=SVDConfig(block_size=4))
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        r = st.finish(state)
+        assert [SolveStatus(int(c)) for c in np.asarray(r.status)] == \
+            [SolveStatus.OK] * 2
+        # Now install an expired control and re-decode: converged members
+        # must still read OK.
+        st.set_control(deadline=time.monotonic() - 1.0)
+        st.should_continue(state)
+        r2 = st.finish(state)
+        assert [SolveStatus(int(c)) for c in np.asarray(r2.status)] == \
+            [SolveStatus.OK] * 2
+
+    def test_all_members_cancelled_stops_batch(self):
+        _, a = _stack(1000, 32, 32, jnp.float64, 2)
+        st = BatchedSweepStepper(a, config=SVDConfig(block_size=4))
+        st.set_control(should_cancel=lambda: True)
+        state = st.init()
+        assert not st.should_continue(state)
+        r = st.finish(state)
+        assert [SolveStatus(int(c)) for c in np.asarray(r.status)] == \
+            [SolveStatus.CANCELLED] * 2
+
+
+BUCKETS64 = ((32, 32, "float64"),)
+SOLVER64 = SVDConfig(block_size=4)
+
+
+def _coalescing_cfg(**over):
+    base = dict(buckets=BUCKETS64, solver=SOLVER64, max_queue_depth=16,
+                max_batch=4, batch_window_s=0.25, batch_tiers=(1, 4))
+    base.update(over)
+    return ServeConfig(**base)
+
+
+@pytest.mark.serve
+class TestServiceCoalescing:
+    def test_padded_tier_dispatch_matches_oracle(self):
+        """3 same-bucket requests coalesce into ONE tier-4 dispatch
+        (padded tail slot); per-member factors match the numpy oracle and
+        the serve records carry the shared batch identity."""
+        mats = [matgen.random_dense(32, 24, seed=40 + i, dtype=jnp.float64)
+                for i in range(3)]
+        with SVDService(_coalescing_cfg()) as svc:
+            tickets = [svc.submit(a) for a in mats]
+            results = [t.result(timeout=180.0) for t in tickets]
+            recs = svc.records()
+        for a, res in zip(mats, results):
+            assert res.status is SolveStatus.OK
+            sref = np.linalg.svd(np.asarray(a), compute_uv=False)
+            np.testing.assert_allclose(np.asarray(res.s), sref, atol=1e-12)
+            assert _residual(a, res.u, res.s, res.v) < 1e-13
+        batch_ids = {r.get("batch_id") for r in recs}
+        assert len(batch_ids) == 1 and None not in batch_ids
+        assert all(r.get("batch_size") == 3 and r.get("batch_tier") == 4
+                   for r in recs)
+
+    def test_numpy_submission_stays_host_until_dispatch(self):
+        """numpy input is admitted without a device put and solves to the
+        same answer (the host-admission fast path)."""
+        a = np.asarray(matgen.random_dense(30, 20, seed=77,
+                                           dtype=jnp.float64))
+        with SVDService(_coalescing_cfg()) as svc:
+            res = svc.submit(a).result(timeout=180.0)
+        assert res.status is SolveStatus.OK
+        sref = np.linalg.svd(a, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(res.s), sref, atol=1e-12)
+
+    def test_nonfinite_numpy_rejected_at_door(self):
+        a = np.full((8, 8), np.nan)
+        with SVDService(_coalescing_cfg()) as svc:
+            with pytest.raises(AdmissionError) as ei:
+                svc.submit(a)
+            assert ei.value.reason is AdmissionReason.NONFINITE_INPUT
+
+    def test_mid_batch_cancel_and_deadline_decode(self):
+        """Coalesced-dispatch control decode: the min-over-members
+        deadline stops the batch (expired member -> DEADLINE with PARTIAL
+        factors, like the serial lane's mid-solve stops); a member
+        cancelled mid-solve decodes CANCELLED at finalize unless it
+        reached tolerance first."""
+        mats = [matgen.random_dense(32, 32, seed=60 + i,
+                                    dtype=jnp.float64) for i in range(2)]
+        svc = SVDService(_coalescing_cfg()).start()
+        try:
+            svc.warmup(timeout=300.0)   # no compile may eat the deadline
+            with chaos.slow_solve(0.15, shots=1):
+                t1 = svc.submit(mats[0], deadline_s=0.5)
+                t2 = svc.submit(mats[1], deadline_s=60.0)
+                time.sleep(0.2)
+                t2.cancel()
+                r1 = t1.result(timeout=120.0)
+                r2 = t2.result(timeout=120.0)
+        finally:
+            svc.stop(drain=False, timeout=30.0)
+        assert r1.status is SolveStatus.DEADLINE
+        assert r1.s is not None, "DEADLINE member must get partial factors"
+        assert r2.status is SolveStatus.CANCELLED
+        recs = [r for r in svc.records()
+                if not r["request"]["id"].startswith("warmup")]
+        assert {r.get("batch_id") for r in recs} == {recs[0]["batch_id"]}
+
+    def test_warmup_compiles_batched_tiers(self):
+        from svd_jacobi_tpu import solver
+        from svd_jacobi_tpu.analysis.recompile_guard import _cache_size
+        # A bucket/tier no other test touches, so the pre-warm cache
+        # cannot already hold it (the assertion is on NEW compiles).
+        svc = SVDService(_coalescing_cfg(
+            buckets=((34, 22, "float64"),), batch_tiers=(1, 3))).start()
+        try:
+            before = _cache_size(solver._sweep_step_xla_batched_jit)
+            svc.warmup(timeout=300.0)
+            after = _cache_size(solver._sweep_step_xla_batched_jit)
+            assert after > before, "warmup must compile the batched tiers"
+        finally:
+            svc.stop(drain=False, timeout=30.0)
+
+
+@pytest.mark.serve
+class TestQueuedCancelReleasesBudget:
+    """PR-5 satellite bugfix: a cancelled-while-queued request's deadline
+    promise is released AT CANCEL, not held until pop."""
+
+    def _req(self, rid, deadline_s):
+        now = time.monotonic()
+        t = Ticket(rid)
+        return Request(
+            id=rid, a=None, m=8, n=8, orig_shape=(8, 8), transposed=False,
+            bucket=Bucket(8, 8, "float32"), compute_u=True, compute_v=True,
+            degraded=False, deadline=now + deadline_s, deadline_s=deadline_s,
+            submitted=now, cancel=t._cancel, ticket=t)
+
+    def test_full_budget_queue_readmits_after_queued_cancel(self):
+        q = AdmissionQueue(max_depth=8, max_deadline_budget_s=100.0)
+        r1 = self._req("r1", 60.0)
+        r2 = self._req("r2", 39.0)
+        q.admit(r1)
+        q.admit(r2)
+        r3 = self._req("r3", 30.0)
+        with pytest.raises(AdmissionError) as ei:
+            q.admit(r3)
+        assert ei.value.reason is AdmissionReason.DEADLINE_BUDGET
+        # Cancel a QUEUED request: its promise must free immediately —
+        # no pop, no worker involvement.
+        r1.ticket.cancel()
+        q.admit(r3)   # re-admission now succeeds
+        assert q.depth() == 3
+
+    def test_pop_same_bucket_leaves_other_buckets_queued(self):
+        q = AdmissionQueue(max_depth=8)
+        b1, b2 = Bucket(8, 8, "float32"), Bucket(16, 16, "float32")
+        reqs = []
+        for i, b in enumerate([b1, b2, b1, b2, b1]):
+            r = self._req(f"r{i}", 60.0)
+            r = Request(**{**r.__dict__, "bucket": b})
+            q.admit(r)
+            reqs.append(r)
+        out = q.pop_same_bucket(b1, limit=8, deadline=None)
+        assert [r.id for r in out] == ["r0", "r2", "r4"]
+        assert q.depth() == 2
+        assert q.pop(0.01).bucket == b2
+
+
+@pytest.mark.serve
+class TestBatchedRetraceFixture:
+    """The batched compile-cache contract must demonstrably FAIL its
+    fixture: two distinct tiers against an under-declared budget is
+    exactly what a tier leak looks like."""
+
+    ENTRIES = ("solver._sweep_step_xla_batched_jit",)
+
+    def test_two_tiers_blow_underdeclared_budget(self):
+        from svd_jacobi_tpu import solver
+        from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+        entries = {e: getattr(solver, e.split(".", 1)[1])
+                   for e in self.ENTRIES}
+        mats = [matgen.random_dense(24, 24, seed=50 + i, dtype=jnp.float64)
+                for i in range(6)]
+        cfg = SVDConfig(block_size=4)
+        with RecompileGuard(budgets={e: 1 for e in self.ENTRIES},
+                            entries=entries) as guard:
+            for e in self.ENTRIES:
+                guard.expect(e, problems=1)   # under-declared on purpose
+            # Two DISTINCT batch tiers (2 and 4) through the batched
+            # stepper — a second problem key the declaration denies.
+            for count in (2, 4):
+                st = BatchedSweepStepper(jnp.stack(mats[:count]),
+                                         config=cfg)
+                state = st.init()
+                while st.should_continue(state):
+                    state = st.step(state)
+                st.finish(state)
+            findings = guard.check()
+        assert findings, "two tiers must blow an under-declared budget"
+        assert all(f.code == "RETRACE001" for f in findings)
+
+
+def test_build_serve_batch_fields_roundtrip():
+    from svd_jacobi_tpu.obs import manifest
+    rec = manifest.build_serve(
+        request_id="r1", m=8, n=8, dtype="float32", bucket="8x8:float32",
+        queue_wait_s=0.01, solve_time_s=0.02, status="OK", path="base",
+        breaker="closed", brownout="FULL", batch_id="b00007",
+        batch_size=3, batch_tier=4)
+    manifest.validate(rec)
+    assert (rec["batch_id"], rec["batch_size"], rec["batch_tier"]) == \
+        ("b00007", 3, 4)
+    assert "batch=b00007[3/4]" in manifest.summarize(rec)
+    single = manifest.build_serve(
+        request_id="r2", m=8, n=8, dtype="float32", bucket="8x8:float32",
+        queue_wait_s=0.01, solve_time_s=0.02, status="OK", path="base",
+        breaker="closed", brownout="FULL")
+    assert single["batch_id"] is None and single["batch_tier"] is None
