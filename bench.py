@@ -170,6 +170,10 @@ def _serve_throughput(flags) -> None:
 
     Flags: --bucket=MxN:dtype (default 64x64:float32)
            --tiers=1,16       (max_batch values to measure, in order)
+           --lanes=1,2        (fleet lane counts to measure per tier; a
+                               lanes>1 row also emits a lane-scaling
+                               ratio vs the lanes=1 row of its tier —
+                               PROFILE.md item 23)
            --requests=N --clients=C --batch-window-ms=W --deadline-s=D
     """
     import os
@@ -196,6 +200,7 @@ def _serve_throughput(flags) -> None:
     window_ms = float(flags.get("batch-window-ms", "25"))
     deadline_s = float(flags.get("deadline-s", "600"))
     tiers = [int(t) for t in flags.get("tiers", "1,16").split(",")]
+    lanes_list = [int(x) for x in flags.get("lanes", "1").split(",")]
     # --pair-solver=pallas pins the stacked kernel lane for buckets below
     # the auto threshold (n < 64) — tiny buckets are exactly where
     # coalescing pays most, and the stacked lane amortizes where the
@@ -212,13 +217,14 @@ def _serve_throughput(flags) -> None:
             for i in range(min(requests, 16))]
 
     rows = []
-    for max_batch in tiers:
+    for max_batch, n_lanes in [(t, l) for t in tiers for l in lanes_list]:
         cfg = ServeConfig(
             buckets=(bucket,), solver=solver_cfg,
             max_queue_depth=max(64, 4 * max_batch),
             max_batch=max_batch,
             batch_window_s=(window_ms / 1e3 if max_batch > 1 else 0.0),
             batch_tiers=((1, max_batch) if max_batch > 1 else (1,)),
+            lanes=n_lanes, steal=True,
             # Brownout off: a degraded response would change the work mix
             # between tiers and poison the comparison.
             brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
@@ -265,10 +271,12 @@ def _serve_throughput(flags) -> None:
                                      int(p * len(lat)))] * 1e3, 2)
              if lat else None)
         row = {
-            "metric": f"serve_throughput_{bucket.name}_b{max_batch}",
+            "metric": (f"serve_throughput_{bucket.name}_b{max_batch}"
+                       f"_l{n_lanes}"),
             "value": round(len(outcomes) / wall, 2),
             "unit": "requests/s",
             "max_batch": max_batch,
+            "lanes": n_lanes,
             "batch_window_ms": window_ms,
             "clients": clients,
             "requests": len(outcomes),
@@ -280,17 +288,33 @@ def _serve_throughput(flags) -> None:
         }
         print(json.dumps(row))
         rows.append(row)
-    if len(rows) >= 2 and rows[0]["max_batch"] == 1 and rows[0]["value"]:
-        base = rows[0]["value"]
-        for r in rows[1:]:
+    base_rows = {(r["max_batch"], r["lanes"]): r for r in rows}
+    base = base_rows.get((1, 1))
+    if base is not None and base["value"]:
+        for r in rows:
+            if r is base or r["lanes"] != 1:
+                continue
             print(json.dumps({
                 "metric": (f"serve_coalescing_speedup_{bucket.name}"
                            f"_b{r['max_batch']}"),
-                "value": round(r["value"] / base, 3),
+                "value": round(r["value"] / base["value"], 3),
                 "unit": "x vs batch-1",
                 "ok": (r["ok"] == r["requests"]
-                       and rows[0]["ok"] == rows[0]["requests"]),
+                       and base["ok"] == base["requests"]),
             }))
+    # Fleet lane scaling (PROFILE.md item 23): each lanes>1 row vs the
+    # lanes=1 row of the SAME batch tier.
+    for r in rows:
+        b1 = base_rows.get((r["max_batch"], 1))
+        if r["lanes"] == 1 or b1 is None or not b1["value"]:
+            continue
+        print(json.dumps({
+            "metric": (f"serve_lane_scaling_{bucket.name}"
+                       f"_b{r['max_batch']}_l{r['lanes']}"),
+            "value": round(r["value"] / b1["value"], 3),
+            "unit": "x vs 1 lane",
+            "ok": (r["ok"] == r["requests"] and b1["ok"] == b1["requests"]),
+        }))
 
 
 def _sweep(passthrough) -> None:

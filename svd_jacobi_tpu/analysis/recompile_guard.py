@@ -274,6 +274,87 @@ def run_serve_sequence() -> tuple:
     b_findings, b_report = _run_serve_batched_case()
     findings += b_findings
     report["batched"] = b_report
+    f_findings, f_report = run_serve_fleet_case()
+    findings += f_findings
+    report["fleet"] = f_report
+    return findings, report
+
+
+def run_serve_fleet_case(expected_problems: Optional[int] = None,
+                         buckets: Optional[tuple] = None) -> tuple:
+    """The per-LANE half of the serve retrace contract (fleet mode).
+
+    With ``lanes = 2`` each lane pins its working set to its own device,
+    so a lane's first dispatch of a bucket compiles that lane's own
+    executable — the per-lane jit cache. The contract: each lane
+    compiles once per (bucket, variant), and an AFFINITY MOVE (a bucket
+    served by a non-home lane after its home is quarantined, or via
+    stealing) costs at most ONE extra compile on the receiving lane —
+    repeats there must be cache hits. The sequence: serve each bucket on
+    its home lane (2 distinct shapes each), quarantine bucket 0's home
+    lane, serve bucket 0 twice more (now on lane 1 — the affinity move),
+    and expect exactly 3 compile-problems per serving entry: bucket 0 on
+    lane 0, bucket 1 on lane 1, bucket 0 on lane 1. On a single-device
+    host the lanes share one executable cache and come in UNDER budget —
+    over-budget is the only failure either way.
+
+    ``expected_problems`` under-declares the budget and ``buckets``
+    substitutes a FRESH (never-compiled) bucket pair for the seeded
+    failing fixture (tests prove the guard actually fires on a
+    per-request/per-dispatch leak — a warm cache would mask it)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..serve import LaneState, ServeConfig, SVDService
+    from ..utils import matgen
+
+    problems = 3 if expected_problems is None else int(expected_problems)
+    buckets = _SERVE_SEQUENCE_BUCKETS if buckets is None else tuple(buckets)
+    # Two distinct request shapes per bucket: exact fit + strictly
+    # smaller (both pad to the bucket — the once-per-bucket claim).
+    shapes = [((m, n), (m - 4, n - 8)) for m, n, _ in buckets]
+    cfg = ServeConfig(
+        buckets=buckets,
+        solver=SVDConfig(pair_solver="pallas"),
+        max_queue_depth=8, lanes=2, steal=False,
+        # The supervisor must not probe the deliberately-quarantined
+        # lane back to ACTIVE mid-measurement.
+        lane_probe_interval_s=600.0,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    statuses = []
+
+    def _serve(svc, bucket_shapes, seed0):
+        tickets = [svc.submit(matgen.random_dense(m, n, seed=seed0 + i,
+                                                  dtype=jnp.float32))
+                   for i, (m, n) in enumerate(bucket_shapes)]
+        statuses.extend(t.result(timeout=600.0).status for t in tickets)
+
+    with RecompileGuard() as guard:
+        for entry in _SERVE_ENTRIES:
+            guard.expect(entry, problems=problems)
+        with SVDService(cfg) as svc:
+            # Home-lane phase: 2 distinct shapes per bucket, repeated —
+            # repeats are cache hits on the home lane.
+            for _ in range(2):
+                _serve(svc, shapes[0], seed0=7000)
+                _serve(svc, shapes[1], seed0=7100)
+            # Affinity move: quarantine bucket 0's home lane; its
+            # traffic fails over to lane 1 (one compile there), repeats
+            # stay cache hits.
+            svc.fleet.evict(svc.fleet.lanes[0], "analysis_forced")
+            assert svc.fleet.lanes[0].state is LaneState.QUARANTINED
+            for _ in range(2):
+                _serve(svc, shapes[0], seed0=7200)
+        findings = guard.check()
+        report = guard.report()
+    report["serve_statuses"] = [getattr(s, "name", None) for s in statuses]
+    if any(s is None or s.name != "OK" for s in statuses):
+        findings.append(Finding(
+            code="RETRACE001", where="serve.run_serve_fleet_case",
+            message=(f"fleet serve sequence produced non-OK statuses "
+                     f"{report['serve_statuses']} — the retrace "
+                     f"measurement is not trustworthy on a failing solve"),
+            suggestion="fix the fleet serving solve path first"))
     return findings, report
 
 

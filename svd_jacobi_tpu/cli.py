@@ -190,6 +190,11 @@ def _parse_serve_args(argv):
                    help="bounded batching window: max wait for same-"
                         "bucket followers after the first pop (only with "
                         "--max-batch > 1)")
+    p.add_argument("--lanes", type=int, default=1,
+                   help="solve lanes (fleet mode when > 1): one worker "
+                        "per lane, per-lane fault domains with bucket-"
+                        "affinity routing, work stealing, and lane "
+                        "eviction/rescue/probe recovery")
     p.add_argument("--report-dir", default="reports",
                    help="manifest directory (per-request 'serve' JSONL "
                         "records appended to <dir>/manifest.jsonl); "
@@ -234,7 +239,8 @@ def serve_demo(argv) -> int:
                       max_queue_depth=args.queue_depth,
                       manifest_path=manifest_path,
                       max_batch=max(1, args.max_batch),
-                      batch_window_s=max(0.0, args.batch_window_ms) / 1e3)
+                      batch_window_s=max(0.0, args.batch_window_ms) / 1e3,
+                      lanes=max(1, args.lanes))
     svc = SVDService(cfg)
 
     # Seeded request plan, built up front so the run is reproducible: a

@@ -95,7 +95,18 @@ _SERVE_SCHEMA: Dict[str, Any] = {
     # dispatch (micro-batched solve lane; all None on a single dispatch).
     # Optional-by-forward-compatibility: records written before the
     # batching lane lack them, so they ride as extra keys rather than
-    # required schema fields.
+    # required schema fields. In fleet mode (ServeConfig.lanes > 1) a
+    # ``lane`` extra key carries the dispatching lane index.
+}
+# Fleet events ("fleet", written by serve.fleet in lanes mode): one
+# record per lane state transition / rescue / steal / probe / healthz
+# snapshot / ladder_overrun, so the whole eviction -> rescue -> recovery
+# history of a multi-lane service reconstructs from the manifest stream
+# alone. ``lane`` is None for fleet-wide events (e.g. healthz).
+_FLEET_SCHEMA: Dict[str, Any] = {
+    "event": str,                 # lane_transition | rescue | steal |
+                                  # probe | healthz | ladder_overrun
+    "lane": (int, type(None)),
 }
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
@@ -252,6 +263,30 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
     return record
 
 
+def build_fleet(*, event: str, lane: Optional[int] = None, **extra) -> dict:
+    """Assemble a schema-valid fleet event record (`serve.fleet`).
+
+    ``event`` enumerates the fleet happenings worth reconstructing:
+    ``lane_transition`` (with ``from_state``/``to_state``/``cause``
+    extras), ``rescue`` (``count``/``request_ids``), ``steal``
+    (``victim``/``request_id``), ``probe`` (``ok``/``request_id``),
+    ``healthz`` (a fleet snapshot dict), and ``ladder_overrun`` (the
+    escalation-ladder watchdog fired — ``elapsed_s``/``budget_s``).
+    ``lane`` is the subject lane's index, or None for fleet-wide events.
+    ``extra`` rides along like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "fleet",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "event": str(event),
+        "lane": None if lane is None else int(lane),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def _check(cond: bool, errors: List[str], msg: str) -> None:
     if not cond:
         errors.append(msg)
@@ -293,6 +328,8 @@ def validate(record: dict) -> None:
                           errors)
     elif record.get("kind") == "serve":
         _check_fields(record, _SERVE_SCHEMA, "record", errors)
+    elif record.get("kind") == "fleet":
+        _check_fields(record, _FLEET_SCHEMA, "record", errors)
     else:
         _check_fields(record, _SOLVE_SCHEMA, "record", errors)
         for i, st in enumerate(record.get("stages") or []):
@@ -362,6 +399,28 @@ def summarize(record: dict) -> str:
                          f"sweeps={at.get('sweeps', '?'):>3} off={off_s}  "
                          f"{at.get('time_s', 0.0):7.2f} s")
         return "\n".join(lines)
+    if record.get("kind") == "fleet":
+        lane = record.get("lane")
+        line = (f"fleet {record.get('event', '?')} @ "
+                f"{record.get('timestamp', '?')}"
+                + (f"  lane={lane}" if lane is not None else ""))
+        if record.get("event") == "lane_transition":
+            line += (f"  {record.get('from_state', '?')} -> "
+                     f"{record.get('to_state', '?')} "
+                     f"({record.get('cause', '?')})")
+        elif record.get("event") == "rescue":
+            line += (f"  {record.get('count', '?')} request(s) "
+                     f"{record.get('request_ids', [])}")
+        elif record.get("event") == "steal":
+            line += (f"  {record.get('request_id', '?')} from lane "
+                     f"{record.get('victim', '?')}")
+        elif record.get("event") == "probe":
+            line += (f"  {'ok' if record.get('ok') else 'FAILED'} "
+                     f"({record.get('request_id', '?')})")
+        elif record.get("event") == "ladder_overrun":
+            line += (f"  elapsed={record.get('elapsed_s', float('nan')):.2f}s"
+                     f" budget={record.get('budget_s', float('nan')):.2f}s")
+        return line
     if record.get("kind") == "serve":
         req = record.get("request", {})
         wait = record.get("queue_wait_s", float("nan"))

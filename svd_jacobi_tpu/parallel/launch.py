@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import time
 from typing import Optional
 
@@ -39,6 +40,27 @@ from ..utils import matgen
 
 # Monkeypatch seam for the retry tests (and anyone who wants virtual time).
 _sleep = time.sleep
+# Jitter source — module-level so tests can seed/replace it. Deliberately
+# NOT seeded from anything process-deterministic: the whole point is that
+# two processes of the same fleet draw DIFFERENT delays.
+_rng = random.Random()
+
+
+def _backoff_delay(base_s: float, prev_s: float,
+                   cap_s: float = 30.0) -> float:
+    """Decorrelated-jitter backoff delay (the AWS Architecture Blog
+    recipe): uniform in ``[base_s, min(cap_s, 3 * prev_s)]``.
+
+    Fixed-multiple exponential backoff synchronizes a FLEET: when N
+    worker processes are restarted together and all fail their first
+    coordinator connect, ``base * 2^k`` has all N retry at the same
+    instants — a thundering herd that can re-knock-over the coordinator
+    it is waiting for. Decorrelated jitter keeps the expected growth
+    (each delay ranges up to 3x the previous one) while spreading the
+    N retries uniformly across the window, and ``cap_s`` bounds the
+    worst-case single wait."""
+    hi = min(float(cap_s), 3.0 * max(float(prev_s), float(base_s)))
+    return _rng.uniform(float(base_s), hi)
 
 # RuntimeError texts worth retrying: transient coordinator bring-up races
 # (refused/unreachable/deadline). Anything else — wrong address, mismatched
@@ -72,6 +94,7 @@ def initialize(
     local_device_ids: Optional[list] = None,
     connect_retries: int = 4,
     connect_backoff_s: float = 0.5,
+    connect_backoff_cap_s: float = 30.0,
 ) -> DistributedContext:
     """Bootstrap multi-host JAX; safe to call on a single process.
 
@@ -83,12 +106,16 @@ def initialize(
     context — the same code path then runs single-host, like the reference
     run with `mpiexec -np 1`.
 
-    Coordinator connection is retried with exponential backoff
-    (``connect_retries`` retries, delays ``connect_backoff_s * 2^k``): on
-    cold pod bring-up the coordinator process routinely comes up seconds
-    after its workers, and the first connect used to fail the whole job on
-    one transient refusal. "Already initialized" errors are never retried
-    — they are a programming-order problem, not a transient one.
+    Coordinator connection is retried with DECORRELATED-JITTER backoff
+    (``connect_retries`` retries; each delay uniform in
+    ``[connect_backoff_s, min(connect_backoff_cap_s, 3 * previous)]`` —
+    see `_backoff_delay`): on cold pod bring-up the coordinator process
+    routinely comes up seconds after its workers, and the first connect
+    used to fail the whole job on one transient refusal; the jitter
+    de-synchronizes a multi-process fleet restart so N workers do not
+    thundering-herd the coordinator at fixed multiples. "Already
+    initialized" errors are never retried — they are a programming-order
+    problem, not a transient one.
     """
     explicit = (coordinator_address is not None
                 or num_processes is not None
@@ -98,6 +125,7 @@ def initialize(
             and not _compat.distributed_is_initialized()):
         _compat.enable_cpu_collectives()
         attempt = 0
+        prev_delay = connect_backoff_s
         while True:
             try:
                 jax.distributed.initialize(
@@ -138,7 +166,9 @@ def initialize(
                     raise RuntimeError(
                         f"coordinator connect failed after {attempt + 1} "
                         f"attempt(s): {e}") from e
-                delay = connect_backoff_s * (2.0 ** attempt)
+                delay = _backoff_delay(connect_backoff_s, prev_delay,
+                                       connect_backoff_cap_s)
+                prev_delay = delay
                 import warnings
                 warnings.warn(
                     f"coordinator connect attempt {attempt + 1} failed "

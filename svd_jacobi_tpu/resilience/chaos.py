@@ -30,6 +30,24 @@ detection/recovery machinery of this repo actually works:
     un-deadlined test forever). Drives the circuit-breaker lane: stuck
     requests time out, consecutive timeouts trip the breaker, and
     recovery runs through the escalation ladder.
+  * **lane injectors** (`kill_lane` / `wedge_lane` / `poison_lane`) —
+    fleet-mode faults targeted at ONE solve lane of a multi-lane
+    `serve.SVDService` (`ServeConfig.lanes > 1`), driving the lane
+    supervisor's whole eviction -> rescue -> probe-recovery ladder:
+      - `kill_lane(lane)`: the lane's worker thread raises `LaneKilled`
+        (a BaseException, so no per-dispatch handler can swallow it) at
+        its next dispatch and DIES with the request still in flight —
+        the supervisor must detect the dead thread, quarantine the
+        lane, and rescue the stranded request onto a healthy lane;
+      - `wedge_lane(lane, wedge_s)`: the lane blocks NON-cooperatively
+        (no heartbeat, control ignored) for up to ``wedge_s`` at its
+        next dispatch — the heartbeat watchdog must evict it; the bound
+        exists so an undetected wedge cannot hang a test forever;
+      - `poison_lane(lane, shots)`: the lane's next ``shots`` dispatches
+        solve NaN-poisoned working sets and surface
+        ``SolveStatus.NONFINITE`` — repeated bad outcomes must evict
+        the lane, and once the shots are exhausted a recovery probe
+        solves clean and returns it to ACTIVE.
 
 Everything here is deterministic: a hook fires at an exact sweep index /
 byte offset, never at random, so chaos-lane failures replay exactly.
@@ -55,6 +73,20 @@ _sigterm_sweep: Optional[int] = None
 # follow the same arm-context-manager / consume-one-shot protocol
 # (`_armed` / `_consume`).
 _serve_faults: dict = {"slow": None, "stuck": None}
+# Lane-targeted fleet faults: {"lane": int, "value": float, "shots": int}
+# per kind — consumed only by dispatches of the TARGETED lane, so a
+# multi-lane test hits exactly the lane it armed for.
+_lane_faults: dict = {"kill": None, "wedge": None, "poison": None}
+
+
+class LaneKilled(BaseException):
+    """Raised inside a lane worker by an armed `kill_lane` hook.
+
+    Deliberately a BaseException: the dispatch loop's last-ditch
+    ``except Exception`` handlers must NOT catch it — the point of the
+    injector is a worker thread that dies with its request stranded in
+    flight, which only the fleet supervisor's dead-lane rescue can then
+    save (the property under test)."""
 
 
 @contextlib.contextmanager
@@ -157,6 +189,78 @@ def stuck_backend(shots: int = 1, max_stall_s: float = 30.0):
 def consume_stuck() -> Optional[float]:
     """The stuck-backend hook's stall bound in seconds, or None."""
     return _consume("stuck")
+
+
+@contextlib.contextmanager
+def _lane_armed(kind: str, lane: int, value: float, shots: int):
+    """Shared arm/restore protocol of the lane-targeted fault slots."""
+    with _lock:
+        prev = _lane_faults[kind]
+        _lane_faults[kind] = {"lane": int(lane), "value": float(value),
+                              "shots": int(shots)}
+    try:
+        yield
+    finally:
+        with _lock:
+            _lane_faults[kind] = prev
+
+
+def _lane_consume(kind: str, lane: int) -> Optional[float]:
+    """One lane dispatch's view of a lane fault slot: the armed value
+    (decrementing the shot budget) when THIS lane is the target, else
+    None — a fault armed for lane 1 is invisible to lane 0."""
+    with _lock:
+        st = _lane_faults[kind]
+        if st is None or st["shots"] <= 0 or st["lane"] != int(lane):
+            return None
+        st["shots"] -= 1
+        return st["value"]
+
+
+def kill_lane(lane: int, shots: int = 1):
+    """Arm a lane-worker kill: the targeted lane raises `LaneKilled` at
+    its next ``shots`` dispatches, AFTER publishing the popped request as
+    in-flight — the worker thread dies with the request stranded, the
+    exact failure shape of a process/device loss mid-solve. Recovery is
+    entirely the fleet supervisor's job (dead-thread detection ->
+    quarantine -> rescue -> probe respawn)."""
+    return _lane_armed("kill", lane, 0.0, shots)
+
+
+def consume_kill(lane: int) -> bool:
+    """True when this lane's dispatch must raise `LaneKilled`."""
+    return _lane_consume("kill", lane) is not None
+
+
+def wedge_lane(lane: int, wedge_s: float = 10.0, shots: int = 1):
+    """Arm a non-cooperative lane wedge: the targeted lane blocks for up
+    to ``wedge_s`` seconds at its next ``shots`` dispatches WITHOUT
+    heartbeating or polling any control — indistinguishable from a hung
+    device to the supervisor, which must evict it on heartbeat
+    staleness. Bounded so an undetected wedge cannot hang a test; a
+    wedged worker that finally wakes finds its lane generation stale and
+    exits without touching the (already rescued) request."""
+    return _lane_armed("wedge", lane, wedge_s, shots)
+
+
+def consume_wedge(lane: int) -> Optional[float]:
+    """The wedge bound in seconds for this lane's dispatch, or None."""
+    return _lane_consume("wedge", lane)
+
+
+def poison_lane(lane: int, shots: int = 1):
+    """Arm lane-scoped solve poison: the targeted lane's next ``shots``
+    dispatches NaN-poison their padded working set before the stepper is
+    built, so the solve surfaces ``SolveStatus.NONFINITE`` through the
+    production health word (never a shortcut status). Drives the
+    bad-outcome eviction ladder; once the shots run out, a recovery
+    probe on the same lane solves clean."""
+    return _lane_armed("poison", lane, 0.0, shots)
+
+
+def consume_poison(lane: int) -> bool:
+    """True when this lane's dispatch must poison its working set."""
+    return _lane_consume("poison", lane) is not None
 
 
 @contextlib.contextmanager

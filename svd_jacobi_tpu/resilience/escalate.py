@@ -107,6 +107,8 @@ def resilient_svd(
     max_attempts: Optional[int] = None,
     manifest_path=None,
     return_report: bool = False,
+    watchdog_s: Optional[float] = None,
+    on_overrun=None,
 ):
     """`svd()` with guarded inputs and a bounded escalation ladder.
 
@@ -120,8 +122,26 @@ def resilient_svd(
 
     ``manifest_path``: append one ``"retry"`` record (`obs.manifest`)
     describing every attempt. ``return_report``: also return the episode
-    report dict ``{"attempts": [...], "final_status": ..., "scale_pow2"}``.
+    report dict ``{"attempts": [...], "final_status": ..., "scale_pow2",
+    "watchdog_overrun"}``.
+
+    ``watchdog_s``: wall-clock overrun watchdog. The ladder runs FUSED
+    entry points and is uncancellable once entered — nothing here can
+    abort a compiled solve mid-flight — so the watchdog's job is to make
+    an overrun LOUD AND ACTIONABLE instead of a silent hang: when the
+    episode runs past ``watchdog_s`` a daemon timer fires ONCE,
+    appending a ``ladder_overrun`` fleet-schema manifest record (when
+    ``manifest_path`` is set) and calling ``on_overrun(info)`` with
+    ``{"elapsed_s", "budget_s", "m", "n"}``. The serving fleet passes an
+    ``on_overrun`` that marks the dispatching lane unhealthy, so the
+    supervisor evicts the lane and rescues its queued requests rather
+    than the whole service blocking behind the ladder (e.g. in
+    ``stop(drain=False)``). The ladder itself continues and still
+    returns its result; ``report["watchdog_overrun"]`` says whether the
+    watchdog fired.
     """
+    import threading
+
     import jax.numpy as jnp
 
     from .. import obs
@@ -163,38 +183,73 @@ def resilient_svd(
     if max_attempts is not None:
         plan = plan[:max(1, int(max_attempts))]
 
-    attempts = []
-    result = None
-    for rung, cfg_i in plan:
-        t0 = time.perf_counter()
-        if cfg_i is None:
-            result = _lapack_fallback(a_s, compute_u, compute_v,
-                                      full_matrices)
-        else:
-            result = run(cfg_i)
-        status = SolveStatus(int(host_scalar(result.status)))
-        off = float(host_scalar(result.off_rel))
-        attempts.append({
-            "rung": rung,
-            "status": status.name,
-            "time_s": time.perf_counter() - t0,
-            "sweeps": int(host_scalar(result.sweeps)),
-            "off_norm": off if math.isfinite(off) else None,
-            "config_sha256": (obs.manifest.config_hash(cfg_i)
-                              if cfg_i is not None else None),
-        })
-        if status == SolveStatus.OK:
-            break
+    # Wall-clock overrun watchdog (see docstring): a one-shot daemon
+    # timer — the ladder cannot be aborted, but an overrun must be
+    # recorded and reported the moment it happens, not after the fused
+    # solve deigns to return.
+    overrun = {"fired": False}
+    t_episode = time.monotonic()
+
+    def _watchdog_fire():
+        overrun["fired"] = True
+        info = {"elapsed_s": time.monotonic() - t_episode,
+                "budget_s": float(watchdog_s),
+                "m": int(a.shape[0]), "n": int(a.shape[1])}
+        if manifest_path is not None:
+            try:
+                obs.manifest.append(manifest_path, obs.manifest.build_fleet(
+                    event="ladder_overrun", lane=None, **info))
+            except Exception:
+                pass  # the watchdog must never raise into the timer thread
+        if on_overrun is not None:
+            try:
+                on_overrun(info)
+            except Exception:
+                pass
+
+    timer = None
+    if watchdog_s is not None:
+        timer = threading.Timer(float(watchdog_s), _watchdog_fire)
+        timer.daemon = True
+        timer.start()
+
+    try:
+        attempts = []
+        result = None
+        for rung, cfg_i in plan:
+            t0 = time.perf_counter()
+            if cfg_i is None:
+                result = _lapack_fallback(a_s, compute_u, compute_v,
+                                          full_matrices)
+            else:
+                result = run(cfg_i)
+            status = SolveStatus(int(host_scalar(result.status)))
+            off = float(host_scalar(result.off_rel))
+            attempts.append({
+                "rung": rung,
+                "status": status.name,
+                "time_s": time.perf_counter() - t0,
+                "sweeps": int(host_scalar(result.sweeps)),
+                "off_norm": off if math.isfinite(off) else None,
+                "config_sha256": (obs.manifest.config_hash(cfg_i)
+                                  if cfg_i is not None else None),
+            })
+            if status == SolveStatus.OK:
+                break
+    finally:
+        if timer is not None:
+            timer.cancel()
 
     if scale_p:
         result = result._replace(s=guard.unscale_sigma(result.s, scale_p))
     report = {"attempts": attempts,
               "final_status": attempts[-1]["status"],
-              "scale_pow2": scale_p}
+              "scale_pow2": scale_p,
+              "watchdog_overrun": overrun["fired"]}
     if manifest_path is not None:
         record = obs.manifest.build_retry(
             m=a.shape[0], n=a.shape[1], dtype=str(a.dtype), config=config,
             attempts=attempts, final_status=report["final_status"],
-            scale_pow2=scale_p)
+            scale_pow2=scale_p, watchdog_overrun=overrun["fired"])
         obs.manifest.append(manifest_path, record)
     return (result, report) if return_report else result

@@ -16,7 +16,13 @@ The request-level robustness layer (PR 4) on top of the solve-level one
     plus queue-pressure brownout (full SVD -> sigma-only -> shed)
     (`breaker`);
   * health/readiness probes and per-request schema-versioned ``"serve"``
-    manifest records (`obs.manifest.build_serve`) (`service`).
+    manifest records (`obs.manifest.build_serve`) (`service`);
+  * fleet mode (``ServeConfig.lanes > 1``, `fleet`): one solve lane per
+    device, each its own fault domain, with bucket-affinity routing,
+    work stealing, lane eviction into QUARANTINED on the declared
+    sickness causes, dead-lane request rescue onto healthy lanes, and
+    outcome-caused probe recovery — all reconstructable from ``"fleet"``
+    manifest records.
 
 Quickstart::
 
@@ -36,11 +42,13 @@ from __future__ import annotations
 
 from .breaker import BreakerState, Brownout, CircuitBreaker
 from .buckets import Bucket, BucketSet, as_bucket
+from .fleet import Fleet, Lane, LaneState
 from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
 from .service import ServeConfig, ServeResult, SVDService, Ticket
 
 __all__ = [
     "AdmissionError", "AdmissionQueue", "AdmissionReason", "Bucket",
-    "BucketSet", "BreakerState", "Brownout", "CircuitBreaker", "Request",
-    "ServeConfig", "ServeResult", "SVDService", "Ticket", "as_bucket",
+    "BucketSet", "BreakerState", "Brownout", "CircuitBreaker", "Fleet",
+    "Lane", "LaneState", "Request", "ServeConfig", "ServeResult",
+    "SVDService", "Ticket", "as_bucket",
 ]

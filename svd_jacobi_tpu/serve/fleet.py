@@ -1,0 +1,478 @@
+"""Solve-lane fleet — per-lane fault domains for the serving layer.
+
+The reference funnels every rotation round through a single MPI root
+rank (one process dies, the whole solve is lost), and the pre-fleet
+`SVDService` reproduced that shape at the serving layer: ONE worker
+thread driving one device was a single fault domain for the entire
+service. This module is the fix: with ``ServeConfig.lanes > 1`` the
+service runs N independent solve lanes, and the blast radius of a
+wedged, killed, or numerically-poisoned lane is that lane alone.
+
+**Lane** — one fault domain: its own `AdmissionQueue`, its own
+`CircuitBreaker`, its own worker thread (respawnable: a lane survives
+its thread), its own device (round-robin over `jax.devices()`, so each
+lane's jit executables compile against its own device — the per-lane
+compile cache the retrace contract budgets), and its own health
+counters (heartbeat, consecutive NONFINITE/ERROR outcomes, dispatches
+spent with the breaker stuck OPEN).
+
+**Routing** — bucket affinity with work stealing: every declared bucket
+has a home lane (bucket order modulo lane count), so a bucket's jit
+cache stays hot on one lane; requests route to the home lane, falling
+over to the next ACTIVE lane when the home is quarantined. An idle lane
+steals the oldest non-probe request off the deepest ACTIVE sibling
+queue — throughput is not left on the floor because the hot bucket's
+home lane is backed up.
+
+**Supervision** — the robustness core. A supervisor thread watches every
+lane and EVICTS sick ones into QUARANTINED on any of the declared
+causes:
+
+  * ``lane_dead``       — the worker thread died (`chaos.kill_lane`,
+    or any uncaught dispatch-loop error);
+  * ``heartbeat_stale`` — no heartbeat for ``lane_heartbeat_timeout_s``
+    (the per-lane watchdog around dispatch: the worker beats at pop,
+    pre-dispatch, and every sweep — `chaos.wedge_lane` is exactly a
+    heartbeat hole);
+  * ``bad_outcomes``    — ``lane_failure_threshold`` consecutive
+    NONFINITE/ERROR dispatch outcomes (`chaos.poison_lane`);
+  * ``breaker_stuck_open`` — ``lane_open_threshold`` consecutive
+    dispatches left the lane breaker OPEN (the ladder is not healing);
+  * ``ladder_overrun``  — the escalation ladder's wall-clock watchdog
+    fired on this lane (`resilience.escalate`, flagged via
+    `flag_unhealthy`).
+
+Eviction **rescues** the lane's requests: everything still queued, plus
+the in-flight requests of a dead/stale/overrun lane, is re-routed onto
+a healthy lane at the FRONT of its queue (they already waited their
+turn). Rescue respects each request's remaining deadline budget — a
+request whose deadline already passed finalizes DEADLINE on the spot,
+a cancelled one CANCELLED, and when no healthy lane exists the request
+finalizes ERROR loudly. A ticket can be finalized by the rescue path
+and (later) by a wedged worker that finally wakes; `Ticket` finalizes
+exactly once, first writer wins, so no request is ever double-served
+or silently lost.
+
+**Recovery** is outcome-caused, the same way the circuit breaker
+recovers: the supervisor periodically sends a PROBE (a zeros solve of
+the smallest bucket, pinned to the lane — never stolen) through the
+quarantined lane's normal dispatch path, respawning the worker thread
+if it died. A probe that solves OK returns the lane to ACTIVE; a
+failing probe leaves it quarantined until the next one. No wall-clock
+amnesty: a lane comes back because a dispatch SUCCEEDED on it.
+
+Every transition, rescue, steal, and probe appends a schema-versioned
+``"fleet"`` manifest record (`obs.manifest.build_fleet`), so the whole
+eviction -> rescue -> recovery history reconstructs from the same JSONL
+stream as the per-request ``"serve"`` records.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
+
+
+class LaneState(enum.Enum):
+    ACTIVE = "active"
+    QUARANTINED = "quarantined"
+
+
+class Lane:
+    """One solve lane: queue + breaker + worker thread + health state.
+
+    Mutable health fields (`heartbeat`, `bad_streak`, `open_streak`,
+    `unhealthy_flag`) are written by the lane's worker and read by the
+    supervisor; each is a single reference assignment (atomic under the
+    GIL), and the supervisor only ever acts on a *stale* view in the
+    direction of caution (an extra tick of patience, never a lost
+    eviction). State transitions themselves go through the fleet's
+    lock."""
+
+    def __init__(self, index: int, *, max_depth: int, budget_s: float,
+                 breaker_threshold: int, device=None):
+        from .breaker import CircuitBreaker
+        self.index = int(index)
+        self.queue = AdmissionQueue(max_depth, budget_s)
+        self.breaker = CircuitBreaker(breaker_threshold)
+        self.device = device          # None = default placement (lanes=1)
+        self.state = LaneState.ACTIVE
+        # Bumped at every eviction: a worker captures the generation at
+        # spawn and exits when it no longer matches, so a wedged thread
+        # that finally wakes cannot dispatch for a lane that moved on.
+        self.generation = 0
+        self.thread: Optional[threading.Thread] = None
+        self.heartbeat = time.monotonic()
+        # True while the worker is blocked inside a stepper/device call
+        # (incl. cold-cache jit compiles): the supervisor then judges
+        # staleness against the longer lane_step_timeout_s.
+        self.in_step = False
+        self.bad_streak = 0           # consecutive NONFINITE/ERROR outcomes
+        self.open_streak = 0          # consecutive dispatches breaker OPEN
+        self.unhealthy_flag: Optional[str] = None  # e.g. "ladder_overrun"
+        self.in_flight: List[Request] = []  # guarded by the service lock
+        self.dispatches = 0
+        self.steals = 0               # requests this lane stole
+        self.rescued_off = 0          # requests rescued OFF this lane
+        self.probe_ticket = None
+        self.last_probe = 0.0
+        self.transitions: List[tuple] = []
+
+    def beat(self) -> None:
+        """Heartbeat: the worker proves liveness at pop, pre-dispatch,
+        and every sweep boundary."""
+        self.heartbeat = time.monotonic()
+
+    def note_outcome(self, status_name: str, breaker_state) -> None:
+        """Per-dispatch health bookkeeping (worker thread only)."""
+        from .breaker import BreakerState
+        self.dispatches += 1
+        if status_name in ("NONFINITE", "ERROR"):
+            self.bad_streak += 1
+        else:
+            self.bad_streak = 0
+        self.open_streak = (self.open_streak + 1
+                            if breaker_state is BreakerState.OPEN else 0)
+
+    def snapshot(self) -> dict:
+        """Health view of this lane (fleet healthz / manifest)."""
+        return {
+            "lane": self.index,
+            "state": self.state.value,
+            "device": None if self.device is None else str(self.device),
+            "alive": bool(self.thread is not None
+                          and self.thread.is_alive()),
+            "queue_depth": self.queue.depth(),
+            "breaker": self.breaker.state().value,
+            "heartbeat_age_s": time.monotonic() - self.heartbeat,
+            "in_step": self.in_step,
+            "bad_streak": self.bad_streak,
+            "open_streak": self.open_streak,
+            "dispatches": self.dispatches,
+            "steals": self.steals,
+            "rescued_off": self.rescued_off,
+            "in_flight": [r.id for r in self.in_flight],
+        }
+
+
+class Fleet:
+    """The lane set + supervisor of one `SVDService` (see module
+    docstring). Single-lane services get a trivial fleet — one always-
+    ACTIVE lane, no supervisor, no stealing, no device pinning — so the
+    lanes=1 behavior is exactly the pre-fleet service."""
+
+    def __init__(self, service):
+        cfg = service.config
+        self.service = service
+        self.size = int(cfg.lanes)
+        devices = self._lane_devices(cfg)
+        self.lanes = [
+            Lane(i, max_depth=cfg.max_queue_depth,
+                 budget_s=cfg.max_deadline_budget_s,
+                 breaker_threshold=cfg.breaker_threshold,
+                 device=devices[i])
+            for i in range(self.size)]
+        # Bucket affinity: declaration order modulo lane count. Stable
+        # across the service's lifetime so a bucket's jit cache stays
+        # hot on one lane.
+        self._bucket_home = {b: i % self.size
+                             for i, b in enumerate(service.buckets)}
+        self.total_steals = 0
+        self.total_rescues = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sup_thread: Optional[threading.Thread] = None
+        self._probe_seq = itertools.count()
+
+    def _lane_devices(self, cfg) -> list:
+        """Per-lane device assignment: None everywhere for a single lane
+        (default placement — the pre-fleet behavior), round-robin over
+        `jax.devices()` otherwise, so each lane compiles and runs its
+        own executables against its own device when the host has more
+        than one."""
+        if self.size == 1:
+            return [None]
+        import jax
+        devices = jax.devices()
+        return [devices[i % len(devices)] for i in range(self.size)]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        for lane in self.lanes:
+            self.service._spawn_worker(lane)
+        if self.size > 1:
+            self._sup_thread = threading.Thread(
+                target=self._supervise, name="svdj-fleet-supervisor",
+                daemon=True)
+            self._sup_thread.start()
+
+    def stop_supervisor(self, timeout: Optional[float] = None) -> None:
+        self._stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout)
+
+    def any_active_alive(self) -> bool:
+        return any(l.state is LaneState.ACTIVE and l.thread is not None
+                   and l.thread.is_alive() for l in self.lanes)
+
+    # -- routing ------------------------------------------------------------
+
+    def route(self, bucket) -> Lane:
+        """The lane a request for ``bucket`` is queued on: its home lane
+        when ACTIVE, else the next ACTIVE lane in index order. Raises
+        `AdmissionError(NO_LANE)` when every lane is quarantined — the
+        fleet cannot promise an answer and says so at the door."""
+        home = self._bucket_home.get(bucket, 0)
+        for k in range(self.size):
+            lane = self.lanes[(home + k) % self.size]
+            if lane.state is LaneState.ACTIVE:
+                return lane
+        raise AdmissionError(
+            AdmissionReason.NO_LANE,
+            f"all {self.size} solve lanes are quarantined")
+
+    def steal_for(self, thief: Lane) -> Optional[Request]:
+        """Work stealing: pop the oldest non-probe request off the
+        deepest ACTIVE sibling queue for an idle ``thief`` lane."""
+        victim, best = None, 0
+        for lane in self.lanes:
+            if lane is thief or lane.state is not LaneState.ACTIVE:
+                continue
+            d = lane.queue.depth()
+            if d > best:
+                victim, best = lane, d
+        if victim is None:
+            return None
+        req = victim.queue.steal_oldest()
+        if req is None:
+            return None
+        thief.steals += 1
+        with self._lock:
+            self.total_steals += 1
+        self.service._record_fleet(event="steal", lane=thief.index,
+                                   victim=victim.index, request_id=req.id)
+        return req
+
+    # -- eviction / rescue --------------------------------------------------
+
+    def flag_unhealthy(self, lane: Lane, cause: str) -> None:
+        """Mark a lane for eviction at the next supervisor tick (used by
+        the escalation-ladder watchdog, which fires on a thread that is
+        still inside the uncancellable ladder)."""
+        lane.unhealthy_flag = str(cause)
+
+    def evict(self, lane: Lane, cause: str) -> None:
+        """Quarantine a sick lane and rescue its requests (see module
+        docstring). Idempotent: a lane already quarantined is left
+        alone."""
+        with self._lock:
+            if lane.state is not LaneState.ACTIVE:
+                return
+            lane.state = LaneState.QUARANTINED
+            lane.generation += 1
+            lane.unhealthy_flag = None
+            lane.bad_streak = 0
+            lane.open_streak = 0
+            # The recovery-probe clock starts at EVICTION: the first
+            # probe runs a full lane_probe_interval_s later, never in
+            # the same supervisor tick (an instant probe would race the
+            # rescue and, on a lane that died mid-compile, just die
+            # again).
+            lane.last_probe = time.monotonic()
+        lane.transitions.append(("active", "quarantined", cause))
+        self.service._record_fleet(
+            event="lane_transition", lane=lane.index, from_state="active",
+            to_state="quarantined", cause=cause)
+        # Rescue scope: everything queued, always; the in-flight
+        # requests only when the worker is not making progress (dead /
+        # stale / stuck in the uncancellable ladder) — an alive worker
+        # evicted for bad OUTCOMES finalizes its current dispatch itself
+        # and exits at the generation check.
+        rescued = lane.queue.drain()
+        if cause in ("lane_dead", "heartbeat_stale", "ladder_overrun",
+                     "stale_worker"):
+            with self.service._lock:
+                rescued += [r for r in lane.in_flight if r not in rescued]
+                # A dead/stale worker never reaches its own clearing
+                # finally-block: clear here or healthz reports the
+                # rescued (long-terminal) request as in flight forever.
+                lane.in_flight = []
+        self.rescue_requests(lane, rescued, cause=cause)
+        self.service._record_fleet(event="healthz", lane=None,
+                                   healthz=self.healthz())
+
+    def rescue_requests(self, lane: Lane, reqs, *, cause: str) -> None:
+        """Re-route a sick lane's requests onto healthy lanes: expired ->
+        DEADLINE, cancelled -> CANCELLED, no healthy lane -> ERROR (all
+        loud, none silent), otherwise requeued at the FRONT of the
+        target lane's queue with the original deadline intact. Exactly-
+        once is the ticket's guarantee: if the sick lane's worker later
+        finalizes the same request, one of the two writes is a no-op."""
+        svc = self.service
+        now = time.monotonic()
+        moved = []
+        for req in reqs:
+            if req.ticket is not None and req.ticket.done():
+                continue
+            if req.probe:
+                # A probe never moves lanes — it exists to test THIS
+                # lane. Finalize it failed; the supervisor sends a new
+                # one later.
+                svc._finalize_rescue(req, "ERROR",
+                                     error=f"lane {lane.index} evicted "
+                                           f"({cause}) during probe",
+                                     lane=lane)
+                continue
+            if req.cancel.is_set():
+                svc._finalize_rescue(req, "CANCELLED", lane=lane)
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                # The remaining deadline budget is spent — requeueing
+                # would serve a request its client already gave up on.
+                svc._finalize_rescue(req, "DEADLINE", lane=lane)
+                continue
+            target = self._route_excluding(req.bucket, lane)
+            if target is None or not target.queue.requeue(req):
+                svc._finalize_rescue(
+                    req, "ERROR",
+                    error=f"lane {lane.index} evicted ({cause}) and no "
+                          f"healthy lane to rescue onto", lane=lane)
+                continue
+            moved.append(req.id)
+        lane.rescued_off += len(moved)
+        with self._lock:
+            self.total_rescues += len(moved)
+        svc._record_fleet(event="rescue", lane=lane.index, cause=cause,
+                          count=len(moved), request_ids=moved)
+
+    def _route_excluding(self, bucket, exclude: Lane) -> Optional[Lane]:
+        home = self._bucket_home.get(bucket, 0)
+        for k in range(self.size):
+            lane = self.lanes[(home + k) % self.size]
+            if lane is not exclude and lane.state is LaneState.ACTIVE:
+                return lane
+        return None
+
+    # -- recovery -----------------------------------------------------------
+
+    def restore(self, lane: Lane, cause: str) -> None:
+        with self._lock:
+            if lane.state is not LaneState.QUARANTINED:
+                return
+            lane.state = LaneState.ACTIVE
+            lane.bad_streak = 0
+            lane.open_streak = 0
+            lane.unhealthy_flag = None
+            lane.beat()
+        lane.transitions.append(("quarantined", "active", cause))
+        self.service._record_fleet(
+            event="lane_transition", lane=lane.index,
+            from_state="quarantined", to_state="active", cause=cause)
+        self.service._record_fleet(event="healthz", lane=None,
+                                   healthz=self.healthz())
+
+    def _probe(self, lane: Lane, now: float) -> None:
+        """Drive a quarantined lane's recovery probe (supervisor tick)."""
+        svc = self.service
+        ticket = lane.probe_ticket
+        if ticket is not None:
+            if not ticket.done():
+                if lane.thread is None or not lane.thread.is_alive():
+                    # The probe's worker died under it: probe failed.
+                    lane.probe_ticket = None
+                    svc._record_fleet(event="probe", lane=lane.index,
+                                      ok=False,
+                                      request_id=ticket.request_id,
+                                      error="probe worker died")
+                return
+            res = ticket.result(0)
+            lane.probe_ticket = None
+            from ..solver import SolveStatus
+            ok = res.error is None and res.status is SolveStatus.OK
+            svc._record_fleet(event="probe", lane=lane.index, ok=bool(ok),
+                              request_id=ticket.request_id, error=res.error)
+            if ok:
+                self.restore(lane, "probe success")
+            return
+        if now - lane.last_probe < svc.config.lane_probe_interval_s:
+            return
+        lane.last_probe = now
+        if lane.thread is None or not lane.thread.is_alive():
+            svc._spawn_worker(lane)    # a lane survives its thread
+        import numpy as np
+        from .service import Ticket
+        b = min(svc.buckets, key=lambda b: b.cost)
+        rid = f"probe-l{lane.index}-{next(self._probe_seq)}"
+        ticket = Ticket(rid)
+        req = Request(
+            id=rid, a=np.zeros((b.m, b.n), np.dtype(b.dtype)), m=b.m,
+            n=b.n, orig_shape=(b.m, b.n), transposed=False, bucket=b,
+            compute_u=False, compute_v=False, degraded=False,
+            deadline=now + svc.config.lane_probe_timeout_s,
+            deadline_s=svc.config.lane_probe_timeout_s, submitted=now,
+            cancel=ticket._cancel, ticket=ticket, probe=True)
+        # Straight onto the lane's queue, bypassing admission: routing
+        # excludes quarantined lanes, and THIS lane is the whole point.
+        if lane.queue.requeue(req):
+            lane.probe_ticket = ticket
+
+    # -- supervision --------------------------------------------------------
+
+    def _supervise(self) -> None:
+        interval = self.service.config.supervise_interval_s
+        while not self._stop.wait(interval):
+            try:
+                self._tick()
+            except Exception as e:  # the supervisor must outlive surprises
+                print(f"svdj-fleet: supervisor tick failed: {e}",
+                      file=sys.stderr)
+
+    def _tick(self, now: Optional[float] = None) -> None:
+        cfg = self.service.config
+        now = time.monotonic() if now is None else now
+        for lane in self.lanes:
+            if lane.state is LaneState.ACTIVE:
+                cause = None
+                if lane.unhealthy_flag is not None:
+                    cause = lane.unhealthy_flag
+                elif lane.thread is not None and not lane.thread.is_alive():
+                    cause = "lane_dead"
+                elif (now - lane.heartbeat > (
+                        cfg.lane_step_timeout_s if lane.in_step
+                        else cfg.lane_heartbeat_timeout_s)
+                        and (lane.in_flight or lane.queue.depth() > 0)):
+                    # Staleness only matters when the lane HOLDS work:
+                    # there is nothing to rescue off an idle lane, and a
+                    # loaded host can starve an idle worker's poll loop
+                    # past the timeout without anything being wrong —
+                    # evicting it would just churn the fleet.
+                    cause = "heartbeat_stale"
+                elif lane.bad_streak >= cfg.lane_failure_threshold:
+                    cause = "bad_outcomes"
+                elif lane.open_streak >= cfg.lane_open_threshold:
+                    cause = "breaker_stuck_open"
+                if cause is not None:
+                    self.evict(lane, cause)
+            elif self.service._accepting:
+                self._probe(lane, now)
+
+    # -- views --------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        lanes = [l.snapshot() for l in self.lanes]
+        return {
+            "lanes": lanes,
+            "active": sum(1 for l in lanes if l["state"] == "active"),
+            "quarantined": sum(1 for l in lanes
+                               if l["state"] == "quarantined"),
+            "steals": self.total_steals,
+            "rescues": self.total_rescues,
+        }

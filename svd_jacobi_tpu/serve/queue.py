@@ -38,6 +38,10 @@ class AdmissionReason(enum.Enum):
     NONFINITE_INPUT = "nonfinite_input"
     BROWNOUT_SHED = "brownout_shed"
     SHUTDOWN = "shutdown"
+    # Fleet mode only: every solve lane is quarantined/dead — the fleet
+    # cannot promise an answer, so it rejects loudly instead of queueing
+    # onto a lane nobody will pop.
+    NO_LANE = "no_lane"
 
 
 class AdmissionError(RuntimeError):
@@ -71,6 +75,9 @@ class Request:
     cancel: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     ticket: Any = None
+    # Fleet-internal lane-recovery probe (serve.fleet): pinned to its
+    # quarantined lane — never stolen, never rescued onto another lane.
+    probe: bool = False
 
 
 class AdmissionQueue:
@@ -161,7 +168,9 @@ class AdmissionQueue:
             return self._q.popleft()
 
     def pop_same_bucket(self, bucket: Bucket, limit: int,
-                        deadline: Optional[float] = None) -> List[Request]:
+                        deadline: Optional[float] = None,
+                        max_bypass_age: Optional[float] = None
+                        ) -> List[Request]:
         """Pop up to ``limit`` queued requests routed to ``bucket`` — the
         coalescing window pop of the batched serving lane. Blocks until
         ``limit`` are collected, the absolute `time.monotonic()`
@@ -170,19 +179,39 @@ class AdmissionQueue:
         order. Requests of OTHER buckets stay queued in order — a
         coalesced same-bucket request can therefore be served ahead of an
         earlier other-bucket one, the documented reordering the batching
-        window trades for the coalescing win."""
+        window trades for the coalescing win.
+
+        ``max_bypass_age`` bounds that reordering (anti-starvation): once
+        the oldest queued request of ANOTHER bucket has waited longer
+        than this many seconds, coalescing may not bypass it any further
+        — same-bucket requests queued BEHIND it are left alone and the
+        window closes immediately, so the starved request is the next
+        plain `pop`. None disables the bound (the pre-fleet behavior:
+        a hot bucket could starve a rarely-requested one for as long as
+        the hot stream kept the window busy)."""
         out: List[Request] = []
         if limit <= 0:
             return out
         with self._cond:
             while True:
-                for r in list(self._q):
+                now = time.monotonic()
+                snapshot = list(self._q)
+                barrier = None
+                if max_bypass_age is not None:
+                    for i, r in enumerate(snapshot):
+                        if (r.bucket != bucket
+                                and now - r.submitted > max_bypass_age):
+                            barrier = i
+                            break
+                for i, r in enumerate(snapshot):
                     if len(out) >= limit:
+                        break
+                    if barrier is not None and i >= barrier:
                         break
                     if r.bucket == bucket:
                         self._q.remove(r)
                         out.append(r)
-                if len(out) >= limit or self._closed:
+                if len(out) >= limit or self._closed or barrier is not None:
                     return out
                 timeout = (None if deadline is None
                            else deadline - time.monotonic())
@@ -190,6 +219,33 @@ class AdmissionQueue:
                     return out
                 if not self._cond.wait(timeout):
                     return out
+
+    def requeue(self, req: Request) -> bool:
+        """Re-enqueue a RESCUED request at the FRONT of the queue (it
+        already waited its turn on the lane that failed it), bypassing
+        the depth/budget admission rules — rescue must never turn into a
+        silent drop because the healthy lane happens to be busy. Returns
+        False when the queue is closed (the service is stopping; the
+        caller finalizes the request loudly instead)."""
+        with self._cond:
+            if self._closed:
+                return False
+            self._q.appendleft(req)
+            self._cond.notify()
+            return True
+
+    def steal_oldest(self) -> Optional[Request]:
+        """Pop the oldest NON-PROBE queued request for an idle sibling
+        lane (work stealing). Probe requests are pinned to their
+        quarantined lane — stealing one would let a healthy lane
+        'recover' a lane it never ran on. Returns None when nothing is
+        stealable; never blocks."""
+        with self._cond:
+            for r in self._q:
+                if not r.probe:
+                    self._q.remove(r)
+                    return r
+            return None
 
     def drain(self) -> List[Request]:
         """Remove and return everything queued (shutdown without drain:

@@ -30,9 +30,15 @@ layer on top of PR 3's solve-level one:
     service history reconstructs from the same manifest stream the rest
     of the tooling reads; `healthz`/`ready` expose live probes.
 
-The worker is a single thread: the device executes one solve at a time
-anyway, and a serial worker makes every breaker/brownout transition
-deterministic. Clients are free-threaded; `Ticket.result` blocks with a
+With ``lanes == 1`` (the default) the worker is a single thread: the
+device executes one solve at a time anyway, and a serial worker makes
+every breaker/brownout transition deterministic. With ``lanes > 1`` the
+service is a **fleet** (`fleet.Fleet`): one solve lane per device, each
+lane its own fault domain (own queue, own breaker, own jit executables,
+own health state), bucket-affinity routing with work stealing, and a
+supervisor that evicts sick lanes, rescues their requests onto healthy
+ones, and probes them back to ACTIVE — see the `fleet` module
+docstring. Clients are free-threaded; `Ticket.result` blocks with a
 timeout.
 """
 
@@ -46,9 +52,10 @@ import time
 from typing import Any, NamedTuple, Optional, Tuple
 
 from ..config import DEFAULT_BATCH_TIERS, DEFAULT_SERVE_BUCKETS, SVDConfig
-from .breaker import BreakerState, Brownout, CircuitBreaker
+from .breaker import BreakerState, Brownout
 from .buckets import BucketSet
-from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
+from .fleet import Fleet, Lane, LaneState
+from .queue import AdmissionError, AdmissionReason, Request
 
 
 class ServeResult(NamedTuple):
@@ -83,12 +90,27 @@ class Ticket:
         self._done = threading.Event()
         self._result: Optional[ServeResult] = None
         self._cancel = threading.Event()
+        self._finalize_lock = threading.Lock()
 
     def cancel(self) -> None:
         self._cancel.set()
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def _finalize_once(self, result: ServeResult) -> bool:
+        """Install the terminal result EXACTLY once; False when another
+        finalizer already won. In fleet mode the same request can be
+        finalized by its (sick) original lane AND by the rescue path —
+        first writer wins, the loser's write is a no-op, and the caller
+        skips its stats/manifest bookkeeping on False so every request
+        appears terminal exactly once everywhere."""
+        with self._finalize_lock:
+            if self._done.is_set():
+                return False
+            self._result = result
+            self._done.set()
+            return True
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         if not self._done.wait(timeout):
@@ -139,6 +161,56 @@ class ServeConfig:
     # jits compile once per (bucket, tier) and the compile cache stays
     # bounded. Tiers above ``max_batch`` are simply never used.
     batch_tiers: tuple = DEFAULT_BATCH_TIERS
+    # Anti-starvation bound on the coalescing window: once the oldest
+    # queued request of ANOTHER bucket has waited this long, same-bucket
+    # coalescing may not bypass it any further (see
+    # `AdmissionQueue.pop_same_bucket`). None disables the bound.
+    batch_bypass_age_s: Optional[float] = 0.5
+    # --- fleet mode (`fleet` module): per-lane fault domains -------------
+    # Solve lanes: 1 = the single-worker service (exact pre-fleet
+    # behavior); > 1 = one worker per lane, each lane its own fault
+    # domain with its own queue/breaker/device, bucket-affinity routing,
+    # work stealing, and the lane supervisor (eviction -> rescue ->
+    # probe recovery). max_queue_depth / max_deadline_budget_s are
+    # PER-LANE limits.
+    lanes: int = 1
+    # Evict a lane whose worker has not heartbeat (pop / pre-dispatch /
+    # per-sweep) for this long — the wedged-lane watchdog. Applies to
+    # the HOST-SIDE dispatch loop; while the worker is blocked inside a
+    # stepper/device call (`lane.in_step`, which legitimately stalls for
+    # a full jit COMPILE on a cold cache) the longer
+    # ``lane_step_timeout_s`` governs instead.
+    lane_heartbeat_timeout_s: float = 2.0
+    # Heartbeat budget while blocked inside one device/compile step:
+    # must exceed the worst-case legitimate compile (minutes-class on
+    # TPU; `warmup()` front-loads them). A lane whose thread is stuck in
+    # a runtime call PAST this is unrecoverable in-process — it is
+    # evicted, its requests rescued, and the probe respawns a fresh
+    # worker thread for the lane (a lane survives its thread).
+    lane_step_timeout_s: float = 300.0
+    # Evict after this many CONSECUTIVE NONFINITE/ERROR dispatch
+    # outcomes on one lane (a poisoned device keeps failing solves that
+    # succeed elsewhere).
+    lane_failure_threshold: int = 3
+    # Evict after this many consecutive dispatches that left the lane's
+    # breaker OPEN (the escalation ladder is not healing this lane).
+    lane_open_threshold: int = 4
+    # Supervisor tick; also bounds eviction-detection latency.
+    supervise_interval_s: float = 0.05
+    # Quarantined-lane recovery probes: at most one probe per lane per
+    # interval, each a zeros solve of the smallest bucket with this
+    # deadline.
+    lane_probe_interval_s: float = 0.25
+    lane_probe_timeout_s: float = 60.0
+    # Work stealing: an idle lane pops the oldest request off the
+    # deepest ACTIVE sibling queue.
+    steal: bool = True
+    # Wall-clock watchdog on the uncancellable escalation ladder: when a
+    # ladder dispatch runs past this, a `ladder_overrun` fleet manifest
+    # record is written and (fleet mode) the dispatching lane is flagged
+    # unhealthy — evicted with its queued requests rescued — instead of
+    # wedging the service behind it. None disables.
+    ladder_watchdog_s: Optional[float] = None
 
 
 class SVDService:
@@ -160,31 +232,52 @@ class SVDService:
                              f"positive ints, got {config.batch_tiers!r}")
         if config.batch_window_s < 0:
             raise ValueError("batch_window_s must be >= 0")
+        if config.lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {config.lanes}")
+        if (config.lane_heartbeat_timeout_s <= 0
+                or config.lane_step_timeout_s <= 0
+                or config.supervise_interval_s <= 0):
+            raise ValueError("lane_heartbeat_timeout_s, "
+                             "lane_step_timeout_s and "
+                             "supervise_interval_s must be > 0")
+        if config.lane_failure_threshold < 1 or config.lane_open_threshold < 1:
+            raise ValueError("lane_failure_threshold and "
+                             "lane_open_threshold must be >= 1")
         self._tiers = tiers
         self.config = config
         self.buckets = BucketSet(config.buckets)
-        self.queue = AdmissionQueue(config.max_queue_depth,
-                                    config.max_deadline_budget_s)
-        self.breaker = CircuitBreaker(config.breaker_threshold)
         self._records: list = []
         self._stats: dict = {}
         self._lock = threading.Lock()
         self._accepting = False
         self._drain = True
-        self._thread: Optional[threading.Thread] = None
-        self._in_flight: Optional[Request] = None
-        # Every member of the in-flight dispatch (== [_in_flight] for a
-        # single solve): stop(drain=False) must cancel them ALL — the
-        # batched control only fires when every member cancelled.
-        self._in_flight_all: list = []
         self._seq = itertools.count()
         self._batch_seq = itertools.count()
+        # The lane set (a trivial one-lane fleet when lanes == 1) owns
+        # the queues, breakers, worker threads, and — in fleet mode —
+        # the supervisor. Built last: it reads config/buckets above.
+        self.fleet = Fleet(self)
+
+    # -- lane-0 views (the whole service when lanes == 1) -------------------
+
+    @property
+    def queue(self):
+        """Lane 0's admission queue — THE queue when ``lanes == 1`` (the
+        pre-fleet surface tests and tooling poke); one lane of several
+        in fleet mode (see ``fleet.lanes`` for all of them)."""
+        return self.fleet.lanes[0].queue
+
+    @property
+    def breaker(self):
+        """Lane 0's circuit breaker (see `queue`)."""
+        return self.fleet.lanes[0].breaker
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "SVDService":
         with self._lock:
-            if self._thread is not None and self._thread.is_alive():
+            if any(l.thread is not None and l.thread.is_alive()
+                   for l in self.fleet.lanes):
                 raise RuntimeError("service already started")
             if self.queue.closed_and_empty():
                 raise RuntimeError(
@@ -192,53 +285,73 @@ class SVDService:
                     "restartable — build a new one")
             self._accepting = True
             self._drain = True
-            self._thread = threading.Thread(target=self._worker,
-                                            name="svdj-serve", daemon=True)
-            self._thread.start()
+            self.fleet.start()
         return self
+
+    def _spawn_worker(self, lane: Lane) -> None:
+        """(Re)spawn a lane's worker thread for its CURRENT generation
+        (the fleet probes call this to revive a dead lane)."""
+        thread = threading.Thread(
+            target=self._worker_entry, args=(lane,),
+            name=f"svdj-serve-l{lane.index}", daemon=True)
+        lane.thread = thread
+        thread.start()
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None
              ) -> None:
-        """Stop accepting; drain the queue (default) or finalize every
+        """Stop accepting; drain the queues (default) or finalize every
         queued request with CANCELLED — either way every admitted request
         reaches a terminal status."""
         with self._lock:
             self._accepting = False
             self._drain = bool(drain)
-            thread = self._thread
+            threads = [l.thread for l in self.fleet.lanes
+                       if l.thread is not None]
+        # Supervisor first: a rescue racing shutdown would requeue onto
+        # a queue that is about to close (requeue refuses and the rescue
+        # finalizes ERROR — loud but misleading at shutdown).
+        self.fleet.stop_supervisor(timeout=timeout)
         # Close BEFORE draining: admit and close share the queue lock, so
         # every submit either enqueued before this point (and is drained
-        # below or served by the worker) or raises SHUTDOWN — no request
+        # below or served by a worker) or raises SHUTDOWN — no request
         # can be admitted onto a queue nobody will pop.
-        self.queue.close()
+        for lane in self.fleet.lanes:
+            lane.queue.close()
         if not drain:
             self._cancel_queued()
-            # Also cancel the IN-FLIGHT solve (cooperatively — it stops at
-            # the next sweep boundary and finalizes CANCELLED), so a
-            # no-drain stop is not blocked behind a long solve and the
-            # running request still reaches a terminal status. The ladder
-            # path cannot be interrupted mid-fused-solve; join() rides it
-            # out up to ``timeout``.
+            # Also cancel the IN-FLIGHT solves (cooperatively — each
+            # stops at the next sweep boundary and finalizes CANCELLED),
+            # so a no-drain stop is not blocked behind a long solve and
+            # running requests still reach a terminal status. The ladder
+            # path cannot be interrupted mid-fused-solve; join() rides
+            # it out up to ``timeout``.
             with self._lock:
-                inflight = list(self._in_flight_all)
+                inflight = [r for l in self.fleet.lanes
+                            for r in l.in_flight]
             for req in inflight:
                 req.cancel.set()
-        if thread is not None:
-            thread.join(timeout)
-            if not thread.is_alive():
-                # Belt-and-braces: the worker is gone, so anything still
-                # queued (it cannot be, by the close/drain protocol, short
-                # of a worker crash) is finalized, never stranded.
-                self._cancel_queued()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for thread in threads:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            thread.join(remaining)
+        # Belt-and-braces: anything still queued anywhere (a crashed or
+        # quarantined lane's leftovers the supervisor no longer rescues)
+        # is finalized, never stranded.
+        if all(not t.is_alive() for t in threads):
+            self._cancel_queued()
 
     def _cancel_queued(self) -> None:
-        for req in self.queue.drain():
-            wait = time.monotonic() - req.submitted
-            self._finalize(req, status_name="CANCELLED",
-                           result=self._control_result(
-                               req, "CANCELLED", wait),
-                           queue_wait=wait, solve_time=None, path="base",
-                           breaker_state=self.breaker.state())
+        for lane in self.fleet.lanes:
+            for req in lane.queue.drain():
+                wait = time.monotonic() - req.submitted
+                self._finalize(req, status_name="CANCELLED",
+                               result=self._control_result(
+                                   req, "CANCELLED", wait),
+                               queue_wait=wait, solve_time=None,
+                               path="base",
+                               breaker_state=lane.breaker.state(),
+                               lane=lane.index)
 
     def warmup(self, *, sigma_only: bool = True,
                timeout: float = 600.0) -> None:
@@ -285,13 +398,40 @@ class SVDService:
                         f"variant (status={status}, degraded="
                         f"{res.degraded}, path={res.path}, breaker now "
                         f"{self.breaker.state().value})")
+        # Fleet mode: affinity routed each bucket's warmup submit to its
+        # HOME lane only — also pre-compile every (bucket, variant)
+        # against every OTHER lane's device (direct zero solves, like
+        # the batched warmup below), so the first affinity move, steal,
+        # or rescue onto a sibling lane is not a compile stall in the
+        # middle of a failover.
+        if self.fleet.size > 1:
+            from ..solver import SweepStepper
+            for lane in self.fleet.lanes:
+                for b in self.buckets:
+                    for cu, cv in variants:
+                        a = self._place(
+                            jnp.zeros((b.m, b.n), jnp.dtype(b.dtype)),
+                            lane)
+                        st = SweepStepper(a, compute_u=cu, compute_v=cv,
+                                          config=self.config.solver)
+                        state = self._place(st.init(), lane)
+                        while st.should_continue(state):
+                            state = st.step(state)
+                        res = st.finish(state)
+                        if res.status_enum() is not SolveStatus.OK:
+                            raise RuntimeError(
+                                f"fleet warmup (lane {lane.index}, "
+                                f"bucket {b.name}, vec={cu}/{cv}) did "
+                                f"not solve OK: "
+                                f"{res.status_enum().name}")
         # Batched tiers: pre-compile every (bucket, tier, variant) the
         # coalescing worker can dispatch — incl. the sigma-only brownout
         # variants — so the FIRST coalesced dispatch is not a compile
         # stall mid-traffic. Direct zero-stack solves (a deterministic
         # tier-T dispatch cannot be forced through the admission queue
         # without racing the batching window); all-zero members deflate in
-        # one sweep, so the cost is the compiles.
+        # one sweep, so the cost is the compiles. In fleet mode, once per
+        # LANE (each lane runs its own per-device executables).
         if self.config.max_batch > 1:
             import numpy as _np
 
@@ -299,24 +439,29 @@ class SVDService:
             cap = min(self.config.max_batch, self._tiers[-1])
             reachable = sorted({min(t for t in self._tiers if t >= c)
                                 for c in range(2, cap + 1)})
-            for b in self.buckets:
-                for cu, cv in variants:
-                    for tier in reachable:
-                        a = jnp.zeros((tier, b.m, b.n),
-                                      jnp.dtype(b.dtype))
-                        st = BatchedSweepStepper(
-                            a, compute_u=cu, compute_v=cv,
-                            config=self.config.solver)
-                        state = st.init()
-                        while st.should_continue(state):
-                            state = st.step(state)
-                        res = st.finish(state)
-                        codes = [int(c) for c in _np.asarray(res.status)]
-                        if any(c != int(SolveStatus.OK) for c in codes):
-                            raise RuntimeError(
-                                f"batched warmup (bucket {b.name}, tier "
-                                f"{tier}, vec={cu}/{cv}) did not solve "
-                                f"OK: statuses {codes}")
+            for lane in self.fleet.lanes:
+                for b in self.buckets:
+                    for cu, cv in variants:
+                        for tier in reachable:
+                            a = self._place(
+                                jnp.zeros((tier, b.m, b.n),
+                                          jnp.dtype(b.dtype)), lane)
+                            st = BatchedSweepStepper(
+                                a, compute_u=cu, compute_v=cv,
+                                config=self.config.solver)
+                            state = self._place(st.init(), lane)
+                            while st.should_continue(state):
+                                state = st.step(state)
+                            res = st.finish(state)
+                            codes = [int(c)
+                                     for c in _np.asarray(res.status)]
+                            if any(c != int(SolveStatus.OK)
+                                   for c in codes):
+                                raise RuntimeError(
+                                    f"batched warmup (lane "
+                                    f"{lane.index}, bucket {b.name}, "
+                                    f"tier {tier}, vec={cu}/{cv}) did "
+                                    f"not solve OK: statuses {codes}")
 
     def __enter__(self) -> "SVDService":
         return self.start()
@@ -327,27 +472,34 @@ class SVDService:
     # -- probes -------------------------------------------------------------
 
     def ready(self) -> bool:
-        """Readiness: accepting work with a live worker."""
+        """Readiness: accepting work with at least one ACTIVE lane whose
+        worker is alive (every lane, when ``lanes == 1``)."""
         with self._lock:
-            return bool(self._accepting and self._thread is not None
-                        and self._thread.is_alive())
+            return bool(self._accepting and self.fleet.any_active_alive())
 
     def healthz(self) -> dict:
-        """Liveness + load snapshot (cheap; safe to poll)."""
+        """Liveness + load snapshot (cheap; safe to poll). Top-level
+        keys keep their single-worker meaning (``breaker`` is lane 0's,
+        depth/budget aggregate over lanes); ``fleet`` carries the
+        per-lane detail — states, heartbeat ages, streaks, steal/rescue
+        counts."""
         with self._lock:
-            alive = self._thread is not None and self._thread.is_alive()
-            in_flight = (self._in_flight.id
-                         if self._in_flight is not None else None)
+            alive = any(l.thread is not None and l.thread.is_alive()
+                        for l in self.fleet.lanes)
+            in_flight = next((r.id for l in self.fleet.lanes
+                              for r in l.in_flight), None)
             stats = dict(self._stats)
         return {
             "ok": alive,
             "ready": self.ready(),
             "breaker": self.breaker.state().value,
             "brownout": self._brownout().name,
-            "queue_depth": self.queue.depth(),
-            "deadline_budget_s": self.queue.deadline_budget(),
+            "queue_depth": sum(l.queue.depth() for l in self.fleet.lanes),
+            "deadline_budget_s": sum(l.queue.deadline_budget()
+                                     for l in self.fleet.lanes),
             "in_flight": in_flight,
             "stats": stats,
+            "fleet": self.fleet.healthz(),
         }
 
     def records(self) -> list:
@@ -362,7 +514,11 @@ class SVDService:
     # -- admission ----------------------------------------------------------
 
     def _brownout(self) -> Brownout:
-        fill = self.queue.depth() / self.queue.max_depth
+        # Aggregate fill over the fleet: brownout is an overload signal,
+        # and a fleet with one backed-up lane but idle siblings is not
+        # overloaded (stealing will drain it).
+        fill = (sum(l.queue.depth() for l in self.fleet.lanes)
+                / sum(l.queue.max_depth for l in self.fleet.lanes))
         if fill >= self.config.brownout_shed_at:
             return Brownout.SHED
         if fill >= self.config.brownout_sigma_only_at:
@@ -474,7 +630,21 @@ class SVDService:
                           else now + float(deadline_s)),
                 deadline_s=deadline_s, submitted=now,
                 cancel=ticket._cancel, ticket=ticket)
-            self.queue.admit(req)
+            # Bucket-affinity routing: the bucket's home lane, or the
+            # next ACTIVE one (lane 0 always, when lanes == 1). Raises
+            # NO_LANE when the whole fleet is quarantined.
+            lane = self.fleet.route(bucket)
+            lane.queue.admit(req)
+            if lane.state is not LaneState.ACTIVE:
+                # Admission raced an eviction: evict() flips the state
+                # BEFORE draining, so either its rescue drain saw this
+                # request (ordinary rescue) or we see the quarantined
+                # state here — re-drain so nothing is stranded on a lane
+                # whose worker is gone until a probe revives it.
+                stranded = lane.queue.drain()
+                if stranded:
+                    self.fleet.rescue_requests(lane, stranded,
+                                               cause="admit_race")
         except AdmissionError as e:
             self._bump("rejected", f"rejected:{e.reason.value}")
             self._record(request_id=rid, orig_shape=orig_shape, dtype=dtype,
@@ -489,29 +659,94 @@ class SVDService:
 
     # -- worker -------------------------------------------------------------
 
-    def _worker(self) -> None:
+    # Fleet-mode pop timeout: lanes must wake to steal work and notice
+    # eviction; a single lane keeps the blocking no-idle-polling pop.
+    _FLEET_POLL_S = 0.05
+
+    def _worker_entry(self, lane: Lane) -> None:
+        """Thread target: run the lane worker; a `chaos.LaneKilled`
+        injection (a BaseException no dispatch handler may swallow)
+        terminates the thread here, with its request stranded in flight
+        — recovering it is the fleet supervisor's job, which is the
+        property the injector exists to test."""
+        from ..resilience import chaos
+        try:
+            self._worker(lane)
+        except chaos.LaneKilled:
+            pass
+
+    def _worker(self, lane: Lane) -> None:
+        from ..resilience import chaos
+        gen = lane.generation
+        single = self.fleet.size == 1
+        poll = None if single else self._FLEET_POLL_S
         while True:
-            # Blocking pop — no idle polling; `admit` and `close` notify.
-            req = self.queue.pop(None)
+            if lane.generation != gen:
+                return     # evicted: a respawned worker owns this lane now
+            lane.beat()
+            # Blocking pop when single (no idle polling; `admit` and
+            # `close` notify); bounded in fleet mode so an idle lane can
+            # steal and a superseded one can exit.
+            stolen = False
+            req = lane.queue.pop(poll)
             if req is None:
-                # Exit only when the queue is closed AND empty — atomic
-                # with admission, so no admitted request is left behind.
-                if self.queue.closed_and_empty():
-                    break
-                continue
+                if lane.queue.closed_and_empty():
+                    return
+                if (not single and self.config.steal
+                        and lane.state is LaneState.ACTIVE
+                        and lane.generation == gen):
+                    req = self.fleet.steal_for(lane)
+                    stolen = req is not None
+                if req is None:
+                    continue
+            if lane.generation != gen:
+                # Evicted between pop and dispatch: this worker may not
+                # serve anymore — hand the request to the rescue path.
+                self.fleet.rescue_requests(lane, [req],
+                                           cause="stale_worker")
+                return
             batch = [req]
             if self.config.max_batch > 1:
                 # Coalesce same-bucket followers under the bounded
                 # batching window: first-request wait <= batch_window_s,
                 # never past the first request's own deadline (members
                 # that expire DURING the window finalize pre-dispatch
-                # without spending a sweep, as today).
+                # without spending a sweep, as today), and never
+                # bypassing another bucket's request older than
+                # batch_bypass_age_s (anti-starvation).
                 limit = min(self.config.max_batch, self._tiers[-1]) - 1
-                window = time.monotonic() + self.config.batch_window_s
-                if req.deadline is not None:
+                # A STOLEN head request's same-bucket followers live on
+                # the victim's queue, not this one (which was empty —
+                # that is why the lane stole): take only what is queued
+                # NOW instead of blocking an already-delayed request for
+                # a window that cannot fill.
+                window = (None if stolen
+                          else time.monotonic() + self.config.batch_window_s)
+                if window is not None and req.deadline is not None:
                     window = min(window, req.deadline)
-                batch += self.queue.pop_same_bucket(req.bucket, limit,
-                                                    window)
+                batch += lane.queue.pop_same_bucket(
+                    req.bucket, limit, window,
+                    max_bypass_age=self.config.batch_bypass_age_s)
+            # Lane chaos (fleet tests): a kill strands the batch in
+            # flight and dies — published FIRST so the supervisor's
+            # dead-lane rescue has something to find; a wedge blocks
+            # with no heartbeat until evicted (stale generation) or the
+            # bound passes.
+            if chaos.consume_kill(lane.index):
+                with self._lock:
+                    lane.in_flight = list(batch)
+                raise chaos.LaneKilled(f"chaos kill_lane({lane.index})")
+            wedge = chaos.consume_wedge(lane.index)
+            if wedge is not None:
+                with self._lock:
+                    lane.in_flight = list(batch)
+                t_end = time.monotonic() + wedge
+                while time.monotonic() < t_end and lane.generation == gen:
+                    time.sleep(0.005)
+                with self._lock:
+                    lane.in_flight = []
+                if lane.generation != gen:
+                    return   # evicted while wedged; batch already rescued
             with self._lock:
                 drain = self._drain or self._accepting
             try:
@@ -524,14 +759,15 @@ class SVDService:
                             result=self._control_result(r, "CANCELLED",
                                                         wait),
                             queue_wait=wait, solve_time=None, path="base",
-                            breaker_state=self.breaker.state())
+                            breaker_state=lane.breaker.state(),
+                            lane=lane.index)
                 elif len(batch) == 1:
-                    self._serve_one(req)
+                    self._serve_one(lane, req)
                 else:
-                    self._serve_batch(batch)
+                    self._serve_batch(lane, batch)
             except BaseException as e:  # last ditch: no undone tickets
                 for r in batch:
-                    if not r.ticket._done.is_set():
+                    if not r.ticket.done():
                         self._finalize(
                             r, status_name="ERROR",
                             result=self._error_result(
@@ -539,17 +775,17 @@ class SVDService:
                                 "base"),
                             queue_wait=time.monotonic() - r.submitted,
                             solve_time=None, path="base",
-                            breaker_state=self.breaker.record(False))
+                            breaker_state=lane.breaker.record(False),
+                            lane=lane.index)
 
-    def _serve_one(self, req: Request) -> None:
+    def _serve_one(self, lane: Lane, req: Request) -> None:
         from ..solver import SolveStatus
         t_pop = time.monotonic()
         queue_wait = t_pop - req.submitted
         with self._lock:
-            self._in_flight = req
-            self._in_flight_all = [req]
+            lane.in_flight = [req]
             if not self._accepting and not self._drain:
-                # stop(drain=False) raced the pop before _in_flight was
+                # stop(drain=False) raced the pop before in_flight was
                 # published (it could not see this request to cancel it);
                 # publish-and-check shares stop()'s lock, so one side
                 # always sets the cancel event.
@@ -562,7 +798,8 @@ class SVDService:
                                    req, "CANCELLED", queue_wait),
                                queue_wait=queue_wait, solve_time=None,
                                path="base",
-                               breaker_state=self.breaker.state())
+                               breaker_state=lane.breaker.state(),
+                               lane=lane.index)
                 return
             if req.deadline is not None and time.monotonic() >= req.deadline:
                 # Deadline expired while QUEUED: terminal without spending
@@ -578,9 +815,10 @@ class SVDService:
                                    req, "DEADLINE", queue_wait),
                                queue_wait=queue_wait, solve_time=None,
                                path="base",
-                               breaker_state=self.breaker.state())
+                               breaker_state=lane.breaker.state(),
+                               lane=lane.index)
                 return
-            path, _ = self.breaker.begin()
+            path, _ = lane.breaker.begin()
             cu = req.compute_u and not req.degraded
             cv = req.compute_v and not req.degraded
             t0 = time.monotonic()
@@ -588,9 +826,9 @@ class SVDService:
             r = None
             try:
                 if path == "ladder":
-                    r = self._solve_ladder(req, cu, cv)
+                    r = self._solve_ladder(lane, req, cu, cv)
                 else:
-                    r = self._solve_base(req, cu, cv)
+                    r = self._solve_base(lane, req, cu, cv)
                 status = r.status_enum()
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
@@ -598,9 +836,9 @@ class SVDService:
             solve_time = time.monotonic() - t0
             if status is SolveStatus.CANCELLED:
                 # Client-initiated: neither a success nor a backend failure.
-                breaker_state = self.breaker.state()
+                breaker_state = lane.breaker.state()
             else:
-                breaker_state = self.breaker.record(
+                breaker_state = lane.breaker.record(
                     error is None and status is SolveStatus.OK)
             if error is not None:
                 result = self._error_result(req, error, queue_wait, path,
@@ -614,15 +852,16 @@ class SVDService:
                     solve_time_s=solve_time, path=path,
                     degraded=req.degraded, request_id=req.id)
                 status_name = status.name
+            lane.note_outcome(status_name, breaker_state)
             self._finalize(req, status_name=status_name, result=result,
                            queue_wait=queue_wait, solve_time=solve_time,
-                           path=path, breaker_state=breaker_state)
+                           path=path, breaker_state=breaker_state,
+                           lane=lane.index)
         finally:
             with self._lock:
-                self._in_flight = None
-                self._in_flight_all = []
+                lane.in_flight = []
 
-    def _serve_batch(self, reqs) -> None:
+    def _serve_batch(self, lane: Lane, reqs) -> None:
         """Serve a coalesced same-bucket batch as ONE batched dispatch.
 
         Pre-dispatch, each member gets the same queued-cancel /
@@ -647,7 +886,8 @@ class SVDService:
                                    req, "CANCELLED", wait),
                                queue_wait=wait, solve_time=None,
                                path="base",
-                               breaker_state=self.breaker.state())
+                               breaker_state=lane.breaker.state(),
+                               lane=lane.index)
             elif req.deadline is not None and t_pop >= req.deadline:
                 # Queue-expired: overload symptom, not backend failure —
                 # never fed to the breaker (cf. _serve_one).
@@ -656,25 +896,25 @@ class SVDService:
                                    req, "DEADLINE", wait),
                                queue_wait=wait, solve_time=None,
                                path="base",
-                               breaker_state=self.breaker.state())
+                               breaker_state=lane.breaker.state(),
+                               lane=lane.index)
             else:
                 live.append(req)
         if not live:
             return
-        path, _ = self.breaker.begin()
+        path, _ = lane.breaker.begin()
         if path == "ladder" or len(live) == 1:
             # Recovery path (or a batch that collapsed to one member):
             # strictly sequential single dispatches.
             for req in live:
-                self._serve_one(req)
+                self._serve_one(lane, req)
             return
         batch_id = f"b{next(self._batch_seq):05d}"
         batch_size = len(live)
         tier = min((t for t in self._tiers if t >= batch_size),
                    default=batch_size)
         with self._lock:
-            self._in_flight = live[0]
-            self._in_flight_all = list(live)
+            lane.in_flight = list(live)
         try:
             bucket = live[0].bucket
             cu = any(r.compute_u and not r.degraded for r in live)
@@ -686,13 +926,14 @@ class SVDService:
             error = None
             r = None
             try:
-                r = self._solve_batched(live, bucket, tier, cu, cv,
+                r = self._solve_batched(lane, live, bucket, tier, cu, cv,
                                         deadline, should_cancel)
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
             solve_time = time.monotonic() - t0
             if error is not None:
-                breaker_state = self.breaker.record(False)
+                breaker_state = lane.breaker.record(False)
+                lane.note_outcome("ERROR", breaker_state)
                 for req in live:
                     wait = t0 - req.submitted
                     self._finalize(
@@ -702,7 +943,7 @@ class SVDService:
                         queue_wait=wait, solve_time=solve_time,
                         path="base", breaker_state=breaker_state,
                         batch_id=batch_id, batch_size=batch_size,
-                        batch_tier=tier)
+                        batch_tier=tier, lane=lane.index)
                 return
             import numpy as np
             # One host pull of the whole batched result: per-member
@@ -728,11 +969,17 @@ class SVDService:
                     status_j = SolveStatus.CANCELLED
                 statuses.append(status_j)
             if all(st is SolveStatus.CANCELLED for st in statuses):
-                breaker_state = self.breaker.state()
+                breaker_state = lane.breaker.state()
             else:
-                breaker_state = self.breaker.record(all(
+                breaker_state = lane.breaker.record(all(
                     st is SolveStatus.OK for st in statuses
                     if st is not SolveStatus.CANCELLED))
+                # One lane-health outcome per batched dispatch (bad =
+                # any member NONFINITE; dispatch ERROR handled above).
+                lane.note_outcome(
+                    "NONFINITE" if any(st is SolveStatus.NONFINITE
+                                       for st in statuses) else "OK",
+                    breaker_state)
             for j, req in enumerate(live):
                 wait = t0 - req.submitted
                 status_j = statuses[j]
@@ -750,14 +997,13 @@ class SVDService:
                                solve_time=solve_time, path="base",
                                breaker_state=breaker_state,
                                batch_id=batch_id, batch_size=batch_size,
-                               batch_tier=tier)
+                               batch_tier=tier, lane=lane.index)
             self._bump("batched_dispatches", f"batch_tier:{tier}")
         finally:
             with self._lock:
-                self._in_flight = None
-                self._in_flight_all = []
+                lane.in_flight = []
 
-    def _solve_batched(self, live, bucket, tier, cu, cv, deadline,
+    def _solve_batched(self, lane, live, bucket, tier, cu, cv, deadline,
                        should_cancel):
         """One coalesced dispatch: pad each member to the bucket, stack,
         zero-pad the tail slots to the batch tier (exact — an all-zero
@@ -783,19 +1029,29 @@ class SVDService:
                                 jnp.dtype(bucket.dtype))
                 stack += [pad] * (tier - len(stack))
             a = jnp.stack(stack)
+        a = self._place(a, lane)
+        if chaos.consume_poison(lane.index):
+            a = a.at[0, 0, 0].set(jnp.nan)
         stall = chaos.consume_stuck()
         if stall is not None:
-            self._stall(live[0], stall)
+            self._stall(live[0], stall, lane)
         slow = chaos.consume_slow()
         st = BatchedSweepStepper(a, compute_u=cu, compute_v=cv,
                                  config=self.config.solver)
         st.set_control(deadline=deadline, should_cancel=should_cancel)
-        state = st.init()
-        while st.should_continue(state):
-            if slow is not None:
-                time.sleep(slow)
-            state = st.step(state)
-        return st.finish(state)
+        lane.in_step = True     # device/compile stalls are legitimate here
+        try:
+            # Pin the whole init state (see _solve_base).
+            state = self._place(st.init(), lane)
+            while st.should_continue(state):
+                lane.beat()
+                if slow is not None:
+                    time.sleep(slow)
+                state = st.step(state)
+            return st.finish(state)
+        finally:
+            lane.in_step = False
+            lane.beat()
 
     def _slice_member(self, req: Request, r, j: int, cu: bool, cv: bool):
         """Member ``j``'s original-shape factors out of a batched result
@@ -815,46 +1071,99 @@ class SVDService:
 
     # -- solve paths --------------------------------------------------------
 
-    def _solve_base(self, req: Request, cu: bool, cv: bool):
+    @staticmethod
+    def _place(a, lane: Lane):
+        """Pin the padded working set to the lane's device (fleet mode:
+        each lane compiles and executes its own per-device executables —
+        the per-lane jit cache). No-op for the default single lane."""
+        if lane.device is None:
+            return a
+        import jax
+        return jax.device_put(a, lane.device)
+
+    def _solve_base(self, lane: Lane, req: Request, cu: bool, cv: bool):
         """The normal path: pad to the bucket, run the host-stepped solver
-        under cooperative control, one control check per sweep."""
+        under cooperative control, one control check (and one lane
+        heartbeat) per sweep."""
+        import jax.numpy as jnp
+
         from ..resilience import chaos
         from ..solver import SweepStepper
-        a_pad = self.buckets.pad(req.a, req.bucket)
+        a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
+        if chaos.consume_poison(lane.index):
+            # NaN-poison the working set so the solve surfaces NONFINITE
+            # through the production health word (chaos.poison_lane).
+            a_pad = a_pad.at[0, 0].set(jnp.nan)
         stall = chaos.consume_stuck()
         if stall is not None:
-            self._stall(req, stall)
+            self._stall(req, stall, lane)
         slow = chaos.consume_slow()
         st = SweepStepper(a_pad, compute_u=cu, compute_v=cv,
                           config=self.config.solver)
         st.set_control(deadline=req.deadline,
                        should_cancel=req.cancel.is_set)
-        state = st.init()
-        while st.should_continue(state):
-            if slow is not None:
-                time.sleep(slow)
-            state = st.step(state)
-        return st.finish(state)
+        lane.in_step = True     # device/compile stalls are legitimate here
+        try:
+            # The whole init state pinned, not just the input: init
+            # creates fresh accumulators (uncommitted, default device),
+            # and a committed/uncommitted mix would give the first sweep
+            # a different jit cache key than every later one — one
+            # silent extra compile per (bucket, lane).
+            state = self._place(st.init(), lane)
+            while st.should_continue(state):
+                lane.beat()
+                if slow is not None:
+                    time.sleep(slow)
+                state = st.step(state)
+            return st.finish(state)
+        finally:
+            lane.in_step = False
+            lane.beat()
 
-    def _solve_ladder(self, req: Request, cu: bool, cv: bool):
+    def _solve_ladder(self, lane: Lane, req: Request, cu: bool, cv: bool):
         """The OPEN-breaker path: route through the escalation ladder.
         The ladder runs the FUSED entry points, so the deadline cannot be
         checked mid-solve — acceptable for the recovery path (bounded by
         the ladder's own attempt cap), and the manifest records it as
-        path="ladder"."""
-        from ..resilience import resilient_svd
-        a_pad = self.buckets.pad(req.a, req.bucket)
-        return resilient_svd(a_pad, compute_u=cu, compute_v=cv,
-                             config=self.config.solver,
-                             manifest_path=self.config.manifest_path)
+        path="ladder". ``ladder_watchdog_s`` arms the wall-clock overrun
+        watchdog: it cannot abort the fused solve, but it records a
+        `ladder_overrun` fleet event and flags THIS lane unhealthy, so
+        the supervisor evicts it and rescues its queued requests instead
+        of the whole fleet blocking behind an unbounded ladder."""
+        import jax.numpy as jnp
+
+        from ..resilience import chaos, resilient_svd
+        a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
+        if chaos.consume_poison(lane.index):
+            a_pad = jnp.asarray(a_pad).at[0, 0].set(jnp.nan)
+        on_overrun = None
+        if self.fleet.size > 1:
+            on_overrun = (lambda info:
+                          self.fleet.flag_unhealthy(lane, "ladder_overrun"))
+        lane.in_step = True     # the fused ladder blocks for whole solves
+        try:
+            return resilient_svd(a_pad, compute_u=cu, compute_v=cv,
+                                 config=self.config.solver,
+                                 manifest_path=self.config.manifest_path,
+                                 watchdog_s=self.config.ladder_watchdog_s,
+                                 on_overrun=on_overrun)
+        finally:
+            lane.in_step = False
+            lane.beat()
 
     @staticmethod
-    def _stall(req: Request, stall_s: float) -> None:
+    def _stall(req: Request, stall_s: float,
+               lane: Optional[Lane] = None) -> None:
         """chaos.stuck_backend: block cooperatively (polling the request's
         deadline/cancel control) for at most ``stall_s``; the stepper's
-        own control check then turns an expired deadline into DEADLINE."""
+        own control check then turns an expired deadline into DEADLINE.
+        The lane heartbeat keeps beating — a stuck BACKEND is the circuit
+        breaker's fault class; a stuck LANE (no heartbeat) is
+        `chaos.wedge_lane` and the supervisor's."""
         t_end = time.monotonic() + stall_s
         while time.monotonic() < t_end:
+            if lane is not None:
+                lane.beat()
             if req.cancel.is_set():
                 return
             if req.deadline is not None and time.monotonic() >= req.deadline:
@@ -876,12 +1185,13 @@ class SVDService:
     # -- bookkeeping --------------------------------------------------------
 
     def _control_result(self, req: Request, status_name: str,
-                        queue_wait: float) -> ServeResult:
+                        queue_wait: float,
+                        path: str = "base") -> ServeResult:
         from ..solver import SolveStatus
         return ServeResult(
             u=None, s=None, v=None, status=SolveStatus[status_name],
             error=None, sweeps=0, bucket=req.bucket.name,
-            queue_wait_s=queue_wait, solve_time_s=None, path="base",
+            queue_wait_s=queue_wait, solve_time_s=None, path=path,
             degraded=req.degraded, request_id=req.id)
 
     def _error_result(self, req: Request, error: str, queue_wait: float,
@@ -899,9 +1209,17 @@ class SVDService:
                   breaker_state: BreakerState,
                   batch_id: Optional[str] = None,
                   batch_size: Optional[int] = None,
-                  batch_tier: Optional[int] = None) -> None:
-        req.ticket._result = result
-        req.ticket._done.set()
+                  batch_tier: Optional[int] = None,
+                  lane: Optional[int] = None) -> bool:
+        """Install the terminal result and its bookkeeping EXACTLY once.
+
+        Returns False (and does nothing — no stats bump, no manifest
+        record) when the ticket was already finalized: in fleet mode a
+        request can legitimately be finalized twice-over — once by the
+        rescue path, once by a sick worker that eventually woke up — and
+        only the first writer may count."""
+        if not req.ticket._finalize_once(result):
+            return False
         self._bump("served", f"status:{status_name}",
                    *(["path:ladder"] if path == "ladder" else []),
                    *(["degraded"] if req.degraded else []))
@@ -914,7 +1232,31 @@ class SVDService:
             degraded=req.degraded, deadline_s=req.deadline_s,
             sweeps=result.sweeps, error=result.error,
             batch_id=batch_id, batch_size=batch_size,
-            batch_tier=batch_tier)
+            batch_tier=batch_tier, lane=lane)
+        return True
+
+    def _finalize_rescue(self, req: Request, status_name: str,
+                         error: Optional[str] = None,
+                         lane: Optional[Lane] = None) -> bool:
+        """Terminalize a request on the RESCUE path (no solve spent):
+        CANCELLED / DEADLINE for requests whose control already fired,
+        ERROR when there is no healthy lane left — all loud, recorded
+        with path="rescue" and attributed to the EVICTED lane (whose
+        failure produced this terminal), so the manifest stream
+        distinguishes a rescue-finalized request from a served one and
+        still reconstructs which lane failed it."""
+        wait = time.monotonic() - req.submitted
+        if error is not None:
+            result = self._error_result(req, error, wait, "rescue")
+        else:
+            result = self._control_result(req, status_name, wait,
+                                          path="rescue")
+        breaker = (lane.breaker if lane is not None else self.breaker)
+        return self._finalize(
+            req, status_name=status_name if error is None else "ERROR",
+            result=result, queue_wait=wait, solve_time=None,
+            path="rescue", breaker_state=breaker.state(),
+            lane=None if lane is None else lane.index)
 
     def _bump(self, *keys: str) -> None:
         with self._lock:
@@ -929,7 +1271,8 @@ class SVDService:
                 sweeps: Optional[int] = None,
                 batch_id: Optional[str] = None,
                 batch_size: Optional[int] = None,
-                batch_tier: Optional[int] = None) -> None:
+                batch_tier: Optional[int] = None,
+                lane: Optional[int] = None) -> None:
         from .. import obs
         record = obs.manifest.build_serve(
             request_id=request_id, m=orig_shape[0], n=orig_shape[1],
@@ -940,7 +1283,20 @@ class SVDService:
             degraded=bool(degraded),
             deadline_s=(None if deadline_s is None else float(deadline_s)),
             sweeps=sweeps, error=error, batch_id=batch_id,
-            batch_size=batch_size, batch_tier=batch_tier)
+            batch_size=batch_size, batch_tier=batch_tier,
+            lane=(None if lane is None else int(lane)))
+        self._store(record)
+
+    def _record_fleet(self, *, event: str, lane: Optional[int] = None,
+                      **extra) -> None:
+        """Append one schema-versioned "fleet" record (lane transitions,
+        rescues, steals, probes, healthz snapshots) to the same stream
+        as the per-request "serve" records."""
+        from .. import obs
+        self._store(obs.manifest.build_fleet(event=event, lane=lane,
+                                             **extra))
+
+    def _store(self, record: dict) -> None:
         with self._lock:
             # max_records <= 0 means "manifest only, keep none in memory"
             # (the naive del lst[:-0] would silently invert the cap into
@@ -950,6 +1306,7 @@ class SVDService:
                 del self._records[:-self.config.max_records]
         if self.config.manifest_path is not None:
             try:
+                from .. import obs
                 obs.manifest.append(self.config.manifest_path, record)
             except Exception as e:  # manifest I/O must not kill the worker
                 self._bump("manifest_errors")

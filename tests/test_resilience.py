@@ -215,6 +215,48 @@ class TestEscalation:
         assert rep["final_status"] == "MAX_SWEEPS"
         assert r.status_enum() == SolveStatus.MAX_SWEEPS
 
+    def test_ladder_watchdog_fires_on_overrun(self, tmp_path):
+        """Satellite: the uncancellable ladder's wall-clock watchdog. An
+        episode that runs past ``watchdog_s`` records a ladder_overrun
+        fleet event and calls on_overrun (the fleet's lane-unhealthy
+        hook) — WITHOUT aborting the ladder, which still returns its
+        honest result."""
+        import time as _time
+
+        from svd_jacobi_tpu.obs import manifest
+        path = tmp_path / "manifest.jsonl"
+        fired = []
+        a = matgen.random_dense(48, 48, seed=9, dtype=jnp.float32)
+        r, rep = sj.resilience.resilient_svd(
+            a, config=SVDConfig(max_sweeps=1),   # starves Jacobi rungs:
+            manifest_path=str(path),             # a multi-attempt episode
+            watchdog_s=0.0, on_overrun=fired.append,
+            return_report=True)
+        deadline = _time.monotonic() + 5.0
+        while not fired and _time.monotonic() < deadline:
+            _time.sleep(0.01)   # the timer thread races the short ladder
+        assert rep["watchdog_overrun"] is True
+        assert len(fired) == 1 and fired[0]["budget_s"] == 0.0
+        assert fired[0]["m"] == 48
+        # The ladder was NOT aborted: it still walked to a result.
+        assert rep["final_status"] == "OK"
+        kinds = [rec["kind"] for rec in manifest.load(path)]
+        assert "fleet" in kinds and "retry" in kinds
+        over = [rec for rec in manifest.load(path)
+                if rec["kind"] == "fleet"]
+        assert over[0]["event"] == "ladder_overrun"
+        manifest.validate(over[0])
+        retry = [rec for rec in manifest.load(path)
+                 if rec["kind"] == "retry"][0]
+        assert retry["watchdog_overrun"] is True
+
+    def test_ladder_watchdog_quiet_within_budget(self):
+        a = matgen.random_dense(32, 32, seed=3, dtype=jnp.float32)
+        r, rep = sj.resilience.resilient_svd(
+            a, watchdog_s=600.0, return_report=True)
+        assert rep["watchdog_overrun"] is False
+        assert rep["final_status"] == "OK"
+
 
 CKPT_CFG = SVDConfig(block_size=4)
 
@@ -388,8 +430,33 @@ class TestLaunchRetry:
             ctx = launch.initialize(coordinator_address="127.0.0.1:1",
                                     num_processes=1, process_id=0)
         assert len(calls) == 3
-        assert sleeps == [0.5, 1.0]  # exponential backoff
+        # Decorrelated-jitter backoff: every delay obeys the declared
+        # bound base <= d <= min(cap, 3 * previous) — no fixed multiples
+        # (a fleet restart must not thundering-herd the coordinator).
+        assert len(sleeps) == 2
+        prev = 0.5
+        for d in sleeps:
+            assert 0.5 <= d <= min(30.0, 3.0 * prev)
+            prev = d
         assert ctx.process_count >= 1
+
+    def test_backoff_delay_bound(self):
+        """Satellite regression: the decorrelated-jitter delay is ALWAYS
+        within [base, min(cap, 3 * prev)] — over many draws and across
+        the cap crossover — and two draws from the same state differ
+        (that is the de-synchronization)."""
+        from svd_jacobi_tpu.parallel import launch
+        draws = []
+        prev = 0.5
+        for _ in range(200):
+            d = launch._backoff_delay(0.5, prev, cap_s=4.0)
+            assert 0.5 <= d <= min(4.0, 3.0 * prev)
+            draws.append(d)
+            prev = d
+        # Growth saturates at the cap, never beyond it.
+        assert max(draws) <= 4.0
+        # Jitter is real: the draws are not a deterministic ladder.
+        assert len({round(d, 6) for d in draws}) > 10
 
     def test_retries_are_bounded(self, monkeypatch):
         from svd_jacobi_tpu import _compat
