@@ -48,6 +48,16 @@ Usage:
                                   seeded mix, plus the coalesced-over-
                                   serial speedup row; see
                                   bench._serve_throughput for its flags)
+         --tuning-table=PATH     (pin a measured tuning table for every
+                                  "auto" knob; =off bypasses tables —
+                                  the builtin hand-picked heuristics.
+                                  The A/B lever of PROFILE.md item 24)
+         --retry-backoff-s=SECS  (backoff before the ONE bounded retry a
+                                  transient backend failure earns —
+                                  UNAVAILABLE/device-pool outages, the
+                                  BENCH_r05 class; the retry is noted in
+                                  the emitted row as "retried".
+                                  Default 15)
 """
 
 from __future__ import annotations
@@ -68,18 +78,43 @@ def _force(tree):
     return force(tree)
 
 
+# Error-text markers of TRANSIENT backend failures (device-pool outage,
+# tunnel reset — the BENCH_r05 class) as opposed to deterministic ones
+# (OOM, shape/validation errors). Deliberately narrow: retrying a
+# deterministic failure would just double the time to the same error row.
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "ABORTED", "device pool",
+                      "socket closed", "connection reset",
+                      "backend unreachable", "heartbeat")
+
+
+def _transient_reason(err: "str | None") -> "str | None":
+    """The matched marker when ``err`` reads as a transient backend
+    failure, else None."""
+    if not err:
+        return None
+    low = err.lower()
+    for marker in _TRANSIENT_MARKERS:
+        if marker.lower() in low:
+            return marker
+    return None
+
+
 def _time_interleaved(fns, *args, reps: int = 2):
-    """(best_times, warm_results): best-of-reps device wall time for each
-    callable, forced by scalar readback, with the timed repetitions of all
-    callables INTERLEAVED — the tunnel's latency drifts on the seconds
-    scale, so back-to-back blocks would hand whichever runs second a
-    different environment. The warm-up results are returned so callers do
-    not pay an extra full solve to get the factors.
+    """(best_times, warm_results, errors): best-of-reps device wall time
+    for each callable, forced by scalar readback, with the timed
+    repetitions of all callables INTERLEAVED — the tunnel's latency
+    drifts on the seconds scale, so back-to-back blocks would hand
+    whichever runs second a different environment. The warm-up results
+    are returned so callers do not pay an extra full solve to get the
+    factors.
 
     A callable that FAILS to compile/run (e.g. `jnp.linalg.svd` at 16384^2
     OOM-kills the remote TPU compile helper) gets time None and warm None
-    instead of sinking the whole bench run."""
+    instead of sinking the whole bench run; its stringified error rides in
+    ``errors`` so the caller can tell a transient outage (worth one
+    bounded retry) from a deterministic failure."""
     warms, dead = [], set()
+    errors = [None] * len(fns)
     for i, f in enumerate(fns):
         try:
             w = f(*args)
@@ -89,6 +124,7 @@ def _time_interleaved(fns, *args, reps: int = 2):
                   f"timing the others", file=sys.stderr)
             w = None
             dead.add(i)
+            errors[i] = f"{type(e).__name__}: {e}"
             import gc
             gc.collect()   # release the failed attempt's device buffers
         warms.append(w)
@@ -107,16 +143,31 @@ def _time_interleaved(fns, *args, reps: int = 2):
                           f"({type(e).__name__})", file=sys.stderr)
                     dead.add(i)
                     warms[i] = None
+                    errors[i] = f"{type(e).__name__}: {e}"
     best = [float("inf")] * len(fns)
     for _ in range(max(1, reps)):
         for i, f in enumerate(fns):
             if i in dead:
                 continue
             t0 = time.perf_counter()
-            _force(f(*args))
+            try:
+                _force(f(*args))
+            except Exception as e:
+                # A failure DURING the timed repetitions (the mid-round
+                # outage class) kills this candidate the same way a warm
+                # failure does — partial timings are discarded so the
+                # caller's transient-retry path sees time None + the
+                # error, not a number measured against a dying backend.
+                print(f"note: candidate {i} failed mid-timing "
+                      f"({type(e).__name__}); dropping its timings",
+                      file=sys.stderr)
+                dead.add(i)
+                warms[i] = None
+                errors[i] = f"{type(e).__name__}: {e}"
+                continue
             best[i] = min(best[i], time.perf_counter() - t0)
     best = [None if i in dead else b for i, b in enumerate(best)]
-    return best, warms
+    return best, warms, errors
 
 
 # The measured-table configs of BASELINE.md (square + tall-skinny, f32,
@@ -188,6 +239,9 @@ def _serve_throughput(flags) -> None:
     bucket = as_bucket(flags.get("bucket", "64x64:float32"))
     if bucket.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
+    if "tuning-table" in flags:
+        from svd_jacobi_tpu import tune
+        tune.set_active_table(flags["tuning-table"])
 
     import jax.numpy as jnp
 
@@ -369,6 +423,12 @@ def main() -> None:
         jax.config.update("jax_platforms", platform)
     if dtype_name == "float64":
         jax.config.update("jax_enable_x64", True)
+    if "tuning-table" in flags:
+        # --tuning-table=PATH pins a measured table for every "auto"
+        # knob this run resolves; =off bypasses tables entirely (builtin
+        # hand-picked heuristics) — the A/B lever PROFILE.md item 24 uses.
+        from svd_jacobi_tpu import tune
+        tune.set_active_table(flags["tuning-table"])
 
     # Backend watchdog: if the attachment's device pool is down,
     # jax.devices() HANGS indefinitely (observed: relay accepts TCP,
@@ -478,23 +538,63 @@ def main() -> None:
 
         ours = lambda _x: _run()
         a = None
-    if not attempted_baseline:
-        (t_ours,), (r,) = _time_interleaved([ours], a, reps=reps)
-        t_base = None
-        base_name = "skipped (--no-baseline: known to OOM at this size)"
-    elif baseline == "numpy":
-        an = np.asarray(a)
-        (t_ours, t_base), (r, _) = _time_interleaved(
-            [ours, lambda x: np.linalg.svd(an, full_matrices=False,
-                                           compute_uv=not novec)], a,
-            reps=reps)
-        base_name = "numpy.linalg.svd same host"
-    else:
-        (t_ours, t_base), (r, _) = _time_interleaved(
+    # Test hook for the transient-retry path: the first K solve attempts
+    # raise a synthetic UNAVAILABLE (the BENCH_r05 outage class) so the
+    # retry is exercisable end-to-end without a real device-pool outage.
+    chaos_left = int(os.environ.get("SVDJ_BENCH_CHAOS_TRANSIENT", "0") or 0)
+    if chaos_left > 0:
+        real_ours = ours
+        _chaos_state = {"left": chaos_left}
+
+        def ours(x):
+            if _chaos_state["left"] > 0:
+                _chaos_state["left"] -= 1
+                raise RuntimeError(
+                    "UNAVAILABLE: injected transient backend outage "
+                    "(SVDJ_BENCH_CHAOS_TRANSIENT)")
+            return real_ours(x)
+
+    def _measure():
+        if not attempted_baseline:
+            (t_ours,), (r,), errs = _time_interleaved([ours], a, reps=reps)
+            return (t_ours, None, r, errs[0],
+                    "skipped (--no-baseline: known to OOM at this size)")
+        if baseline == "numpy":
+            an = np.asarray(a)
+            (t_ours, t_base), (r, _), errs = _time_interleaved(
+                [ours, lambda x: np.linalg.svd(an, full_matrices=False,
+                                               compute_uv=not novec)], a,
+                reps=reps)
+            return t_ours, t_base, r, errs[0], "numpy.linalg.svd same host"
+        (t_ours, t_base), (r, _), errs = _time_interleaved(
             [ours, lambda x: jnp.linalg.svd(x, full_matrices=False,
                                             compute_uv=not novec)], a,
             reps=reps)
-        base_name = "jnp.linalg.svd same device"
+        return t_ours, t_base, r, errs[0], "jnp.linalg.svd same device"
+
+    # One BOUNDED retry, with backoff, when OUR solve failed with a
+    # transient backend error (device-pool outage, tunnel reset — the
+    # BENCH_r05 class): a momentary outage must not void a whole bench
+    # round. The retry is noted in the emitted row ("retried") so the
+    # number's provenance is honest; deterministic failures (OOM,
+    # validation) never retry.
+    try:
+        retry_backoff = float(flags.get("retry-backoff-s", "15"))
+    except ValueError:
+        raise SystemExit("--retry-backoff-s=SECONDS required, got "
+                         f"{flags.get('retry-backoff-s')!r}")
+    retried = None
+    t_ours, t_base, r, err, base_name = _measure()
+    if t_ours is None:
+        reason = _transient_reason(err)
+        if reason is not None:
+            print(f"note: transient backend failure ({reason}); retrying "
+                  f"once after {retry_backoff:.0f}s backoff",
+                  file=sys.stderr)
+            time.sleep(max(0.0, retry_backoff))
+            retried = {"reason": reason, "backoff_s": retry_backoff,
+                       "error": err[:300]}
+            t_ours, t_base, r, err, base_name = _measure()
 
     if t_ours is None:
         # Our own solver failed at this config (e.g. OOM): emit a row that
@@ -504,6 +604,7 @@ def main() -> None:
                       f"{'_novec' if novec else ''}_gflops",
             "value": None, "unit": "GFLOP/s", "vs_baseline": None,
             "error": "solver failed to compile/run at this config",
+            "detail": err, "retried": retried,
             "device": str(jax.devices()[0])}))
         return
 
@@ -537,6 +638,8 @@ def main() -> None:
         "device": str(jax.devices()[0]),
         **extras,
     }
+    if retried is not None:
+        row["retried"] = retried
     print(json.dumps(row))
 
     manifest_path = flags.get("manifest", "reports/manifest.jsonl")
@@ -596,6 +699,7 @@ def main() -> None:
             metric=row["metric"], baseline=row["baseline"],
             baseline_time_s=row["baseline_time_s"],
             novec=novec, stepped=stepped, reps=reps,
+            retried=retried,
             argv=sys.argv[1:])
         obs.manifest.append(manifest_path, record)
         print(f"manifest: {manifest_path}", file=sys.stderr)

@@ -7,11 +7,11 @@ on the MXU, sharded over TPU meshes with ICI collectives, with a
 LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 """
 
-from . import obs, resilience, serve
+from . import obs, resilience, serve, tune
 from .config import SVDConfig
 from .solver import SolveStatus, SVDResult, svd, svd_batched
 
 __version__ = "0.1.0"
 
 __all__ = ["svd", "svd_batched", "SVDConfig", "SVDResult", "SolveStatus", "obs",
-           "resilience", "serve", "__version__"]
+           "resilience", "serve", "tune", "__version__"]

@@ -32,6 +32,12 @@ properties that decide whether those artifacts stay sane:
   * `sanitize`      — the runtime-sanitizer context (jax_debug_nans,
     jax_debug_infs, jax_transfer_guard) behind the `-m sanitized` pytest
     lane and the CLI's `--sanitized` flag.
+  * `tune_checks`   — the autotuner contract (TUNE001): shipped tuning
+    tables pass schema + content-hash validation, every declared serve
+    bucket resolves through a measured (non-generic) table row, and
+    table-resolved serving configs keep the once-per-bucket compile
+    contract (reusing `recompile_guard` over a resolved-config serve
+    sequence).
 
 `python -m svd_jacobi_tpu.analysis` runs every pass and appends one
 schema-versioned "analysis" record to the run manifest (`obs.manifest`);

@@ -20,7 +20,7 @@ import sys
 import time
 from pathlib import Path
 
-PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve")
+PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune")
 
 
 def _parse_args(argv):
@@ -73,6 +73,13 @@ def main(argv=None) -> int:
             # The serving layer's compile-cache contract: the bucket set
             # compiles once per bucket, never per request (RETRACE001).
             findings, report = recompile_guard.run_serve_sequence()
+            return findings, report
+        if name == "tune":
+            # The autotuner contract (TUNE001): shipped tables validate,
+            # the declared serve buckets resolve via measured rows, and
+            # table-resolved configs introduce no new retraces.
+            from . import tune_checks
+            findings, report = tune_checks.run_all()
             return findings, report
         findings, report = recompile_guard.run_default_sequence()
         return findings, report

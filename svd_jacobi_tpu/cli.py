@@ -27,6 +27,11 @@ Usage:
         — seeded closed-loop clients against a live `serve.SVDService`
         (deadlines, admission control, brownout; one "serve" manifest
         record per request).
+
+    python -m svd_jacobi_tpu.cli tune [--smoke] [--shapes ...] [--out PATH]
+        — the measured autotuner: benchmark the knob grid on the attached
+        backend and write a versioned tuning table (tune.search; pin the
+        result with --tuning-table=PATH on any run).
 """
 
 from __future__ import annotations
@@ -95,6 +100,11 @@ def _parse_args(argv):
     p.add_argument("--report-dir", default="reports",
                    help="directory of the run manifest (one JSONL record "
                         "per run appended to <dir>/manifest.jsonl)")
+    p.add_argument("--tuning-table", default=None, metavar="PATH|off",
+                   help="pin a measured tuning table for this run's "
+                        "'auto' knob resolution (tune.tables; 'off' = "
+                        "builtin hand-picked heuristics; default = the "
+                        "shipped table / SVDJ_TUNING_TABLE)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the solve into DIR "
                         "(obs.trace: creates the dir, warns instead of "
@@ -199,6 +209,10 @@ def _parse_serve_args(argv):
                    help="manifest directory (per-request 'serve' JSONL "
                         "records appended to <dir>/manifest.jsonl); "
                         "'off' disables")
+    p.add_argument("--tuning-table", default=None, metavar="PATH|off",
+                   help="pin a measured tuning table for the service's "
+                        "per-bucket knob resolution ('off' = builtin "
+                        "hand-picked heuristics)")
     return p.parse_args(argv)
 
 
@@ -221,6 +235,10 @@ def serve_demo(argv) -> int:
     from svd_jacobi_tpu import SVDConfig
     from svd_jacobi_tpu.serve import AdmissionError, ServeConfig, SVDService
     from svd_jacobi_tpu.utils import matgen
+
+    if args.tuning_table:
+        from svd_jacobi_tpu import tune
+        tune.set_active_table(args.tuning_table)
 
     def log(msg):
         print(msg, file=sys.stderr)
@@ -334,6 +352,11 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve-demo":
         return serve_demo(argv[1:])
+    if argv and argv[0] == "tune":
+        # `cli.py tune ...` — the measured-autotuner subcommand
+        # (regenerates a tuning table; see `python -m svd_jacobi_tpu.tune`).
+        from svd_jacobi_tpu.tune.__main__ import main as tune_main
+        return tune_main(argv[1:])
     args = _parse_args(argv)
 
     import os
@@ -353,6 +376,11 @@ def main(argv=None) -> int:
 
     def log(msg):
         print(msg, file=sys.stderr)
+
+    if args.tuning_table:
+        from svd_jacobi_tpu import tune
+        table = tune.set_active_table(args.tuning_table)
+        log(f"tuning table: {table.table_id} ({table.sha256[:12]})")
 
     m = args.m if args.m is not None else args.n
     n = args.n

@@ -133,29 +133,25 @@ class SVDConfig:
     # chip's largest sizes (30000^2 sigma-only needs it on 16 GB HBM).
     donate_input: bool = False
 
-    def pick_block_size(self, n: int) -> int:
+    def pick_block_size(self, n: int, m: Optional[int] = None,
+                        dtype=None) -> int:
+        """Block width ``b`` for an (m, n) tall-oriented problem.
+
+        Explicit ``block_size`` wins; otherwise the width resolves
+        through the active tuning table (`tune.resolve` — the measured
+        replacement for the old if-ladder, whose hand-picked values
+        survive as the table machinery's generic fallback, so a missing
+        or bypassed table reproduces the historical defaults exactly).
+        ``m``/``dtype`` refine the lookup (aspect/dtype classes); omitted
+        they default to square/float32 — the historical n-only behavior.
+        """
         if self.block_size is not None:
             if self.block_size < 1:
                 raise ValueError(f"block_size must be >= 1, got {self.block_size}")
             return self.block_size
-        # TPU-friendly default: lane-aligned blocks once n is big enough
-        # (otherwise roughly n/8 so there is parallelism across pairs).
-        # b=256 doubles the fused apply's arithmetic intensity (crossing
-        # the f32 ridge) at the price of a costlier rotation kernel;
-        # measured end-to-end (PROFILE.md item 18) it wins from n = 8192
-        # up (16384^2: 34.8 vs 39.0 s) and loses below (4096^2: 0.98 vs
-        # 0.88 s) — including small-n tall-skinny (65536x4096: 1.35 vs
-        # 1.21 s), which the n-threshold excludes. b=512 exceeds the
-        # rotation kernel's scoped-VMEM budget and measured 2.1x slower
-        # through the XLA fallback.
-        if n >= 8192:
-            return 256
-        if n >= 2048:
-            return 128
-        b = 1
-        while b * 16 <= n and b < 128:
-            b *= 2
-        return b
+        from .tune import tables as _tables
+        return _tables.resolve(
+            n, m=m, dtype="float32" if dtype is None else dtype).block_size
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,21 @@ _SERVE_SCHEMA: Dict[str, Any] = {
     # required schema fields. In fleet mode (ServeConfig.lanes > 1) a
     # ``lane`` extra key carries the dispatching lane index.
 }
+# Autotuner search records ("tune", written by tune.search per searched
+# shape): the full measured grid — baseline knobs/time, every candidate
+# point's knobs/time/ok, and the winning knob set — plus the id/hash of
+# the table the run wrote, so a tuning table's provenance reconstructs
+# from the record stream alone (which grid, which times, which verdict).
+_TUNE_SCHEMA: Dict[str, Any] = {
+    "dimension": {"m": int, "n": int},
+    "dtype": str,
+    "key": dict,                  # n_class/aspect/dtype/backend/device_kind
+    "baseline": dict,             # {"knobs", "time_s", "reps", "ok", "note"}
+    "grid": list,                 # [{"knobs", "time_s", "reps", "ok"}]
+    "winner": dict,               # the knob set the table row encodes
+    "table_id": str,
+    "table_sha256": str,
+}
 # Fleet events ("fleet", written by serve.fleet in lanes mode): one
 # record per lane state transition / rescue / steal / probe / healthz
 # snapshot / ladder_overrun, so the whole eviction -> rescue -> recovery
@@ -263,6 +278,32 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
     return record
 
 
+def build_tune(*, m: int, n: int, dtype: str, key: dict, baseline: dict,
+               grid: List[dict], winner: dict, table_id: str,
+               table_sha256: str, **extra) -> dict:
+    """Assemble a schema-valid autotuner search record (`tune.search`):
+    one per searched shape — the (class) key, the measured baseline, every
+    grid point, the winning knob set, and the written table's identity.
+    ``extra`` (tiers, smoke, argv, ...) rides along like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "tune",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "dimension": {"m": int(m), "n": int(n)},
+        "dtype": str(dtype),
+        "key": dict(key),
+        "baseline": dict(baseline),
+        "grid": [dict(p) for p in grid],
+        "winner": dict(winner),
+        "table_id": str(table_id),
+        "table_sha256": str(table_sha256),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def build_fleet(*, event: str, lane: Optional[int] = None, **extra) -> dict:
     """Assemble a schema-valid fleet event record (`serve.fleet`).
 
@@ -328,6 +369,13 @@ def validate(record: dict) -> None:
                           errors)
     elif record.get("kind") == "serve":
         _check_fields(record, _SERVE_SCHEMA, "record", errors)
+    elif record.get("kind") == "tune":
+        _check_fields(record, _TUNE_SCHEMA, "record", errors)
+        for i, p in enumerate(record.get("grid") or []):
+            if not isinstance(p, dict) or not isinstance(p.get("knobs"),
+                                                         dict):
+                errors.append(f"record.grid[{i}]: expected an object with "
+                              f"a 'knobs' dict")
     elif record.get("kind") == "fleet":
         _check_fields(record, _FLEET_SCHEMA, "record", errors)
     else:
@@ -398,6 +446,25 @@ def summarize(record: dict) -> str:
                          f"{at.get('status', '?'):<11} "
                          f"sweeps={at.get('sweeps', '?'):>3} off={off_s}  "
                          f"{at.get('time_s', 0.0):7.2f} s")
+        return "\n".join(lines)
+    if record.get("kind") == "tune":
+        dim = record.get("dimension", {})
+        base = record.get("baseline", {})
+        bt = base.get("time_s")
+        lines = [
+            f"tune search @ {record.get('timestamp', '?')}  "
+            f"{dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
+            f"table={record.get('table_id')} "
+            f"({str(record.get('table_sha256', ''))[:12]})",
+            f"  baseline {base.get('knobs', {})}  "
+            + (f"{bt:.4f} s" if isinstance(bt, float) else "n/a"),
+        ]
+        for p in record.get("grid") or []:
+            t = p.get("time_s")
+            t_s = f"{t:.4f} s" if isinstance(t, float) else \
+                (p.get("note") or "n/a")
+            lines.append(f"  point {p.get('knobs', {})}  {t_s}")
+        lines.append(f"  winner {record.get('winner', {})}")
         return "\n".join(lines)
     if record.get("kind") == "fleet":
         lane = record.get("lane")

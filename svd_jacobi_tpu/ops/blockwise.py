@@ -325,7 +325,12 @@ def orthogonalize_pairs(
       `off_diag_stats`).
     """
     if gram_dtype is None:
-        gram_dtype = jnp.promote_types(top.dtype, jnp.float32)
+        # The shared accumulation-boundary default (tune.tables
+        # .default_gram_dtype — also `solver._resolve_options`'s), so the
+        # block-solver lane cannot drift from the fused lane's declared
+        # MIXED_PRECISION_BOUNDARIES contract.
+        from ..tune import tables as _tables
+        gram_dtype = _tables.default_gram_dtype(top.dtype)
     with_v = vtop is not None
     if not with_v:
         # Placeholders keep a single jitted signature; zero-size arrays cost

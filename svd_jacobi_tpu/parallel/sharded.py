@@ -372,7 +372,10 @@ def _plan_entry(a, mesh: Mesh, config: SVDConfig, *, compute_u: bool = True,
     (axis_name,) = mesh.axis_names
     n_devices = mesh.size
     n = a.shape[1]
-    b, k = _single._plan(n, n_devices, config)
+    # m/dtype refine the tuning-table width lookup (aspect/dtype rows) —
+    # the mesh plan must agree with the single-device plan for the same
+    # input, or the two lanes solve the same problem at different widths.
+    b, k = _single._plan(n, n_devices, config, m=a.shape[0], dtype=a.dtype)
     tol, gram_dtype_name, method, criterion = _single._resolve_options(
         a, config, compute_uv=compute_u)
     if method == "pallas" and b % 2:
@@ -387,7 +390,8 @@ def _plan_entry(a, mesh: Mesh, config: SVDConfig, *, compute_u: bool = True,
     # keep their own convergence structure, and an explicit "on" there is
     # rejected by the single-device solver too.
     precondition = (config.precondition == "auto" and method == "pallas"
-                    ) or config.precondition == "on"
+                    and _single._tuned(n, a.shape[0], a.dtype).precondition
+                    == "on") or config.precondition == "on"
     if config.precondition == "on" and method != "pallas":
         raise ValueError(
             f"precondition='on' requires the Pallas kernel path; this "
@@ -584,8 +588,10 @@ class SweepStepper(_single.SweepStepper):
                          full_matrices=full_matrices, config=config)
         # Re-plan with the mesh's device count (the base class planned for
         # 1), mirroring `sharded.svd`'s geometry exactly (including the
-        # even-b adjustment for the self kernel).
-        b, k = _single._plan(self.n, self.n_devices, config)
+        # even-b adjustment for the self kernel and the same m/dtype
+        # tuning-table lookup the base class just resolved).
+        b, k = _single._plan(self.n, self.n_devices, config,
+                             m=self.m, dtype=self.input_dtype)
         if self._kernel_path and b % 2:
             b += 1
         self.nblocks, self.n_pad = 2 * k, 2 * k * b

@@ -109,6 +109,33 @@ class BucketSet:
                 return b
         return None
 
+    def resolve_solver_configs(self, base) -> dict:
+        """bucket -> concrete solver config, resolved through the active
+        tuning table ONCE at declaration time (`tune.resolve_config`):
+        every "auto"/None knob of ``base`` the table can pin shape-safely
+        is pinned to the value the solver's own planner would resolve for
+        the bucket's padded shape. The service stores this map and every
+        dispatch path — lanes included — reads it instead of re-resolving
+        per request; resolution being pure/deterministic, the pinned
+        configs produce byte-identical jit keys to the auto path (the
+        TUNE001 analysis pass proves no new retraces)."""
+        from ..tune import tables
+        return {b: tables.resolve_config(base, m=b.m, n=b.n, dtype=b.dtype)
+                for b in self.buckets}
+
+    def resolved_batch_tiers(self) -> dict:
+        """bucket -> coalescing tier tuple from the active tuning table
+        (`ServeConfig.batch_tiers="auto"`): tiers are a measured knob —
+        which batch sizes amortize the latency-bound rotation chain is
+        backend-dependent (PROFILE.md item 22) — so the table rows carry
+        them per (n-class, aspect, dtype, backend, device_kind). Resolved
+        once at declaration, like the solver configs."""
+        from ..tune import tables
+        return {b: tuple(sorted(set(
+            int(t) for t in tables.resolve(b.n, m=b.m,
+                                           dtype=b.dtype).batch_tiers)))
+                for b in self.buckets}
+
     @staticmethod
     def pad(a, bucket: Bucket):
         """Zero-pad a tall (m, n) array up to the bucket shape (exact for

@@ -159,7 +159,12 @@ class ServeConfig:
     # tier holding it, zero-padding the tail slots (exact for the SVD —
     # an all-zero member deflates in one sweep), so the batched stepper
     # jits compile once per (bucket, tier) and the compile cache stays
-    # bounded. Tiers above ``max_batch`` are simply never used.
+    # bounded. Tiers above ``max_batch`` are simply never used. The
+    # string "auto" resolves each BUCKET's tier set through the active
+    # tuning table at declaration time (`tune.resolve(...).batch_tiers`
+    # — which batch sizes amortize is a measured, backend-dependent
+    # verdict; PROFILE.md item 22) — still static per bucket, so the
+    # compile-cache contract is unchanged.
     batch_tiers: tuple = DEFAULT_BATCH_TIERS
     # Anti-starvation bound on the coalescing window: once the oldest
     # queued request of ANOTHER bucket has waited this long, same-bucket
@@ -226,7 +231,21 @@ class SVDService:
         if config.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got "
                              f"{config.max_batch}")
-        tiers = tuple(sorted(set(int(t) for t in config.batch_tiers)))
+        self.buckets = BucketSet(config.buckets)
+        # Tuning-table resolution, ONCE per bucket at declaration: every
+        # dispatch path (all lanes — they inherit this map) reads the
+        # per-bucket resolved solver config instead of re-resolving per
+        # request, and `batch_tiers="auto"` takes each bucket's measured
+        # tier set from the same table.
+        self._bucket_solver = self.buckets.resolve_solver_configs(
+            config.solver)
+        if config.batch_tiers == "auto":
+            self._bucket_tiers = self.buckets.resolved_batch_tiers()
+            tiers = tuple(sorted(set(
+                t for ts in self._bucket_tiers.values() for t in ts)))
+        else:
+            tiers = tuple(sorted(set(int(t) for t in config.batch_tiers)))
+            self._bucket_tiers = {b: tiers for b in self.buckets}
         if not tiers or tiers[0] < 1:
             raise ValueError(f"batch_tiers must be a non-empty set of "
                              f"positive ints, got {config.batch_tiers!r}")
@@ -245,7 +264,6 @@ class SVDService:
                              "lane_open_threshold must be >= 1")
         self._tiers = tiers
         self.config = config
-        self.buckets = BucketSet(config.buckets)
         self._records: list = []
         self._stats: dict = {}
         self._lock = threading.Lock()
@@ -271,6 +289,19 @@ class SVDService:
     def breaker(self):
         """Lane 0's circuit breaker (see `queue`)."""
         return self.fleet.lanes[0].breaker
+
+    # -- tuning-table resolution (declaration-time, bucket-granular) --------
+
+    def _solver_for(self, bucket) -> SVDConfig:
+        """The bucket's declaration-time resolved solver config (falls
+        back to the base config for a bucket outside the declared set —
+        only warmup/probe internals could ever pass one)."""
+        return self._bucket_solver.get(bucket, self.config.solver)
+
+    def _tiers_for(self, bucket) -> tuple:
+        """The bucket's coalescing tier set (global unless
+        ``batch_tiers="auto"`` resolved per-bucket tiers)."""
+        return self._bucket_tiers.get(bucket, self._tiers)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -413,7 +444,7 @@ class SVDService:
                             jnp.zeros((b.m, b.n), jnp.dtype(b.dtype)),
                             lane)
                         st = SweepStepper(a, compute_u=cu, compute_v=cv,
-                                          config=self.config.solver)
+                                          config=self._solver_for(b))
                         state = self._place(st.init(), lane)
                         while st.should_continue(state):
                             state = st.step(state)
@@ -436,11 +467,12 @@ class SVDService:
             import numpy as _np
 
             from ..solver import BatchedSweepStepper
-            cap = min(self.config.max_batch, self._tiers[-1])
-            reachable = sorted({min(t for t in self._tiers if t >= c)
-                                for c in range(2, cap + 1)})
             for lane in self.fleet.lanes:
                 for b in self.buckets:
+                    tiers = self._tiers_for(b)
+                    cap = min(self.config.max_batch, tiers[-1])
+                    reachable = sorted({min(t for t in tiers if t >= c)
+                                        for c in range(2, cap + 1)})
                     for cu, cv in variants:
                         for tier in reachable:
                             a = self._place(
@@ -448,7 +480,7 @@ class SVDService:
                                           jnp.dtype(b.dtype)), lane)
                             st = BatchedSweepStepper(
                                 a, compute_u=cu, compute_v=cv,
-                                config=self.config.solver)
+                                config=self._solver_for(b))
                             state = self._place(st.init(), lane)
                             while st.should_continue(state):
                                 state = st.step(state)
@@ -714,7 +746,8 @@ class SVDService:
                 # without spending a sweep, as today), and never
                 # bypassing another bucket's request older than
                 # batch_bypass_age_s (anti-starvation).
-                limit = min(self.config.max_batch, self._tiers[-1]) - 1
+                limit = min(self.config.max_batch,
+                            self._tiers_for(req.bucket)[-1]) - 1
                 # A STOLEN head request's same-bucket followers live on
                 # the victim's queue, not this one (which was empty —
                 # that is why the lane stole): take only what is queued
@@ -911,12 +944,12 @@ class SVDService:
             return
         batch_id = f"b{next(self._batch_seq):05d}"
         batch_size = len(live)
-        tier = min((t for t in self._tiers if t >= batch_size),
+        bucket = live[0].bucket
+        tier = min((t for t in self._tiers_for(bucket) if t >= batch_size),
                    default=batch_size)
         with self._lock:
             lane.in_flight = list(live)
         try:
-            bucket = live[0].bucket
             cu = any(r.compute_u and not r.degraded for r in live)
             cv = any(r.compute_v and not r.degraded for r in live)
             deadlines = [r.deadline for r in live if r.deadline is not None]
@@ -1037,7 +1070,7 @@ class SVDService:
             self._stall(live[0], stall, lane)
         slow = chaos.consume_slow()
         st = BatchedSweepStepper(a, compute_u=cu, compute_v=cv,
-                                 config=self.config.solver)
+                                 config=self._solver_for(bucket))
         st.set_control(deadline=deadline, should_cancel=should_cancel)
         lane.in_step = True     # device/compile stalls are legitimate here
         try:
@@ -1099,7 +1132,7 @@ class SVDService:
             self._stall(req, stall, lane)
         slow = chaos.consume_slow()
         st = SweepStepper(a_pad, compute_u=cu, compute_v=cv,
-                          config=self.config.solver)
+                          config=self._solver_for(req.bucket))
         st.set_control(deadline=req.deadline,
                        should_cancel=req.cancel.is_set)
         lane.in_step = True     # device/compile stalls are legitimate here
@@ -1143,7 +1176,7 @@ class SVDService:
         lane.in_step = True     # the fused ladder blocks for whole solves
         try:
             return resilient_svd(a_pad, compute_u=cu, compute_v=cv,
-                                 config=self.config.solver,
+                                 config=self._solver_for(req.bucket),
                                  manifest_path=self.config.manifest_path,
                                  watchdog_s=self.config.ladder_watchdog_s,
                                  on_overrun=on_overrun)

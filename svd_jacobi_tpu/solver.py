@@ -117,15 +117,28 @@ def _abs_phase_tol(dtype) -> float:
     return _default_tol(1, 1, dtype, "abs")
 
 
-def _plan(n: int, n_devices: int, config: SVDConfig):
+def _tuned(n: int, m: Optional[int], dtype) -> "object":
+    """The active tuning-table resolution for a tall-oriented (m, n)
+    problem of ``dtype`` — the one lookup every "auto" knob below goes
+    through (`tune.tables.resolve`: pure and deterministic, so it is
+    jit/retrace-safe; the TUNE001 analysis pass checks it)."""
+    from .tune import tables as _tables
+    return _tables.resolve(n, m=m, dtype=jnp.dtype(dtype).name)
+
+
+def _plan(n: int, n_devices: int, config: SVDConfig, m: Optional[int] = None,
+          dtype=None):
     """Choose block width ``b`` and pair count ``k`` (columns pad to 2*k*b).
 
     On a multi-device mesh each device must hold k/P >= 2 pair slots (the
     ring exchange splices one incoming block per stream), and blocks are
     shrunk — even user-specified ones — so the padded width 2*k*b stays
     within ~2x of n instead of ballooning with the device count.
+    ``m``/``dtype`` refine the tuning-table lookup behind the automatic
+    width (aspect/dtype classes); omitted, the lookup assumes square f32
+    — the historical n-only behavior, kept for direct callers.
     """
-    b = config.pick_block_size(n)
+    b = config.pick_block_size(n, m=m, dtype=dtype)
     b = min(b, max(1, (n + 1) // 2))
     if n_devices > 1:
         b = min(b, max(1, -(-n // (4 * n_devices))))
@@ -152,17 +165,40 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     """
     m, n = a.shape
     method = config.pair_solver
+    tuned = None
+    if method == "auto" or config.criterion == "auto":
+        tuned = _tuned(n, m, a.dtype)
     if method == "auto":
-        if a.dtype == jnp.float64:
+        # The tuning table proposes the solver family; the capability
+        # guards below are the final word (they reproduce the historical
+        # hand-picked routing when the table's generic row proposes
+        # "pallas", and protect against a mis-tuned table ever selecting
+        # an incompatible solver):
+        #   * f64 computes rotations the Pallas kernel cannot (f32-only
+        #     MXU) -> qr-svd (gesvj-class relative accuracy);
+        #   * the kernel path needs min(m, n) >= 64 to block usefully,
+        #     and measures only the rel statistic — an explicit abs
+        #     criterion routes to the XLA block solvers instead ("auto"
+        #     means "pick a compatible solver");
+        #   * "hybrid" exists to protect U orthogonality; with
+        #     compute_uv=False there is no U and the cheap gram-eigh/abs
+        #     bulk path suffices.
+        method = tuned.pair_solver
+        if a.dtype == jnp.float64 and method in ("pallas",):
             method = "qr-svd"
-        elif min(m, n) >= 64 and config.criterion != "abs":
-            # The Pallas device-kernel path (TPU fast path; interpreter on
-            # CPU backends). An explicit abs criterion routes to the XLA
-            # block solvers instead — the kernel measures only the rel
-            # statistic, and "auto" means "pick a compatible solver".
-            method = "pallas"
-        else:
-            method = "hybrid" if compute_uv else "gram-eigh"
+        if method == "pallas" and not (min(m, n) >= 64
+                                       and config.criterion != "abs"):
+            method = "hybrid"
+        if method == "gram-eigh" and compute_uv:
+            # gram-eigh alone cannot deliver an orthogonal U (abs-class
+            # convergence only); a table may pin it for sigma-only
+            # classes, but a factor-computing auto solve upgrades to
+            # hybrid (gram-eigh bulk + qr-svd polish) — the guard the
+            # search harness mirrors by never offering bare gram-eigh
+            # for compute_uv grids.
+            method = "hybrid"
+        if method == "hybrid" and not compute_uv:
+            method = "gram-eigh"
     if method == "pallas" and a.dtype == jnp.float64:
         raise ValueError("pair_solver='pallas' computes rotations in float32; "
                          "use 'qr-svd' (the auto choice) for float64 inputs")
@@ -170,7 +206,19 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
         raise ValueError(f"unknown pair solver method: {method!r}")
     criterion = config.criterion
     if criterion == "auto":
-        criterion = "abs" if method == "gram-eigh" else "rel"
+        # Table value "follow" (the generic default) = derive from the
+        # resolved method: gram-eigh converges only to the absolute
+        # (sigma_max-relative) class, everything else runs the dgesvj
+        # rel statistic. A table may pin "rel"/"abs" outright, guarded
+        # by the same compatibility rules as explicit user values
+        # (pallas cannot measure abs; gram-eigh stalls under rel).
+        tcrit = tuned.criterion if tuned is not None else "follow"
+        if tcrit == "rel" and method != "gram-eigh":
+            criterion = "rel"
+        elif tcrit == "abs" and method != "pallas":
+            criterion = "abs"
+        else:
+            criterion = "abs" if method == "gram-eigh" else "rel"
     if method == "pallas":
         if criterion == "abs":
             # The kernel path measures only the rel (dgesvj scaled-coupling)
@@ -192,7 +240,8 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     # phase always runs with the abs default tolerance.
     tol = (config.tol if config.tol is not None
            else _default_tol(m, n, a.dtype, criterion))
-    gram_dtype = config.gram_dtype or jnp.promote_types(a.dtype, jnp.float32).name
+    from .tune import tables as _tables
+    gram_dtype = config.gram_dtype or _tables.default_gram_dtype(a.dtype)
     return float(tol), jnp.dtype(gram_dtype).name, method, criterion
 
 
@@ -951,7 +1000,7 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
     `svd()`; requires m >= n (`svd()` transposes wide inputs first).
     """
     m, n = a.shape
-    b, k = _plan(n, 1, config)
+    b, k = _plan(n, 1, config, m=m, dtype=a.dtype)
     n_pad = 2 * k * b
     tol, gram_dtype_name, method, criterion = _resolve_options(
         a, config, compute_uv=compute_u)
@@ -964,7 +1013,11 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
             b += 1
             k = max(1, -(-n // (2 * b)))
             n_pad = 2 * k * b
-        precondition = ("on" if config.precondition == "auto"
+        # Auto resolves through the tuning table ("double" is never a
+        # table value — dgejsv's second QR measured not worthwhile on
+        # random input, PROFILE.md — so auto picks between on/off).
+        precondition = (_tuned(n, m, a.dtype).precondition
+                        if config.precondition == "auto"
                         else config.precondition)
         bulk_bf16 = (config.bulk_bf16 if config.bulk_bf16 is not None
                      else False)
@@ -990,15 +1043,16 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
         if config.mixed_store not in ("auto", "f32", "bf16", "bf16g"):
             raise ValueError(
                 f"unknown mixed_store mode: {config.mixed_store!r}")
-        # auto = "f32": measured at 8192^2 on v5e (PROFILE.md item 17) the
-        # byte-halved regimes make the bulk monotonically faster (4.19 ->
-        # 3.51 -> 2.76 s) but every byte saved costs polish sweeps (4 ->
-        # 6 -> 8; storage rounding degrades the reconstituted state), so
-        # f32 storage + x3 applies stays the best END-TO-END mixed mode
-        # (6.27 vs 6.47 vs 6.66 s). The bf16 regimes remain selectable for
-        # chips whose polish-phase cost structure differs.
+        # auto resolves through the tuning table; the shipped verdict is
+        # "f32" (PROFILE.md item 17, measured at 8192^2 on v5e: the
+        # byte-halved regimes make the bulk monotonically faster, 4.19 ->
+        # 3.51 -> 2.76 s, but every byte saved costs polish sweeps 4 ->
+        # 6 -> 8, so f32 storage + x3 applies stays the best END-TO-END
+        # mixed mode, 6.27 vs 6.47 vs 6.66 s). The bf16 regimes remain
+        # selectable — per table row, for chips whose polish-phase cost
+        # structure differs, or explicitly.
         mixed_store = (config.mixed_store if config.mixed_store != "auto"
-                       else "f32")
+                       else _tuned(n, m, a.dtype).mixed_store)
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
         solve = _svd_pallas_donated if config.donate_input else _svd_pallas
@@ -1046,7 +1100,7 @@ def _plan_entry_batched(a, config: SVDConfig, *, compute_u: bool = True,
     exactly what `svd_batched` dispatches. Requires m >= n (the public
     entry transposes wide stacks first)."""
     bsz, m, n = a.shape
-    b, k = _plan(n, 1, config)
+    b, k = _plan(n, 1, config, m=m, dtype=a.dtype)
     n_pad = 2 * k * b
     tol, gram_dtype_name, method, criterion = _resolve_options(
         a[0], config, compute_uv=compute_u)
@@ -1069,7 +1123,9 @@ def _plan_entry_batched(a, config: SVDConfig, *, compute_u: bool = True,
             raise ValueError("mixed_bulk/bulk_bf16 are fused single-solve "
                              "bulk regimes; the batched lane runs plain "
                              "f32 kernel sweeps")
-        precondition = config.precondition in ("auto", "on")
+        precondition = (_tuned(n, m, a.dtype).precondition == "on"
+                        if config.precondition == "auto"
+                        else config.precondition == "on")
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
         kwargs = dict(
@@ -1357,7 +1413,7 @@ class SweepStepper(_SweepControlMixin):
         self.compute_u, self.compute_v = compute_u, compute_v
         self.full_matrices = full_matrices
         self.config = config
-        b, k = _plan(n, 1, config)
+        b, k = _plan(n, 1, config, m=m, dtype=a.dtype)
         (self.tol, self.gram_dtype_name, self.method,
          self.criterion) = _resolve_options(a, config, compute_uv=compute_u)
         self._kernel_path = (self.method == "pallas"
@@ -1379,7 +1435,10 @@ class SweepStepper(_SweepControlMixin):
             if b % 2:   # the self kernel splits blocks in half
                 b += 1
                 k = max(1, -(-n // (2 * b)))
-            self._precondition = config.precondition in ("auto", "on")
+            self._precondition = (
+                _tuned(n, m, a.dtype).precondition == "on"
+                if config.precondition == "auto"
+                else config.precondition == "on")
             self._accumulate = (compute_u if self._precondition
                                 else compute_v)
             self._pc = None          # lazy (q1, order, work) cache
@@ -1898,7 +1957,7 @@ class BatchedSweepStepper(_SweepControlMixin):
         self.input_dtype = a.dtype
         self.compute_u, self.compute_v = compute_u, compute_v
         self.config = config
-        b, k = _plan(n, 1, config)
+        b, k = _plan(n, 1, config, m=m, dtype=a.dtype)
         (self.tol, self.gram_dtype_name, self.method,
          self.criterion) = _resolve_options(a[0], config,
                                             compute_uv=compute_u)
@@ -1915,7 +1974,10 @@ class BatchedSweepStepper(_SweepControlMixin):
             if b % 2:   # the self kernel splits blocks in half
                 b += 1
                 k = max(1, -(-n // (2 * b)))
-            self._precondition = config.precondition in ("auto", "on")
+            self._precondition = (
+                _tuned(n, m, a.dtype).precondition == "on"
+                if config.precondition == "auto"
+                else config.precondition == "on")
             self._accumulate = (compute_u if self._precondition
                                 else compute_v)
             self._pc = None

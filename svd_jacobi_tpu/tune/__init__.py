@@ -1,0 +1,36 @@
+"""Measured autotuner: benchmark-driven tuning tables behind every "auto".
+
+Two halves (ROADMAP "Measured autotuner replacing hand-picked constants"):
+
+  * :mod:`tune.tables` — schema-versioned, content-hashed tuning tables
+    plus the ONE deterministic lookup (:func:`resolve`) every "auto"/None
+    knob in `SVDConfig` goes through (block width, ``mixed_store``,
+    ``pair_solver``, ``precondition``, ``criterion``, serve batch tiers).
+    Shipped defaults (``tune/tables/default.json``) encode the measured
+    conclusions of PROFILE.md items 17-18; a missing or corrupt table
+    falls back — loudly — to the builtin generic row, which reproduces
+    the historical hand-picked heuristics exactly.
+  * :mod:`tune.search` — the ATLAS/OpenTuner-style empirical search
+    harness (`python -m svd_jacobi_tpu.tune`, `cli.py tune`): benchmarks
+    the knob grid per (n-class, aspect-class, dtype, backend,
+    device_kind) with a same-session A/B protocol, warm-up discard and a
+    per-point time budget, writes a regenerated table, and appends one
+    schema-versioned "tune" manifest record per searched shape so a
+    table's provenance reconstructs from the record stream.
+"""
+
+from __future__ import annotations
+
+from .tables import (GENERIC_KNOBS, KNOBS, Resolved, TableError, TuningTable,
+                     active_table, aspect_class, builtin_table,
+                     default_gram_dtype, heuristic_block_size, load_table,
+                     n_class, resolve, resolve_config, save_table,
+                     set_active_table, shipped_table_dir, shipped_table_path)
+
+__all__ = [
+    "GENERIC_KNOBS", "KNOBS", "Resolved", "TableError", "TuningTable",
+    "active_table", "aspect_class", "builtin_table", "default_gram_dtype",
+    "heuristic_block_size", "load_table", "n_class", "resolve",
+    "resolve_config", "save_table", "set_active_table", "shipped_table_dir",
+    "shipped_table_path",
+]

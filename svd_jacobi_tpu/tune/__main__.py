@@ -1,0 +1,123 @@
+"""`python -m svd_jacobi_tpu.tune` — regenerate a tuning table by
+measurement (also reachable as `python -m svd_jacobi_tpu.cli tune ...`).
+
+Benchmarks the knob grid on the ATTACHED backend (this is a measurement
+tool — unlike `svd_jacobi_tpu.analysis` it deliberately dials the real
+device) and writes a schema-versioned, content-hashed table; pin it with
+``--tuning-table=PATH`` on bench.py / the CLI, or SVDJ_TUNING_TABLE.
+
+    python -m svd_jacobi_tpu.tune --smoke            # bounded CPU smoke grid
+    python -m svd_jacobi_tpu.tune --out reports/tuning-cpu.json
+    python -m svd_jacobi_tpu.tune --shapes 2048x2048:float32,65536x4096:float32
+
+Exit 0 on a written table; one "tune" manifest record per searched shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+
+def _parse_shapes(spec: str):
+    shapes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            dims, dtype = part.split(":")
+            m, n = dims.split("x")
+            shapes.append((int(m), int(n), dtype))
+        except ValueError:
+            raise SystemExit(f"--shapes entry {part!r} is not of the form "
+                             f"'MxN:dtype'")
+    if not shapes:
+        raise SystemExit("--shapes parsed to an empty list")
+    return tuple(shapes)
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="svd-tune",
+        description="Measured autotuner: benchmark the knob grid and write "
+                    "a versioned tuning table.")
+    p.add_argument("--smoke", action="store_true",
+                   help="bounded smoke grid (2 shapes x 2 knob axes, tiny "
+                        "budgets) — the `-m tune` CI lane's configuration")
+    p.add_argument("--shapes", default=None, metavar="MxN:dtype,...",
+                   help="benchmark shapes (default: a CPU-regenerable "
+                        "small/medium set; --smoke overrides)")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="table output path (default: "
+                        "reports/tuning-<backend>.json)")
+    p.add_argument("--reps", type=int, default=3,
+                   help="timed repetitions per grid point (best-of; the "
+                        "warm-up run is always discarded)")
+    p.add_argument("--budget-s", type=float, default=60.0,
+                   help="per-point TIMED budget in seconds; a point whose "
+                        "first repetition exceeds it records that one "
+                        "honest rep and stops")
+    p.add_argument("--min-gain", type=float, default=0.03,
+                   help="fraction a challenger must beat the incumbent by "
+                        "to win (conservative: below this is noise)")
+    p.add_argument("--tiers", default="auto",
+                   choices=["auto", "off"],
+                   help="also measure serve batch tiers (svd_batched vs "
+                        "serial same-session A/B) on the smallest shape")
+    p.add_argument("--table-id", default=None,
+                   help="table id (default: <backend>-<device_kind>-r01)")
+    p.add_argument("--manifest", default="reports/manifest.jsonl",
+                   help="manifest JSONL ('tune' records; 'off' disables)")
+    p.add_argument("--platform", default=None,
+                   help="pin the JAX backend (e.g. cpu) before any device "
+                        "dial — the same escape hatch as bench.py")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(sys.argv[1:] if argv is None else list(argv))
+
+    import jax
+    platform = args.platform or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from . import search, tables
+    if args.smoke:
+        shapes = search.SMOKE_SHAPES
+        reps = min(args.reps, 2)
+        budget_s = min(args.budget_s, 10.0)
+    else:
+        shapes = (_parse_shapes(args.shapes) if args.shapes
+                  else search.DEFAULT_SHAPES)
+        reps, budget_s = args.reps, args.budget_s
+    if any(d == "float64" for _, _, d in shapes):
+        jax.config.update("jax_enable_x64", True)
+
+    backend = jax.default_backend()
+    out = Path(args.out) if args.out else Path("reports") / (
+        f"tuning-{backend}{'-smoke' if args.smoke else ''}.json")
+    tiers_shape = None
+    if args.tiers == "auto":
+        # Tier measurement on the smallest shape: coalescing pays most at
+        # small buckets (PROFILE.md item 22), and the smallest shape keeps
+        # the B-stack solves inside the budget.
+        tiers_shape = min(shapes, key=lambda s: s[0] * s[1] * s[1])
+    summary = search.run(
+        shapes=shapes, out_path=out, reps=reps, budget_s=budget_s,
+        min_gain=args.min_gain, smoke=args.smoke, tiers_shape=tiers_shape,
+        manifest_path=args.manifest,
+        table_id=args.table_id)
+    # Prove the written table loads + resolves before calling it done.
+    table = tables.load_table(out)
+    summary["rows"] = len(table.rows)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,438 @@
+"""Empirical knob search — the harness that regenerates tuning tables.
+
+ATLAS/OpenTuner-style measured search over the solver's tunable knobs
+(:data:`tune.tables.KNOBS`), per benchmark shape, with the measurement
+discipline PROFILE.md rounds 4-5 used by hand:
+
+  * SAME-SESSION A/B: every candidate is timed in one process against the
+    baseline (the knobs the active resolution would pick today), interleaved
+    warm — environment drift between sessions was the reason item 18's
+    crossovers needed same-session re-runs;
+  * WARM-UP DISCARD: the first run of every candidate compiles and warms
+    caches and is never timed;
+  * PER-POINT TIME BUDGET: a candidate whose first timed repetition
+    exceeds the budget records that one honest repetition and stops —
+    a full CPU regeneration stays bounded (~10 min default grid);
+  * COORDINATE DESCENT, not a full cross product: knob axes are swept one
+    at a time from the baseline (the measured knobs interact weakly —
+    items 17-18 tuned them independently), so the point count is the SUM
+    of axis sizes, not the product;
+  * CONSERVATIVE WINNERS: a candidate must beat the baseline by more than
+    ``min_gain`` (default 3% — under the same-session run-to-run noise
+    floor observed in PROFILE.md) to displace it, so a regenerated table
+    never encodes noise as a verdict.
+
+Each searched shape appends one schema-versioned ``"tune"`` manifest
+record (grid point knobs + times + winner — `obs.manifest.build_tune`),
+so a table's provenance reconstructs from the record stream alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import tables
+
+
+@dataclasses.dataclass
+class Point:
+    """One measured grid point."""
+
+    knobs: Dict[str, object]
+    time_s: Optional[float] = None
+    reps: int = 0
+    ok: bool = False
+    note: str = ""
+
+    def as_record(self) -> dict:
+        return {"knobs": dict(self.knobs),
+                "time_s": self.time_s, "reps": self.reps,
+                "ok": self.ok, "note": self.note}
+
+
+@dataclasses.dataclass
+class ShapeResult:
+    """Search outcome for one benchmark shape."""
+
+    m: int
+    n: int
+    dtype: str
+    key: Dict[str, str]
+    baseline: Point
+    points: List[Point]
+    winner: Dict[str, object]
+    tiers: Optional[List[dict]] = None
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _build_config(base, knobs: Dict[str, object]):
+    """An `SVDConfig` with the candidate knob values applied (only the
+    solver-side knobs; serve ``batch_tiers`` is measured separately)."""
+    import dataclasses as _dc
+    updates = {}
+    for k in ("block_size", "mixed_store", "pair_solver", "precondition",
+              "criterion"):
+        if k in knobs:
+            updates[k] = knobs[k]
+    if updates.get("pair_solver", "auto") not in ("auto", "pallas"):
+        # Preconditioning is a Pallas-path mode; pinning "on" onto an
+        # explicit XLA solver is a validation error, not a grid point.
+        if updates.get("precondition", "auto") in ("on", "double"):
+            updates["precondition"] = "auto"
+    return _dc.replace(base, **updates)
+
+
+def time_solve(a, config, *, reps: int, budget_s: float,
+               compute_uv: bool = True) -> Point:
+    """Best-of-``reps`` wall time of one config on one input, warm-up
+    discarded, bounded by ``budget_s`` of TIMED work. Failures (a config
+    invalid for the shape, OOM, ...) record as ok=False — one broken
+    candidate must not void the shape's whole search."""
+    from .. import solver
+    from ..utils._exec import force
+    point = Point(knobs={})
+    try:
+        solve = lambda: solver.svd(a, compute_u=compute_uv,
+                                   compute_v=compute_uv, config=config)
+        r = solve()
+        force((r.s, r.status))          # warm-up: compile + caches, DISCARDED
+        if r.status_enum().name not in ("OK", "STAGNATED"):
+            point.note = f"warmup status {r.status_enum().name}"
+            return point
+        best = float("inf")
+        spent = 0.0
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            force((solve().s,))
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+            point.reps += 1
+            spent += dt
+            if spent + best > budget_s:
+                break                    # budget: keep what was measured
+        point.time_s = best
+        point.ok = True
+    except Exception as e:               # noqa: BLE001 — candidate quality
+        point.note = f"{type(e).__name__}: {e}"
+    return point
+
+
+def _axes(n: int, dtype: str, baseline: Dict[str, object],
+          smoke: bool) -> List[Tuple[str, List[object]]]:
+    """The knob axes swept for one shape (values exclude the baseline's
+    own — it is already measured). Axis values are capability-filtered
+    up front so the grid never spends budget on a certainly-invalid
+    point (f64 x pallas, b > n/2, ...)."""
+    import jax.numpy as jnp
+    f64 = jnp.dtype(dtype) == jnp.float64
+    # Whether auto routing would take the Pallas kernel path — the
+    # precondition knob only exists there, and sweeping it on an
+    # XLA-routed shape would time the identical program twice (recording
+    # noise as a verdict).
+    pallas_routed = (not f64) and n >= 64
+    if smoke:
+        # The documented smoke grid: 2 knob axes, tiny value sets.
+        axes = [("block_size", [b for b in (4, 8) if b <= max(1, n // 2)]),
+                ("pair_solver", (["pallas"] if pallas_routed else [])
+                 + ["qr-svd"])]
+        return [(k, [v for v in vs if v != baseline.get(k)])
+                for k, vs in axes]
+    block_axis = [b for b in (64, 128, 256) if b <= max(1, (n + 1) // 2)]
+    if not block_axis:
+        block_axis = [b for b in (4, 8, 16, 32) if b <= max(1, n // 2)]
+    # gram-eigh is offered only where U orthogonality is not at stake —
+    # it converges to the absolute class only (ops.blockwise), so a
+    # measured table must never route compute_uv solves onto it.
+    solver_axis = (["qr-svd"] if f64
+                   else (["pallas", "hybrid", "qr-svd"] if n >= 64
+                         else ["hybrid", "qr-svd"]))
+    axes = [
+        ("block_size", block_axis),
+        ("pair_solver", solver_axis),
+    ]
+    if pallas_routed:
+        axes.append(("precondition", ["on", "off"]))
+    return [(k, [v for v in vs if v != baseline.get(k)]) for k, vs in axes]
+
+
+def measure_batch_tiers(n: int, m: int, dtype: str, *, candidates=(4, 16),
+                        reps: int, budget_s: float,
+                        base_config=None) -> Tuple[Tuple[int, ...],
+                                                   List[dict]]:
+    """Measure which coalescing tiers pay on this backend: per-candidate
+    tier B, one `solver.svd_batched` dispatch of a B-stack vs B serial
+    solves of the same members (same-session, warm-up discarded). A tier
+    joins the set when the coalesced dispatch is cheaper per member
+    (ratio > 1.05 — the coalescing exists to amortize the latency-bound
+    rotation chain, PROFILE.md item 22)."""
+    import jax.numpy as jnp
+
+    from .. import solver
+    from ..config import SVDConfig
+    from ..utils import matgen
+    from ..utils._exec import force
+
+    base = base_config if base_config is not None else SVDConfig()
+    dt = jnp.dtype(dtype)
+    rows: List[dict] = []
+    tiers = [1]
+    for bsz in sorted(set(int(b) for b in candidates)):
+        if bsz < 2:
+            continue
+        try:
+            stack = jnp.stack([matgen.random_dense(m, n, seed=5000 + j,
+                                                   dtype=dt)
+                               for j in range(bsz)])
+            batched = lambda: solver.svd_batched(stack, config=base)
+            serial = lambda: [solver.svd(stack[j], config=base)
+                              for j in range(bsz)]
+            force((batched().s,))                      # warm-up, discarded
+            force(tuple(r.s for r in serial()))
+            t_b = t_s = float("inf")
+            spent = 0.0
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                force((batched().s,))
+                dt_b = time.perf_counter() - t0
+                t_b = min(t_b, dt_b)
+                t0 = time.perf_counter()
+                force(tuple(r.s for r in serial()))
+                dt_s = time.perf_counter() - t0
+                t_s = min(t_s, dt_s)
+                # Budget on the MEASURED durations (the minima would
+                # undercount a slow point and run far past the budget).
+                spent += dt_b + dt_s
+                if spent > budget_s:
+                    break
+            ratio = t_s / t_b if t_b > 0 else 0.0
+            keep = ratio > 1.05
+            rows.append({"tier": bsz, "batched_s": t_b, "serial_s": t_s,
+                         "speedup": ratio, "kept": keep})
+            if keep:
+                tiers.append(bsz)
+        except Exception as e:              # noqa: BLE001
+            rows.append({"tier": bsz, "batched_s": None, "serial_s": None,
+                         "speedup": None, "kept": False,
+                         "note": f"{type(e).__name__}: {e}"})
+    return tuple(tiers), rows
+
+
+def search_shape(m: int, n: int, dtype: str, *, reps: int, budget_s: float,
+                 min_gain: float, smoke: bool,
+                 base_config=None) -> ShapeResult:
+    """Coordinate-descent search over one shape: measure the baseline
+    (today's resolution), sweep each knob axis, and keep a challenger
+    only when it beats the incumbent by more than ``min_gain``."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..utils import matgen
+
+    from .. import solver
+
+    dt = jnp.dtype(dtype)
+    a = matgen.random_dense(m, n, seed=1_000_000, dtype=dt)
+    base = base_config if base_config is not None else SVDConfig()
+    resolved = tables.resolve(n, m=m, dtype=dtype)
+    key = {
+        "n_class": tables.n_class(n),
+        "aspect": tables.aspect_class(m, n),
+        "dtype": str(dt.name),
+        "backend": tables._runtime_identity()[0],
+        "device_kind": tables._runtime_identity()[1],
+    }
+    # The baseline records the ROUTED solver (what "auto" resolves to
+    # today), so the sweep never wastes a point re-timing the identical
+    # program under an explicit spelling — and a winner row pins the
+    # measured method by name, not "auto".
+    routed = (solver._resolve_options(a, base, compute_uv=True)[2]
+              if base.pair_solver == "auto" else base.pair_solver)
+    baseline_knobs = {
+        "block_size": resolved.block_size,
+        "mixed_store": resolved.mixed_store,
+        "pair_solver": routed,
+        "precondition": resolved.precondition,
+        "criterion": base.criterion,
+    }
+    _log(f"tune: shape {m}x{n} {dt.name} baseline {baseline_knobs}")
+    baseline = time_solve(a, base, reps=reps, budget_s=budget_s)
+    baseline.knobs = dict(baseline_knobs)
+    if not baseline.ok:
+        _log(f"tune: baseline failed ({baseline.note}); shape skipped")
+        return ShapeResult(m=m, n=n, dtype=dt.name, key=key,
+                           baseline=baseline, points=[],
+                           winner=dict(baseline_knobs))
+    _log(f"tune: baseline {baseline.time_s:.4f} s ({baseline.reps} reps)")
+
+    incumbent_knobs = dict(baseline_knobs)
+    incumbent_time = baseline.time_s
+    points: List[Point] = []
+    for knob, values in _axes(n, dt.name, baseline_knobs, smoke):
+        for value in values:
+            cand = dict(incumbent_knobs)
+            cand[knob] = value
+            cfg = _build_config(base, cand)
+            point = time_solve(a, cfg, reps=reps, budget_s=budget_s)
+            point.knobs = dict(cand)
+            points.append(point)
+            shown = f"{point.time_s:.4f} s" if point.ok else point.note
+            _log(f"tune:   {knob}={value!r}: {shown}")
+            if (point.ok and point.time_s is not None
+                    and point.time_s < incumbent_time * (1.0 - min_gain)):
+                incumbent_knobs = cand
+                incumbent_time = point.time_s
+                _log(f"tune:   -> new incumbent ({knob}={value!r})")
+    return ShapeResult(m=m, n=n, dtype=dt.name, key=key, baseline=baseline,
+                       points=points, winner=incumbent_knobs)
+
+
+def _winner_row(res: ShapeResult) -> dict:
+    """A table row from one shape's winner. The row matches the shape's
+    full key (backend + device_kind pinned — a measured verdict holds
+    only for the hardware it was measured on); knob values that are
+    still the AUTO defaults pin anyway, recording the measurement."""
+    knobs: Dict[str, object] = {}
+    for k, v in res.winner.items():
+        if k in ("pair_solver", "criterion") and v == "auto":
+            continue                      # never pin an unmeasured "auto"
+        if k in tables.KNOBS:
+            knobs[k] = v
+    if knobs.get("block_size") == tables.heuristic_block_size(res.n):
+        # The winner IS the exact-n ladder value: record the ladder
+        # POLICY (null), not the number — a class spans many n and two
+        # same-class shapes with different ladder optima would otherwise
+        # write conflicting rows.
+        knobs["block_size"] = None
+    if res.tiers is not None:
+        kept = tuple(sorted({1} | {r["tier"] for r in res.tiers
+                                   if r.get("kept")}))
+        knobs["batch_tiers"] = list(kept)
+    delta = None
+    if res.baseline.time_s and res.winner != res.baseline.knobs:
+        best = min((p.time_s for p in res.points
+                    if p.ok and p.knobs == res.winner),
+                   default=res.baseline.time_s)
+        delta = f"{res.baseline.time_s:.4f} -> {best:.4f} s"
+    return {
+        "match": dict(res.key),
+        "knobs": knobs,
+        "evidence": (f"measured {res.m}x{res.n} {res.dtype} "
+                     f"(baseline {res.baseline.time_s:.4f} s"
+                     + (f"; winner {delta}" if delta else "; baseline kept")
+                     + ")"),
+    }
+
+
+DEFAULT_SHAPES = ((256, 256, "float32"), (512, 512, "float32"),
+                  (2048, 256, "float32"))
+SMOKE_SHAPES = ((64, 48, "float32"), (96, 64, "float32"))
+
+
+def run(*, shapes: Sequence[Tuple[int, int, str]], out_path,
+        reps: int = 3, budget_s: float = 60.0, min_gain: float = 0.03,
+        smoke: bool = False, tiers_shape: Optional[Tuple[int, int, str]]
+        = None, manifest_path: Optional[str] = "reports/manifest.jsonl",
+        table_id: Optional[str] = None, base_config=None) -> dict:
+    """The full regeneration: search every shape, write the table, append
+    the "tune" manifest records. Returns a summary dict (one parseable
+    JSON object — the __main__ prints it)."""
+    from ..obs import manifest
+
+    t_start = time.perf_counter()
+    results: List[ShapeResult] = []
+    for m, n, dtype in shapes:
+        res = search_shape(int(m), int(n), str(dtype), reps=reps,
+                           budget_s=budget_s, min_gain=min_gain,
+                           smoke=smoke, base_config=base_config)
+        results.append(res)
+    if tiers_shape is not None:
+        tm, tn, tdtype = tiers_shape
+        target = next((r for r in results
+                       if (r.m, r.n, r.dtype) == (int(tm), int(tn),
+                                                  str(tdtype))), None)
+        tiers, tier_rows = measure_batch_tiers(
+            int(tn), int(tm), str(tdtype),
+            candidates=(4,) if smoke else (4, 16),
+            reps=reps, budget_s=budget_s, base_config=base_config)
+        _log(f"tune: batch tiers {tiers} ({tier_rows})")
+        if target is not None:
+            target.tiers = tier_rows
+        else:
+            # A tiers_shape outside the searched set has no class row to
+            # attach the verdict to — dropping it loudly beats grafting
+            # it onto an unrelated shape's row.
+            _log(f"tune: tiers shape {tiers_shape} not among the searched "
+                 f"shapes; tier verdict dropped")
+
+    backend, device_kind = tables._runtime_identity()
+    tid = table_id or (f"{backend}-{device_kind}-"
+                       f"{'smoke' if smoke else 'r01'}")
+    rows = []
+    by_match: Dict[str, dict] = {}
+    for res in results:
+        if not res.baseline.ok:
+            continue
+        row = _winner_row(res)
+        mkey = json.dumps(row["match"], sort_keys=True)
+        prior = by_match.get(mkey)
+        if prior is None:
+            by_match[mkey] = row
+            rows.append(row)
+            continue
+        # Two searched shapes landed in the same class key: merge —
+        # first writer wins a conflicting knob (declaration order is
+        # the documented tie-break), agreement just accumulates
+        # evidence. Disagreement on a non-null knob is surfaced in the
+        # evidence string so a reader of the table sees it.
+        for k, v in row["knobs"].items():
+            if k not in prior["knobs"]:
+                prior["knobs"][k] = v
+            elif prior["knobs"][k] != v:
+                prior["evidence"] += (f"; CONFLICT from {res.m}x{res.n}: "
+                                      f"{k}={v!r} lost to "
+                                      f"{prior['knobs'][k]!r}")
+        prior["evidence"] += f" | {row['evidence']}"
+    # The generic fallback row closes every table (tables without one
+    # would leave unmatched problems knob-less).
+    rows.append({"match": {}, "knobs": dict(tables.GENERIC_KNOBS),
+                 "evidence": "generic fallback: the hand-picked defaults "
+                             "(tune.tables.GENERIC_KNOBS)"})
+    table = tables.save_table(
+        out_path, table_id=tid, rows=rows,
+        provenance=(f"regenerated by `python -m svd_jacobi_tpu.tune` on "
+                    f"{backend}/{device_kind}; shapes "
+                    f"{[(r.m, r.n, r.dtype) for r in results]}; see the "
+                    f"'tune' manifest records for the full grid"))
+
+    records = []
+    for res in results:
+        rec = manifest.build_tune(
+            m=res.m, n=res.n, dtype=res.dtype, key=res.key,
+            baseline=res.baseline.as_record(),
+            grid=[p.as_record() for p in res.points],
+            winner=dict(res.winner),
+            table_id=table.table_id, table_sha256=table.sha256,
+            tiers=res.tiers, smoke=bool(smoke))
+        records.append(rec)
+        if manifest_path and manifest_path != "off":
+            manifest.append(manifest_path, rec)
+    summary = {
+        "table": str(out_path),
+        "table_id": table.table_id,
+        "table_sha256": table.sha256,
+        "shapes": len(results),
+        "points": sum(len(r.points) for r in results),
+        "changed": sum(1 for r in results
+                       if r.baseline.ok and r.winner != r.baseline.knobs),
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "manifest": (manifest_path if manifest_path
+                     and manifest_path != "off" else None),
+    }
+    return summary

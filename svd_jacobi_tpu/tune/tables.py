@@ -1,0 +1,529 @@
+"""Versioned tuning tables — the ONE lookup layer behind every "auto" knob.
+
+PROFILE.md items 17-18 established that the performance-critical knobs
+(block width ``b``, ``mixed_store``, ``pair_solver``, ``precondition``,
+serve ``batch_tiers``) have data-size- and chip-dependent crossover points
+that were found by hand, one chip and one round at a time. This module
+replaces the growing if-ladders (``SVDConfig.pick_block_size``, the
+``"auto"`` branches in ``solver._resolve_options``/``solver._plan_entry``)
+with a declarative, schema-versioned, content-hashed table:
+
+  * a table is a JSON document of ROWS; each row has a ``match`` block
+    (``n_class`` / ``aspect`` / ``dtype`` / ``backend`` / ``device_kind``,
+    absent keys are wildcards) and a ``knobs`` block (concrete values for
+    any subset of :data:`KNOBS`);
+  * :func:`resolve` classifies a problem ``(n, m, dtype, backend,
+    device_kind)`` and walks the matching rows most-specific-first; the
+    first row providing a knob wins, and the builtin ``generic`` defaults
+    (exactly the pre-table hand-picked heuristics) backstop everything —
+    so a MISSING or corrupt table degrades loudly to the historical
+    behavior, never to a crash;
+  * resolution is a PURE DETERMINISTIC function of its arguments — no
+    clocks, no benchmarking, no device calls beyond the (cached) backend
+    identity — so it is jit/retrace-safe and the analysis passes
+    (``TUNE001``) can machine-check it.
+
+Tables are produced two ways: the SHIPPED default
+(``tune/tables/default.json``) encodes the measured conclusions of
+PROFILE.md items 17-18 (b=256 for fused square n >= 8192, b=128 below and
+for tall-skinny, ``mixed_store="f32"``), and `python -m svd_jacobi_tpu.tune`
+regenerates a local table by measuring the knob grid on the attached
+backend (``tune.search``). Pin one with ``--tuning-table=PATH`` (bench/cli),
+``SVDJ_TUNING_TABLE=PATH`` (environment), or :func:`set_active_table`;
+``off`` bypasses tables entirely (builtin generic defaults).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import json
+import os
+import warnings
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+SCHEMA_VERSION = 1
+
+# The tunable knobs a table row may pin. Everything else in SVDConfig is
+# either semantic (tolerances, job options) or validated elsewhere.
+KNOBS = ("block_size", "mixed_store", "pair_solver", "precondition",
+         "criterion", "batch_tiers")
+
+# Problem-size classes (columns n of the tall-oriented problem). The
+# boundaries are the measured crossover neighborhoods of PROFILE.md item
+# 18 (b=128 -> 256 at n = 8192) and the kernel-path threshold
+# (solver._resolve_options: the Pallas lane needs min(m, n) >= 64 to
+# block usefully).
+N_CLASSES = ("tiny", "small", "medium", "large")
+# Aspect classes on m/n of the tall-oriented (m >= n) problem. "tall"
+# starts at m >= 8n: item 18's 65536x4096 (m/n = 16) keeps b=128 while
+# 32768x8192 (m/n = 4) takes the square verdict — the boundary sits
+# between them.
+ASPECT_CLASSES = ("square", "tall")
+TALL_ASPECT_RATIO = 8
+
+_MATCH_KEYS = ("n_class", "aspect", "dtype", "backend", "device_kind")
+_VALID_MIXED_STORE = ("f32", "bf16", "bf16g")
+_VALID_PAIR_SOLVER = ("pallas", "qr-svd", "gram-eigh", "hybrid")
+# "double" (dgejsv's second QR) is deliberately NOT a table value: it is
+# a fused-single-solve-only mode the stepper/batched/mesh lanes cannot
+# run, so a row pinning it would make the fused and served solves of the
+# same problem diverge. Explicit config.precondition="double" remains
+# available; tables choose between on/off.
+_VALID_PRECONDITION = ("on", "off")
+_VALID_CRITERION = ("follow", "rel", "abs")
+
+
+def n_class(n: int) -> str:
+    """Size class of the column count ``n`` (tall-oriented problem)."""
+    if n < 64:
+        return "tiny"
+    if n < 2048:
+        return "small"
+    if n < 8192:
+        return "medium"
+    return "large"
+
+
+def aspect_class(m: Optional[int], n: int) -> str:
+    """Aspect class: "tall" from m >= 8n up, else "square". ``m`` None
+    (callers that only know n, e.g. direct ``pick_block_size`` use)
+    defaults to square — the historical n-only behavior."""
+    if m is None:
+        return "square"
+    return "tall" if m >= TALL_ASPECT_RATIO * n else "square"
+
+
+def normalize_device_kind(kind: str) -> str:
+    """Canonical device-kind token: lowercase, spaces/underscores to
+    dashes ("TPU v5 lite" -> "tpu-v5-lite") so table rows match across
+    jax's spelling variations."""
+    return str(kind).strip().lower().replace(" ", "-").replace("_", "-")
+
+
+@functools.lru_cache(maxsize=None)
+def _runtime_identity() -> Tuple[str, str]:
+    """(backend, device_kind) of the attached runtime, cached. Resolution
+    never calls this when the caller pins both — keeping offline use
+    (table tooling, tests) free of any device dial."""
+    import jax
+    backend = jax.default_backend()
+    devices = jax.devices()
+    kind = devices[0].device_kind if devices else "unknown"
+    return backend, normalize_device_kind(kind)
+
+
+def heuristic_block_size(n: int) -> int:
+    """The legacy hand-picked block-width ladder — the pre-table
+    ``SVDConfig.pick_block_size`` body, kept verbatim as the ``generic``
+    fallback so a missing/bypassed table reproduces the historical
+    defaults bit-for-bit. Measured basis (PROFILE.md item 18): b=256
+    crosses the f32 ridge and wins end-to-end from n = 8192 up (16384^2:
+    34.8 vs 39.0 s) and loses below (4096^2: 0.98 vs 0.88 s); b=512
+    exceeds the rotation kernel's scoped-VMEM budget (2.1x slower via
+    the XLA fallback)."""
+    if n >= 8192:
+        return 256
+    if n >= 2048:
+        return 128
+    b = 1
+    while b * 16 <= n and b < 128:
+        b *= 2
+    return b
+
+
+def default_gram_dtype(dtype) -> str:
+    """The one declared mixed-precision accumulation boundary
+    (``config.MIXED_PRECISION_BOUNDARIES``): Gram panels / rotations
+    accumulate in ``promote_types(input, float32)`` — f32 for f32/bf16
+    inputs, f64 for f64. Shared by ``solver._resolve_options`` and
+    ``ops.blockwise.orthogonalize_pairs`` so the None-default cannot
+    drift between the fused and block-solver lanes."""
+    import jax.numpy as jnp
+    return jnp.promote_types(jnp.dtype(dtype), jnp.float32).name
+
+
+# The builtin ``generic`` knob set: exactly the historical hand-picked
+# defaults. ``block_size`` None = the exact-n heuristic ladder;
+# ``pair_solver`` "pallas" is a CANDIDATE subject to the solver's
+# capability guards (f64 -> qr-svd, min(m, n) < 64 -> hybrid/gram-eigh,
+# explicit criterion="abs" -> XLA solvers), which reproduce the old
+# if-ladder; ``criterion`` "follow" = derive from the resolved method
+# (abs for gram-eigh, rel otherwise).
+GENERIC_KNOBS: Dict[str, object] = {
+    "block_size": None,
+    "mixed_store": "f32",      # PROFILE.md item 17 (v5e measured)
+    "pair_solver": "pallas",
+    "precondition": "on",
+    "criterion": "follow",
+    "batch_tiers": (1, 4, 16),  # config.DEFAULT_BATCH_TIERS
+}
+
+
+class TableError(ValueError):
+    """A tuning table failed schema/content-hash validation."""
+
+
+class Resolved(NamedTuple):
+    """One resolution: every tunable knob concrete, plus provenance.
+
+    ``block_size`` is always a concrete int (row value, or the heuristic
+    ladder when the winning row declined to pin it). ``generic_only`` is
+    True when NO non-generic row contributed any knob — the signal the
+    TUNE001 analysis pass uses to prove the declared serve buckets are
+    covered by measured rows. ``source`` is "<table_id>:<row indices>"
+    for provenance."""
+
+    block_size: int
+    mixed_store: str
+    pair_solver: str
+    precondition: str
+    criterion: str
+    batch_tiers: Tuple[int, ...]
+    generic_only: bool
+    source: str
+
+
+def _validate_row(row: dict, where: str, errors: List[str]) -> None:
+    if not isinstance(row, dict):
+        errors.append(f"{where}: expected object, got {type(row).__name__}")
+        return
+    match = row.get("match")
+    knobs = row.get("knobs")
+    if not isinstance(match, dict):
+        errors.append(f"{where}.match: missing or not an object")
+        match = {}
+    if not isinstance(knobs, dict):
+        errors.append(f"{where}.knobs: missing or not an object")
+        knobs = {}
+    for k in match:
+        if k not in _MATCH_KEYS:
+            errors.append(f"{where}.match.{k}: unknown match key "
+                          f"(known: {_MATCH_KEYS})")
+    if "n_class" in match and match["n_class"] not in N_CLASSES:
+        errors.append(f"{where}.match.n_class: {match['n_class']!r} not in "
+                      f"{N_CLASSES}")
+    if "aspect" in match and match["aspect"] not in ASPECT_CLASSES:
+        errors.append(f"{where}.match.aspect: {match['aspect']!r} not in "
+                      f"{ASPECT_CLASSES}")
+    for k in knobs:
+        if k not in KNOBS:
+            errors.append(f"{where}.knobs.{k}: unknown knob "
+                          f"(known: {KNOBS})")
+    bs = knobs.get("block_size", None)
+    if bs is not None and (not isinstance(bs, int) or bs < 1):
+        errors.append(f"{where}.knobs.block_size: expected null or int >= 1, "
+                      f"got {bs!r}")
+    for name, valid in (("mixed_store", _VALID_MIXED_STORE),
+                        ("pair_solver", _VALID_PAIR_SOLVER),
+                        ("precondition", _VALID_PRECONDITION),
+                        ("criterion", _VALID_CRITERION)):
+        if name in knobs and knobs[name] not in valid:
+            errors.append(f"{where}.knobs.{name}: {knobs[name]!r} not in "
+                          f"{valid}")
+    tiers = knobs.get("batch_tiers")
+    if tiers is not None and (
+            not isinstance(tiers, (list, tuple)) or not tiers
+            or any(not isinstance(t, int) or t < 1 for t in tiers)):
+        errors.append(f"{where}.knobs.batch_tiers: expected a non-empty "
+                      f"list of ints >= 1, got {tiers!r}")
+    elif tiers is not None and 1 not in tiers:
+        # Without tier 1 a lone request would zero-pad into the smallest
+        # larger tier — paying a multi-member batched solve per solo
+        # request, silently. The search harness always includes 1.
+        errors.append(f"{where}.knobs.batch_tiers: must include tier 1 "
+                      f"(the non-coalesced dispatch), got {tiers!r}")
+
+
+def content_hash(payload: dict) -> str:
+    """SHA-256 of the canonical-JSON table body (everything except
+    ``content_sha256`` itself) — the same content-hash discipline as
+    ``obs.manifest.config_hash``: two tables with equal hashes resolve
+    identically whatever the file's formatting."""
+    body = {k: v for k, v in payload.items() if k != "content_sha256"}
+    canon = json.dumps(body, sort_keys=True, default=list)
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningTable:
+    """An immutable, validated tuning table (see module docstring)."""
+
+    table_id: str
+    rows: Tuple[dict, ...]
+    sha256: str
+    provenance: str = ""
+
+    @staticmethod
+    def from_payload(payload: dict, *, verify_hash: bool = True
+                     ) -> "TuningTable":
+        """Validate a parsed JSON document into a table. Raises
+        :class:`TableError` listing every violation; hash mismatches are
+        a violation too (a hand-edited table must be re-hashed via
+        :func:`save_table` — silent edits are exactly what the hash
+        exists to catch)."""
+        errors: List[str] = []
+        if not isinstance(payload, dict):
+            raise TableError("table: not a JSON object")
+        # Canonicalize to pure JSON values (tuples -> lists) so a table
+        # built in memory and its file round-trip compare equal; the
+        # content hash already serializes through the same mapping.
+        payload = json.loads(json.dumps(payload, default=list))
+        version = payload.get("schema_version")
+        if version != SCHEMA_VERSION:
+            errors.append(f"schema_version: {version!r} != supported "
+                          f"{SCHEMA_VERSION}")
+        table_id = payload.get("table_id")
+        if not isinstance(table_id, str) or not table_id:
+            errors.append("table_id: missing or empty")
+            table_id = "?"
+        rows = payload.get("rows")
+        if not isinstance(rows, list) or not rows:
+            errors.append("rows: missing or empty")
+            rows = []
+        for i, row in enumerate(rows):
+            _validate_row(row, f"rows[{i}]", errors)
+        declared = payload.get("content_sha256")
+        actual = content_hash(payload)
+        if verify_hash and declared != actual:
+            errors.append(f"content_sha256: declared {str(declared)[:12]}... "
+                          f"!= actual {actual[:12]}... (table edited "
+                          f"without re-hashing?)")
+        if errors:
+            raise TableError("invalid tuning table: " + "; ".join(errors))
+        return TuningTable(table_id=table_id,
+                           rows=tuple(dict(r) for r in rows),
+                           sha256=actual,
+                           provenance=str(payload.get("provenance", "")))
+
+    def to_payload(self) -> dict:
+        payload = {
+            "schema_version": SCHEMA_VERSION,
+            "table_id": self.table_id,
+            "provenance": self.provenance,
+            "rows": [dict(r) for r in self.rows],
+        }
+        payload["content_sha256"] = content_hash(payload)
+        return payload
+
+    def _matching_rows(self, key: Dict[str, str]) -> List[Tuple[int, dict]]:
+        """(index, row) of every row matching ``key``, most specific
+        first (ties keep declaration order — tables list their sharper
+        rows first by convention)."""
+        scored = []
+        for i, row in enumerate(self.rows):
+            match = row.get("match", {})
+            ok = all(match[k] == key[k] for k in match)
+            if ok:
+                scored.append((-len(match), i, row))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        return [(i, row) for _, i, row in scored]
+
+    def resolve(self, n: int, m: Optional[int] = None,
+                dtype: str = "float32", backend: Optional[str] = None,
+                device_kind: Optional[str] = None) -> Resolved:
+        """Resolve every tunable knob for one problem (see module
+        docstring for the layered row semantics)."""
+        import jax.numpy as jnp
+        if backend is None or device_kind is None:
+            rb, rk = _runtime_identity()
+            backend = backend or rb
+            device_kind = device_kind or rk
+        key = {
+            "n_class": n_class(int(n)),
+            "aspect": aspect_class(None if m is None else int(m), int(n)),
+            "dtype": str(jnp.dtype(dtype).name),
+            "backend": str(backend),
+            "device_kind": normalize_device_kind(device_kind),
+        }
+        knobs = dict(GENERIC_KNOBS)
+        contributors: List[str] = []
+        generic_only = True
+        unresolved = set(KNOBS)
+        for i, row in self._matching_rows(key):
+            row_knobs = row.get("knobs", {})
+            took = [k for k in list(unresolved) if k in row_knobs]
+            for k in took:
+                knobs[k] = row_knobs[k]
+                unresolved.discard(k)
+            if took:
+                contributors.append(str(i))
+                if row.get("match"):
+                    generic_only = False
+            if not unresolved:
+                break
+        bs = knobs["block_size"]
+        return Resolved(
+            block_size=int(bs) if bs is not None
+            else heuristic_block_size(int(n)),
+            mixed_store=str(knobs["mixed_store"]),
+            pair_solver=str(knobs["pair_solver"]),
+            precondition=str(knobs["precondition"]),
+            criterion=str(knobs["criterion"]),
+            batch_tiers=tuple(int(t) for t in knobs["batch_tiers"]),
+            generic_only=generic_only,
+            source=f"{self.table_id}:{','.join(contributors) or 'builtin'}",
+        )
+
+
+def builtin_table() -> TuningTable:
+    """The in-memory fallback table: ONE generic row carrying the
+    hand-picked defaults (:data:`GENERIC_KNOBS`). Used when no table is
+    shipped/pinned, when the active table fails validation, and under
+    ``--tuning-table=off`` — in all three cases resolution equals the
+    pre-table heuristics exactly."""
+    rows = ({"match": {}, "knobs": dict(GENERIC_KNOBS)},)
+    payload = {"schema_version": SCHEMA_VERSION, "table_id": "builtin",
+               "provenance": "hand-picked defaults (pre-table heuristics)",
+               "rows": [dict(r) for r in rows]}
+    return TuningTable(table_id="builtin", rows=rows,
+                       sha256=content_hash(payload),
+                       provenance=payload["provenance"])
+
+
+def load_table(path) -> TuningTable:
+    """Load + validate one table file. Raises :class:`TableError` /
+    ``OSError`` — callers that must never crash (the active-table
+    machinery) catch and fall back to :func:`builtin_table`."""
+    with Path(path).open() as f:
+        payload = json.load(f)
+    return TuningTable.from_payload(payload)
+
+
+def save_table(path, *, table_id: str, rows: Sequence[dict],
+               provenance: str = "") -> TuningTable:
+    """Validate, content-hash and write a table; returns the loaded
+    result (so a written table is by construction loadable)."""
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "table_id": str(table_id),
+        "provenance": str(provenance),
+        "rows": [dict(r) for r in rows],
+    }
+    payload["content_sha256"] = content_hash(payload)
+    table = TuningTable.from_payload(payload)   # validate before writing
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True, default=list)
+        f.write("\n")
+    return table
+
+
+def shipped_table_dir() -> Path:
+    return Path(__file__).parent / "tables"
+
+
+def shipped_table_path() -> Path:
+    return shipped_table_dir() / "default.json"
+
+
+# --------------------------------------------------------------------------
+# Active-table selection. Priority: explicit set_active_table() > the
+# SVDJ_TUNING_TABLE environment variable > the shipped default. A table
+# that fails to load is a LOUD warning + builtin fallback, never a crash
+# (a corrupt table file must not take the solver down with it).
+
+_ENV_VAR = "SVDJ_TUNING_TABLE"
+_active: Dict[str, object] = {"table": None, "pinned": False,
+                              "env_seen": None}
+
+
+def _load_or_fallback(source: str, loader) -> TuningTable:
+    try:
+        return loader()
+    except (TableError, OSError, json.JSONDecodeError) as e:
+        warnings.warn(
+            f"tuning table {source} failed to load ({e}); falling back to "
+            f"the builtin generic defaults (hand-picked heuristics)",
+            RuntimeWarning, stacklevel=3)
+        return builtin_table()
+
+
+def set_active_table(
+        table: Union[None, str, Path, TuningTable]) -> TuningTable:
+    """Pin the process-wide active table. ``"off"`` = builtin generic
+    defaults (bypass tables); a path = load it (loud fallback to builtin
+    on failure); a :class:`TuningTable` = use as-is; ``None`` = unpin
+    (back to env/shipped discovery). Returns the now-active table."""
+    if table is None:
+        _active.update(table=None, pinned=False, env_seen=None)
+        return active_table()
+    if isinstance(table, TuningTable):
+        resolved = table
+    elif str(table) == "off":
+        resolved = builtin_table()
+    else:
+        resolved = _load_or_fallback(str(table),
+                                     lambda: load_table(table))
+    _active.update(table=resolved, pinned=True)
+    return resolved
+
+
+def active_table() -> TuningTable:
+    """The table :func:`resolve` consults (see selection priority above).
+    The environment variable is re-read on change so test harnesses can
+    swap tables between cases without touching module state."""
+    env = os.environ.get(_ENV_VAR)
+    if not _active["pinned"]:
+        if env != _active["env_seen"] or _active["table"] is None:
+            _active["env_seen"] = env
+            if env == "off":
+                _active["table"] = builtin_table()
+            elif env:
+                _active["table"] = _load_or_fallback(
+                    f"{_ENV_VAR}={env}", lambda: load_table(env))
+            else:
+                path = shipped_table_path()
+                if path.exists():
+                    _active["table"] = _load_or_fallback(
+                        str(path), lambda: load_table(path))
+                else:
+                    _active["table"] = builtin_table()
+    return _active["table"]
+
+
+def resolve(n: int, m: Optional[int] = None, dtype: str = "float32",
+            backend: Optional[str] = None,
+            device_kind: Optional[str] = None,
+            table: Optional[TuningTable] = None) -> Resolved:
+    """Module-level resolution through the active (or given) table —
+    the single lookup every "auto" knob goes through. Deterministic:
+    same arguments + same table content => same result, in any process
+    (proven by tests/test_tune.py's cross-process case)."""
+    t = table if table is not None else active_table()
+    return t.resolve(n, m=m, dtype=dtype, backend=backend,
+                     device_kind=device_kind)
+
+
+def resolve_config(config, m: int, n: int, dtype,
+                   backend: Optional[str] = None,
+                   device_kind: Optional[str] = None):
+    """A concrete ``SVDConfig`` for one declared problem shape: every
+    knob the caller left on "auto"/None is pinned to the table's choice
+    (explicit user values always win). Used by the serving layer to
+    resolve ONCE per bucket at declaration — lanes inherit the resolved
+    config and never re-resolve per dispatch.
+
+    Only shape-safe knobs are pinned: ``block_size`` (the value the
+    solver's own planner would resolve to — identical jit keys), and
+    ``mixed_store`` (read only on the mixed Pallas path, valid
+    everywhere). ``pair_solver``/``precondition``/``criterion`` stay
+    "auto": their resolution is capability-guarded per entry point
+    (f64/tiny-n/compute_uv) and pinning them here would turn the
+    solver's auto-routing into hard validation errors on the guarded
+    paths. They still resolve through the SAME table at solve time, so
+    the choice is one table either way."""
+    import dataclasses as _dc
+    if m < n:
+        m, n = n, m   # tall orientation, as every solve entry enforces
+    r = resolve(n, m=m, dtype=dtype, backend=backend,
+                device_kind=device_kind)
+    updates = {}
+    if config.block_size is None:
+        updates["block_size"] = int(r.block_size)
+    if config.mixed_store == "auto":
+        updates["mixed_store"] = r.mixed_store
+    return _dc.replace(config, **updates) if updates else config

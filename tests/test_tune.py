@@ -1,0 +1,466 @@
+"""The measured autotuner (svd_jacobi_tpu/tune/): table machinery,
+resolution semantics, the measured-crossover regressions, the TUNE001
+analysis pass, and the `-m tune` smoke search lane.
+
+Contract under test (ISSUE/ROADMAP "Measured autotuner"):
+  * every "auto" knob resolves through ONE deterministic table lookup;
+  * a missing/corrupt/bypassed table reproduces the historical
+    hand-picked defaults exactly (loud fallback, never a crash);
+  * the SHIPPED table pins the measured verdicts of PROFILE.md items
+    17-18 — a regeneration that flips one is a failing test here (a loud
+    diff), not a silent default change.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig, solver
+from svd_jacobi_tpu import tune
+from svd_jacobi_tpu.analysis import tune_checks
+from svd_jacobi_tpu.obs import manifest
+from svd_jacobi_tpu.tune import search, tables
+
+BAD_TABLE = Path(__file__).parent / "fixtures" / "tune_bad_table.json"
+BENCH = str(Path(__file__).resolve().parent.parent / "bench.py")
+
+
+@pytest.fixture(autouse=True)
+def _restore_active_table():
+    """Every test leaves the process-wide active table as it found it."""
+    yield
+    tune.set_active_table(None)
+
+
+def _legacy_block_size(n):
+    """The pre-table `pick_block_size` if-ladder, verbatim — the oracle
+    for 'missing-table behavior equals the hand-picked defaults'."""
+    if n >= 8192:
+        return 256
+    if n >= 2048:
+        return 128
+    b = 1
+    while b * 16 <= n and b < 128:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Table machinery.
+
+
+class TestTableMachinery:
+    def test_schema_round_trip(self, tmp_path):
+        rows = [
+            {"match": {"n_class": "large", "aspect": "square",
+                       "dtype": "float32"},
+             "knobs": {"block_size": 256}},
+            {"match": {}, "knobs": dict(tables.GENERIC_KNOBS)},
+        ]
+        path = tmp_path / "t.json"
+        written = tables.save_table(path, table_id="rt-test", rows=rows,
+                                    provenance="round trip")
+        loaded = tables.load_table(path)
+        assert loaded.table_id == "rt-test"
+        assert loaded.sha256 == written.sha256
+        assert loaded.rows == written.rows
+        # And the loaded table resolves like the in-memory one.
+        a = written.resolve(16384, m=16384, dtype="float32",
+                            backend="tpu", device_kind="x")
+        b = loaded.resolve(16384, m=16384, dtype="float32",
+                           backend="tpu", device_kind="x")
+        assert a == b and a.block_size == 256
+
+    def test_content_hash_mismatch_is_loud(self, tmp_path):
+        payload = tables.save_table(
+            tmp_path / "t.json", table_id="hash-test",
+            rows=[{"match": {}, "knobs": dict(tables.GENERIC_KNOBS)}],
+        ).to_payload()
+        payload["rows"][0]["knobs"]["block_size"] = 512   # edit, no re-hash
+        bad = tmp_path / "edited.json"
+        bad.write_text(json.dumps(payload))
+        with pytest.raises(tables.TableError, match="content_sha256"):
+            tables.load_table(bad)
+
+    def test_corrupt_table_falls_back_loudly_never_crashes(self):
+        """The shipped failing fixture: hand-edited without re-hashing.
+        Activating it WARNS and falls back to the builtin generic row —
+        resolution keeps working with the hand-picked defaults."""
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            tune.set_active_table(BAD_TABLE)
+        for n in (96, 2048, 8192, 16384):
+            assert tune.resolve(n, m=n).block_size == _legacy_block_size(n)
+
+    def test_env_var_table_and_off(self, tmp_path, monkeypatch):
+        path = tmp_path / "env.json"
+        tables.save_table(path, table_id="env-test", rows=[
+            {"match": {"n_class": "medium"}, "knobs": {"block_size": 64}},
+            {"match": {}, "knobs": dict(tables.GENERIC_KNOBS)},
+        ])
+        monkeypatch.setenv("SVDJ_TUNING_TABLE", str(path))
+        assert tune.resolve(4096, m=4096).block_size == 64
+        monkeypatch.setenv("SVDJ_TUNING_TABLE", "off")
+        assert tune.resolve(4096, m=4096).block_size == 128
+
+    def test_invalid_rows_rejected(self, tmp_path):
+        for bad_rows, msg in [
+            ([{"match": {"n_class": "huge"}, "knobs": {}}], "n_class"),
+            ([{"match": {}, "knobs": {"block_size": 0}}], "block_size"),
+            ([{"match": {}, "knobs": {"mixed_store": "f16"}}],
+             "mixed_store"),
+            ([{"match": {}, "knobs": {"batch_tiers": []}}], "batch_tiers"),
+            # Tier 1 (the non-coalesced dispatch) is mandatory: without
+            # it a lone request would zero-pad into a larger tier.
+            ([{"match": {}, "knobs": {"batch_tiers": [4, 16]}}],
+             "must include tier 1"),
+            # "double" is a fused-single-solve-only mode the stepper/
+            # batched/mesh lanes cannot run — never a table value.
+            ([{"match": {}, "knobs": {"precondition": "double"}}],
+             "precondition"),
+            ([{"match": {"shape": "2048"}, "knobs": {}}], "unknown match"),
+        ]:
+            with pytest.raises(tables.TableError, match=msg):
+                tables.save_table(tmp_path / "bad.json",
+                                  table_id="x", rows=bad_rows)
+
+    def test_resolution_deterministic_across_processes(self):
+        """Same inputs + same table content => byte-identical resolution
+        in a fresh interpreter (PYTHONHASHSEED deliberately varied — set
+        iteration order must not leak into the result)."""
+        probe = (
+            "import json;"
+            "from svd_jacobi_tpu.tune import tables;"
+            "t = tables.load_table(tables.shipped_table_path());"
+            "out = [t.resolve(n, m=m, dtype=d, backend=b, device_kind=k)"
+            "       for n, m, d, b, k in ["
+            "  (96, 96, 'float32', 'cpu', 'cpu'),"
+            "  (2048, 2048, 'float32', 'tpu', 'TPU v5 lite'),"
+            "  (8192, 8192, 'float32', 'tpu', 'TPU v5 lite'),"
+            "  (8192, 131072, 'float32', 'tpu', 'TPU v5 lite'),"
+            "  (512, 512, 'float64', 'cpu', 'cpu')]];"
+            "print(json.dumps([list(r) for r in out]))"
+        )
+        outs = []
+        for seed in ("0", "1"):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONHASHSEED=seed, SVDJ_SKIP_GRAFTCHECK="1")
+            p = subprocess.run([sys.executable, "-c", probe], env=env,
+                               capture_output=True, text=True, timeout=120)
+            assert p.returncode == 0, p.stderr[-500:]
+            outs.append(p.stdout.strip())
+        assert outs[0] == outs[1]
+
+    def test_missing_table_equals_hand_picked_defaults(self):
+        """`--tuning-table=off` (builtin generic row) reproduces the
+        legacy ladder and the legacy auto-routing exactly."""
+        tune.set_active_table("off")
+        cfg = SVDConfig()
+        for n in (4, 16, 48, 64, 96, 256, 512, 1024, 2047, 2048, 4096,
+                  8191, 8192, 16384, 65536):
+            assert cfg.pick_block_size(n) == _legacy_block_size(n)
+        a32 = jnp.zeros((96, 96), jnp.float32)
+        tiny = jnp.zeros((48, 32), jnp.float32)
+        a64 = jnp.zeros((96, 96), jnp.float64)
+        assert solver._resolve_options(a32, cfg, True)[2:] == \
+            ("pallas", "rel")
+        assert solver._resolve_options(tiny, cfg, True)[2:] == \
+            ("hybrid", "rel")
+        assert solver._resolve_options(tiny, cfg, False)[2:] == \
+            ("gram-eigh", "abs")
+        assert solver._resolve_options(a64, cfg, True)[2:] == \
+            ("qr-svd", "rel")
+        assert solver._resolve_options(
+            a32, SVDConfig(criterion="abs"), True)[2:] == ("hybrid", "abs")
+
+    def test_mistuned_table_cannot_break_capability_guards(self):
+        """A table proposing pallas for f64 / tiny shapes is coerced by
+        the solver's guards, not obeyed into an invalid program."""
+        t = tables.TuningTable(
+            table_id="mistuned", sha256="0" * 64,
+            rows=({"match": {}, "knobs": {**tables.GENERIC_KNOBS,
+                                          "pair_solver": "pallas"}},))
+        tune.set_active_table(t)
+        a64 = jnp.zeros((96, 96), jnp.float64)
+        tiny = jnp.zeros((48, 32), jnp.float32)
+        assert solver._resolve_options(a64, SVDConfig(), True)[2] == "qr-svd"
+        assert solver._resolve_options(tiny, SVDConfig(), True)[2] == \
+            "hybrid"
+        # gram-eigh pinned for a factor-computing solve upgrades to
+        # hybrid (gram-eigh alone cannot deliver an orthogonal U);
+        # sigma-only keeps the cheap path.
+        t2 = tables.TuningTable(
+            table_id="mistuned2", sha256="0" * 64,
+            rows=({"match": {}, "knobs": {**tables.GENERIC_KNOBS,
+                                          "pair_solver": "gram-eigh"}},))
+        tune.set_active_table(t2)
+        a32 = jnp.zeros((96, 96), jnp.float32)
+        assert solver._resolve_options(a32, SVDConfig(), True)[2] == "hybrid"
+        assert solver._resolve_options(a32, SVDConfig(), False)[2] == \
+            "gram-eigh"
+
+
+# ---------------------------------------------------------------------------
+# Measured-crossover regressions: the shipped table's verdicts are pinned
+# CONTENT — a regeneration that flips one fails here, loudly.
+
+
+class TestShippedTableVerdicts:
+    @pytest.fixture(scope="class")
+    def shipped(self):
+        return tables.load_table(tables.shipped_table_path())
+
+    V5E = {"backend": "tpu", "device_kind": "TPU v5 lite"}
+
+    def test_block_256_for_fused_square_n_ge_8192(self, shipped):
+        # PROFILE.md item 18: 16384^2 34.8 vs 39.0 s, 8192^2 5.53 vs 5.65.
+        for n in (8192, 16384, 32768):
+            r = shipped.resolve(n, m=n, dtype="float32", **self.V5E)
+            assert r.block_size == 256, (n, r)
+        # 32768x8192 (m/n = 4) carries the square verdict too.
+        assert shipped.resolve(8192, m=32768, dtype="float32",
+                               **self.V5E).block_size == 256
+
+    def test_block_128_below_8192_and_tall_skinny(self, shipped):
+        # item 18: 2048^2/4096^2 and 65536x4096 keep b=128.
+        for m, n in ((2048, 2048), (4096, 4096), (65536, 4096)):
+            r = shipped.resolve(n, m=m, dtype="float32", **self.V5E)
+            assert r.block_size == 128, ((m, n), r)
+        # Tall-skinny (m >= 8n) keeps 128 even at large n.
+        assert shipped.resolve(8192, m=65536, dtype="float32",
+                               **self.V5E).block_size == 128
+
+    def test_mixed_store_auto_is_f32(self, shipped):
+        # PROFILE.md item 17: f32-store 6.27 s vs bf16 6.47 / bf16g 6.66.
+        for kwargs in (self.V5E, {"backend": "cpu", "device_kind": "cpu"}):
+            assert shipped.resolve(8192, m=8192, dtype="float32",
+                                   **kwargs).mixed_store == "f32"
+
+    def test_f64_routes_qr_svd(self, shipped):
+        r = shipped.resolve(512, m=512, dtype="float64", **self.V5E)
+        assert r.pair_solver == "qr-svd"
+
+    def test_solver_consumes_shipped_verdicts(self):
+        """End-to-end: `_plan_entry` on a (spoofed-large) problem takes
+        the table width. Exercised at the plan level (no 8192^2 solve on
+        CPU): pick_block_size is what `_plan` consults."""
+        cfg = SVDConfig()
+        assert cfg.pick_block_size(8192, m=8192) == 256
+        assert cfg.pick_block_size(8192, m=65536) == 128
+        assert cfg.pick_block_size(4096, m=65536) == 128
+
+    def test_shipped_table_covers_default_serve_buckets(self, shipped):
+        from svd_jacobi_tpu.config import DEFAULT_SERVE_BUCKETS
+        for m, n, dtype in DEFAULT_SERVE_BUCKETS:
+            r = shipped.resolve(n, m=m, dtype=dtype, backend="cpu",
+                                device_kind="cpu")
+            assert not r.generic_only, (m, n, dtype, r)
+
+
+# ---------------------------------------------------------------------------
+# Serving-layer resolution: once per bucket at declaration.
+
+
+class TestServeResolution:
+    def test_bucket_configs_resolved_at_declaration(self):
+        from svd_jacobi_tpu.serve import SVDService, ServeConfig
+        cfg = ServeConfig(buckets=((64, 48, "float32"), (96, 64, "float32")),
+                          solver=SVDConfig())
+        svc = SVDService(cfg)
+        for b in svc.buckets:
+            resolved = svc._solver_for(b)
+            want = tune.resolve(b.n, m=b.m, dtype=b.dtype)
+            assert resolved.block_size == want.block_size
+            assert resolved.mixed_store == want.mixed_store
+        # Explicit user knobs always win over the table.
+        cfg2 = ServeConfig(buckets=((64, 48, "float32"),),
+                           solver=SVDConfig(block_size=6,
+                                            mixed_store="bf16"))
+        svc2 = SVDService(cfg2)
+        b = next(iter(svc2.buckets))
+        assert svc2._solver_for(b).block_size == 6
+        assert svc2._solver_for(b).mixed_store == "bf16"
+
+    def test_auto_batch_tiers_resolve_per_bucket(self):
+        from svd_jacobi_tpu.serve import SVDService, ServeConfig
+        cfg = ServeConfig(buckets=((64, 48, "float32"),),
+                          batch_tiers="auto", max_batch=4)
+        svc = SVDService(cfg)
+        b = next(iter(svc.buckets))
+        assert svc._tiers_for(b) == tuple(sorted(set(
+            tune.resolve(b.n, m=b.m, dtype=b.dtype).batch_tiers)))
+
+    def test_resolved_config_serves_identically(self):
+        """A request served through the resolved per-bucket config equals
+        the direct solve (the resolution is a relabeling of the auto
+        path, not a numerical change)."""
+        from svd_jacobi_tpu.serve import SVDService, ServeConfig
+        from svd_jacobi_tpu.utils import matgen
+        a = matgen.random_dense(40, 32, seed=7, dtype=jnp.float32)
+        with SVDService(ServeConfig(
+                buckets=((48, 36, "float32"),))) as svc:
+            res = svc.submit(a).result(timeout=600.0)
+        assert res.status.name == "OK"
+        direct = sj.svd(jnp.pad(a, ((0, 8), (0, 4))))
+        # Host-stepped (serve) vs fused solve: same f32 accuracy class,
+        # not bit-identical — compare at the class's tolerance.
+        np.testing.assert_allclose(np.asarray(res.s),
+                                   np.asarray(direct.s)[:32],
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# TUNE001 analysis pass: clean on the repo, fires on its seeded fixtures.
+
+
+class TestTune001:
+    def test_shipped_tables_validate(self):
+        assert tune_checks.check_tables() == []
+
+    def test_fixture_table_fires(self):
+        findings = tune_checks.check_tables(paths=[BAD_TABLE])
+        assert findings and findings[0].code == "TUNE001"
+        assert "content_sha256" in findings[0].message
+
+    def test_bucket_coverage_clean_on_shipped(self):
+        assert tune_checks.check_bucket_resolution() == []
+
+    def test_bucket_coverage_fires_on_generic_only(self):
+        findings = tune_checks.check_bucket_resolution(
+            table=tables.builtin_table())
+        from svd_jacobi_tpu.config import DEFAULT_SERVE_BUCKETS
+        assert len(findings) == len(DEFAULT_SERVE_BUCKETS)
+        assert all(f.code == "TUNE001" for f in findings)
+
+    def test_resolved_serve_case_clean(self):
+        findings, report = tune_checks.run_resolved_serve_case()
+        assert findings == [], [f.render() for f in findings]
+        assert set(report["resolved_configs"]) == {"64x48:float32",
+                                                   "96x64:float32"}
+
+    def test_resolved_serve_case_fires_when_underdeclared(self):
+        """The seeded failing direction: FRESH buckets (cold jit cache)
+        with the budget under-declared at 1 — the guard must fire."""
+        findings, _ = tune_checks.run_resolved_serve_case(
+            expected_problems=1,
+            buckets=((72, 52, "float32"), (112, 72, "float32")),
+            shapes=((72, 52), (60, 44), (112, 72), (100, 60)))
+        assert findings and all(f.code == "TUNE001" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Manifest "tune" records.
+
+
+class TestTuneManifest:
+    def test_build_tune_round_trip(self, tmp_path):
+        rec = manifest.build_tune(
+            m=96, n=64, dtype="float32",
+            key={"n_class": "small", "aspect": "square",
+                 "dtype": "float32", "backend": "cpu",
+                 "device_kind": "cpu"},
+            baseline={"knobs": {"block_size": 8}, "time_s": 0.01,
+                      "reps": 2, "ok": True, "note": ""},
+            grid=[{"knobs": {"block_size": 4}, "time_s": 0.02,
+                   "reps": 2, "ok": True, "note": ""}],
+            winner={"block_size": 8},
+            table_id="t", table_sha256="a" * 64)
+        manifest.validate(rec)
+        path = manifest.append(tmp_path / "m.jsonl", rec)
+        loaded = manifest.load(path)
+        assert loaded[0]["kind"] == "tune"
+        assert loaded[0]["winner"] == {"block_size": 8}
+        assert "tune search" in manifest.summarize(loaded[0])
+
+    def test_build_tune_rejects_malformed_grid(self):
+        with pytest.raises(ValueError, match="grid"):
+            manifest.build_tune(
+                m=1, n=1, dtype="float32", key={}, baseline={},
+                grid=[{"time_s": 1.0}],   # no knobs
+                winner={}, table_id="t", table_sha256="a" * 64)
+
+
+# ---------------------------------------------------------------------------
+# The `-m tune` smoke lane: a bounded search really runs, writes a
+# loadable table, and leaves reconstructable manifest records.
+
+
+@pytest.mark.tune
+def test_smoke_search_end_to_end(tmp_path):
+    from svd_jacobi_tpu.tune.__main__ import main as tune_main
+    out = tmp_path / "table.json"
+    man = tmp_path / "manifest.jsonl"
+    rc = tune_main(["--smoke", "--out", str(out), "--manifest", str(man),
+                    "--reps", "1", "--budget-s", "5"])
+    assert rc == 0
+    table = tables.load_table(out)
+    assert len(table.rows) >= 2          # >= 1 winner row + generic
+    assert table.rows[-1]["match"] == {}
+    # The winners resolve (the written table is usable as --tuning-table).
+    r = table.resolve(64, m=96, dtype="float32")
+    assert r.block_size >= 1
+    records = manifest.load(man)
+    assert len(records) == len(search.SMOKE_SHAPES)
+    for rec in records:
+        manifest.validate(rec)
+        assert rec["kind"] == "tune"
+        assert rec["table_sha256"] == table.sha256
+        assert rec["baseline"]["ok"]
+        # Provenance: every searched point carries knobs + outcome.
+        assert all("knobs" in p for p in rec["grid"])
+
+
+# ---------------------------------------------------------------------------
+# bench.py satellites: the bounded transient retry and --tuning-table.
+
+
+def _run_bench(*args, env_extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, BENCH, *args, "--platform=cpu", "--manifest=off"],
+        capture_output=True, text=True, env=env, timeout=600)
+
+
+class TestBenchSatellites:
+    def test_transient_failure_retries_once_and_notes_it(self):
+        p = _run_bench("64", "--novec", "--no-baseline", "--reps=1",
+                       "--retry-backoff-s=0",
+                       env_extra={"SVDJ_BENCH_CHAOS_TRANSIENT": "1"})
+        assert p.returncode == 0, p.stderr[-500:]
+        row = json.loads(p.stdout.strip().splitlines()[-1])
+        assert row["value"] > 0
+        assert row["retried"]["reason"] == "UNAVAILABLE"
+        assert "retrying once" in p.stderr
+
+    def test_persistent_transient_failure_emits_error_row(self):
+        p = _run_bench("64", "--novec", "--no-baseline", "--reps=1",
+                       "--retry-backoff-s=0",
+                       env_extra={"SVDJ_BENCH_CHAOS_TRANSIENT": "9"})
+        assert p.returncode == 0, p.stderr[-500:]
+        row = json.loads(p.stdout.strip().splitlines()[-1])
+        assert row["value"] is None and row["retried"] is not None
+
+    def test_clean_run_has_no_retry_note(self):
+        p = _run_bench("64", "--novec", "--no-baseline", "--reps=1")
+        assert p.returncode == 0, p.stderr[-500:]
+        row = json.loads(p.stdout.strip().splitlines()[-1])
+        assert "retried" not in row
+
+    def test_tuning_table_flag_off_and_pinned(self, tmp_path):
+        path = tmp_path / "pin.json"
+        tables.save_table(path, table_id="pin", rows=[
+            {"match": {}, "knobs": dict(tables.GENERIC_KNOBS)}])
+        for flag in ("--tuning-table=off", f"--tuning-table={path}"):
+            p = _run_bench("64", "--novec", "--no-baseline", "--reps=1",
+                           flag)
+            assert p.returncode == 0, (flag, p.stderr[-500:])
+            assert json.loads(
+                p.stdout.strip().splitlines()[-1])["value"] > 0
