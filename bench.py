@@ -58,6 +58,17 @@ Usage:
                                   BENCH_r05 class; the retry is noted in
                                   the emitted row as "retried".
                                   Default 15)
+         --top-k=K               (truncated top-K row via the randomized
+                                  range-finder lane, timed against OUR
+                                  OWN full solve at the same shape; emits
+                                  the svd_topk GFLOP/s row under the
+                                  honest 2mnl-class flop model PLUS a
+                                  topk_speedup row — the >= 4x
+                                  acceptance number)
+         --tall-vs-pad           (tall-lane row, m >= 8n required: timed
+                                  against the full solve on the input
+                                  padded to square; emits a
+                                  tall_vs_pad_speedup row)
 """
 
 from __future__ import annotations
@@ -466,6 +477,26 @@ def main() -> None:
     novec = "novec" in flags   # sigma-only solve (jobu = jobv = NoVec)
     stepped = "stepped" in flags
     attempted_baseline = "no-baseline" not in flags
+    # --top-k=K: truncated solve via the randomized range-finder lane;
+    # the baseline becomes OUR OWN full solve at the same shape — the
+    # topk_speedup row is the number the lane exists for. --tall-vs-pad:
+    # the blocked-TSQR tall lane vs the full solve on the input padded
+    # to square (what a square-bucket-only service would do).
+    top_k = int(flags["top-k"]) if "top-k" in flags else None
+    tall_vs_pad = "tall-vs-pad" in flags
+    if top_k is not None and top_k < 1:
+        raise SystemExit(f"--top-k must be >= 1, got {top_k}")
+    if (top_k is not None or tall_vs_pad) and (
+            stepped or "donate" in flags or "fused-gen" in flags):
+        raise SystemExit("--top-k/--tall-vs-pad are fused-lane "
+                         "comparisons; not combinable with "
+                         "--stepped/--donate/--fused-gen")
+    if top_k is not None and tall_vs_pad:
+        raise SystemExit("--top-k and --tall-vs-pad are separate rows; "
+                         "run them one at a time")
+    if tall_vs_pad and m < 8 * n:
+        raise SystemExit(f"--tall-vs-pad needs a tall shape (m >= 8n), "
+                         f"got {m}x{n}")
     # --precondition=off: skip the Drmac QR (its Q1/R factors are 2 extra
     # n^2 buffers — the difference between fitting and OOM at 30000^2).
     # --block-size=K / --mixed-bulk: the block-width and mixed-regime
@@ -482,6 +513,14 @@ def main() -> None:
         donate_input="donate" in flags)
     ours = lambda x: sj.svd(x, compute_u=not novec, compute_v=not novec,
                             config=cfg)
+    if top_k is not None:
+        from svd_jacobi_tpu.solver import svd_topk
+        ours = lambda x: svd_topk(x, top_k, compute_u=not novec,
+                                  compute_v=not novec, config=cfg)
+    if tall_vs_pad:
+        from svd_jacobi_tpu.solver import svd_tall
+        ours = lambda x: svd_tall(x, compute_u=not novec,
+                                  compute_v=not novec, config=cfg)
     if stepped:
         # Host-stepped solve (solver.SweepStepper, the checkpoint-grade
         # API): ONE jitted sweep per device execution. Required at the
@@ -559,6 +598,24 @@ def main() -> None:
             (t_ours,), (r,), errs = _time_interleaved([ours], a, reps=reps)
             return (t_ours, None, r, errs[0],
                     "skipped (--no-baseline: known to OOM at this size)")
+        if top_k is not None or tall_vs_pad:
+            # The comparison row of the truncated/tall lanes: the
+            # baseline is OUR OWN full solve — of the same input
+            # (top-k), or of the input padded to square (tall: the
+            # dispatch a square-bucket-only service would pay).
+            if top_k is not None:
+                base_fn = lambda x: sj.svd(x, compute_u=not novec,
+                                           compute_v=not novec, config=cfg)
+                name = "full svd() same shape"
+            else:
+                pad_cols = m - n
+                base_fn = lambda x: sj.svd(
+                    jnp.pad(x, ((0, 0), (0, pad_cols))),
+                    compute_u=not novec, compute_v=not novec, config=cfg)
+                name = "full svd() on pad-to-square"
+            (t_ours, t_base), (r, _), errs = _time_interleaved(
+                [ours, base_fn], a, reps=reps)
+            return t_ours, t_base, r, errs[0], name
         if baseline == "numpy":
             an = np.asarray(a)
             (t_ours, t_base), (r, _), errs = _time_interleaved(
@@ -609,22 +666,52 @@ def main() -> None:
         return
 
     # Residual computed ON DEVICE at pinned precision (a host transfer of
-    # the factors through the tunnel would dominate at large N).
+    # the factors through the tunnel would dominate at large N). A top-k
+    # row skips it: the full-reconstruction residual of a TRUNCATED
+    # factorization equals the discarded tail energy, not an error.
     extras = {}
-    if a is not None and r.u is not None and r.v is not None:
+    if (a is not None and r.u is not None and r.v is not None
+            and top_k is None):
         extras["residual_rel"] = float(
             np.asarray(validation.relative_residual(a, r.u, r.s, r.v)))
     if oracle == "auto":
         oracle = "on" if max(m, n) <= 2048 else "off"
     if oracle == "on" and a is not None:
         s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        if top_k is not None:
+            s_ref = s_ref[:top_k]
         extras["sigma_err"] = float(validation.sigma_error(r.s, s_ref))
 
-    flops = 4.0 * m * n**2 + 8.0 * n**3
+    # Honest FLOP model per lane. Full/tall: the classic full-SVD count
+    # 4mn^2 + 8n^3 (the tall lane computes the same factorization — its
+    # win is a smaller CONSTANT, so the model stays comparable across
+    # rows). Top-k: the 2mnk-class randomized pipeline — sketch 2mnl,
+    # power iterations 4mnl each, projection 2mnl, (q+1) TSQR passes
+    # 2ml^2, the small (n, l) core ~4nl^2 + 8l^3, lift 2mlk — so a top-k
+    # row's GFLOP/s is NOT comparable to a full row's (the whole point:
+    # ~n/l times less work); the topk_speedup row carries the
+    # end-to-end verdict.
+    if top_k is not None:
+        from svd_jacobi_tpu import solver as _solver
+        p_over, q_iters, _ = _solver._resolve_sketch(cfg, n, m, dtype,
+                                                     k=top_k)
+        l = min(top_k + p_over, n)
+        flops = (2.0 * m * n * l * (1 + 2 * q_iters)
+                 + 2.0 * m * n * l                  # projection B = Q^T A
+                 + (q_iters + 1) * 2.0 * m * l * l  # TSQR passes
+                 + 4.0 * n * l * l + 8.0 * l**3     # small core
+                 + 2.0 * m * l * top_k)             # lift U = Q Z
+        extras["flop_model"] = "randomized-topk(2mnl-class)"
+        extras["sketch_l"] = l
+        extras["power_iters"] = q_iters
+    else:
+        flops = 4.0 * m * n**2 + 8.0 * n**3
     gflops = flops / t_ours / 1e9
     tag = "_novec" if novec else ""
+    lane = ("_topk_k%d" % top_k if top_k is not None
+            else "_tall" if tall_vs_pad else "")
     row = {
-        "metric": f"svd_{m}x{n}_{dtype_name}{tag}_gflops",
+        "metric": f"svd{lane}_{m}x{n}_{dtype_name}{tag}_gflops",
         "value": round(gflops, 2),
         "unit": "GFLOP/s",
         "vs_baseline": (round(t_base / t_ours, 3) if t_base is not None
@@ -641,6 +728,26 @@ def main() -> None:
     if retried is not None:
         row["retried"] = retried
     print(json.dumps(row))
+    if top_k is not None and row["vs_baseline"] is not None:
+        # The lane's raison d'etre, as its own parseable row: end-to-end
+        # speedup of the truncated solve over the full one at the same
+        # shape (acceptance target: >= 4x at 1024^2 f32, k <= n/8).
+        print(json.dumps({
+            "metric": f"topk_speedup_{m}x{n}_{dtype_name}_k{top_k}",
+            "value": row["vs_baseline"],
+            "unit": "x vs full solve",
+            "time_s": row["time_s"],
+            "full_time_s": row["baseline_time_s"],
+            "sigma_err_vs_oracle": extras.get("sigma_err"),
+        }))
+    if tall_vs_pad and row["vs_baseline"] is not None:
+        print(json.dumps({
+            "metric": f"tall_vs_pad_speedup_{m}x{n}_{dtype_name}",
+            "value": row["vs_baseline"],
+            "unit": "x vs pad-to-square full solve",
+            "time_s": row["time_s"],
+            "padded_time_s": row["baseline_time_s"],
+        }))
 
     manifest_path = flags.get("manifest", "reports/manifest.jsonl")
     if manifest_path == "1":
@@ -699,7 +806,7 @@ def main() -> None:
             metric=row["metric"], baseline=row["baseline"],
             baseline_time_s=row["baseline_time_s"],
             novec=novec, stepped=stepped, reps=reps,
-            retried=retried,
+            retried=retried, top_k=top_k, tall_vs_pad=tall_vs_pad,
             argv=sys.argv[1:])
         obs.manifest.append(manifest_path, record)
         print(f"manifest: {manifest_path}", file=sys.stderr)

@@ -9,9 +9,11 @@ LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 
 from . import obs, resilience, serve, tune
 from .config import SVDConfig
-from .solver import SolveStatus, SVDResult, svd, svd_batched
+from .solver import (SolveStatus, SVDResult, svd, svd_batched, svd_tall,
+                     svd_topk)
 
 __version__ = "0.1.0"
 
-__all__ = ["svd", "svd_batched", "SVDConfig", "SVDResult", "SolveStatus", "obs",
-           "resilience", "serve", "tune", "__version__"]
+__all__ = ["svd", "svd_batched", "svd_tall", "svd_topk", "SVDConfig",
+           "SVDResult", "SolveStatus", "obs", "resilience", "serve", "tune",
+           "__version__"]

@@ -115,10 +115,32 @@ def single_device_probes(include_f64: Optional[bool] = None) -> List[EntryProbe]
         _batched_probe("pallas_batched", jnp.zeros((3, 48, 32), jnp.float32),
                        SVDConfig(pair_solver="pallas")),
     ]
+    probes += sketch_probes()
     if include_f64:
         a64 = jnp.zeros((48, 32), jnp.float64)
         probes.append(_single_probe("padded_f64_qr", a64, SVDConfig()))
     return probes
+
+
+def sketch_probes() -> List[EntryProbe]:
+    """Probes for the top-k/tall lane stage jits (ops/sketch.py wrapped
+    by solver): the randomized range finder + projection and the blocked
+    TSQR. The explicit small ``chunk`` forces the CHUNKED tree (the
+    structure under contract — zero collectives, no host callbacks, no
+    upcasts) even at the probe's toy shape. No telemetry flag (the
+    sketch stages emit no in-graph events)."""
+    from .. import solver
+    a_tall = jnp.zeros((256, 24), jnp.float32)   # m >= 8n: the tall class
+    return [
+        EntryProbe(name="sketch_project", fn=solver._sketch_project_jit,
+                   args=(a_tall,),
+                   kwargs=dict(l=8, power_iters=1, chunk=64, seed=0),
+                   entry_id="solver._sketch_project_jit",
+                   telemetry_key=None),
+        EntryProbe(name="tsqr_tall", fn=solver._tsqr_jit, args=(a_tall,),
+                   kwargs=dict(chunk=64),
+                   entry_id="solver._tsqr_jit", telemetry_key=None),
+    ]
 
 
 def mesh_probes(mesh=None) -> List[EntryProbe]:
@@ -142,11 +164,26 @@ def mesh_probes(mesh=None) -> List[EntryProbe]:
                           args=(a,), kwargs=kwargs,
                           entry_id="sharded._svd_sharded_jit")
 
+    a_tall = jnp.zeros((8 * n, n), jnp.float32)
+
+    def probe_tall(name, config, **solve_kw):
+        kwargs = sharded._plan_entry(a_tall, mesh, config, **solve_kw)
+        return EntryProbe(name=name, fn=sharded._svd_sharded_jit,
+                          args=(a_tall,), kwargs=kwargs,
+                          entry_id="sharded._svd_sharded_jit")
+
     return [
         probe("sharded_pallas", SVDConfig(pair_solver="pallas")),
         probe("sharded_pallas_novec", SVDConfig(pair_solver="pallas"),
               compute_u=False, compute_v=False),
         probe("sharded_hybrid", SVDConfig(pair_solver="hybrid")),
+        # Tall (m >= 8n) mesh solve: the chunked-TSQR preconditioner
+        # (engaged by the aspect threshold — m/8-scaled chunks, so the
+        # tree is real even at probe scale) runs under GSPMD OUTSIDE the
+        # shard_map sweep loop, so its budget must equal the square
+        # entry's — a collective difference here means the QR tree
+        # leaked into the fused loop.
+        probe_tall("sharded_pallas_tall", SVDConfig(pair_solver="pallas")),
     ]
 
 

@@ -210,11 +210,13 @@ def check_default_entries(include_mesh: bool = True) -> List[Finding]:
     if "pallas_donated" in singles and "pallas" in singles:
         findings += check_donation(singles["pallas_donated"],
                                    singles["pallas"])
-    if "pallas_batched" in singles:
-        # The batched entry's zero-collective budget: stacking B matrices
-        # along the pair axis is pure data layout and must add NO
-        # collectives of any kind to the single-device lowering.
-        findings += check_collective_budget(singles["pallas_batched"])
+    # Zero-collective budgets on the single-device entries that declare
+    # one: the batched pair-axis stack (pure data layout) and the
+    # sketch/TSQR stage jits of the top-k/tall lanes (matmul/QR chains —
+    # any collective here would be hand-written, never legitimate).
+    for name in ("pallas_batched", "sketch_project", "tsqr_tall"):
+        if name in singles:
+            findings += check_collective_budget(singles[name])
     if include_mesh:
         for probe in entries.mesh_probes():
             findings += check_collective_budget(probe)

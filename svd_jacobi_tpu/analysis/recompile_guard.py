@@ -67,6 +67,14 @@ def default_entries() -> Dict[str, object]:
         "solver._finish_xla_batched_jit": solver._finish_xla_batched_jit,
         "solver._nonfinite_probe_batched_jit":
             solver._nonfinite_probe_batched_jit,
+        # Top-k / tall lane stage jits (run_serve_rank_case).
+        "solver._tsqr_jit": solver._tsqr_jit,
+        "solver._tsqr_batched_jit": solver._tsqr_batched_jit,
+        "solver._sketch_project_jit": solver._sketch_project_jit,
+        "solver._sketch_project_batched_jit":
+            solver._sketch_project_batched_jit,
+        "solver._lift_q_jit": solver._lift_q_jit,
+        "solver._lift_q_batched_jit": solver._lift_q_batched_jit,
     }
 
 
@@ -277,6 +285,100 @@ def run_serve_sequence() -> tuple:
     f_findings, f_report = run_serve_fleet_case()
     findings += f_findings
     report["fleet"] = f_report
+    r_findings, r_report = run_serve_rank_case()
+    findings += r_findings
+    report["rank"] = r_report
+    return findings, report
+
+
+# Top-k / tall bucket family contract: the sketch width is BUCKET-static
+# (bucket.k + oversample) and the TSQR chunk bucket-resolved, so the
+# stage jits and the core steppers compile once per bucket — a request-k
+# or request-shape leak into any of those keys blows the budget. The
+# request stream mixes shapes AND k values per bucket to prove it.
+_RANK_BUCKETS = ((256, 32, "float32", "tall"), (96, 96, "float32", "topk", 8))
+# (shape, top_k) per submit; top_k None routes the tall family.
+_RANK_REQUESTS = (
+    ((256, 32), None), ((200, 20), None), ((256, 24), None),
+    ((96, 96), 8), ((80, 64), 4), ((90, 90), 6),
+)
+_RANK_ENTRIES = ("solver._tsqr_jit", "solver._sketch_project_jit",
+                 "solver._lift_q_jit", "solver._precondition_qr_jit",
+                 "solver._sweep_step_pallas_jit",
+                 "solver._finish_pallas_jit",
+                 "solver._nonfinite_probe_jit")
+
+
+def run_serve_rank_case(expected_problems: Optional[int] = None,
+                        buckets: Optional[tuple] = None,
+                        requests: Optional[tuple] = None) -> tuple:
+    """The rank-family half of the serve retrace contract: one tall and
+    one top-k bucket, fed several distinct request shapes and — on the
+    top-k bucket — several distinct request k values, everything
+    repeated. The stage jits (`_tsqr_jit` / `_sketch_project_jit` /
+    `_lift_q_jit`) and the core steppers must compile once per bucket
+    family usage, never per request or per k (RETRACE001 otherwise) —
+    the "no per-request or per-k retrace" acceptance of the truncated
+    workload lane.
+
+    Entry budget derivation for the default sequence: each of the two
+    buckets drives the shared core stepper entries once (problems=2);
+    `_tsqr_jit` is the tall bucket's alone and `_sketch_project_jit`
+    the top-k bucket's (problems=1 each); `_lift_q_jit` sees the tall
+    lift (m, n)x(n, n) and the top-k lift (m, l)x(l, k) — two distinct
+    shapes (problems=2); `_precondition_qr_jit` runs inside the core
+    stepper per bucket (problems=2).
+
+    ``expected_problems`` under-declares every budget and ``buckets``/
+    ``requests`` substitute FRESH problems — the seeded failing fixture
+    (tests prove the guard fires; a warm cache would mask a leak)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..serve import ServeConfig, SVDService
+    from ..utils import matgen
+
+    buckets = _RANK_BUCKETS if buckets is None else tuple(buckets)
+    requests = _RANK_REQUESTS if requests is None else tuple(requests)
+    budgets = {
+        "solver._tsqr_jit": 1,
+        "solver._sketch_project_jit": 1,
+        "solver._lift_q_jit": 2,
+        "solver._precondition_qr_jit": 2,
+        "solver._sweep_step_pallas_jit": 2,
+        "solver._finish_pallas_jit": 2,
+        "solver._nonfinite_probe_jit": 2,
+    }
+    cfg = ServeConfig(
+        buckets=buckets,
+        solver=SVDConfig(pair_solver="pallas"),
+        max_queue_depth=len(requests) + 2,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    statuses = []
+    with RecompileGuard() as guard:
+        for entry in _RANK_ENTRIES:
+            guard.expect(entry, problems=(budgets[entry]
+                                          if expected_problems is None
+                                          else int(expected_problems)))
+        with SVDService(cfg) as svc:
+            for _ in range(2):   # repeats must be pure cache hits
+                tickets = [
+                    svc.submit(matgen.random_dense(m, n, seed=m * 131 + n,
+                                                   dtype=jnp.float32),
+                               top_k=k)
+                    for (m, n), k in requests]
+                statuses += [t.result(timeout=600.0).status
+                             for t in tickets]
+        findings = guard.check()
+        report = guard.report()
+    report["serve_statuses"] = [getattr(s, "name", None) for s in statuses]
+    if any(s is None or s.name != "OK" for s in statuses):
+        findings.append(Finding(
+            code="RETRACE001", where="serve.run_serve_rank_case",
+            message=(f"rank-family serve sequence produced non-OK "
+                     f"statuses {report['serve_statuses']} — the retrace "
+                     f"measurement is not trustworthy on a failing solve"),
+            suggestion="fix the tall/top-k serving path first"))
     return findings, report
 
 

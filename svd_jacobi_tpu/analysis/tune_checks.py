@@ -63,8 +63,16 @@ def check_tables(paths: Optional[Sequence] = None) -> List[Finding]:
 def check_bucket_resolution(table=None,
                             buckets: Optional[Sequence] = None
                             ) -> List[Finding]:
-    """Rule 2: the declared serving buckets resolve via measured rows."""
+    """Rule 2: the declared serving buckets resolve via measured rows —
+    for every family. Bucket specs coerce through `serve.as_bucket`
+    (tuples of any arity, strings, Buckets), a "topk" bucket resolves
+    with its rank class, and the top-k family additionally requires the
+    SKETCH knobs (oversample/power_iters/tsqr_chunk) to come from a
+    non-generic row (``Resolved.sketch_generic_only``) — the truncated
+    lane's accuracy/speed trade must be a measured verdict, not the
+    catch-all default."""
     from .. import config as _config
+    from ..serve import as_bucket
     from ..tune import tables
     if table is None:
         try:
@@ -74,18 +82,31 @@ def check_bucket_resolution(table=None,
             # duplicate it against the builtin fallback.
             return []
     findings = []
-    for m, n, dtype in (buckets if buckets is not None
-                        else _config.DEFAULT_SERVE_BUCKETS):
-        r = tables.resolve(int(n), m=int(m), dtype=dtype, table=table)
+    for spec in (buckets if buckets is not None
+                 else _config.DEFAULT_SERVE_BUCKETS):
+        b = as_bucket(spec)
+        r = tables.resolve(b.n, m=b.m, dtype=b.dtype,
+                           k=(b.k if b.kind == "topk" else None),
+                           table=table)
         if r.generic_only:
             findings.append(Finding(
-                code=CODE, where=f"DEFAULT_SERVE_BUCKETS[{m}x{n}:{dtype}]",
+                code=CODE, where=f"DEFAULT_SERVE_BUCKETS[{b.name}]",
                 message=(f"bucket resolves only through the generic "
                          f"fallback row of table {table.table_id!r} — the "
                          f"declared serving surface is not covered by "
                          f"measured rows"),
                 suggestion="add a measured row for this (n_class, aspect, "
                            "dtype) to the shipped table"))
+        elif b.kind == "topk" and r.sketch_generic_only:
+            findings.append(Finding(
+                code=CODE, where=f"DEFAULT_SERVE_BUCKETS[{b.name}]",
+                message=(f"top-k bucket's SKETCH knobs (oversample/"
+                         f"power_iters/tsqr_chunk) resolve only through "
+                         f"the generic fallback of table "
+                         f"{table.table_id!r} — the truncated lane's "
+                         f"rank class is not covered by measured rows"),
+                suggestion="add a k_class row pinning the sketch knobs "
+                           "for this rank class to the shipped table"))
     return findings
 
 

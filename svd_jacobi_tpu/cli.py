@@ -90,6 +90,18 @@ def _parse_args(argv):
     p.add_argument("--max-sweeps", type=int, default=32)
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--block-size", type=int, default=None)
+    p.add_argument("--top-k", type=int, default=None, metavar="K",
+                   help="truncated top-K solve via the randomized "
+                        "range-finder lane (solver.svd_topk): only the "
+                        "top-K factors are computed, in O(mnK)-class "
+                        "flops instead of the full O(n^3); exits "
+                        "non-zero on status != OK like the full solve")
+    p.add_argument("--oversample", type=int, default=None,
+                   help="sketch oversampling columns beyond K "
+                        "(default: tuning table, generic 8)")
+    p.add_argument("--power-iters", type=int, default=None,
+                   help="TSQR-stabilized power iterations of the sketch "
+                        "(default: tuning table, generic 1)")
     p.add_argument("--no-selftest", action="store_true",
                    help="skip the built-in warm-up self-test "
                         "(reference runs one unconditionally, main.cu:1461)")
@@ -132,10 +144,15 @@ def _solve(a, args, config, mesh):
     """Run the solver with the driver's jobu/jobv mapped exactly as
     `lapack.gesvd` maps SVD_OPTIONS (NoVec -> compute_*=False, AllVec ->
     full_matrices) so sigma-only and AllVec runs are reproducible from the
-    CLI alone (reference: main.cu:1587)."""
+    CLI alone (reference: main.cu:1587). ``--top-k`` routes the one-shot
+    truncated lane (`solver.svd_topk`)."""
     import svd_jacobi_tpu as sj
     cu, cv = args.jobu != "none", args.jobv != "none"
     full = args.jobu == "all" or args.jobv == "all"
+    if getattr(args, "top_k", None):
+        from svd_jacobi_tpu.solver import svd_topk
+        return svd_topk(a, args.top_k, compute_u=cu, compute_v=cv,
+                        config=config)
     if mesh is not None:
         from svd_jacobi_tpu.parallel import sharded
         return sharded.svd(a, mesh=mesh, compute_u=cu, compute_v=cv,
@@ -156,7 +173,7 @@ def _self_test(args, config, log) -> dict:
     # The self-test checks the residual, so it always computes economy
     # factors regardless of the main run's jobu/jobv.
     st_args = argparse.Namespace(**{**vars(args), "jobu": "some",
-                                    "jobv": "some"})
+                                    "jobv": "some", "top_k": None})
     t0 = time.perf_counter()
     r = _solve(a, st_args, config, None)
     _force(tuple(r[:3]))
@@ -182,8 +199,16 @@ def _parse_serve_args(argv):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bucket", action="append", default=None,
                    metavar="MxN:dtype",
-                   help="declared shape bucket (repeatable); default: "
+                   help="declared shape bucket (repeatable; also "
+                        "'MxN:dtype:tall' / 'MxN:dtype:topkK'); default: "
                         "64x48:float32 + 96x64:float32 (CPU-friendly)")
+    p.add_argument("--topk-mix", action="store_true",
+                   help="seeded full + tall + top-k request mix: adds a "
+                        "tall and a topk bucket to the default set, draws "
+                        "~25%% of requests as tall shapes and ~25%% as "
+                        "top-k submits (top_k within the bucket's rank "
+                        "class); exits non-zero if any untimed-out "
+                        "request ends with status != OK")
     p.add_argument("--deadline-s", type=float, default=60.0,
                    help="per-request deadline for ordinary requests")
     p.add_argument("--tight-frac", type=float, default=0.2,
@@ -245,6 +270,17 @@ def serve_demo(argv) -> int:
 
     from svd_jacobi_tpu.serve import as_bucket
     buckets = tuple(args.bucket or ("64x48:float32", "96x64:float32"))
+    if args.topk_mix:
+        # The three workload families in one service instance: EXTEND
+        # the effective set (explicit --bucket included — the mix must
+        # never become a silent no-op) with a tall and a top-k bucket
+        # when the set declares none (CPU-friendly sizes; tall needs
+        # m >= 8n).
+        kinds = {as_bucket(b).kind for b in buckets}
+        if "tall" not in kinds:
+            buckets += ("256x24:float32:tall",)
+        if "topk" not in kinds:
+            buckets += ("96x96:float32:topk8",)
     bucket_set = [as_bucket(b) for b in buckets]
     if any(b.dtype == "float64" for b in bucket_set):
         # Declared f64 buckets (under any dtype spelling — as_bucket
@@ -262,7 +298,10 @@ def serve_demo(argv) -> int:
     svc = SVDService(cfg)
 
     # Seeded request plan, built up front so the run is reproducible: a
-    # shape drawn within a random bucket, plus the deadline class.
+    # shape drawn within a random bucket, plus the deadline class. A
+    # draw from a "topk" bucket ALWAYS submits with top_k (a full
+    # request never routes into a truncated bucket), so the mix
+    # exercises all three workload families end to end.
     rng = np.random.default_rng(args.seed)
     bs = bucket_set
     plan = []
@@ -270,8 +309,11 @@ def serve_demo(argv) -> int:
         b = bs[int(rng.integers(len(bs)))]
         m = int(rng.integers(max(2, b.m // 2), b.m + 1))
         n = int(rng.integers(max(1, min(m, b.n) // 2), min(m, b.n) + 1))
+        top_k = (int(rng.integers(1, b.k + 1)) if b.kind == "topk"
+                 else None)
         tight = bool(rng.random() < args.tight_frac)
-        plan.append((m, n, b.dtype, tight, int(rng.integers(2 ** 31))))
+        plan.append((m, n, b.dtype, tight, int(rng.integers(2 ** 31)),
+                     top_k))
 
     outcomes = []
     out_lock = threading.Lock()
@@ -284,25 +326,27 @@ def serve_demo(argv) -> int:
                     return
                 i = next_i[0]
                 next_i[0] += 1
-            m, n, dtype, tight, seed = plan[i]
+            m, n, dtype, tight, seed, top_k = plan[i]
             a = matgen.random_dense(m, n, seed=seed, dtype=jnp.dtype(dtype))
             deadline = (args.tight_ms / 1e3) if tight else args.deadline_s
             try:
-                t = svc.submit(a, deadline_s=deadline)
+                t = svc.submit(a, deadline_s=deadline, top_k=top_k)
             except AdmissionError as e:
                 with out_lock:
-                    outcomes.append({"i": i, "terminal": True,
+                    outcomes.append({"i": i, "terminal": True, "tight": tight,
                                      "status": f"REJECTED_{e.reason.name}"})
                 continue
             try:
                 res = t.result(timeout=600.0)
-                out = {"i": i, "terminal": True,
+                out = {"i": i, "terminal": True, "tight": tight,
+                       "top_k": top_k,
                        "status": ("ERROR" if res.error else res.status.name),
                        "queue_wait_s": res.queue_wait_s,
                        "solve_time_s": res.solve_time_s,
                        "error": res.error}
             except TimeoutError:
-                out = {"i": i, "terminal": False, "status": "HUNG"}
+                out = {"i": i, "terminal": False, "tight": tight,
+                       "status": "HUNG"}
             with out_lock:
                 outcomes.append(out)
 
@@ -336,11 +380,23 @@ def serve_demo(argv) -> int:
         "wall_s": wall,
         "health": health,
     }
+    if args.topk_mix:
+        summary["topk_requests"] = sum(1 for p in plan if p[5] is not None)
     if manifest_path:
         log(f"manifest: {manifest_path}")
     print(json.dumps(summary))
     ok = (summary["terminal"] == len(plan) and summary["errors"] == 0
           and len(outcomes) == len(plan))
+    if ok and args.topk_mix:
+        # The mix's acceptance: every request that was given a meetable
+        # deadline must come back OK — a tall/top-k lane that quietly
+        # degrades or stalls fails the demo loudly.
+        bad = [o for o in outcomes
+               if not o.get("tight") and o["status"] != "OK"]
+        if bad:
+            log(f"exit 1: {len(bad)} non-tight request(s) with status != "
+                f"OK: {[o['status'] for o in bad]}")
+            return 1
     if not ok:
         log("exit 1: non-terminal or errored requests "
             f"({len(plan) - summary['terminal']} non-terminal, "
@@ -412,13 +468,32 @@ def main(argv=None) -> int:
     if args.mixed_bulk == "on" and args.dtype == "bfloat16":
         log("--mixed-bulk on requires a float32 input")
         return 2
+    if args.top_k is not None and args.top_k < 1:
+        log("--top-k must be >= 1")
+        return 2
+    if args.top_k is not None and args.distributed:
+        # The truncated lane is single-controller today (the sketch jits
+        # are not mesh entries); fail at parse time like the other
+        # single-device modes.
+        log("--top-k is a single-device lane; not supported with "
+            "--distributed")
+        return 2
+    if args.top_k is not None and (args.jobu == "all" or args.jobv == "all"):
+        # AllVec promises a full (m, m)/(n, n) factor; a truncated solve
+        # returns k columns by construction — reject instead of silently
+        # dropping the documented SVD_OPTIONS mapping.
+        log("--top-k returns truncated (m, K)/(n, K) factors; "
+            "--jobu/--jobv all (AllVec) is not satisfiable — use 'some'")
+        return 2
     dtype = jnp.dtype(args.dtype)
     tristate = {"auto": None, "on": True, "off": False}
     config = sj.SVDConfig(block_size=args.block_size, max_sweeps=args.max_sweeps,
                           tol=args.tol, pair_solver=args.pair_solver,
                           precondition=args.precondition,
                           mixed_bulk=tristate[args.mixed_bulk],
-                          sigma_refine=tristate[args.sigma_refine])
+                          sigma_refine=tristate[args.sigma_refine],
+                          oversample=args.oversample,
+                          power_iters=args.power_iters)
 
     mesh = None
     ctx = None
@@ -450,6 +525,8 @@ def main(argv=None) -> int:
         "distributed": bool(mesh),
         "jobu": args.jobu, "jobv": args.jobv,
     }
+    if args.top_k:
+        extra["top_k"] = int(args.top_k)
     if args.sanitized:
         extra["sanitized"] = True
     stages = []
@@ -517,27 +594,56 @@ def main(argv=None) -> int:
 
     from svd_jacobi_tpu.solver import SolveStatus  # noqa: F401 (decode)
     status_name = r.status_enum().name
-    rep = validation.validate(a, r).as_dict()
-    solve = {
-        "time_s": solve_time,
-        "sweeps": int(r.sweeps),
-        "off_norm": float(r.off_rel),
-        # The in-graph health word: anything but "OK" makes this run exit
-        # non-zero (a NaN-poisoned or non-converged solve must not look
-        # like a success to the harness driving this CLI).
-        "status": status_name,
-        # None where the job options suppressed a factor (e.g. sigma-only);
-        # jobu/jobv themselves ride at manifest top level with the other
-        # CLI-surface options.
-        "residual_rel": rep["residual_rel"],
-        "u_orth": rep["u_orth"],
-        "u_orth_live": rep["u_orth_live"],
-        "v_orth": rep["v_orth"],
-    }
-    res_str = ("n/a (factor suppressed)" if rep["residual_rel"] is None
-               else f"{rep['residual_rel']:.3e}")
-    log(f"solve {m}x{n}: time={solve_time:.3f}s sweeps={int(r.sweeps)} "
-        f"residual={res_str} status={status_name}")
+    if args.top_k:
+        # Truncated solve: the full-reconstruction residual equals the
+        # DISCARDED tail energy, so it is not a correctness metric here.
+        # Report the per-vector subspace residual ||A v_i - s_i u_i||
+        # instead (zero for exact top-k factors), plus factor
+        # orthogonality — the truncated lane's accuracy surface.
+        solve = {
+            "time_s": solve_time,
+            "sweeps": int(r.sweeps),
+            "off_norm": float(r.off_rel),
+            "status": status_name,
+            "residual_rel": None,
+            "k": int(args.top_k),
+            "u_orth": (float(validation.orthogonality_error(r.u))
+                       if r.u is not None else None),
+            "v_orth": (float(validation.orthogonality_error(r.v))
+                       if r.v is not None else None),
+        }
+        if r.u is not None and r.v is not None:
+            an = np.asarray(a, np.float64)
+            un, sn, vn = (np.asarray(r.u, np.float64),
+                          np.asarray(r.s, np.float64),
+                          np.asarray(r.v, np.float64))
+            solve["topk_subspace_residual"] = float(
+                np.linalg.norm(an @ vn - un * sn[None, :])
+                / max(np.linalg.norm(an), 1e-300))
+        log(f"solve {m}x{n} top-{args.top_k}: time={solve_time:.3f}s "
+            f"sweeps={int(r.sweeps)} status={status_name}")
+    else:
+        rep = validation.validate(a, r).as_dict()
+        solve = {
+            "time_s": solve_time,
+            "sweeps": int(r.sweeps),
+            "off_norm": float(r.off_rel),
+            # The in-graph health word: anything but "OK" makes this run
+            # exit non-zero (a NaN-poisoned or non-converged solve must
+            # not look like a success to the harness driving this CLI).
+            "status": status_name,
+            # None where the job options suppressed a factor (e.g.
+            # sigma-only); jobu/jobv themselves ride at manifest top
+            # level with the other CLI-surface options.
+            "residual_rel": rep["residual_rel"],
+            "u_orth": rep["u_orth"],
+            "u_orth_live": rep["u_orth_live"],
+            "v_orth": rep["v_orth"],
+        }
+        res_str = ("n/a (factor suppressed)" if rep["residual_rel"] is None
+                   else f"{rep['residual_rel']:.3e}")
+        log(f"solve {m}x{n}: time={solve_time:.3f}s sweeps={int(r.sweeps)} "
+            f"residual={res_str} status={status_name}")
 
     multiproc = ctx is not None and ctx.process_count > 1
     if args.oracle:
@@ -548,6 +654,8 @@ def main(argv=None) -> int:
             log("--oracle skipped: not supported with multi-process runs")
         else:
             s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+            if args.top_k:
+                s_ref = s_ref[:int(args.top_k)]
             solve["sigma_err"] = float(validation.sigma_error(r.s, s_ref))
             log(f"sigma_err vs numpy: {solve['sigma_err']:.3e}")
 

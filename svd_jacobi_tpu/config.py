@@ -132,6 +132,25 @@ class SVDConfig:
     # working set. This is the difference between fitting and OOM at the
     # chip's largest sizes (30000^2 sigma-only needs it on 16 GB HBM).
     donate_input: bool = False
+    # --- truncated / tall workload knobs (solver.svd_topk / svd_tall) ---
+    # Randomized range-finder sketch width beyond k: the sketch solves a
+    # (k + oversample)-wide projection; larger oversampling tightens the
+    # Halko tail bound at O(mn) extra flops per column. None = tuning
+    # table (generic 8).
+    oversample: Optional[int] = None
+    # TSQR-stabilized power iterations A (A^T Q(Y)) before the range
+    # basis is taken: each iteration sharpens the sketch's spectral
+    # separation ((s_{l+1}/s_k)^(2q+1)-class tail), needed for
+    # spectral-decay-poor inputs. None = tuning table (generic 1).
+    power_iters: Optional[int] = None
+    # Rows per chunk of the blocked TSQR stages of svd_tall / svd_topk
+    # (solver._tsqr_jit / _sketch_project_jit). None = tuning table
+    # (generic: the sketch.default_chunk heuristic). NOTE: the Drmac
+    # preconditioner inside the core (`solver._precondition_qr`) also
+    # routes tall inputs through the chunked TSQR but always uses the
+    # heuristic chunk — it is a config-free shared helper (its jit
+    # signature is fixed), so this knob does not reach it.
+    tsqr_chunk: Optional[int] = None
 
     def pick_block_size(self, n: int, m: Optional[int] = None,
                         dtype=None) -> int:
@@ -207,6 +226,16 @@ COLLECTIVE_BUDGET = {
     "pallas_batched": {"collective_permute": 0, "all_reduce": 0,
                        "all_gather": 0, "all_to_all": 0,
                        "reduce_scatter": 0},
+    # The sketch/TSQR stage jits of the top-k and tall lanes
+    # (solver._sketch_project_jit / _tsqr_jit): single-device matmul/QR
+    # chains — zero collectives of any kind, always (on a mesh the
+    # chunked-QR communication is GSPMD-inserted OUTSIDE these fused
+    # entries, never hand-written into them).
+    "sketch_project": {"collective_permute": 0, "all_reduce": 0,
+                       "all_gather": 0, "all_to_all": 0,
+                       "reduce_scatter": 0},
+    "tsqr_tall": {"collective_permute": 0, "all_reduce": 0,
+                  "all_gather": 0, "all_to_all": 0, "reduce_scatter": 0},
     "sharded_pallas": {"collective_permute": 4, "all_reduce": 4,
                        "all_gather": 0, "all_to_all": 0, "reduce_scatter": 0},
     "sharded_pallas_novec": {"collective_permute": 2, "all_reduce": 4,
@@ -214,6 +243,16 @@ COLLECTIVE_BUDGET = {
                              "reduce_scatter": 0},
     "sharded_hybrid": {"collective_permute": 8, "all_reduce": 4,
                        "all_gather": 0, "all_to_all": 0, "reduce_scatter": 0},
+    # Tall (m >= 8n) mesh solve: the chunked-TSQR preconditioner runs
+    # under GSPMD outside the shard_map sweep loop, where the lowered
+    # StableHLO carries sharding annotations but no explicit collectives
+    # — so the tall entry's budget equals the square one's (the ring
+    # exchange + pmax'd convergence machinery, nothing else). A
+    # collective appearing here would mean the QR tree leaked INTO the
+    # fused loop.
+    "sharded_pallas_tall": {"collective_permute": 4, "all_reduce": 4,
+                            "all_gather": 0, "all_to_all": 0,
+                            "reduce_scatter": 0},
 }
 
 # Compilation budget per fused entry point: how many times an entry may
@@ -253,6 +292,18 @@ RETRACE_BUDGETS = {
     "solver._finish_pallas_batched_jit": 1,
     "solver._finish_xla_batched_jit": 1,
     "solver._nonfinite_probe_batched_jit": 1,
+    # Top-k / tall lane stages (ops/sketch.py wrapped by solver): the
+    # sketch width l, power-iteration count, TSQR chunk and seed are all
+    # static and BUCKET-derived in serving, so one compile per distinct
+    # problem key — a request-k or per-request leak into any of these
+    # keys blows the budget (analysis.recompile_guard.run_serve_rank_case
+    # proves it over mixed-k request streams).
+    "solver._tsqr_jit": 1,
+    "solver._tsqr_batched_jit": 1,
+    "solver._sketch_project_jit": 1,
+    "solver._sketch_project_batched_jit": 1,
+    "solver._lift_q_jit": 1,
+    "solver._lift_q_batched_jit": 1,
 }
 
 # Batch-size tiers of the serving layer's coalesced dispatch
@@ -275,6 +326,17 @@ DEFAULT_SERVE_BUCKETS = (
     (256, 256, "float32"),
     (1024, 512, "float32"),
     (2048, 2048, "float32"),
+    # Tall bucket family (kind "tall", m >= 8n): dispatches through the
+    # blocked-TSQR lane — chunked QR, Jacobi on the n x n triangle —
+    # instead of padding a genuinely rectangular request to a square
+    # bucket's full solve.
+    (2048, 256, "float32", "tall"),
+    # Top-k bucket family (kind "topk", k-classed): requests submitted
+    # with top_k route here; the randomized range-finder solves a
+    # (k + oversample)-wide projection. The bucket's k bounds the
+    # admissible request k (the sketch width is BUCKET-static, so the
+    # compile contract holds across request k values).
+    (1024, 1024, "float32", "topk", 64),
 )
 
 # PROFILE.md hot-region coverage: every component row of the cost tables
@@ -299,4 +361,10 @@ HOT_SCOPES = {
     # scalar ops, but keeping it scoped proves in any profile that the
     # resilience layer costs ~nothing on the hot path (PROFILE.md).
     "health": ("solver.py", "_status_word"),
+    # Top-k / tall lane stages: the chunked TSQR tree, the randomized
+    # range-finder sketch, and the Q-basis factor lift — the three new
+    # hot regions of the rectangular/truncated workload lanes.
+    "tsqr": ("ops/sketch.py", "tsqr"),
+    "sketch": ("ops/sketch.py", "sketch_project"),
+    "lift": ("solver.py", "_lift_q"),
 }

@@ -246,13 +246,19 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
                 breaker: str, brownout: str,
                 batch_id: Optional[str] = None,
                 batch_size: Optional[int] = None,
-                batch_tier: Optional[int] = None, **extra) -> dict:
+                batch_tier: Optional[int] = None,
+                rank_mode: str = "full",
+                k: Optional[int] = None, **extra) -> dict:
     """Assemble a schema-valid per-request serving record
     (`serve.SVDService`). ``batch_id``/``batch_size``/``batch_tier``
     identify a COALESCED dispatch (micro-batched solve lane): every
     member of one batched solve shares the batch_id, ``batch_size`` is
     the real member count and ``batch_tier`` the padded static tier it
-    snapped to; all None for a single (uncoalesced) dispatch. ``extra``
+    snapped to; all None for a single (uncoalesced) dispatch.
+    ``rank_mode`` is the workload family the request dispatched through
+    ("full" | "tall" | "topk") and ``k`` the requested top-k rank (None
+    unless rank_mode is "topk") — together they make the truncated-
+    workload traffic reconstructable from the stream. ``extra``
     (degraded, deadline_s, sweeps, error, ...) rides along like in
     `build`."""
     record = {
@@ -272,6 +278,8 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
         "batch_id": None if batch_id is None else str(batch_id),
         "batch_size": None if batch_size is None else int(batch_size),
         "batch_tier": None if batch_tier is None else int(batch_tier),
+        "rank_mode": str(rank_mode),
+        "k": None if k is None else int(k),
     }
     record.update(extra)
     validate(record)
@@ -501,6 +509,13 @@ def summarize(record: dict) -> str:
                 f" breaker={record.get('breaker', '?')}"
                 f" brownout={record.get('brownout', '?')}"
                 f" wait={wait * 1e3:.1f}ms solve={solve_s}")
+        if record.get("rank_mode", "full") != "full":
+            # Top-k / tall workload branch: a truncated request shows its
+            # rank, a tall one its TSQR routing — the summarizer's view
+            # of the "Workloads" families.
+            line += f" {record['rank_mode']}"
+            if record.get("k") is not None:
+                line += f"[k={record['k']}]"
         if record.get("batch_id"):
             line += (f" batch={record['batch_id']}"
                      f"[{record.get('batch_size', '?')}"

@@ -1,0 +1,173 @@
+"""Randomized range-finder + blocked tall-skinny QR (TSQR).
+
+The two primitives behind the truncated/rectangular solver lanes
+(`solver.svd_topk` / `solver.svd_tall`):
+
+  * :func:`tsqr` — a blocked, tree-reduction tall-skinny QR: the input's
+    rows split into static chunks, each chunk gets its own reduced QR,
+    the stacked per-chunk R factors recurse until one dense QR closes
+    the tree, and the thin Q is recombined chunk-wise
+    (``Q_chunk = Q_i @ Q2_i``). No step ever touches a buffer taller
+    than ``chunk`` rows or wider than ``n`` columns, and in particular
+    no square m x m factor is ever materialized — the memory-locality
+    property that lets the Drmac preconditioner
+    (`solver._precondition_qr`) and the mesh solver handle genuinely
+    tall m >> n inputs, and that GSPMD can partition chunk-wise on a
+    mesh (the chunked-QR collectives ride OUTSIDE the fused sweep loop,
+    so the sharded round loop's collective budget is unchanged —
+    `config.COLLECTIVE_BUDGET`).
+  * :func:`sketch_project` — the Halko-style randomized range finder: a
+    SEEDED Gaussian sketch ``Y = A @ Omega`` (deterministic: the seed is
+    a static argument, so two solves of the same problem see the same
+    sketch and the jit cache key carries it), optional power iterations
+    ``Y <- A (A^T Q(Y))`` for spectral-decay-poor inputs (each
+    stabilized through :func:`tsqr` — unstabilized powers lose the
+    small-singular-value directions to roundoff), then the projected
+    matrix ``B = Q^T A`` returned TRANSPOSED as the tall (n, l) input
+    the existing Jacobi core consumes. Cost is O(mnl) with
+    l = k + oversample — the whole point: the O(n^3) full decomposition
+    is never done for a top-k request.
+
+Accuracy contract (documented in README "Workloads"): with
+``A = U S V^T``, the top-k singular values of ``B`` match those of ``A``
+up to the tail-energy term of Halko et al. — exact for exactly-rank-k
+input, relative error ~ (s_{l+1}/s_k)^(2q+1)-class otherwise, so
+decaying spectra are accurate at q = 0-1 and flat spectra keep their
+VALUES exact (any l-dimensional subspace of a flat spectrum carries the
+same sigmas) while their vectors are arbitrary within the tie.
+
+Both functions are pure trace-time constructions (static shapes/loop
+counts); `solver` wraps them in the jitted entries the retrace budgets
+name (`config.RETRACE_BUDGETS`).
+
+NaN/Inf policy: a non-finite input poisons the sketch (`B` inherits NaN
+through the matmuls/QR), and :func:`sketch_project` returns an explicit
+``nonfinite`` flag probed on the SMALL projected matrix — the sketch
+path's equivalent of the fused loops' in-graph health word, decoded by
+the caller into `SolveStatus.NONFINITE`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..obs.scopes import scope
+
+# The tall-aspect threshold shared with the tuning tables
+# (tune.tables.TALL_ASPECT_RATIO): chunked TSQR engages inside the
+# preconditioner from m >= 8n up (below it one dense reduced QR is
+# cheaper than the tree).
+TALL_RATIO = 8
+
+# Default rows per TSQR chunk (the "tsqr chunk rows" tuning knob's
+# builtin): small enough that a chunk QR stays cache/VMEM-local, large
+# enough that the R-stack reduction tree stays shallow.
+DEFAULT_CHUNK_ROWS = 2048
+
+
+def default_chunk(m: int, n: int) -> int:
+    """Heuristic chunk rows for an (m, n) TSQR: at least n (a reduced
+    chunk QR needs rows >= cols for its R to be n x n), capped at
+    :data:`DEFAULT_CHUNK_ROWS`, and never more than m/8 — so any input
+    past the tall threshold (m >= 8n) actually runs the chunked tree
+    rather than collapsing to the dense base case."""
+    return max(int(n), min(DEFAULT_CHUNK_ROWS, -(-int(m) // TALL_RATIO)))
+
+
+def tsqr(a: jax.Array, *, chunk: Optional[int] = None
+         ) -> Tuple[jax.Array, jax.Array]:
+    """Blocked tall-skinny QR: ``a = q @ r`` with ``q`` (m, n) thin
+    orthonormal and ``r`` (n, n) upper triangular (up to row signs — QR
+    is unique only up to a diagonal sign flip, which every caller here
+    absorbs). Computed in the accumulation dtype
+    ``promote_types(a.dtype, float32)`` (sub-f32 dtypes have no QR
+    kernel); callers cast back as needed.
+
+    ``chunk`` is the static rows-per-chunk (None = :func:`default_chunk`).
+    Inputs short enough for one dense reduced QR (m <= max(chunk, 2n))
+    take it directly — so calling :func:`tsqr` on a square or
+    modestly-tall input is byte-equivalent to ``jnp.linalg.qr``.
+
+    Rows are zero-padded up to a chunk multiple; a zero chunk's QR is
+    (Q = I-slice, R = 0) and the zero rows of the stacked R make the
+    reduction's matching Q2 rows zero for full-column-rank input, so the
+    sliced-back thin Q stays orthonormal. (Exactly rank-deficient input
+    can leak padding energy into the dropped rows — the same tie class
+    the solver's rank-deficiency guard documents.)
+    """
+    m, n = a.shape
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    if chunk is None:
+        chunk = default_chunk(m, n)
+    # chunk >= 2n guarantees the reduction tree makes progress: each
+    # level's stacked R has ceil(m/chunk)*n <= m/2 + n rows, strictly
+    # fewer than m whenever the chunked branch is taken.
+    chunk = max(int(chunk), 2 * int(n))
+    if m <= max(chunk, 2 * n):
+        with scope("tsqr"):
+            q, r = jnp.linalg.qr(a.astype(acc))
+        return q, r
+    with scope("tsqr"):
+        hi = jax.lax.Precision.HIGHEST
+        c = -(-m // chunk)
+        pad = c * chunk - m
+        w = a.astype(acc)
+        if pad:
+            w = jnp.pad(w, ((0, pad), (0, 0)))
+        blocks = w.reshape(c, chunk, n)
+        qs, rs = jax.vmap(jnp.linalg.qr)(blocks)      # (c,chunk,n), (c,n,n)
+    # Reduce the stacked R factors (c*n, n) — recursion keeps every
+    # level's buffer at most chunk-rows tall; one extra level suffices
+    # until c*n itself exceeds the chunk.
+    q2, r = tsqr(rs.reshape(c * n, n), chunk=chunk)
+    with scope("tsqr"):
+        q = jnp.matmul(qs, q2.reshape(c, n, n), precision=hi)
+        q = q.reshape(c * chunk, n)[:m]
+    return q, r
+
+
+def sketch_project(a: jax.Array, *, l: int, power_iters: int,
+                   chunk: Optional[int] = None, seed: int = 0
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Halko randomized range finder + projection for a tall (m, n)
+    input: returns ``(q, bt, nonfinite)`` with ``q`` (m, l) an
+    orthonormal basis of the (power-iterated) sketch range, ``bt``
+    (n, l) the TRANSPOSED projected matrix ``B^T = A^T Q`` — the tall
+    input the existing Jacobi core consumes directly — and ``nonfinite``
+    a scalar bool flag (NaN/Inf anywhere in the input reaches ``bt``
+    through the matmul chain; probing the small projection costs O(nl)).
+
+    With ``B^T = W S Z^T`` from the core, ``A ~= (Q Z) S W^T``: the
+    lift ``U = Q @ Z`` is the caller's job (`solver._lift_q_jit`).
+
+    Static arguments (all part of the caller's jit key): ``l`` the
+    sketch width (k + oversample), ``power_iters`` the number of
+    TSQR-stabilized power iterations, ``chunk`` the TSQR chunk rows,
+    ``seed`` the sketch seed — resolution of all four goes through the
+    tuning tables (`tune.tables`, knobs ``oversample`` /
+    ``power_iters`` / ``tsqr_chunk``) so the choice is measured, not
+    hand-picked.
+    """
+    m, n = a.shape
+    if not 1 <= l <= min(m, n):
+        raise ValueError(f"sketch width l={l} must satisfy "
+                         f"1 <= l <= min(m, n) = {min(m, n)}")
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    with scope("sketch"):
+        aw = a.astype(acc)
+        omega = jax.random.normal(jax.random.PRNGKey(seed), (n, l), acc)
+        y = jnp.matmul(aw, omega, precision=hi)
+    for _ in range(int(power_iters)):
+        qy, _ = tsqr(y, chunk=chunk)
+        with scope("sketch"):
+            z = jnp.matmul(aw.T, qy, precision=hi)     # (n, l)
+            y = jnp.matmul(aw, z, precision=hi)
+    q, _ = tsqr(y, chunk=chunk)
+    with scope("sketch"):
+        bt = jnp.matmul(aw.T, q, precision=hi)         # (n, l) = B^T
+        nonfinite = ~jnp.all(jnp.isfinite(bt))
+        return q.astype(a.dtype), bt.astype(a.dtype), nonfinite

@@ -11,13 +11,33 @@ dispatch is a cache hit (`config.RETRACE_BUDGETS` entries
 bucket is REJECTED at admission (`AdmissionReason.NO_BUCKET`) — loudly,
 never solved off-bucket.
 
+Buckets come in three FAMILIES (``Bucket.kind``), one per workload the
+service understands (README "Workloads"):
+
+  * ``"full"`` — the classic padded full decomposition;
+  * ``"tall"`` — genuinely rectangular m >= 8n shapes, dispatched
+    through the blocked-TSQR lane (chunked QR, then the Jacobi core on
+    the n x n triangle only) instead of a padded square solve. A tall
+    bucket still serves FULL factors — it is a cheaper dispatch
+    strategy, not a different contract — so ordinary requests route into
+    it whenever it is the cheapest fit;
+  * ``"topk"`` — truncated top-k requests (`submit(..., top_k=k)`),
+    dispatched through the randomized range-finder lane. The bucket's
+    ``k`` is the RANK CLASS: it bounds the admissible request k and
+    fixes the static sketch width (k + oversample), so the compile
+    contract holds across request k values (no per-k retrace — the
+    request's k only slices the result). Full requests never route into
+    a topk bucket (its result is truncated), and topk requests route
+    ONLY into topk buckets.
+
 Zero-padding is exact for the SVD, not an approximation: padded columns
 are exactly zero, so they deflate (sigma 0, sorted to the back by the
 descending sort) and never rotate against live columns; padded ROWS stay
 exactly zero through every column rotation (a rotation forms linear
 combinations of columns, and both combined entries in a padded row are
 zero). The original factors are therefore recovered by slicing:
-``u[:m, :k], s[:k], v[:n, :k]`` with ``k = min(m, n)``.
+``u[:m, :k], s[:k], v[:n, :k]`` with ``k = min(m, n)`` (the request's
+``top_k`` on the truncated family).
 
 Rank-deficiency caveat: a request with EXACT-zero singular values ties
 with the padding's zero sigmas in the descending sort, so its null-space
@@ -33,51 +53,108 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Sequence, Tuple, Union
 
+BUCKET_KINDS = ("full", "tall", "topk")
+
 
 class Bucket(NamedTuple):
-    """One declared padded shape: tall (m >= n) plus the dtype name."""
+    """One declared padded shape: tall (m >= n) plus the dtype name,
+    the workload family ``kind`` and — for the "topk" family — the rank
+    class ``k`` (0 otherwise)."""
 
     m: int
     n: int
     dtype: str
+    kind: str = "full"
+    k: int = 0
 
     @property
     def name(self) -> str:
-        return f"{self.m}x{self.n}:{self.dtype}"
+        base = f"{self.m}x{self.n}:{self.dtype}"
+        if self.kind == "tall":
+            return base + ":tall"
+        if self.kind == "topk":
+            return base + f":topk{self.k}"
+        return base
 
     @property
     def cost(self) -> int:
-        # One-sided Jacobi cost proxy (O(m n^2) per sweep) — routing picks
-        # the cheapest bucket that holds the request, not the smallest
-        # area, so a tall-skinny request never lands in a huge square
-        # bucket when a cheaper tall one fits.
+        # Routing picks the cheapest bucket that holds the request, not
+        # the smallest area. Cost proxies per family: one-sided Jacobi is
+        # O(m n^2) per sweep; the tall lane pays the TSQR (2 m n^2-class)
+        # plus a small n^3 solve — same leading term, discounted so a
+        # tall bucket beats an equal-area square one; the top-k lane is
+        # O(m n l) with l = k + oversample.
+        if self.kind == "topk":
+            return self.m * self.n * max(1, self.k)
+        if self.kind == "tall":
+            return (2 * self.m * self.n * self.n) // 3
         return self.m * self.n * self.n
 
 
-BucketSpec = Union[Bucket, Tuple[int, int, str], str]
+BucketSpec = Union[Bucket, Tuple, str]
 
 
 def as_bucket(spec: BucketSpec) -> Bucket:
-    """Coerce a (m, n, dtype) tuple / "MxN:dtype" string / Bucket."""
+    """Coerce a bucket spec: a Bucket, an (m, n, dtype[, kind[, k]])
+    tuple, or a string ``"MxN:dtype"`` / ``"MxN:dtype:tall"`` /
+    ``"MxN:dtype:topkK"``."""
     if isinstance(spec, Bucket):
         b = spec
     elif isinstance(spec, str):
         try:
-            dims, dtype = spec.split(":")
+            parts = spec.split(":")
+            dims, dtype = parts[0], parts[1]
             m, n = dims.split("x")
-            b = Bucket(int(m), int(n), dtype)
-        except ValueError:
+            kind, k = "full", 0
+            if len(parts) == 3:
+                fam = parts[2]
+                if fam == "tall":
+                    kind = "tall"
+                elif fam.startswith("topk"):
+                    kind, k = "topk", int(fam[len("topk"):])
+                else:
+                    raise ValueError(fam)
+            elif len(parts) != 2:
+                raise ValueError(spec)
+            b = Bucket(int(m), int(n), dtype, kind, int(k))
+        except (ValueError, IndexError):
             raise ValueError(
-                f"bucket spec {spec!r} is not of the form 'MxN:dtype'")
+                f"bucket spec {spec!r} is not of the form 'MxN:dtype', "
+                f"'MxN:dtype:tall' or 'MxN:dtype:topkK'")
     else:
-        m, n, dtype = spec
-        b = Bucket(int(m), int(n), str(dtype))
+        parts = tuple(spec)
+        if len(parts) == 3:
+            m, n, dtype = parts
+            kind, k = "full", 0
+        elif len(parts) == 4:
+            m, n, dtype, kind = parts
+            k = 0
+        elif len(parts) == 5:
+            m, n, dtype, kind, k = parts
+        else:
+            raise ValueError(f"bucket spec {spec!r}: expected "
+                             f"(m, n, dtype[, kind[, k]])")
+        b = Bucket(int(m), int(n), str(dtype), str(kind), int(k))
     import jax.numpy as jnp
-    b = Bucket(b.m, b.n, str(jnp.dtype(b.dtype).name))
+    b = Bucket(b.m, b.n, str(jnp.dtype(b.dtype).name), b.kind, b.k)
     if b.n < 1 or b.m < b.n:
         raise ValueError(
             f"bucket {b.name}: buckets are tall, need m >= n >= 1 "
             f"(the service orients wide requests by transposition)")
+    if b.kind not in BUCKET_KINDS:
+        raise ValueError(f"bucket {b.name}: unknown kind {b.kind!r} "
+                         f"(known: {BUCKET_KINDS})")
+    if b.kind == "tall" and b.m < 8 * b.n:
+        raise ValueError(
+            f"bucket {b.name}: the tall family requires m >= 8n (below "
+            f"that the TSQR lane does not pay; declare a 'full' bucket)")
+    if b.kind == "topk" and not 1 <= b.k <= b.n:
+        raise ValueError(
+            f"bucket {b.name}: the topk family needs 1 <= k <= n, "
+            f"got k={b.k}")
+    if b.kind != "topk" and b.k:
+        raise ValueError(f"bucket {b.name}: k is only meaningful on the "
+                         f"topk family")
     return b
 
 
@@ -91,7 +168,8 @@ class BucketSet:
         if len(set(bs)) != len(bs):
             raise ValueError(f"duplicate buckets in {bs}")
         self.buckets: Tuple[Bucket, ...] = tuple(
-            sorted(bs, key=lambda b: (b.cost, b.m, b.n, b.dtype)))
+            sorted(bs, key=lambda b: (b.cost, b.m, b.n, b.dtype, b.kind,
+                                      b.k)))
 
     def __iter__(self):
         return iter(self.buckets)
@@ -99,13 +177,25 @@ class BucketSet:
     def __len__(self):
         return len(self.buckets)
 
-    def route(self, m: int, n: int, dtype: str) -> Optional[Bucket]:
+    def route(self, m: int, n: int, dtype: str,
+              top_k: Optional[int] = None) -> Optional[Bucket]:
         """Cheapest bucket holding a TALL-oriented (m >= n) request of
-        exact dtype, or None (-> admission rejects with NO_BUCKET)."""
+        exact dtype, or None (-> admission rejects with NO_BUCKET).
+
+        ``top_k`` selects the family: None routes over the full/tall
+        buckets (a tall bucket serves full factors — see module
+        docstring); an int routes ONLY over topk buckets whose rank
+        class covers it (``bucket.k >= top_k``), so the request's k can
+        never widen a bucket's static sketch."""
         import jax.numpy as jnp
         dtype = str(jnp.dtype(dtype).name)
         for b in self.buckets:
-            if b.dtype == dtype and b.m >= m and b.n >= n:
+            if b.dtype != dtype or b.m < m or b.n < n:
+                continue
+            if top_k is None:
+                if b.kind in ("full", "tall"):
+                    return b
+            elif b.kind == "topk" and b.k >= top_k:
                 return b
         return None
 
@@ -114,13 +204,17 @@ class BucketSet:
         tuning table ONCE at declaration time (`tune.resolve_config`):
         every "auto"/None knob of ``base`` the table can pin shape-safely
         is pinned to the value the solver's own planner would resolve for
-        the bucket's padded shape. The service stores this map and every
-        dispatch path — lanes included — reads it instead of re-resolving
-        per request; resolution being pure/deterministic, the pinned
-        configs produce byte-identical jit keys to the auto path (the
-        TUNE001 analysis pass proves no new retraces)."""
+        the bucket's padded shape (topk buckets pass their rank class so
+        the sketch knobs resolve through the k-class rows). The service
+        stores this map and every dispatch path — lanes included — reads
+        it instead of re-resolving per request; resolution being
+        pure/deterministic, the pinned configs produce byte-identical jit
+        keys to the auto path (the TUNE001 analysis pass proves no new
+        retraces)."""
         from ..tune import tables
-        return {b: tables.resolve_config(base, m=b.m, n=b.n, dtype=b.dtype)
+        return {b: tables.resolve_config(
+                    base, m=b.m, n=b.n, dtype=b.dtype,
+                    k=(b.k if b.kind == "topk" else None))
                 for b in self.buckets}
 
     def resolved_batch_tiers(self) -> dict:
@@ -128,12 +222,13 @@ class BucketSet:
         (`ServeConfig.batch_tiers="auto"`): tiers are a measured knob —
         which batch sizes amortize the latency-bound rotation chain is
         backend-dependent (PROFILE.md item 22) — so the table rows carry
-        them per (n-class, aspect, dtype, backend, device_kind). Resolved
-        once at declaration, like the solver configs."""
+        them per (n-class, aspect, dtype, backend, device_kind).
+        Resolved once at declaration, like the solver configs."""
         from ..tune import tables
         return {b: tuple(sorted(set(
-            int(t) for t in tables.resolve(b.n, m=b.m,
-                                           dtype=b.dtype).batch_tiers)))
+            int(t) for t in tables.resolve(
+                b.n, m=b.m, dtype=b.dtype,
+                k=(b.k if b.kind == "topk" else None)).batch_tiers)))
                 for b in self.buckets}
 
     @staticmethod

@@ -418,7 +418,8 @@ class Fleet:
             compute_u=False, compute_v=False, degraded=False,
             deadline=now + svc.config.lane_probe_timeout_s,
             deadline_s=svc.config.lane_probe_timeout_s, submitted=now,
-            cancel=ticket._cancel, ticket=ticket, probe=True)
+            cancel=ticket._cancel, ticket=ticket, probe=True,
+            top_k=(b.k if b.kind == "topk" else None), rank_mode=b.kind)
         # Straight onto the lane's queue, bypassing admission: routing
         # excludes quarantined lanes, and THIS lane is the whole point.
         if lane.queue.requeue(req):

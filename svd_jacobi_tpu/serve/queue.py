@@ -78,6 +78,13 @@ class Request:
     # Fleet-internal lane-recovery probe (serve.fleet): pinned to its
     # quarantined lane — never stolen, never rescued onto another lane.
     probe: bool = False
+    # Truncated top-k request (`submit(..., top_k=k)`): the requested
+    # rank; None = full decomposition. The BUCKET's rank class fixes the
+    # solve's static sketch width — top_k only slices the result.
+    top_k: Optional[int] = None
+    # Workload family of the routed bucket ("full" | "tall" | "topk"),
+    # recorded per-request in the serve manifest (`rank_mode`).
+    rank_mode: str = "full"
 
 
 class AdmissionQueue:

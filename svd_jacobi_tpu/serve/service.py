@@ -415,7 +415,9 @@ class SVDService:
                 res = self.submit(jnp.zeros((b.m, b.n), jnp.dtype(b.dtype)),
                                   compute_u=cu, compute_v=cv,
                                   deadline_s=float("inf"),
-                                  request_id=rid).result(timeout)
+                                  request_id=rid,
+                                  top_k=(b.k if b.kind == "topk"
+                                         else None)).result(timeout)
                 if (res.status is not SolveStatus.OK or res.degraded
                         or res.path != "base"):
                     # A degraded or ladder-routed warmup solved SOMETHING,
@@ -436,19 +438,10 @@ class SVDService:
         # or rescue onto a sibling lane is not a compile stall in the
         # middle of a failover.
         if self.fleet.size > 1:
-            from ..solver import SweepStepper
             for lane in self.fleet.lanes:
                 for b in self.buckets:
                     for cu, cv in variants:
-                        a = self._place(
-                            jnp.zeros((b.m, b.n), jnp.dtype(b.dtype)),
-                            lane)
-                        st = SweepStepper(a, compute_u=cu, compute_v=cv,
-                                          config=self._solver_for(b))
-                        state = self._place(st.init(), lane)
-                        while st.should_continue(state):
-                            state = st.step(state)
-                        res = st.finish(state)
+                        res = self._direct_zero_solve(lane, b, cu, cv)
                         if res.status_enum() is not SolveStatus.OK:
                             raise RuntimeError(
                                 f"fleet warmup (lane {lane.index}, "
@@ -465,8 +458,6 @@ class SVDService:
         # LANE (each lane runs its own per-device executables).
         if self.config.max_batch > 1:
             import numpy as _np
-
-            from ..solver import BatchedSweepStepper
             for lane in self.fleet.lanes:
                 for b in self.buckets:
                     tiers = self._tiers_for(b)
@@ -475,16 +466,8 @@ class SVDService:
                                         for c in range(2, cap + 1)})
                     for cu, cv in variants:
                         for tier in reachable:
-                            a = self._place(
-                                jnp.zeros((tier, b.m, b.n),
-                                          jnp.dtype(b.dtype)), lane)
-                            st = BatchedSweepStepper(
-                                a, compute_u=cu, compute_v=cv,
-                                config=self._solver_for(b))
-                            state = self._place(st.init(), lane)
-                            while st.should_continue(state):
-                                state = st.step(state)
-                            res = st.finish(state)
+                            res = self._direct_zero_solve(lane, b, cu, cv,
+                                                          batch=tier)
                             codes = [int(c)
                                      for c in _np.asarray(res.status)]
                             if any(c != int(SolveStatus.OK)
@@ -559,7 +542,8 @@ class SVDService:
 
     def submit(self, a, *, compute_u: bool = True, compute_v: bool = True,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> Ticket:
+               request_id: Optional[str] = None,
+               top_k: Optional[int] = None) -> Ticket:
         """Admit one request: returns a `Ticket` or raises
         `AdmissionError` (reason: SHUTDOWN | NO_BUCKET | BROWNOUT_SHED |
         QUEUE_FULL | DEADLINE_BUDGET). ``deadline_s`` is relative to now;
@@ -567,7 +551,14 @@ class SVDService:
         inherits ``default_deadline_s``; an explicit ``float("inf")``
         means NO deadline even when a default is configured (exempt from
         the deadline budget — `warmup` uses this so a compile can never
-        expire the deadline that exists to front-load it)."""
+        expire the deadline that exists to front-load it).
+
+        ``top_k`` requests a TRUNCATED decomposition: only the top-k
+        factors come back (`ServeResult.u` (m, k) / ``s`` (k,) / ``v``
+        (n, k)), solved through the randomized range-finder lane of a
+        "topk" bucket whose rank class covers k (`buckets` module
+        docstring; no declared topk bucket -> NO_BUCKET). Clamped to
+        min(m, n). The accuracy contract is `solver.svd_topk`'s."""
         import math
 
         import jax
@@ -594,6 +585,13 @@ class SVDService:
             eff_dtype = jnp.dtype(a.dtype)
         if a.ndim != 2:
             raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+        if top_k is not None:
+            top_k = int(top_k)
+            if top_k < 1:
+                raise ValueError(f"top_k must be >= 1, got {top_k}")
+            # A rank beyond min(m, n) adds only exact-zero sigmas —
+            # clamp, so clients need not know the orientation rules.
+            top_k = min(top_k, int(min(a.shape)))
         rid = request_id or f"r{next(self._seq):05d}"
         orig_shape = tuple(int(d) for d in a.shape)
         transposed = a.shape[0] < a.shape[1]
@@ -626,12 +624,14 @@ class SVDService:
                     f"representable in this runtime (jnp.asarray produces "
                     f"{eff_dtype}; jax_enable_x64?) — refusing to "
                     f"silently downcast")
-            bucket = self.buckets.route(m, n, dtype)
+            bucket = self.buckets.route(m, n, dtype, top_k=top_k)
             if bucket is None:
+                what = (f"shape {orig_shape} dtype {dtype}"
+                        + (f" top_k={top_k}" if top_k is not None else ""))
                 raise AdmissionError(
                     AdmissionReason.NO_BUCKET,
-                    f"shape {orig_shape} dtype {dtype} fits no declared "
-                    f"bucket {[b.name for b in self.buckets]}")
+                    f"{what} fits no declared bucket "
+                    f"{[b.name for b in self.buckets]}")
             finite = (host_finite if host_finite is not None
                       else bool(jnp.isfinite(a).all()))
             if not finite:
@@ -661,7 +661,8 @@ class SVDService:
                 deadline=(None if deadline_s is None
                           else now + float(deadline_s)),
                 deadline_s=deadline_s, submitted=now,
-                cancel=ticket._cancel, ticket=ticket)
+                cancel=ticket._cancel, ticket=ticket,
+                top_k=top_k, rank_mode=bucket.kind)
             # Bucket-affinity routing: the bucket's home lane, or the
             # next ACTIVE one (lane 0 always, when lanes == 1). Raises
             # NO_LANE when the whole fleet is quarantined.
@@ -684,7 +685,9 @@ class SVDService:
                          status=f"REJECTED_{e.reason.name}", path="rejected",
                          breaker=self.breaker.state().value,
                          brownout=brown.name, degraded=False,
-                         deadline_s=deadline_s, error=e.detail)
+                         deadline_s=deadline_s, error=e.detail,
+                         rank_mode="topk" if top_k is not None else "full",
+                         k=top_k)
             raise
         self._bump("submitted")
         return ticket
@@ -1069,11 +1072,14 @@ class SVDService:
         if stall is not None:
             self._stall(live[0], stall, lane)
         slow = chaos.consume_slow()
-        st = BatchedSweepStepper(a, compute_u=cu, compute_v=cv,
-                                 config=self._solver_for(bucket))
-        st.set_control(deadline=deadline, should_cancel=should_cancel)
+        scfg = self._solver_for(bucket)
+        ccu, ccv = self._core_flags(bucket, cu, cv)
         lane.in_step = True     # device/compile stalls are legitimate here
         try:
+            core_in, lift = self._pre_core(bucket, a, scfg, batched=True)
+            st = BatchedSweepStepper(core_in, compute_u=ccu, compute_v=ccv,
+                                     config=scfg)
+            st.set_control(deadline=deadline, should_cancel=should_cancel)
             # Pin the whole init state (see _solve_base).
             state = self._place(st.init(), lane)
             while st.should_continue(state):
@@ -1081,7 +1087,8 @@ class SVDService:
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
-            return st.finish(state)
+            return self._post_core(bucket, lift, st.finish(state),
+                                   cu, cv, batched=True)
         finally:
             lane.in_step = False
             lane.beat()
@@ -1089,8 +1096,12 @@ class SVDService:
     def _slice_member(self, req: Request, r, j: int, cu: bool, cv: bool):
         """Member ``j``'s original-shape factors out of a batched result
         (slice the bucket padding, undo the tall orientation, drop
-        factors the member did not ask for or was degraded out of)."""
+        factors the member did not ask for or was degraded out of).
+        A top-k member additionally truncates to ITS OWN requested rank
+        (the batched solve ran at the bucket's rank class)."""
         k = min(req.m, req.n)
+        if req.top_k is not None:
+            k = min(k, req.top_k)
         want_u = req.compute_u and not req.degraded
         want_v = req.compute_v and not req.degraded
         u = (r.u[j][:req.m, :k]
@@ -1114,10 +1125,103 @@ class SVDService:
         import jax
         return jax.device_put(a, lane.device)
 
+    # -- bucket-family staging (full | tall | topk) -------------------------
+
+    @staticmethod
+    def _core_flags(bucket, cu: bool, cv: bool):
+        """Compute flags for the CORE solve of a bucket family: the
+        top-k lane solves B^T, whose left factor is A's RIGHT one and
+        vice versa, so the flags swap."""
+        return (cv, cu) if bucket.kind == "topk" else (cu, cv)
+
+    def _pre_core(self, bucket, a, scfg, *, batched: bool):
+        """Bucket-family pre-stage on the PADDED working set: identity
+        for the full family; blocked TSQR for the tall family (the core
+        then solves the n x n triangle R only); randomized sketch +
+        projection for the top-k family (the core solves the (n, l)
+        B^T, l = bucket.k + oversample — BUCKET-static, so the jit key
+        is the bucket, never the request's k). Returns
+        ``(core_input, lift)`` with ``lift`` None or the context
+        `_post_core` needs (range basis + the stage's nonfinite flag).
+        All sketch knobs come from the bucket's declaration-time
+        resolved config ``scfg``."""
+        from .. import solver
+        if bucket.kind == "tall":
+            fn = (solver._tsqr_batched_jit if batched
+                  else solver._tsqr_jit)
+            q, r, nf = fn(a, chunk=scfg.tsqr_chunk)
+            return r, {"kind": "tall", "q": q, "nf": nf}
+        if bucket.kind == "topk":
+            l = min(bucket.k + int(scfg.oversample), bucket.n)
+            fn = (solver._sketch_project_batched_jit if batched
+                  else solver._sketch_project_jit)
+            q, bt, nf = fn(a, l=l, power_iters=int(scfg.power_iters),
+                           chunk=scfg.tsqr_chunk, seed=0)
+            return bt, {"kind": "topk", "q": q, "nf": nf}
+        return a, None
+
+    def _post_core(self, bucket, lift, r, cu: bool, cv: bool, *,
+                   batched: bool = False):
+        """Lift a core result back through the range basis and fold the
+        pre-stage health flag into the status word (a poisoned
+        sketch/TSQR reads NONFINITE whatever the small solve decoded).
+        Top-k results are truncated to the BUCKET's rank class here; the
+        request's own k slices further in `_slice`/`_slice_member`. One
+        body for both dispatch shapes: ``batched`` selects the vmapped
+        lift, and the Ellipsis slices apply to (l,)/(B, l) factors
+        alike."""
+        from .. import solver
+        if lift is None:
+            return r
+        lift_fn = (solver._lift_q_batched_jit if batched
+                   else solver._lift_q_jit)
+        status = solver._combine_sketch_status(lift["nf"], r.status)
+        if lift["kind"] == "tall":
+            u = (lift_fn(lift["q"], r.u)
+                 if cu and r.u is not None else None)
+            return r._replace(u=u, status=status)
+        # topk: the core solved B^T = W S Z^T — its U (W) is A's right
+        # factor, its V (Z) lifts to A's left one through Q.
+        kb = bucket.k
+        u = (lift_fn(lift["q"], r.v[..., :kb])
+             if cu and r.v is not None else None)
+        v = r.u[..., :kb] if cv and r.u is not None else None
+        from ..solver import SVDResult
+        return SVDResult(u=u, s=r.s[..., :kb], v=v, sweeps=r.sweeps,
+                         off_rel=r.off_rel, status=status)
+
+    def _direct_zero_solve(self, lane: Lane, bucket, cu: bool, cv: bool,
+                           batch: Optional[int] = None):
+        """One zeros solve of a bucket through the full staging +
+        stepper path, pinned to ``lane`` — warmup's direct pre-compile
+        lane (a deterministic dispatch that cannot race the admission
+        queue or the batching window). Zeros deflate in one sweep, so
+        the cost is the compiles."""
+        import jax.numpy as jnp
+
+        from ..solver import BatchedSweepStepper, SweepStepper
+        scfg = self._solver_for(bucket)
+        shape = ((bucket.m, bucket.n) if batch is None
+                 else (batch, bucket.m, bucket.n))
+        a = self._place(jnp.zeros(shape, jnp.dtype(bucket.dtype)), lane)
+        core_in, lift = self._pre_core(bucket, a, scfg,
+                                       batched=batch is not None)
+        ccu, ccv = self._core_flags(bucket, cu, cv)
+        cls = SweepStepper if batch is None else BatchedSweepStepper
+        st = cls(core_in, compute_u=ccu, compute_v=ccv, config=scfg)
+        state = self._place(st.init(), lane)
+        while st.should_continue(state):
+            state = st.step(state)
+        r = st.finish(state)
+        return self._post_core(bucket, lift, r, cu, cv,
+                               batched=batch is not None)
+
     def _solve_base(self, lane: Lane, req: Request, cu: bool, cv: bool):
-        """The normal path: pad to the bucket, run the host-stepped solver
-        under cooperative control, one control check (and one lane
-        heartbeat) per sweep."""
+        """The normal path: pad to the bucket, run the bucket family's
+        pre-stage (`_pre_core`: TSQR for tall, sketch+project for topk,
+        identity for full), then the host-stepped solver under
+        cooperative control — one control check (and one lane heartbeat)
+        per sweep — and the family's lift (`_post_core`)."""
         import jax.numpy as jnp
 
         from ..resilience import chaos
@@ -1125,18 +1229,25 @@ class SVDService:
         a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
         if chaos.consume_poison(lane.index):
             # NaN-poison the working set so the solve surfaces NONFINITE
-            # through the production health word (chaos.poison_lane).
+            # through the production health word (chaos.poison_lane) —
+            # on the tall/topk families through the sketch-stage flag.
             a_pad = a_pad.at[0, 0].set(jnp.nan)
         stall = chaos.consume_stuck()
         if stall is not None:
             self._stall(req, stall, lane)
         slow = chaos.consume_slow()
-        st = SweepStepper(a_pad, compute_u=cu, compute_v=cv,
-                          config=self._solver_for(req.bucket))
-        st.set_control(deadline=req.deadline,
-                       should_cancel=req.cancel.is_set)
+        scfg = self._solver_for(req.bucket)
+        ccu, ccv = self._core_flags(req.bucket, cu, cv)
         lane.in_step = True     # device/compile stalls are legitimate here
         try:
+            # The pre-stage runs under in_step too: its first dispatch
+            # per (bucket, lane) is a legitimate compile stall.
+            core_in, lift = self._pre_core(req.bucket, a_pad, scfg,
+                                           batched=False)
+            st = SweepStepper(core_in, compute_u=ccu, compute_v=ccv,
+                              config=scfg)
+            st.set_control(deadline=req.deadline,
+                           should_cancel=req.cancel.is_set)
             # The whole init state pinned, not just the input: init
             # creates fresh accumulators (uncommitted, default device),
             # and a committed/uncommitted mix would give the first sweep
@@ -1148,7 +1259,8 @@ class SVDService:
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
-            return st.finish(state)
+            return self._post_core(req.bucket, lift, st.finish(state),
+                                   cu, cv)
         finally:
             lane.in_step = False
             lane.beat()
@@ -1158,7 +1270,10 @@ class SVDService:
         The ladder runs the FUSED entry points, so the deadline cannot be
         checked mid-solve — acceptable for the recovery path (bounded by
         the ladder's own attempt cap), and the manifest records it as
-        path="ladder". ``ladder_watchdog_s`` arms the wall-clock overrun
+        path="ladder". Tall/top-k bucket requests run the FULL padded
+        solve here (the ladder is a correctness-first recovery path; a
+        top-k request's truncation happens in `_slice`, which is exact —
+        more accurate than the sketch, just slower). ``ladder_watchdog_s`` arms the wall-clock overrun
         watchdog: it cannot abort the fused solve, but it records a
         `ladder_overrun` fleet event and flags THIS lane unhealthy, so
         the supervisor evicts it and rescues its queued requests instead
@@ -1206,8 +1321,12 @@ class SVDService:
     def _slice(self, req: Request, r, cu: bool, cv: bool):
         """Recover the original-shape factors from the bucket-padded solve
         (exact — see buckets module docstring) and undo the tall
-        orientation."""
+        orientation. A top-k request truncates to its requested rank
+        (the solve ran at the bucket's rank class — or at full rank on
+        the ladder recovery path, where truncation is equally exact)."""
         k = min(req.m, req.n)
+        if req.top_k is not None:
+            k = min(k, req.top_k)
         u = r.u[:req.m, :k] if (cu and r.u is not None) else None
         s = r.s[:k]
         v = r.v[:req.n, :k] if (cv and r.v is not None) else None
@@ -1255,7 +1374,9 @@ class SVDService:
             return False
         self._bump("served", f"status:{status_name}",
                    *(["path:ladder"] if path == "ladder" else []),
-                   *(["degraded"] if req.degraded else []))
+                   *(["degraded"] if req.degraded else []),
+                   *([f"rank_mode:{req.rank_mode}"]
+                     if req.rank_mode != "full" else []))
         self._record(
             request_id=req.id, orig_shape=req.orig_shape,
             dtype=req.bucket.dtype, bucket=req.bucket.name,
@@ -1265,7 +1386,8 @@ class SVDService:
             degraded=req.degraded, deadline_s=req.deadline_s,
             sweeps=result.sweeps, error=result.error,
             batch_id=batch_id, batch_size=batch_size,
-            batch_tier=batch_tier, lane=lane)
+            batch_tier=batch_tier, lane=lane,
+            rank_mode=req.rank_mode, k=req.top_k)
         return True
 
     def _finalize_rescue(self, req: Request, status_name: str,
@@ -1305,7 +1427,9 @@ class SVDService:
                 batch_id: Optional[str] = None,
                 batch_size: Optional[int] = None,
                 batch_tier: Optional[int] = None,
-                lane: Optional[int] = None) -> None:
+                lane: Optional[int] = None,
+                rank_mode: str = "full",
+                k: Optional[int] = None) -> None:
         from .. import obs
         record = obs.manifest.build_serve(
             request_id=request_id, m=orig_shape[0], n=orig_shape[1],
@@ -1317,7 +1441,8 @@ class SVDService:
             deadline_s=(None if deadline_s is None else float(deadline_s)),
             sweeps=sweeps, error=error, batch_id=batch_id,
             batch_size=batch_size, batch_tier=batch_tier,
-            lane=(None if lane is None else int(lane)))
+            lane=(None if lane is None else int(lane)),
+            rank_mode=str(rank_mode), k=(None if k is None else int(k)))
         self._store(record)
 
     def _record_fleet(self, *, event: str, lane: Optional[int] = None,

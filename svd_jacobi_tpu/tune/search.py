@@ -56,7 +56,10 @@ class Point:
 
 @dataclasses.dataclass
 class ShapeResult:
-    """Search outcome for one benchmark shape."""
+    """Search outcome for one benchmark shape. The ``sketch_*`` fields
+    carry the top-k sketch-axis sweep (oversample/power_iters measured
+    against a `solver.svd_topk` objective at rank ``sketch_k``) when the
+    shape was eligible; None otherwise."""
 
     m: int
     n: int
@@ -66,6 +69,10 @@ class ShapeResult:
     points: List[Point]
     winner: Dict[str, object]
     tiers: Optional[List[dict]] = None
+    sketch_k: Optional[int] = None
+    sketch_baseline: Optional[Point] = None
+    sketch_points: List[Point] = dataclasses.field(default_factory=list)
+    sketch_winner: Optional[Dict[str, object]] = None
 
 
 def _log(msg: str) -> None:
@@ -90,17 +97,26 @@ def _build_config(base, knobs: Dict[str, object]):
 
 
 def time_solve(a, config, *, reps: int, budget_s: float,
-               compute_uv: bool = True) -> Point:
+               compute_uv: bool = True,
+               top_k: Optional[int] = None) -> Point:
     """Best-of-``reps`` wall time of one config on one input, warm-up
-    discarded, bounded by ``budget_s`` of TIMED work. Failures (a config
-    invalid for the shape, OOM, ...) record as ok=False — one broken
-    candidate must not void the shape's whole search."""
+    discarded, bounded by ``budget_s`` of TIMED work. ``top_k`` switches
+    the objective to `solver.svd_topk` (the sketch-axis sweep's
+    objective). Failures (a config invalid for the shape, OOM, ...)
+    record as ok=False — one broken candidate must not void the shape's
+    whole search."""
     from .. import solver
     from ..utils._exec import force
     point = Point(knobs={})
     try:
-        solve = lambda: solver.svd(a, compute_u=compute_uv,
-                                   compute_v=compute_uv, config=config)
+        if top_k is not None:
+            solve = lambda: solver.svd_topk(a, top_k,
+                                            compute_u=compute_uv,
+                                            compute_v=compute_uv,
+                                            config=config)
+        else:
+            solve = lambda: solver.svd(a, compute_u=compute_uv,
+                                       compute_v=compute_uv, config=config)
         r = solve()
         force((r.s, r.status))          # warm-up: compile + caches, DISCARDED
         if r.status_enum().name not in ("OK", "STAGNATED"):
@@ -289,8 +305,90 @@ def search_shape(m: int, n: int, dtype: str, *, reps: int, budget_s: float,
                 incumbent_knobs = cand
                 incumbent_time = point.time_s
                 _log(f"tune:   -> new incumbent ({knob}={value!r})")
-    return ShapeResult(m=m, n=n, dtype=dt.name, key=key, baseline=baseline,
-                       points=points, winner=incumbent_knobs)
+    res = ShapeResult(m=m, n=n, dtype=dt.name, key=key, baseline=baseline,
+                      points=points, winner=incumbent_knobs)
+    if not smoke and min(m, n) >= 256:
+        _search_sketch_axes(res, a, base, reps=reps, budget_s=budget_s,
+                            min_gain=min_gain)
+    return res
+
+
+# The sketch knob axes of the top-k lane (solver.svd_topk), swept with
+# the SAME coordinate-descent discipline and >= min_gain win threshold
+# as the solver axes — but against a TRUNCATED objective at rank n/8
+# (the workload class the lane exists for). Values bracket the Halko
+# defaults; the baseline is today's table resolution for the rank class.
+SKETCH_AXES = (("oversample", (4, 8, 16)), ("power_iters", (0, 1, 2)))
+
+
+def _sketch_config(base, knobs: Dict[str, object]):
+    import dataclasses as _dc
+    ups = {k: knobs[k] for k in ("oversample", "power_iters", "tsqr_chunk")
+           if k in knobs}
+    return _dc.replace(base, **ups)
+
+
+def _search_sketch_axes(res: ShapeResult, a, base, *, reps: int,
+                        budget_s: float, min_gain: float) -> None:
+    """Sweep the sketch axes for one eligible shape, writing the
+    ``sketch_*`` fields of ``res``. Accuracy guard: a candidate only
+    displaces the incumbent when its top-k sigmas stay within 2x of the
+    baseline's error against the full-solve oracle — a sketch knob that
+    buys speed by dropping accuracy is not a win, it is a different
+    contract."""
+    import numpy as np
+
+    from .. import solver
+    m, n = res.m, res.n
+    k = max(8, n // 8)
+    res.sketch_k = k
+    r0 = tables.resolve(n, m=m, dtype=res.dtype, k=k)
+    base_knobs = {"oversample": r0.oversample, "power_iters": r0.power_iters}
+    _log(f"tune: sketch axes (top-k objective, k={k}) baseline "
+         f"{base_knobs}")
+    s_full = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)[:k]
+
+    def sigma_err(cfg) -> float:
+        r = solver.svd_topk(a, k, config=cfg)
+        return float(np.max(np.abs(np.asarray(r.s, np.float64) - s_full)
+                            / np.maximum(s_full, 1e-300)))
+
+    baseline = time_solve(a, _sketch_config(base, base_knobs), reps=reps,
+                          budget_s=budget_s, top_k=k)
+    baseline.knobs = dict(base_knobs)
+    res.sketch_baseline = baseline
+    res.sketch_winner = dict(base_knobs)
+    if not baseline.ok:
+        _log(f"tune: sketch baseline failed ({baseline.note}); skipped")
+        return
+    base_err = sigma_err(_sketch_config(base, base_knobs))
+    incumbent = dict(base_knobs)
+    incumbent_time = baseline.time_s
+    for knob, values in SKETCH_AXES:
+        for value in values:
+            if value == incumbent.get(knob):
+                continue
+            cand = dict(incumbent)
+            cand[knob] = value
+            cfg = _sketch_config(base, cand)
+            point = time_solve(a, cfg, reps=reps, budget_s=budget_s,
+                               top_k=k)
+            point.knobs = dict(cand)
+            res.sketch_points.append(point)
+            shown = f"{point.time_s:.4f} s" if point.ok else point.note
+            _log(f"tune:   sketch {knob}={value!r}: {shown}")
+            if (point.ok and point.time_s is not None
+                    and point.time_s < incumbent_time * (1.0 - min_gain)):
+                err = sigma_err(cfg)
+                if err > 2.0 * max(base_err, 1e-7):
+                    point.note = (f"faster but sigma err {err:.2e} vs "
+                                  f"baseline {base_err:.2e} — rejected")
+                    _log(f"tune:   -> rejected on accuracy ({point.note})")
+                    continue
+                incumbent = cand
+                incumbent_time = point.time_s
+                _log(f"tune:   -> new sketch incumbent ({knob}={value!r})")
+    res.sketch_winner = incumbent
 
 
 def _winner_row(res: ShapeResult) -> dict:
@@ -399,6 +497,25 @@ def run(*, shapes: Sequence[Tuple[int, int, str]], out_path,
                                       f"{k}={v!r} lost to "
                                       f"{prior['knobs'][k]!r}")
         prior["evidence"] += f" | {row['evidence']}"
+    # Sketch-axis winners: one EXTRA row per shape whose top-k sweep
+    # displaced the baseline, matched on the measured rank class (the
+    # k_class axis) so it applies only to truncated solves of that
+    # class. A baseline-kept sweep writes no row — the shipped k-class
+    # verdicts stand.
+    for res in results:
+        if (res.sketch_winner is None or res.sketch_baseline is None
+                or not res.sketch_baseline.ok
+                or res.sketch_winner == res.sketch_baseline.knobs):
+            continue
+        rows.append({
+            "match": {**res.key, "k_class": tables.k_class(res.sketch_k)},
+            "knobs": {kn: v for kn, v in res.sketch_winner.items()
+                      if kn in tables.SKETCH_KNOBS},
+            "evidence": (f"sketch axes measured on {res.m}x{res.n} "
+                         f"{res.dtype} top-k k={res.sketch_k} (baseline "
+                         f"{res.sketch_baseline.knobs} "
+                         f"{res.sketch_baseline.time_s:.4f} s)"),
+        })
     # The generic fallback row closes every table (tables without one
     # would leave unmatched problems knob-less).
     rows.append({"match": {}, "knobs": dict(tables.GENERIC_KNOBS),
@@ -413,13 +530,21 @@ def run(*, shapes: Sequence[Tuple[int, int, str]], out_path,
 
     records = []
     for res in results:
+        sketch = None
+        if res.sketch_baseline is not None:
+            sketch = {
+                "k": res.sketch_k,
+                "baseline": res.sketch_baseline.as_record(),
+                "grid": [p.as_record() for p in res.sketch_points],
+                "winner": dict(res.sketch_winner or {}),
+            }
         rec = manifest.build_tune(
             m=res.m, n=res.n, dtype=res.dtype, key=res.key,
             baseline=res.baseline.as_record(),
             grid=[p.as_record() for p in res.points],
             winner=dict(res.winner),
             table_id=table.table_id, table_sha256=table.sha256,
-            tiers=res.tiers, smoke=bool(smoke))
+            tiers=res.tiers, smoke=bool(smoke), sketch=sketch)
         records.append(rec)
         if manifest_path and manifest_path != "off":
             manifest.append(manifest_path, rec)

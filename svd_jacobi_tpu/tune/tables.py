@@ -48,8 +48,15 @@ SCHEMA_VERSION = 1
 
 # The tunable knobs a table row may pin. Everything else in SVDConfig is
 # either semantic (tolerances, job options) or validated elsewhere.
+# oversample / power_iters / tsqr_chunk are the SKETCH knobs of the
+# top-k / tall lanes (solver.svd_topk / svd_tall / ops.sketch).
 KNOBS = ("block_size", "mixed_store", "pair_solver", "precondition",
-         "criterion", "batch_tiers")
+         "criterion", "batch_tiers", "oversample", "power_iters",
+         "tsqr_chunk")
+
+# The sketch-knob subset, used by the TUNE001 coverage rule: a declared
+# top-k serve bucket must get these from a MEASURED (non-generic) row.
+SKETCH_KNOBS = ("oversample", "power_iters", "tsqr_chunk")
 
 # Problem-size classes (columns n of the tall-oriented problem). The
 # boundaries are the measured crossover neighborhoods of PROFILE.md item
@@ -63,8 +70,14 @@ N_CLASSES = ("tiny", "small", "medium", "large")
 # between them.
 ASPECT_CLASSES = ("square", "tall")
 TALL_ASPECT_RATIO = 8
+# Rank classes of a top-k request (the k-class match axis): "none" is a
+# full/tall solve (no truncation — rows matching a real k-class never
+# apply to it), the rest bound the requested rank. Boundaries follow the
+# serve bucket granularity (a bucket's k is the class representative).
+K_CLASSES = ("none", "small", "medium", "large")
 
-_MATCH_KEYS = ("n_class", "aspect", "dtype", "backend", "device_kind")
+_MATCH_KEYS = ("n_class", "aspect", "dtype", "backend", "device_kind",
+               "k_class")
 _VALID_MIXED_STORE = ("f32", "bf16", "bf16g")
 _VALID_PAIR_SOLVER = ("pallas", "qr-svd", "gram-eigh", "hybrid")
 # "double" (dgejsv's second QR) is deliberately NOT a table value: it is
@@ -94,6 +107,17 @@ def aspect_class(m: Optional[int], n: int) -> str:
     if m is None:
         return "square"
     return "tall" if m >= TALL_ASPECT_RATIO * n else "square"
+
+
+def k_class(k: Optional[int]) -> str:
+    """Rank class of a top-k request; None/0 = "none" (full-rank solve)."""
+    if not k:
+        return "none"
+    if k <= 64:
+        return "small"
+    if k <= 256:
+        return "medium"
+    return "large"
 
 
 def normalize_device_kind(kind: str) -> str:
@@ -159,6 +183,12 @@ GENERIC_KNOBS: Dict[str, object] = {
     "precondition": "on",
     "criterion": "follow",
     "batch_tiers": (1, 4, 16),  # config.DEFAULT_BATCH_TIERS
+    # Sketch knobs of the top-k/tall lanes (Halko defaults): +8 columns
+    # of oversampling, one stabilized power iteration, heuristic TSQR
+    # chunk rows (None = ops.sketch.default_chunk).
+    "oversample": 8,
+    "power_iters": 1,
+    "tsqr_chunk": None,
 }
 
 
@@ -173,8 +203,10 @@ class Resolved(NamedTuple):
     ladder when the winning row declined to pin it). ``generic_only`` is
     True when NO non-generic row contributed any knob — the signal the
     TUNE001 analysis pass uses to prove the declared serve buckets are
-    covered by measured rows. ``source`` is "<table_id>:<row indices>"
-    for provenance."""
+    covered by measured rows; ``sketch_generic_only`` is the same signal
+    restricted to the sketch knobs (:data:`SKETCH_KNOBS`) — TUNE001's
+    extension for the top-k bucket family. ``source`` is
+    "<table_id>:<row indices>" for provenance."""
 
     block_size: int
     mixed_store: str
@@ -182,7 +214,11 @@ class Resolved(NamedTuple):
     precondition: str
     criterion: str
     batch_tiers: Tuple[int, ...]
+    oversample: int
+    power_iters: int
+    tsqr_chunk: Optional[int]
     generic_only: bool
+    sketch_generic_only: bool
     source: str
 
 
@@ -208,6 +244,9 @@ def _validate_row(row: dict, where: str, errors: List[str]) -> None:
     if "aspect" in match and match["aspect"] not in ASPECT_CLASSES:
         errors.append(f"{where}.match.aspect: {match['aspect']!r} not in "
                       f"{ASPECT_CLASSES}")
+    if "k_class" in match and match["k_class"] not in K_CLASSES:
+        errors.append(f"{where}.match.k_class: {match['k_class']!r} not in "
+                      f"{K_CLASSES}")
     for k in knobs:
         if k not in KNOBS:
             errors.append(f"{where}.knobs.{k}: unknown knob "
@@ -223,6 +262,20 @@ def _validate_row(row: dict, where: str, errors: List[str]) -> None:
         if name in knobs and knobs[name] not in valid:
             errors.append(f"{where}.knobs.{name}: {knobs[name]!r} not in "
                           f"{valid}")
+    if "oversample" in knobs and (
+            not isinstance(knobs["oversample"], int)
+            or knobs["oversample"] < 1):
+        errors.append(f"{where}.knobs.oversample: expected int >= 1, got "
+                      f"{knobs['oversample']!r}")
+    if "power_iters" in knobs and (
+            not isinstance(knobs["power_iters"], int)
+            or knobs["power_iters"] < 0):
+        errors.append(f"{where}.knobs.power_iters: expected int >= 0, got "
+                      f"{knobs['power_iters']!r}")
+    tc = knobs.get("tsqr_chunk", None)
+    if tc is not None and (not isinstance(tc, int) or tc < 1):
+        errors.append(f"{where}.knobs.tsqr_chunk: expected null or int >= 1, "
+                      f"got {tc!r}")
     tiers = knobs.get("batch_tiers")
     if tiers is not None and (
             not isinstance(tiers, (list, tuple)) or not tiers
@@ -323,9 +376,12 @@ class TuningTable:
 
     def resolve(self, n: int, m: Optional[int] = None,
                 dtype: str = "float32", backend: Optional[str] = None,
-                device_kind: Optional[str] = None) -> Resolved:
+                device_kind: Optional[str] = None,
+                k: Optional[int] = None) -> Resolved:
         """Resolve every tunable knob for one problem (see module
-        docstring for the layered row semantics)."""
+        docstring for the layered row semantics). ``k`` is the top-k
+        request rank (None = full/tall solve): it selects the k-class
+        match axis, so rows can pin sketch knobs per rank class."""
         import jax.numpy as jnp
         if backend is None or device_kind is None:
             rb, rk = _runtime_identity()
@@ -337,24 +393,29 @@ class TuningTable:
             "dtype": str(jnp.dtype(dtype).name),
             "backend": str(backend),
             "device_kind": normalize_device_kind(device_kind),
+            "k_class": k_class(None if k is None else int(k)),
         }
         knobs = dict(GENERIC_KNOBS)
         contributors: List[str] = []
         generic_only = True
+        sketch_generic_only = True
         unresolved = set(KNOBS)
         for i, row in self._matching_rows(key):
             row_knobs = row.get("knobs", {})
-            took = [k for k in list(unresolved) if k in row_knobs]
-            for k in took:
-                knobs[k] = row_knobs[k]
-                unresolved.discard(k)
+            took = [k_ for k_ in list(unresolved) if k_ in row_knobs]
+            for k_ in took:
+                knobs[k_] = row_knobs[k_]
+                unresolved.discard(k_)
             if took:
                 contributors.append(str(i))
                 if row.get("match"):
                     generic_only = False
+                    if any(k_ in SKETCH_KNOBS for k_ in took):
+                        sketch_generic_only = False
             if not unresolved:
                 break
         bs = knobs["block_size"]
+        tc = knobs["tsqr_chunk"]
         return Resolved(
             block_size=int(bs) if bs is not None
             else heuristic_block_size(int(n)),
@@ -363,7 +424,11 @@ class TuningTable:
             precondition=str(knobs["precondition"]),
             criterion=str(knobs["criterion"]),
             batch_tiers=tuple(int(t) for t in knobs["batch_tiers"]),
+            oversample=int(knobs["oversample"]),
+            power_iters=int(knobs["power_iters"]),
+            tsqr_chunk=None if tc is None else int(tc),
             generic_only=generic_only,
+            sketch_generic_only=sketch_generic_only,
             source=f"{self.table_id}:{','.join(contributors) or 'builtin'}",
         )
 
@@ -488,19 +553,22 @@ def active_table() -> TuningTable:
 def resolve(n: int, m: Optional[int] = None, dtype: str = "float32",
             backend: Optional[str] = None,
             device_kind: Optional[str] = None,
+            k: Optional[int] = None,
             table: Optional[TuningTable] = None) -> Resolved:
     """Module-level resolution through the active (or given) table —
     the single lookup every "auto" knob goes through. Deterministic:
     same arguments + same table content => same result, in any process
-    (proven by tests/test_tune.py's cross-process case)."""
+    (proven by tests/test_tune.py's cross-process case). ``k`` selects
+    the top-k rank class (None = full/tall solve)."""
     t = table if table is not None else active_table()
     return t.resolve(n, m=m, dtype=dtype, backend=backend,
-                     device_kind=device_kind)
+                     device_kind=device_kind, k=k)
 
 
 def resolve_config(config, m: int, n: int, dtype,
                    backend: Optional[str] = None,
-                   device_kind: Optional[str] = None):
+                   device_kind: Optional[str] = None,
+                   k: Optional[int] = None):
     """A concrete ``SVDConfig`` for one declared problem shape: every
     knob the caller left on "auto"/None is pinned to the table's choice
     (explicit user values always win). Used by the serving layer to
@@ -508,9 +576,12 @@ def resolve_config(config, m: int, n: int, dtype,
     config and never re-resolve per dispatch.
 
     Only shape-safe knobs are pinned: ``block_size`` (the value the
-    solver's own planner would resolve to — identical jit keys), and
+    solver's own planner would resolve to — identical jit keys),
     ``mixed_store`` (read only on the mixed Pallas path, valid
-    everywhere). ``pair_solver``/``precondition``/``criterion`` stay
+    everywhere), and the sketch knobs ``oversample``/``power_iters``/
+    ``tsqr_chunk`` (read only by the top-k/tall lanes; ``k`` selects
+    their rank class for top-k buckets).
+    ``pair_solver``/``precondition``/``criterion`` stay
     "auto": their resolution is capability-guarded per entry point
     (f64/tiny-n/compute_uv) and pinning them here would turn the
     solver's auto-routing into hard validation errors on the guarded
@@ -520,10 +591,19 @@ def resolve_config(config, m: int, n: int, dtype,
     if m < n:
         m, n = n, m   # tall orientation, as every solve entry enforces
     r = resolve(n, m=m, dtype=dtype, backend=backend,
-                device_kind=device_kind)
+                device_kind=device_kind, k=k)
     updates = {}
     if config.block_size is None:
         updates["block_size"] = int(r.block_size)
     if config.mixed_store == "auto":
         updates["mixed_store"] = r.mixed_store
+    # Sketch knobs (read only by the top-k/tall lanes, valid everywhere):
+    # pinned to what solve-time auto resolution would pick for this
+    # (shape, k-class) — identical static jit arguments either way.
+    if config.oversample is None:
+        updates["oversample"] = int(r.oversample)
+    if config.power_iters is None:
+        updates["power_iters"] = int(r.power_iters)
+    if config.tsqr_chunk is None and r.tsqr_chunk is not None:
+        updates["tsqr_chunk"] = int(r.tsqr_chunk)
     return _dc.replace(config, **updates) if updates else config

@@ -296,3 +296,23 @@ def test_mesh_precondition_sweep_parity():
     # bulk + polish otherwise).
     single = sj.svd(a, config=SVDConfig(mixed_bulk=False))
     assert abs(int(r_on.sweeps) - int(single.sweeps)) <= 2
+
+
+@pytest.mark.rank
+def test_mesh_tall_input_chunked_precondition():
+    """Tall (m >= 8n) mesh solve: the preconditioner routes through the
+    chunked TSQR (ops.sketch) under GSPMD, and the factors still match
+    the host oracle — the 'mesh solves of tall inputs work' half of the
+    rectangular-workloads lane. The collective budget of this entry is
+    pinned by analysis (config.COLLECTIVE_BUDGET['sharded_pallas_tall'])."""
+    rng = np.random.default_rng(41)
+    a = jnp.asarray(rng.standard_normal((768, 96)), jnp.float32)
+    mesh = sharded.make_mesh()
+    r = sharded.svd(a, mesh=mesh)
+    assert r.status_enum().name == "OK"
+    a64 = np.asarray(a, np.float64)
+    s_ref = np.linalg.svd(a64, compute_uv=False)
+    assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0] < 5e-6
+    recon = (np.asarray(r.u, np.float64) * np.asarray(r.s, np.float64)
+             @ np.asarray(r.v, np.float64).T)
+    assert np.linalg.norm(recon - a64) / np.linalg.norm(a64) < 5e-6

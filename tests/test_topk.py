@@ -1,0 +1,446 @@
+"""Truncated top-k + tall-skinny lane tests (ops/sketch.py, solver.svd_topk
+/ svd_tall, the serve bucket families, and their analysis contracts).
+
+Oracle discipline: singular VALUES compare against the full solve /
+numpy's f64 SVD; singular VECTORS compare through the per-vector
+subspace residual ``||A v_i - s_i u_i||`` (vectors are unique only up to
+sign/rotation within sigma ties, so elementwise comparison would be
+flaky by construction). Tolerances follow the documented accuracy
+contract (README "Workloads"): gap spectra tight, smooth geometric decay
+at the Halko tail class, flat spectra exact in value.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig, solver
+from svd_jacobi_tpu.ops import sketch
+from svd_jacobi_tpu.utils import matgen
+
+
+def _with_spectrum(m, n, sigmas, seed=0):
+    """(m, n) f32 matrix with the given singular values (f64 build)."""
+    rng = np.random.default_rng(seed)
+    u, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return jnp.asarray((u * np.asarray(sigmas)) @ v.T, jnp.float32)
+
+
+def _subspace_residual(a, r):
+    """max_i ||A v_i - s_i u_i|| / s_i — per-vector accuracy of the
+    truncated factors, invariant under sign flips and tie rotations."""
+    an = np.asarray(a, np.float64)
+    un = np.asarray(r.u, np.float64)
+    sn = np.asarray(r.s, np.float64)
+    vn = np.asarray(r.v, np.float64)
+    res = np.linalg.norm(an @ vn - un * sn[None, :], axis=0)
+    return float(np.max(res / np.maximum(sn, 1e-300)))
+
+
+class TestTsqr:
+    def test_chunked_equals_factorization(self):
+        a = matgen.random_dense(300, 24, seed=1, dtype=jnp.float32)
+        q, r = sketch.tsqr(a, chunk=64)
+        qn, rn = np.asarray(q, np.float64), np.asarray(r, np.float64)
+        an = np.asarray(a, np.float64)
+        assert q.shape == (300, 24) and r.shape == (24, 24)
+        np.testing.assert_allclose(qn @ rn, an, atol=2e-6)
+        np.testing.assert_allclose(qn.T @ qn, np.eye(24), atol=2e-6)
+        # R is triangular up to the sign convention.
+        assert np.max(np.abs(np.tril(rn, -1))) < 2e-6
+
+    def test_base_case_matches_dense_qr(self):
+        # Short inputs take the dense reduced QR directly.
+        a = matgen.random_dense(48, 32, seed=2, dtype=jnp.float32)
+        q, r = sketch.tsqr(a)
+        qd, rd = jnp.linalg.qr(a)
+        np.testing.assert_allclose(np.asarray(q), np.asarray(qd),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r), np.asarray(rd),
+                                   atol=1e-6)
+
+    def test_non_chunk_multiple_rows(self):
+        # 300 rows over 64-row chunks: the zero-padded tail chunk.
+        a = matgen.random_dense(300, 16, seed=3, dtype=jnp.float32)
+        q, r = sketch.tsqr(a, chunk=128)
+        qn = np.asarray(q, np.float64)
+        np.testing.assert_allclose(qn.T @ qn, np.eye(16), atol=2e-6)
+        np.testing.assert_allclose(qn @ np.asarray(r, np.float64),
+                                   np.asarray(a, np.float64), atol=2e-6)
+
+    def test_tsqr_jit_nonfinite_flag(self):
+        a = matgen.random_dense(256, 16, seed=4, dtype=jnp.float32)
+        _, _, nf = solver._tsqr_jit(a, chunk=64)
+        assert not bool(nf)
+        _, _, nf = solver._tsqr_jit(a.at[5, 3].set(jnp.nan), chunk=64)
+        assert bool(nf)
+
+    def test_batched_tsqr_matches_members(self):
+        stack = jnp.stack([matgen.random_dense(256, 16, seed=s,
+                                               dtype=jnp.float32)
+                           for s in (5, 6, 7)])
+        qb, rb, nfb = solver._tsqr_batched_jit(stack, chunk=64)
+        for j in range(3):
+            q1, r1, nf1 = solver._tsqr_jit(stack[j], chunk=64)
+            np.testing.assert_allclose(np.asarray(qb[j]), np.asarray(q1),
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(rb[j]), np.asarray(r1),
+                                       atol=1e-6)
+        assert not bool(np.any(np.asarray(nfb)))
+
+    def test_precondition_qr_tall_routes_chunked_and_agrees(self):
+        """The Drmac preconditioner's tall path (m >= 8n -> chunked TSQR)
+        produces a valid factorization with the same bookkeeping."""
+        a = matgen.random_dense(512, 32, seed=8, dtype=jnp.float32)
+        q1, r, order, work = solver._precondition_qr(a)
+        an = np.asarray(a, np.float64)
+        qn, rn = np.asarray(q1, np.float64), np.asarray(r, np.float64)
+        on = np.asarray(order)
+        np.testing.assert_allclose(qn @ rn, an[:, on], atol=3e-6)
+        np.testing.assert_allclose(np.asarray(work), np.asarray(r).T,
+                                   atol=1e-6)
+
+
+class TestSvdTopk:
+    def test_gap_spectrum_matches_full_solve(self):
+        """The PCA/embedding workload class: rank-k signal over a noise
+        floor — the randomized lane recovers values AND vectors at the
+        f32 class."""
+        m, n, k = 192, 160, 12
+        sig = np.concatenate([np.geomspace(1.0, 0.2, k),
+                              np.full(n - k, 1e-4)])
+        a = _with_spectrum(m, n, sig, seed=10)
+        r = solver.svd_topk(a, k)
+        assert r.status_enum().name == "OK"
+        full = sj.svd(a)
+        np.testing.assert_allclose(np.asarray(r.s),
+                                   np.asarray(full.s)[:k],
+                                   rtol=1e-4, atol=1e-5)
+        assert _subspace_residual(a, r) < 1e-3
+        assert r.u.shape == (m, k) and r.v.shape == (n, k)
+
+    def test_decaying_spectrum_tolerance_class(self):
+        """Smooth geometric decay (no gap): the documented Halko-tail
+        class — q power iterations tighten the relative error
+        geometrically; q=2 holds 2% on this spectrum."""
+        m, n, k = 192, 160, 16
+        a = _with_spectrum(m, n, np.geomspace(1.0, 1e-5, n), seed=11)
+        s_ref = np.linalg.svd(np.asarray(a, np.float64),
+                              compute_uv=False)[:k]
+        r = solver.svd_topk(a, k, config=SVDConfig(power_iters=2))
+        err = np.max(np.abs(np.asarray(r.s, np.float64) - s_ref) / s_ref)
+        assert err < 2e-2, err
+
+    def test_flat_spectrum_values_exact(self):
+        """All sigmas equal: any sketch subspace carries the exact
+        values (vectors are arbitrary within the tie — not compared)."""
+        m, n, k = 192, 160, 16
+        a = _with_spectrum(m, n, np.ones(n), seed=12)
+        r = solver.svd_topk(a, k)
+        np.testing.assert_allclose(np.asarray(r.s), 1.0, atol=1e-5)
+
+    def test_wide_input_transposes(self):
+        tall = _with_spectrum(192, 160, np.concatenate(
+            [np.geomspace(1.0, 0.3, 8), np.full(152, 1e-4)]), seed=13)
+        a = tall.T                               # wide (160, 192)
+        r = solver.svd_topk(a, 8)
+        assert r.u.shape == (160, 8) and r.v.shape == (192, 8)
+        assert _subspace_residual(tall, r._replace(u=r.v, v=r.u)) < 1e-3
+
+    def test_wide_sketch_fallback_is_full_truncation(self):
+        """k + oversample >= n: the lane degrades to the full solve
+        truncated — identical values."""
+        a = matgen.random_dense(64, 24, seed=14, dtype=jnp.float32)
+        r = solver.svd_topk(a, 20)            # l = 20 + 8 >= 24
+        full = sj.svd(a)
+        np.testing.assert_allclose(np.asarray(r.s),
+                                   np.asarray(full.s)[:20], rtol=1e-6)
+
+    def test_nan_input_reads_nonfinite(self):
+        a = matgen.random_dense(192, 160, seed=15, dtype=jnp.float32)
+        r = solver.svd_topk(a.at[3, 4].set(jnp.nan), 8)
+        assert r.status_enum().name == "NONFINITE"
+
+    def test_sigma_only(self):
+        a = _with_spectrum(128, 96, np.concatenate(
+            [np.geomspace(1.0, 0.5, 8), np.full(88, 1e-4)]), seed=16)
+        r = solver.svd_topk(a, 8, compute_u=False, compute_v=False)
+        assert r.u is None and r.v is None and r.s.shape == (8,)
+        assert r.status_enum().name == "OK"
+
+    def test_deterministic(self):
+        """Seeded sketch: repeated calls agree bitwise (nothing dynamic
+        in the pipeline — the retrace-safety prerequisite)."""
+        a = matgen.random_dense(128, 96, seed=17, dtype=jnp.float32)
+        r1 = solver.svd_topk(a, 8)
+        r2 = solver.svd_topk(a, 8)
+        assert np.array_equal(np.asarray(r1.s), np.asarray(r2.s))
+        assert np.array_equal(np.asarray(r1.u), np.asarray(r2.u))
+
+    def test_validates_knobs(self):
+        a = matgen.random_dense(64, 48, seed=18, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="top-k rank"):
+            solver.svd_topk(a, 0)
+        with pytest.raises(ValueError, match="oversample"):
+            solver.svd_topk(a, 4, config=SVDConfig(oversample=0))
+        with pytest.raises(ValueError, match="power_iters"):
+            solver.svd_topk(a, 4, config=SVDConfig(power_iters=-1))
+
+
+class TestSvdTall:
+    def test_factors_match_oracle(self):
+        m, n = 512, 48
+        a = matgen.random_dense(m, n, seed=20, dtype=jnp.float32)
+        r = solver.svd_tall(a)
+        assert r.status_enum().name == "OK"
+        an = np.asarray(a, np.float64)
+        s_ref = np.linalg.svd(an, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(r.s, np.float64), s_ref,
+                                   rtol=1e-4, atol=1e-6)
+        un, vn = np.asarray(r.u, np.float64), np.asarray(r.v, np.float64)
+        recon = un @ np.diag(np.asarray(r.s, np.float64)) @ vn.T
+        assert np.linalg.norm(recon - an) / np.linalg.norm(an) < 1e-5
+        np.testing.assert_allclose(un.T @ un, np.eye(n), atol=1e-5)
+        np.testing.assert_allclose(vn.T @ vn, np.eye(n), atol=1e-5)
+
+    def test_below_threshold_delegates(self):
+        a = matgen.random_dense(96, 48, seed=21, dtype=jnp.float32)  # m<8n
+        r = solver.svd_tall(a)
+        full = sj.svd(a)
+        np.testing.assert_allclose(np.asarray(r.s), np.asarray(full.s),
+                                   rtol=1e-6)
+
+    def test_wide_transposes(self):
+        a = matgen.random_dense(48, 512, seed=22, dtype=jnp.float32)
+        r = solver.svd_tall(a)
+        assert r.u.shape == (48, 48) and r.v.shape == (512, 48)
+        assert r.status_enum().name == "OK"
+
+    def test_nan_input_reads_nonfinite(self):
+        a = matgen.random_dense(512, 48, seed=23, dtype=jnp.float32)
+        r = solver.svd_tall(a.at[100, 7].set(jnp.nan))
+        assert r.status_enum().name == "NONFINITE"
+
+    def test_f64_qr_svd_family(self):
+        """The tall lane composes with the f64 qr-svd core (no Pallas
+        dependency — TSQR + XLA block solvers)."""
+        a = matgen.random_dense(400, 40, seed=24, dtype=jnp.float64)
+        r = solver.svd_tall(a)
+        s_ref = np.linalg.svd(np.asarray(a), compute_uv=False)
+        np.testing.assert_allclose(np.asarray(r.s), s_ref, rtol=1e-12,
+                                   atol=1e-13)
+
+
+@pytest.mark.rank
+@pytest.mark.serve
+class TestServeRankFamilies:
+    BUCKETS = ((64, 48, "float32"), (256, 24, "float32", "tall"),
+               (96, 96, "float32", "topk", 8))
+
+    def _cfg(self, **kw):
+        from svd_jacobi_tpu.serve import ServeConfig
+        kw.setdefault("buckets", self.BUCKETS)
+        kw.setdefault("solver", SVDConfig())
+        kw.setdefault("brownout_sigma_only_at", 2.0)
+        kw.setdefault("brownout_shed_at", 2.0)
+        return ServeConfig(**kw)
+
+    def test_routing_families(self):
+        from svd_jacobi_tpu.serve import BucketSet
+        bs = BucketSet(self.BUCKETS)
+        # Full requests never land in the topk bucket...
+        b = bs.route(90, 90, "float32")
+        assert b is None          # 90x90 fits only the topk bucket
+        # ...tall requests take the tall bucket over nothing...
+        assert bs.route(250, 20, "float32").kind == "tall"
+        # ...top-k requests take ONLY topk buckets with covering k.
+        assert bs.route(90, 80, "float32", top_k=5).kind == "topk"
+        assert bs.route(90, 80, "float32", top_k=9) is None
+        assert bs.route(60, 40, "float32").name == "64x48:float32"
+
+    def test_bucket_spec_validation(self):
+        from svd_jacobi_tpu.serve import as_bucket
+        assert as_bucket("256x24:float32:tall").kind == "tall"
+        assert as_bucket("96x96:float32:topk8").k == 8
+        assert as_bucket((96, 96, "float32", "topk", 8)).kind == "topk"
+        with pytest.raises(ValueError, match="m >= 8n"):
+            as_bucket((64, 48, "float32", "tall"))
+        with pytest.raises(ValueError, match="1 <= k <= n"):
+            as_bucket((96, 96, "float32", "topk", 200))
+        with pytest.raises(ValueError, match="unknown kind"):
+            as_bucket((96, 96, "float32", "rank"))
+
+    def test_serve_tall_and_topk_vs_oracle(self):
+        from svd_jacobi_tpu.serve import SVDService
+        with SVDService(self._cfg()) as svc:
+            at = matgen.random_dense(250, 20, seed=30, dtype=jnp.float32)
+            rt = svc.submit(at).result(600)
+            assert rt.status.name == "OK" and rt.bucket.endswith(":tall")
+            s_ref = np.linalg.svd(np.asarray(at, np.float64),
+                                  compute_uv=False)
+            np.testing.assert_allclose(np.asarray(rt.s, np.float64),
+                                       s_ref, rtol=1e-3, atol=1e-5)
+            ak = _with_spectrum(90, 80, np.concatenate(
+                [np.geomspace(1.0, 0.3, 5), np.full(75, 1e-4)]), seed=31)
+            rk = svc.submit(ak, top_k=5).result(600)
+            assert rk.status.name == "OK"
+            assert rk.bucket == "96x96:float32:topk8"
+            assert rk.u.shape == (90, 5) and rk.v.shape == (80, 5)
+            sk = np.linalg.svd(np.asarray(ak, np.float64),
+                               compute_uv=False)[:5]
+            np.testing.assert_allclose(np.asarray(rk.s, np.float64), sk,
+                                       rtol=1e-3)
+
+    def test_batched_topk_dispatch_vs_per_request(self):
+        """Padded-tier coalesced top-k dispatch: two requests ride ONE
+        tier-4 batched solve (zero-padded tail) and must match their
+        per-request serve results — the per-member oracle."""
+        from svd_jacobi_tpu.serve import SVDService
+        mats = [
+            _with_spectrum(90, 80, np.concatenate(
+                [np.geomspace(1.0, 0.4, 6), np.full(74, 1e-4)]), seed=s)
+            for s in (40, 41)]
+        serial = {}
+        with SVDService(self._cfg()) as svc:
+            for j, a in enumerate(mats):
+                serial[j] = svc.submit(a, top_k=6).result(600)
+                assert serial[j].status.name == "OK"
+        with SVDService(self._cfg(max_batch=4, batch_tiers=(1, 4),
+                                  batch_window_s=2.0)) as svc:
+            svc.warmup(sigma_only=False)
+            tickets = [svc.submit(a, top_k=6) for a in mats]
+            results = [t.result(600) for t in tickets]
+        recs = {r["request"]["id"]: r for r in svc.records()
+                if r["status"] == "OK" and not
+                r["request"]["id"].startswith("warmup")}
+        batch_ids = {recs[t.request_id]["batch_id"] for t in tickets}
+        assert len(batch_ids) == 1 and None not in batch_ids
+        assert all(recs[t.request_id]["batch_tier"] == 4 for t in tickets)
+        assert all(recs[t.request_id]["rank_mode"] == "topk"
+                   and recs[t.request_id]["k"] == 6 for t in tickets)
+        for j, r in enumerate(results):
+            assert r.status.name == "OK"
+            np.testing.assert_allclose(np.asarray(r.s),
+                                       np.asarray(serial[j].s),
+                                       rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(np.abs(np.asarray(r.v)),
+                                       np.abs(np.asarray(serial[j].v)),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_serve_record_carries_rank_fields(self):
+        from svd_jacobi_tpu import obs
+        rec = obs.manifest.build_serve(
+            request_id="r1", m=90, n=80, dtype="float32",
+            bucket="96x96:float32:topk8", queue_wait_s=0.0,
+            solve_time_s=0.1, status="OK", path="base", breaker="closed",
+            brownout="FULL", rank_mode="topk", k=5)
+        obs.manifest.validate(rec)
+        assert rec["rank_mode"] == "topk" and rec["k"] == 5
+        assert "topk[k=5]" in obs.manifest.summarize(rec)
+
+    def test_topk_degraded_sigma_only(self):
+        """A SIGMA_ONLY-browned-out top-k request still returns its
+        truncated sigmas (factors dropped, degraded=True) — the brownout
+        variant of the truncated lane."""
+        import time
+
+        from svd_jacobi_tpu.resilience import chaos
+        from svd_jacobi_tpu.serve import SVDService
+        cfg = self._cfg(buckets=(("96x96:float32:topk8"),),
+                        max_queue_depth=10,
+                        brownout_sigma_only_at=0.2, brownout_shed_at=2.0)
+        with SVDService(cfg) as svc:
+            with chaos.stuck_backend(shots=1, max_stall_s=3.0):
+                first = svc.submit(matgen.random_dense(
+                    90, 80, seed=42, dtype=jnp.float32), top_k=5)
+                time.sleep(0.1)            # let it dispatch and stall
+                tickets = [svc.submit(matgen.random_dense(
+                    90, 80, seed=43 + i, dtype=jnp.float32), top_k=5)
+                    for i in range(4)]
+                results = [t.result(600) for t in [first] + tickets]
+        assert all(r.status.name == "OK" for r in results)
+        degraded = [r for r in results if r.degraded]
+        assert degraded, "no request was admitted under SIGMA_ONLY"
+        for r in degraded:
+            assert r.u is None and r.v is None
+            assert r.s.shape == (5,)
+            assert np.isfinite(np.asarray(r.s)).all()
+
+
+@pytest.mark.rank
+class TestRankAnalysisContracts:
+    def test_rank_retrace_case_clean(self):
+        from svd_jacobi_tpu.analysis import recompile_guard
+        findings, report = recompile_guard.run_serve_rank_case()
+        assert findings == [], [f.render() for f in findings]
+        assert all(s == "OK" for s in report["serve_statuses"])
+
+    def test_rank_retrace_fires_when_underdeclared(self):
+        """Seeded failing fixture: FRESH buckets, budget under-declared
+        at 0 problems — the guard must fire (a warm cache would mask a
+        per-request/per-k leak)."""
+        from svd_jacobi_tpu.analysis import recompile_guard
+        findings, _ = recompile_guard.run_serve_rank_case(
+            expected_problems=0,
+            buckets=((272, 28, "float32", "tall"),
+                     (104, 104, "float32", "topk", 6)),
+            requests=(((272, 28), None), ((104, 104), 6)))
+        assert findings and all(f.code == "RETRACE001" for f in findings)
+
+    def test_tune001_topk_sketch_coverage_fires(self):
+        """Seeded failing fixture for the TUNE001 extension: a table
+        whose rows cover the bucket's shape class but carry NO k-class
+        sketch rows — the topk bucket's sketch knobs resolve generic and
+        the rule fires."""
+        from svd_jacobi_tpu.analysis import tune_checks
+        from svd_jacobi_tpu.tune import tables
+        payload = {
+            "schema_version": tables.SCHEMA_VERSION,
+            "table_id": "no-sketch-rows",
+            "rows": [
+                {"match": {"n_class": "small"},
+                 "knobs": {"block_size": 16}},
+                {"match": {}, "knobs": dict(tables.GENERIC_KNOBS)},
+            ],
+        }
+        payload["content_sha256"] = tables.content_hash(payload)
+        t = tables.TuningTable.from_payload(payload)
+        findings = tune_checks.check_bucket_resolution(
+            table=t, buckets=((96, 96, "float32", "topk", 8),))
+        assert len(findings) == 1
+        assert "SKETCH" in findings[0].message
+
+    def test_tune001_clean_on_shipped_table_with_rank_buckets(self):
+        from svd_jacobi_tpu.analysis import tune_checks
+        assert tune_checks.check_bucket_resolution() == []
+
+    def test_sketch_probes_zero_collectives(self):
+        from svd_jacobi_tpu.analysis import entries, hlo_checks
+        probes = {p.name: p for p in entries.sketch_probes()}
+        for name in ("sketch_project", "tsqr_tall"):
+            assert hlo_checks.check_collective_budget(probes[name]) == []
+
+
+class TestSearchSketchAxes:
+    def test_sketch_axis_sweep_records_points(self):
+        """The coordinate-descent sketch sweep on a small eligible shape:
+        baseline + grid points recorded, winner never silently less
+        accurate (the 2x-accuracy guard)."""
+        from svd_jacobi_tpu.tune import search
+        a = _with_spectrum(256, 256, np.concatenate(
+            [np.geomspace(1.0, 0.2, 32), np.full(224, 1e-4)]), seed=50)
+        res = search.ShapeResult(
+            m=256, n=256, dtype="float32",
+            key={"n_class": "small", "aspect": "square",
+                 "dtype": "float32", "backend": "cpu",
+                 "device_kind": "cpu"},
+            baseline=search.Point(knobs={}), points=[], winner={})
+        search._search_sketch_axes(res, a, SVDConfig(), reps=1,
+                                   budget_s=30.0, min_gain=0.03)
+        assert res.sketch_k == 32
+        assert res.sketch_baseline is not None and res.sketch_baseline.ok
+        assert res.sketch_points, "no sketch grid points recorded"
+        assert set(res.sketch_winner) == {"oversample", "power_iters"}

@@ -257,10 +257,17 @@ class TestShippedTableVerdicts:
 
     def test_shipped_table_covers_default_serve_buckets(self, shipped):
         from svd_jacobi_tpu.config import DEFAULT_SERVE_BUCKETS
-        for m, n, dtype in DEFAULT_SERVE_BUCKETS:
-            r = shipped.resolve(n, m=m, dtype=dtype, backend="cpu",
-                                device_kind="cpu")
-            assert not r.generic_only, (m, n, dtype, r)
+        from svd_jacobi_tpu.serve import as_bucket
+        for spec in DEFAULT_SERVE_BUCKETS:
+            b = as_bucket(spec)
+            r = shipped.resolve(b.n, m=b.m, dtype=b.dtype, backend="cpu",
+                                device_kind="cpu",
+                                k=(b.k if b.kind == "topk" else None))
+            assert not r.generic_only, (b, r)
+            if b.kind == "topk":
+                # The truncated family's extension: the sketch knobs
+                # themselves must come from a measured k-class row.
+                assert not r.sketch_generic_only, (b, r)
 
 
 # ---------------------------------------------------------------------------
