@@ -48,6 +48,12 @@ Usage:
                                   seeded mix, plus the coalesced-over-
                                   serial speedup row; see
                                   bench._serve_throughput for its flags)
+         --serve-coldstart       (cold vs warm restart cost of the
+                                  persistent executable cache: two
+                                  serve-demo --warmup subprocesses
+                                  against one cache dir; the warm row
+                                  must report ZERO fresh compiles —
+                                  PROFILE.md item 26)
          --tuning-table=PATH     (pin a measured tuning table for every
                                   "auto" knob; =off bypasses tables —
                                   the builtin hand-picked heuristics.
@@ -402,10 +408,90 @@ def _sweep(passthrough) -> None:
             raise subprocess.CalledProcessError(rc, full_cmd)
 
 
+def _serve_coldstart(flags) -> None:
+    """--serve-coldstart: measure the restart cost the persistent
+    executable cache removes (PROFILE.md item 26). Two `serve-demo
+    --warmup --requests 0` SUBPROCESSES against the same fresh cache
+    directory — restarts must cross a process boundary, or the
+    in-process jit caches would fake the warm number:
+
+      row 1 (cold): empty cache — warmup pays every fresh compile;
+      row 2 (warm): same cache — warmup must be ~all cache hits, and
+        its fresh-compile count is asserted in the row (nonzero =
+        the restart story is broken, loudly).
+
+    Flags: --cache-dir=DIR (default: a fresh temp dir),
+    --buckets=spec,spec (default: 64x48:float32)."""
+    import json as _json
+    import subprocess
+    import tempfile
+    cache = flags.get("cache-dir") or tempfile.mkdtemp(
+        prefix="svdj-coldstart-")
+    buckets = (flags.get("buckets") or "64x48:float32").split(",")
+    cmd = [sys.executable, "-m", "svd_jacobi_tpu.cli", "serve-demo",
+           "--requests", "0", "--clients", "1", "--warmup",
+           "--compile-cache", cache, "--report-dir", "off"]
+    # The table changes BOTH the measured config and the cache namespace
+    # (its content hash is part of the key) — an unforwarded pin would
+    # silently measure the untuned deployment.
+    if flags.get("tuning-table"):
+        cmd += ["--tuning-table", flags["tuning-table"]]
+    for b in buckets:
+        cmd += ["--bucket", b]
+    rows = []
+    for phase in ("cold", "warm"):
+        t0 = time.perf_counter()
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=900.0)
+        wall = time.perf_counter() - t0
+        if out.returncode != 0:
+            raise SystemExit(f"serve-coldstart {phase} phase failed "
+                             f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+        summary = _json.loads(out.stdout.strip().splitlines()[-1])
+        row = {
+            "metric": f"serve_coldstart_{phase}",
+            "buckets": buckets,
+            "warmup_s": summary.get("warmup_s"),
+            "process_wall_s": wall,
+            "fresh_compiles": (summary.get("coldstart") or {}).get(
+                "fresh_compiles"),
+            "cache_hits": (summary.get("coldstart") or {}).get(
+                "cache_hits"),
+            "cache_dir": cache,
+        }
+        print(_json.dumps(row))
+        rows.append(row)
+    if rows[0]["warmup_s"] and rows[1]["warmup_s"]:
+        print(_json.dumps({
+            "metric": "serve_coldstart_speedup",
+            "cold_warmup_s": rows[0]["warmup_s"],
+            "warm_warmup_s": rows[1]["warmup_s"],
+            "speedup": rows[0]["warmup_s"] / rows[1]["warmup_s"],
+            "warm_fresh_compiles": rows[1]["fresh_compiles"],
+            "warm_cache_ok": rows[1]["fresh_compiles"] == 0,
+        }))
+    if rows[1]["fresh_compiles"] is None:
+        # An unmeasured run must not pass as a verified one: the warm
+        # phase produced no coldstart record, so the zero-fresh-compiles
+        # acceptance was never checked.
+        raise SystemExit("serve-coldstart: the warm phase reported no "
+                         "coldstart record (fresh_compiles is None) — "
+                         "the zero-fresh-compiles acceptance was NOT "
+                         "verified")
+    if rows[1]["fresh_compiles"] != 0:
+        raise SystemExit("serve-coldstart: the WARM restart still paid "
+                         f"{rows[1]['fresh_compiles']} fresh compile(s) — "
+                         "the persistent executable cache is not doing "
+                         "its job")
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
                  for f in sys.argv[1:] if f.startswith("--"))
+    if "serve-coldstart" in flags:
+        _serve_coldstart(flags)
+        return
     if "serve-throughput" in flags:
         _serve_throughput(flags)
         return

@@ -38,6 +38,12 @@ properties that decide whether those artifacts stay sane:
     table-resolved serving configs keep the once-per-bucket compile
     contract (reusing `recompile_guard` over a resolved-config serve
     sequence).
+  * `aot_checks`    — the entry-registry contract (AOT001):
+    `config.RETRACE_BUDGETS` and the serving entry registry
+    (`serve.registry.jit_entries`) enumerate EXACTLY the same entry
+    set, and every jit the registry's AOT warmup plan can dispatch is
+    budgeted — a new jit entry cannot ship unbudgeted, a stale budget
+    cannot linger undeclared.
 
 `python -m svd_jacobi_tpu.analysis` runs every pass and appends one
 schema-versioned "analysis" record to the run manifest (`obs.manifest`);

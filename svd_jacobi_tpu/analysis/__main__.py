@@ -20,7 +20,7 @@ import sys
 import time
 from pathlib import Path
 
-PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune")
+PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot")
 
 
 def _parse_args(argv):
@@ -80,6 +80,13 @@ def main(argv=None) -> int:
             # table-resolved configs introduce no new retraces.
             from . import tune_checks
             findings, report = tune_checks.run_all()
+            return findings, report
+        if name == "aot":
+            # The entry-registry contract (AOT001): RETRACE_BUDGETS and
+            # serve.registry.jit_entries agree exactly, and every jit
+            # the registry's AOT plan dispatches is budgeted.
+            from . import aot_checks
+            findings, report = aot_checks.run_all()
             return findings, report
         findings, report = recompile_guard.run_default_sequence()
         return findings, report

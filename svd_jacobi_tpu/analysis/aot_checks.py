@@ -1,0 +1,118 @@
+"""AOT001: the entry registry and the retrace budgets must agree.
+
+`serve.registry.jit_entries()` is the authoritative ``entry name -> live
+jit object`` map (the AOT warmup lane compiles through it; the retrace
+guard resolves its probes through it), and `config.RETRACE_BUDGETS` is
+the declared compile-budget ledger. The two grew independently before
+the registry existed; this pass pins them together:
+
+  * every `RETRACE_BUDGETS` key must be enumerated by the registry — a
+    budget for an entry the registry cannot name is dead declaration
+    (nothing AOT-compiles it, nothing can guard it);
+  * every registry name must carry a budget — an entry the registry
+    compiles but nobody budgeted is an unguarded compile surface (a
+    retrace leak there would be invisible to RETRACE001).
+
+Additionally, every jit call the registry PLANS for a representative
+service configuration (all three bucket families + batched tiers, via
+`EntryRegistry.aot_plan` — pure `jax.eval_shape`, no compiles) must
+resolve to a declared entry name, so the AOT warmup can never compile a
+program the budgets don't know about.
+
+The seeded failing fixture is parameter injection (tests): an extra
+budget key / a dropped registry name makes `check_budget_coverage` fire,
+and an undeclared plan name makes `check_plan_names` fire.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import Finding
+from .. import config as _config
+
+
+def check_budget_coverage(budgets: Optional[Dict[str, int]] = None,
+                          entries: Optional[Dict[str, object]] = None
+                          ) -> List[Finding]:
+    """Two-way set equality of `config.RETRACE_BUDGETS` keys vs the
+    registry's `jit_entries()` names (AOT001 findings otherwise).
+    ``budgets``/``entries`` substitute the seeded failing fixtures."""
+    from ..serve import registry as _registry
+    budgets = dict(_config.RETRACE_BUDGETS if budgets is None else budgets)
+    entries = (_registry.jit_entries() if entries is None
+               else dict(entries))
+    findings = []
+    for name in sorted(set(budgets) - set(entries)):
+        findings.append(Finding(
+            code="AOT001", where=name,
+            message=(f"RETRACE_BUDGETS declares {name!r} but the entry "
+                     f"registry (serve.registry.jit_entries) does not "
+                     f"enumerate it — a budget nothing can AOT-compile "
+                     f"or guard"),
+            suggestion=("add the entry to serve.registry.jit_entries() "
+                        "or drop the stale budget")))
+    for name in sorted(set(entries) - set(budgets)):
+        findings.append(Finding(
+            code="AOT001", where=name,
+            message=(f"the entry registry enumerates {name!r} but "
+                     f"config.RETRACE_BUDGETS carries no budget for it "
+                     f"— an unguarded compile surface"),
+            suggestion="declare a RETRACE_BUDGETS entry for it"))
+    return findings
+
+
+# A representative configuration covering all three bucket families AND
+# the batched tiers, so the plan walk exercises every stepper lane the
+# serving layer can dispatch (single + batched, pallas + hybrid XLA,
+# tall TSQR, top-k sketch, factor lifts).
+_PLAN_BUCKETS = ((64, 48, "float32"), (96, 64, "float32"),
+                 (256, 32, "float32", "tall"),
+                 (96, 96, "float32", "topk", 8))
+
+
+def check_plan_names(budgets: Optional[Dict[str, int]] = None,
+                     buckets=None, max_batch: int = 4) -> List[Finding]:
+    """Every jit call the registry plans for a representative service
+    must be a declared budget key (AOT001 otherwise). Pure
+    `jax.eval_shape` — nothing compiles."""
+    from ..config import SVDConfig
+    from ..serve.buckets import BucketSet
+    from ..serve.registry import EntryRegistry
+    budgets = dict(_config.RETRACE_BUDGETS if budgets is None else budgets)
+    bucket_set = BucketSet(_PLAN_BUCKETS if buckets is None else buckets)
+    base = SVDConfig()
+    solver_map = bucket_set.resolve_solver_configs(base)
+    tiers = (1, 4)
+    reg = EntryRegistry(bucket_set, solver_map,
+                        {b: tiers for b in bucket_set}, base,
+                        max_batch=max_batch, lanes=1,
+                        default_tiers=tiers)
+    findings = []
+    planned: Dict[str, List[str]] = {}
+    for key in reg.entries():
+        for name, _, _, _ in reg.aot_plan(key):
+            planned.setdefault(name, []).append(key.name)
+    for name, where in sorted(planned.items()):
+        if name not in budgets:
+            findings.append(Finding(
+                code="AOT001", where=name,
+                message=(f"the registry's AOT plan dispatches {name!r} "
+                         f"(for {where[:3]}) but RETRACE_BUDGETS does "
+                         f"not declare it — the warmup would compile an "
+                         f"unbudgeted program"),
+                suggestion="declare a RETRACE_BUDGETS entry for it"))
+    return findings
+
+
+def run_all() -> tuple:
+    """The CLI's ``aot`` pass: both coverage checks plus a registry
+    report. Returns ``(findings, report)``."""
+    from ..serve import registry as _registry
+    findings = check_budget_coverage()
+    findings += check_plan_names()
+    report = {
+        "registry_entries": sorted(_registry.jit_entries()),
+        "budget_entries": sorted(_config.RETRACE_BUDGETS),
+    }
+    return findings, report

@@ -35,47 +35,15 @@ from typing import Dict, List, Optional
 from . import Finding
 from .. import config as _config
 
-_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-
 
 def default_entries() -> Dict[str, object]:
     """The declared entry points (keys of config.RETRACE_BUDGETS) resolved
-    to their live jit objects."""
-    from .. import solver
-    from ..parallel import sharded
-    return {
-        "solver._svd_padded": solver._svd_padded,
-        "solver._svd_pallas": solver._svd_pallas,
-        "solver._svd_pallas_donated": solver._svd_pallas_donated,
-        "sharded._svd_sharded_jit": sharded._svd_sharded_jit,
-        # Serving-path entries (host-stepped; see run_serve_sequence).
-        "solver._precondition_qr_jit": solver._precondition_qr_jit,
-        "solver._sweep_step_pallas_jit": solver._sweep_step_pallas_jit,
-        "solver._finish_pallas_jit": solver._finish_pallas_jit,
-        "solver._nonfinite_probe_jit": solver._nonfinite_probe_jit,
-        # Batched (coalesced-dispatch) lane entries: fused + stepper.
-        "solver._svd_pallas_batched": solver._svd_pallas_batched,
-        "solver._svd_padded_batched": solver._svd_padded_batched,
-        "solver._precondition_qr_batched_jit":
-            solver._precondition_qr_batched_jit,
-        "solver._sweep_step_pallas_batched_jit":
-            solver._sweep_step_pallas_batched_jit,
-        "solver._sweep_step_xla_batched_jit":
-            solver._sweep_step_xla_batched_jit,
-        "solver._finish_pallas_batched_jit":
-            solver._finish_pallas_batched_jit,
-        "solver._finish_xla_batched_jit": solver._finish_xla_batched_jit,
-        "solver._nonfinite_probe_batched_jit":
-            solver._nonfinite_probe_batched_jit,
-        # Top-k / tall lane stage jits (run_serve_rank_case).
-        "solver._tsqr_jit": solver._tsqr_jit,
-        "solver._tsqr_batched_jit": solver._tsqr_batched_jit,
-        "solver._sketch_project_jit": solver._sketch_project_jit,
-        "solver._sketch_project_batched_jit":
-            solver._sketch_project_batched_jit,
-        "solver._lift_q_jit": solver._lift_q_jit,
-        "solver._lift_q_batched_jit": solver._lift_q_batched_jit,
-    }
+    to their live jit objects — delegated to the serving entry registry
+    (`serve.registry.jit_entries`), the ONE authoritative name map; the
+    AOT001 analysis pass asserts it covers the budget keys exactly in
+    both directions."""
+    from ..serve import registry as _registry
+    return _registry.jit_entries()
 
 
 def _cache_size(jit_fn) -> int:
@@ -96,9 +64,27 @@ class RecompileGuard:
                             else budgets)
         self.entries = default_entries() if entries is None else dict(entries)
         self.expected: Dict[str, int] = {}
-        self.backend_compiles = 0
+        # The compile/cache-hit event counting (why "fresh" is the
+        # compiles-minus-hits difference, the private-API unregistration
+        # dance) lives in ONE place: serve.registry.CompileCounter. The
+        # guard keeps a counter across its lifetime — counts stay
+        # readable after __exit__.
+        from ..serve.registry import CompileCounter
+        self._counter = CompileCounter()
         self._start: Dict[str, int] = {}
-        self._listening = False
+
+    @property
+    def backend_compiles(self) -> int:
+        return self._counter.backend_compiles
+
+    @property
+    def cache_hits(self) -> int:
+        """Persistent-compilation-cache hits inside the guard window: the
+        backend-compile duration event fires on cache HITS too (it wraps
+        compile_or_get_cached), so "fresh compilations" — the cold-start
+        cost the AOT/persistent-cache lane eliminates — is the
+        difference (`fresh_backend_compiles`)."""
+        return self._counter.cache_hits
 
     def expect(self, name: str, problems: int = 1) -> None:
         """Declare that ``problems`` distinct problem keys will be solved
@@ -108,31 +94,13 @@ class RecompileGuard:
                            f"{sorted(self.entries)}")
         self.expected[name] = self.expected.get(name, 0) + int(problems)
 
-    # -- monitoring hook ----------------------------------------------------
-    def _on_duration(self, name: str, duration: float, **kw) -> None:
-        # Gated on _listening: if unregistration is unavailable (private
-        # jax API moved), the still-registered bound method goes inert
-        # instead of mutating an exited guard's counts forever.
-        if self._listening and name == _COMPILE_EVENT:
-            self.backend_compiles += 1
-
     def __enter__(self) -> "RecompileGuard":
-        import jax.monitoring
         self._start = {n: _cache_size(f) for n, f in self.entries.items()}
-        jax.monitoring.register_event_duration_secs_listener(
-            self._on_duration)
-        self._listening = True
+        self._counter.__enter__()
         return self
 
     def __exit__(self, *exc) -> None:
-        if self._listening:
-            self._listening = False   # inert even if unregistration fails
-            try:
-                from jax._src import monitoring as _m
-                _m._unregister_event_duration_listener_by_callback(
-                    self._on_duration)
-            except Exception:
-                pass  # listener stays registered but gated off
+        self._counter.__exit__(*exc)
 
 
     # -- results ------------------------------------------------------------
@@ -141,9 +109,17 @@ class RecompileGuard:
         return {n: _cache_size(f) - self._start.get(n, 0)
                 for n, f in self.entries.items()}
 
+    def fresh_backend_compiles(self) -> int:
+        """Backend compiles the persistent compilation cache did NOT
+        serve — the real cold-start cost (zero on a fully warm cache:
+        the restart acceptance criterion)."""
+        return self._counter.fresh
+
     def report(self) -> dict:
         return {"new_traces": self.new_traces(),
                 "backend_compiles": self.backend_compiles,
+                "cache_hits": self.cache_hits,
+                "fresh_backend_compiles": self.fresh_backend_compiles(),
                 "expected": dict(self.expected)}
 
     def check(self) -> List[Finding]:

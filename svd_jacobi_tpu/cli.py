@@ -238,6 +238,37 @@ def _parse_serve_args(argv):
                    help="pin a measured tuning table for the service's "
                         "per-bucket knob resolution ('off' = builtin "
                         "hand-picked heuristics)")
+    # --- restart survivability (serve.registry / serve.journal) ----------
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="durable request journal (write-ahead JSONL, "
+                        "fsync per record): admitted requests survive a "
+                        "process kill and are re-admitted on restart")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent executable cache root: warmup "
+                        "compiles land in <DIR>/<config-hash>/ so a "
+                        "restarted process warms from cache hits "
+                        "instead of fresh compiles")
+    p.add_argument("--warmup", action="store_true",
+                   help="run SVDService.warmup() before the clients "
+                        "(AOT + zero-solve phases when --compile-cache "
+                        "is set); per-entry timing lands in a "
+                        "'coldstart' manifest record and the summary")
+    p.add_argument("--restart-drill", action="store_true",
+                   help="kill-and-restart drill: serve under load in a "
+                        "child process, SIGKILL it mid-load, restart "
+                        "it, and report cold-start latency + resumed "
+                        "request count; exits non-zero on ANY lost "
+                        "request")
+    p.add_argument("--drill-requests", type=int, default=6,
+                   help="requests the restart drill pushes through the "
+                        "child (kept small: each is slowed so the kill "
+                        "window is wide)")
+    # Internal drill plumbing (the orchestrator spawns serve-demo
+    # children with these; not for direct use).
+    p.add_argument("--_drill-resume", action="store_true",
+                   dest="drill_resume", help=argparse.SUPPRESS)
+    p.add_argument("--_drill-slow-ms", type=float, default=0.0,
+                   dest="drill_slow_ms", help=argparse.SUPPRESS)
     return p.parse_args(argv)
 
 
@@ -248,6 +279,8 @@ def serve_demo(argv) -> int:
     and admission rejections are EXPECTED outcomes here (the demo
     deliberately provokes them), not failures."""
     args = _parse_serve_args(argv)
+    if args.restart_drill:
+        return _restart_drill(args)
 
     import os
     import threading
@@ -294,8 +327,40 @@ def serve_demo(argv) -> int:
                       manifest_path=manifest_path,
                       max_batch=max(1, args.max_batch),
                       batch_window_s=max(0.0, args.batch_window_ms) / 1e3,
-                      lanes=max(1, args.lanes))
+                      lanes=max(1, args.lanes),
+                      journal_path=args.journal,
+                      compile_cache_dir=args.compile_cache)
     svc = SVDService(cfg)
+
+    if args.drill_resume:
+        # Restart-drill phase 2 (spawned by `_restart_drill`): recover
+        # the journal, serve every resumed request, report cold-start
+        # latency — no fresh client load.
+        t_proc = time.perf_counter()
+        tickets = svc.recover()
+        svc.start()
+        if args.warmup:
+            svc.warmup(timeout=600.0)
+        first_s = None
+        results = {}
+        for rid, t in sorted(tickets.items()):
+            res = t.result(timeout=600.0)
+            if first_s is None:
+                first_s = time.perf_counter() - t_proc
+            results[rid] = (res.status.name if res.status is not None
+                            else "ERROR")
+        svc.stop(drain=True, timeout=60.0)
+        cold = [r for r in svc.records() if r.get("kind") == "coldstart"]
+        print(json.dumps({
+            "resumed": len(results), "results": results,
+            "cold_start_s": first_s,
+            "coldstart": (None if not cold else {
+                "fresh_compiles": cold[-1]["fresh_compiles"],
+                "cache_hits": cold[-1]["cache_hits"],
+                "total_s": cold[-1]["total_s"]}),
+        }))
+        return 0 if all(s in ("OK", "DEADLINE") for s in results.values()) \
+            else 1
 
     # Seeded request plan, built up front so the run is reproducible: a
     # shape drawn within a random bucket, plus the deadline class. A
@@ -352,6 +417,16 @@ def serve_demo(argv) -> int:
 
     t0 = time.perf_counter()
     svc.start()
+    warmup_s = None
+    if args.warmup:
+        t_w = time.perf_counter()
+        svc.warmup(timeout=600.0)
+        warmup_s = time.perf_counter() - t_w
+    if args.drill_slow_ms > 0:
+        # Restart-drill phase 1: slow every dispatch so the parent's
+        # kill window (journaled but unfinalized requests exist) is wide.
+        from svd_jacobi_tpu.resilience import chaos
+        chaos.slow_solve(args.drill_slow_ms / 1e3, shots=10 ** 6).__enter__()
     threads = [threading.Thread(target=client, args=(c,), daemon=True)
                for c in range(max(1, args.clients))]
     for th in threads:
@@ -382,6 +457,15 @@ def serve_demo(argv) -> int:
     }
     if args.topk_mix:
         summary["topk_requests"] = sum(1 for p in plan if p[5] is not None)
+    if warmup_s is not None:
+        summary["warmup_s"] = warmup_s
+        cold = [r for r in svc.records() if r.get("kind") == "coldstart"]
+        if cold:
+            summary["coldstart"] = {
+                "fresh_compiles": cold[-1]["fresh_compiles"],
+                "cache_hits": cold[-1]["cache_hits"],
+                "total_s": cold[-1]["total_s"],
+            }
     if manifest_path:
         log(f"manifest: {manifest_path}")
     print(json.dumps(summary))
@@ -402,6 +486,143 @@ def serve_demo(argv) -> int:
             f"({len(plan) - summary['terminal']} non-terminal, "
             f"{summary['errors']} errors)")
     return 0 if ok else 1
+
+
+def _restart_drill(args) -> int:
+    """``serve-demo --restart-drill``: the kill-and-restart acceptance
+    drill. Phase 1 serves the request load in a CHILD process (slowed
+    dispatches, durable journal, persistent compile cache); once the
+    journal shows at least one finalized AND one still-unfinalized
+    request, the child takes a real SIGKILL. Phase 2 restarts serve-demo
+    in resume mode on the same journal/cache and reports cold-start
+    latency and the resumed-request count. Exit non-zero if ANY
+    journaled unfinalized request is not resumed-and-terminal — a lost
+    request is the one unacceptable outcome."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
+    from svd_jacobi_tpu.serve import Journal
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    workdir = tempfile.mkdtemp(prefix="svdj-drill-")
+    journal = args.journal or os.path.join(workdir, "journal.jsonl")
+    cache = args.compile_cache or os.path.join(workdir, "compile-cache")
+    base = [sys.executable, "-m", "svd_jacobi_tpu.cli", "serve-demo",
+            "--journal", journal, "--compile-cache", cache,
+            "--seed", str(args.seed),
+            "--queue-depth", str(max(args.queue_depth,
+                                     args.drill_requests + 2)),
+            "--report-dir", args.report_dir]
+    if args.tuning_table:
+        base += ["--tuning-table", args.tuning_table]
+    for b in (args.bucket or ()):
+        base += ["--bucket", b]
+    phase1_cmd = base + ["--requests", str(args.drill_requests),
+                         "--clients", "2", "--tight-frac", "0",
+                         "--deadline-s", "600",
+                         "--_drill-slow-ms", "250"]
+    log(f"drill phase 1 (serve + SIGKILL): journal={journal}")
+    child = subprocess.Popen(phase1_cmd, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    killed = False
+    deadline = time.monotonic() + 300.0
+    # Incremental kill-window poll: admit lines carry the full base64
+    # input payload (megabytes at real bucket sizes), so a full
+    # Journal.scan() per 50 ms tick would be O(journal bytes x polls).
+    # Read only the NEW bytes each tick, holding back the (possibly
+    # half-flushed, in-flight) unterminated tail line in `buf` — each
+    # journal byte is parsed at most once, and a torn tail is simply
+    # not yet a line, never a quarantine.
+    admitted: set = set()
+    finalized: set = set()
+    offset, buf = 0, b""
+    try:
+        while time.monotonic() < deadline and child.poll() is None:
+            if Path(journal).exists():
+                with open(journal, "rb") as jf:
+                    jf.seek(offset)
+                    chunk = jf.read()
+                offset += len(chunk)
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    rid = rec.get("id")
+                    if rid is None:
+                        continue
+                    if rec.get("kind") == "admit":
+                        admitted.add(rid)
+                    elif rec.get("kind") == "finalize":
+                        finalized.add(rid)
+                if finalized and admitted - finalized:
+                    os.kill(child.pid, signal.SIGKILL)
+                    killed = True
+                    break
+            time.sleep(0.05)
+    finally:
+        if child.poll() is None and not killed:
+            child.kill()
+    child.wait(timeout=30.0)
+    if not killed:
+        log("drill: never reached a kill window (finalized + pending "
+            "requests) — nothing was proven")
+        return 1
+    st = Journal(journal).scan()
+    debt = [r["id"] for r in st.unfinalized]
+    log(f"drill: SIGKILL'd pid {child.pid} with "
+        f"{len(st.finalized)} finalized / {len(debt)} unfinalized "
+        f"({debt})")
+    if not debt:
+        # The worker finalized its remaining in-flight requests between
+        # the poll that observed the kill window and the SIGKILL landing:
+        # a resume with nothing to resume proves nothing, same as never
+        # reaching a kill window.
+        log("drill: kill landed after every request finalized — nothing "
+            "was proven")
+        return 1
+    phase2_cmd = base + ["--_drill-resume", "--warmup"]
+    out = subprocess.run(phase2_cmd, capture_output=True, text=True,
+                         timeout=600.0)
+    try:
+        resumed = json.loads(out.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        log(f"drill: resume phase produced no JSON "
+            f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+        return 1
+    results = resumed.get("results", {})
+    lost = sorted(set(debt) - set(results))
+    summary = {
+        "killed_pid": child.pid,
+        "finalized_before_kill": len(st.finalized),
+        "unfinalized_at_kill": debt,
+        "resumed": len(results),
+        "results": results,
+        "lost": lost,
+        "cold_start_s": resumed.get("cold_start_s"),
+        "coldstart": resumed.get("coldstart"),
+        "journal": journal,
+        "cache": cache,
+    }
+    print(json.dumps(summary))
+    if lost:
+        log(f"exit 1: {len(lost)} journaled request(s) LOST across the "
+            f"restart: {lost}")
+        return 1
+    if out.returncode != 0:
+        log(f"exit 1: resume phase exited {out.returncode}")
+        return 1
+    log(f"drill OK: {len(results)} request(s) resumed exactly-once, "
+        f"first result {summary['cold_start_s']:.2f}s after restart "
+        f"(fresh compiles: "
+        f"{(resumed.get('coldstart') or {}).get('fresh_compiles')})")
+    return 0
 
 
 def main(argv=None) -> int:

@@ -277,6 +277,15 @@ RETRACE_BUDGETS = {
     "solver._sweep_step_pallas_jit": 1,
     "solver._finish_pallas_jit": 1,
     "solver._nonfinite_probe_jit": 1,
+    # The XLA-block-solver stepper twins (tiny-n / f64 serving buckets
+    # resolve to the hybrid method, whose host-stepped sweeps run these
+    # instead of the Pallas kernels). Budget 1 per distinct problem key —
+    # the hybrid's bulk and polish stages are DISTINCT static keys
+    # (method/criterion), so a hybrid bucket legitimately counts two
+    # problems for the sweep entry, which the serve registry enumerates
+    # (serve.registry / analysis pass AOT001).
+    "solver._sweep_step_jit": 1,
+    "solver._finish_jit": 1,
     # Batched (coalesced-dispatch) lane: the fused entry and the stepper
     # entries `serve.SVDService` drives when max_batch > 1. The problem
     # key is (bucket x batch TIER) — batch sizes snap to the small static

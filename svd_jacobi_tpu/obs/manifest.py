@@ -30,6 +30,7 @@ import dataclasses
 import datetime
 import hashlib
 import json
+import threading
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -123,6 +124,21 @@ _FLEET_SCHEMA: Dict[str, Any] = {
                                   # probe | healthz | ladder_overrun
     "lane": (int, type(None)),
 }
+# Cold-start reports ("coldstart", written by SVDService.warmup): one
+# record per warmup — every registry entry's ahead-of-time compile time
+# and whether the persistent executable cache served it
+# (fresh_compiles == 0), so the cost of every restart is measurable from
+# the manifest stream (warm restarts must read ~all cache hits).
+_COLDSTART_SCHEMA: Dict[str, Any] = {
+    "entries": list,              # [{"entry", "time_s", "cache_hit", ...}]
+    "total_s": _NUM,
+    "backend_compiles": int,
+    "cache_hits": int,
+    "fresh_compiles": int,
+    "cache_dir": (str, type(None)),   # None = persistent cache disabled
+    "config_sha256": (str, type(None)),
+}
+_COLDSTART_ENTRY_FIELDS = {"entry": str, "time_s": _NUM, "cache_hit": bool}
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
 
@@ -312,6 +328,37 @@ def build_tune(*, m: int, n: int, dtype: str, key: dict, baseline: dict,
     return record
 
 
+def build_coldstart(*, entries: List[dict], total_s: float,
+                    backend_compiles: int, cache_hits: int,
+                    fresh_compiles: int, cache_dir: Optional[str],
+                    config_sha256: Optional[str], **extra) -> dict:
+    """Assemble a schema-valid cold-start record
+    (`serve.SVDService.warmup`): the per-entry AOT compile timings of one
+    warmup pass. ``entries``: one dict per registry entry
+    ({"entry", "time_s", "cache_hit", "backend_compiles", "cache_hits",
+    "fresh_compiles", "jits"}); the top-level counters aggregate them
+    plus the zero-solve execution phase. ``cache_dir``/``config_sha256``
+    identify the persistent cache namespace (None when disabled).
+    ``extra`` (exec_s, aot_s, lanes, ...) rides along like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "coldstart",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "entries": [dict(e) for e in entries],
+        "total_s": float(total_s),
+        "backend_compiles": int(backend_compiles),
+        "cache_hits": int(cache_hits),
+        "fresh_compiles": int(fresh_compiles),
+        "cache_dir": None if cache_dir is None else str(cache_dir),
+        "config_sha256": (None if config_sha256 is None
+                          else str(config_sha256)),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def build_fleet(*, event: str, lane: Optional[int] = None, **extra) -> dict:
     """Assemble a schema-valid fleet event record (`serve.fleet`).
 
@@ -386,6 +433,11 @@ def validate(record: dict) -> None:
                               f"a 'knobs' dict")
     elif record.get("kind") == "fleet":
         _check_fields(record, _FLEET_SCHEMA, "record", errors)
+    elif record.get("kind") == "coldstart":
+        _check_fields(record, _COLDSTART_SCHEMA, "record", errors)
+        for i, e in enumerate(record.get("entries") or []):
+            _check_fields(e, _COLDSTART_ENTRY_FIELDS,
+                          f"record.entries[{i}]", errors)
     else:
         _check_fields(record, _SOLVE_SCHEMA, "record", errors)
         for i, st in enumerate(record.get("stages") or []):
@@ -402,24 +454,123 @@ def validate(record: dict) -> None:
         raise ValueError("invalid manifest record: " + "; ".join(errors))
 
 
-def append(path, record: dict) -> Path:
-    """Validate and append one JSONL record (creating parent dirs)."""
-    validate(record)
+# Per-path append locks: concurrent appends from worker/client threads
+# must serialize per file, or two large lines could interleave mid-line
+# through the OS write path and BOTH come back torn. The guard is
+# created at import: minting it lazily would itself race (two threads
+# making the process's first appends could each see None and mint
+# separate guards — and therefore separate per-path locks).
+_APPEND_LOCKS: Dict[str, Any] = {}
+_APPEND_LOCKS_GUARD = threading.Lock()
+
+
+def _append_lock(path: str):
+    with _APPEND_LOCKS_GUARD:
+        lock = _APPEND_LOCKS.get(path)
+        if lock is None:
+            lock = _APPEND_LOCKS[path] = threading.Lock()
+        return lock
+
+
+def append_jsonl(path, record: dict, *, fsync: bool = True) -> Path:
+    """Crash-safe JSONL append: one record, one line, written as a
+    SINGLE unbuffered ``os.write`` to an O_APPEND fd under a per-path
+    lock (two threads appending large lines concurrently must not
+    interleave fragments), fsync'd to stable storage before returning (a
+    record this function returned for is never lost to a SIGKILL — the
+    `utils.checkpoint` discipline, applied per line). If the file's
+    current tail is a TORN line (a previous writer died mid-write,
+    leaving no trailing newline), a newline is written first so the new
+    record can never be concatenated into the torn fragment and parse
+    as garbage. The shared low-level writer of the run manifest and the
+    serving layer's durable request journal (`serve.journal`)."""
+    import os
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with path.open("a") as f:
-        f.write(json.dumps(record, sort_keys=True) + "\n")
+    line = (json.dumps(record, sort_keys=True) + "\n").encode()
+    with _append_lock(str(path)):
+        # O_RDWR, not O_WRONLY: the torn-tail probe pread()s the last
+        # byte, which needs read permission on the fd.
+        fd = os.open(str(path), os.O_RDWR | os.O_APPEND | os.O_CREAT,
+                     0o644)
+        try:
+            size = os.fstat(fd).st_size
+            if size > 0 and os.pread(fd, 1, size - 1) != b"\n":
+                os.write(fd, b"\n")
+            os.write(fd, line)
+            if fsync:
+                os.fsync(fd)
+        finally:
+            os.close(fd)
     return path
 
 
-def load(path) -> List[dict]:
-    """Read every record of a JSONL manifest (skipping blank lines)."""
-    records = []
-    with Path(path).open() as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                records.append(json.loads(line))
+def read_jsonl_tolerant(path, *, quarantine: bool = True):
+    """Read a JSONL stream, tolerating torn lines: a line that fails to
+    parse (the classic SIGKILL-mid-write artifact — most often the
+    trailing line) is QUARANTINED to ``<path>.torn`` (appended verbatim,
+    for forensics) with a loud `RuntimeWarning`, and every parseable
+    record is still returned — one torn record must not take the whole
+    stream's history with it. Returns ``(records, torn_count)``."""
+    import warnings
+    path = Path(path)
+    records: List[dict] = []
+    torn = 0
+    sidecar = Path(str(path) + ".torn")
+    # Already-quarantined lines: a torn line stays in the source stream
+    # (appends self-repair around it, nothing rewrites it out), so
+    # repeated loads would otherwise re-quarantine it — and re-warn —
+    # forever. Dedupe against the sidecar's existing content.
+    seen: set = set()
+    if quarantine and sidecar.exists():
+        try:
+            seen = set(sidecar.read_text().splitlines())
+        except OSError:
+            pass
+    with path.open() as f:
+        for lineno, line in enumerate(f, 1):
+            stripped = line.strip()
+            if not stripped:
+                continue
+            try:
+                records.append(json.loads(stripped))
+            except json.JSONDecodeError:
+                torn += 1
+                if quarantine and line.rstrip("\n") not in seen:
+                    seen.add(line.rstrip("\n"))
+                    try:
+                        with sidecar.open("a") as sf:
+                            sf.write(line if line.endswith("\n")
+                                     else line + "\n")
+                        where = f"quarantined to {sidecar}"
+                    except OSError as e:
+                        # A read-only manifest location must still read.
+                        where = f"NOT quarantined ({sidecar}: {e})"
+                    warnings.warn(
+                        f"{path}:{lineno}: torn/unparseable JSONL line "
+                        f"{where} "
+                        f"({stripped[:60]!r}...)", RuntimeWarning,
+                        stacklevel=2)
+    return records, torn
+
+
+def append(path, record: dict) -> Path:
+    """Validate and append one JSONL record (creating parent dirs).
+    fsync'd per record (`append_jsonl`): a process kill right after a
+    request finalizes cannot lose its serve record."""
+    validate(record)
+    return append_jsonl(path, record)
+
+
+def load(path, *, quarantine: bool = True) -> List[dict]:
+    """Read every record of a JSONL manifest (skipping blank lines). A
+    torn trailing line — a writer killed mid-append — is quarantined to
+    ``<path>.torn`` with a warning instead of failing the whole stream
+    parse (`read_jsonl_tolerant`). Pass ``quarantine=False`` when
+    reading a manifest a LIVE process may be appending to (the
+    `Journal.scan` discipline): a half-flushed tail is an in-flight
+    append, not a crash artifact, and must not be sidecarred."""
+    records, _ = read_jsonl_tolerant(path, quarantine=quarantine)
     return records
 
 
@@ -473,6 +624,24 @@ def summarize(record: dict) -> str:
                 (p.get("note") or "n/a")
             lines.append(f"  point {p.get('knobs', {})}  {t_s}")
         lines.append(f"  winner {record.get('winner', {})}")
+        return "\n".join(lines)
+    if record.get("kind") == "coldstart":
+        hits = sum(1 for e in record.get("entries") or []
+                   if e.get("cache_hit"))
+        total = len(record.get("entries") or [])
+        lines = [
+            f"coldstart @ {record.get('timestamp', '?')}  "
+            f"{record.get('total_s', float('nan')):.2f} s  "
+            f"entries {hits}/{total} cache-hit  "
+            f"fresh_compiles={record.get('fresh_compiles', '?')}"
+            + (f"  cache={record['cache_dir']}"
+               if record.get("cache_dir") else "  (no persistent cache)"),
+        ]
+        for e in record.get("entries") or []:
+            lines.append(
+                f"  entry {e.get('entry', '?'):<36} "
+                f"{e.get('time_s', float('nan')):7.3f} s  "
+                f"{'hit' if e.get('cache_hit') else 'COMPILE'}")
         return "\n".join(lines)
     if record.get("kind") == "fleet":
         lane = record.get("lane")

@@ -49,6 +49,16 @@ detection/recovery machinery of this repo actually works:
         the lane, and once the shots are exhausted a recovery probe
         solves clean and returns it to ACTIVE.
 
+  * `sigkill_at_dispatch(k)` — arm a REAL SIGKILL to this process at its
+    k-th next served dispatch, delivered after the dispatch is journaled
+    (`serve.journal`) — the process-loss fault the restart-survivability
+    lane (journal replay + persistent executable cache) exists to
+    survive; subprocess tests only, nothing in-process can catch it.
+  * `corrupt_compile_cache(dir, mode)` — corrupt one persistent
+    compile-cache entry on disk (`serve.registry`'s executable cache),
+    proving a corrupt entry degrades to a loud fresh compile, never a
+    crash or a garbage executable.
+
 Everything here is deterministic: a hook fires at an exact sweep index /
 byte offset, never at random, so chaos-lane failures replay exactly.
 """
@@ -261,6 +271,64 @@ def poison_lane(lane: int, shots: int = 1):
 def consume_poison(lane: int) -> bool:
     """True when this lane's dispatch must poison its working set."""
     return _lane_consume("poison", lane) is not None
+
+
+# Armed SIGKILL: {"after": int} — decremented once per SERVED dispatch
+# (serve.SVDService consults `maybe_sigkill` right after a popped batch
+# is published in flight and journaled as dispatched); at zero the
+# process gets a REAL SIGKILL. No context manager: nothing survives to
+# restore state, which is the point.
+_sigkill_state: Optional[dict] = None
+
+
+def sigkill_at_dispatch(after: int = 1) -> None:
+    """Arm a SIGKILL to THIS process at its ``after``-th next served
+    dispatch — the process-loss twin of `kill_lane` (which kills one
+    worker THREAD and lets the fleet supervisor recover it; this kills
+    the whole process so nothing in-memory survives). Delivered after
+    the dispatch is journaled (`serve.journal`), so the durable state a
+    restarted service replays is exactly "this request was in flight
+    when the process died" — the restart-survivability lane's fixture.
+    SIGKILL cannot be caught, so only subprocess tests
+    (tests/test_restart.py) may arm this."""
+    global _sigkill_state
+    with _lock:
+        _sigkill_state = {"after": int(after)}
+
+
+def maybe_sigkill() -> None:
+    """Deliver the armed SIGKILL when its dispatch countdown hits zero.
+    A real `os.kill(..., SIGKILL)` — no handler, no cleanup, no final
+    snapshot: the process vanishes mid-serve, exactly what the journal
+    exists to survive."""
+    global _sigkill_state
+    with _lock:
+        st = _sigkill_state
+        if st is None:
+            return
+        st["after"] -= 1
+        if st["after"] > 0:
+            return
+        _sigkill_state = None
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def corrupt_compile_cache(cache_dir, mode: str = "flip") -> Path:
+    """Deterministically corrupt one persistent-compile-cache entry (the
+    largest executable file in ``cache_dir``, recursively — skipping the
+    registry's ``CACHE_MANIFEST.json`` identity file, which has its own
+    quarantine lane in `serve.registry.verify_cache`). Modes are
+    `corrupt_checkpoint`'s. The contract under test: JAX degrades a
+    corrupt cache ENTRY to a fresh compile with a loud warning — never a
+    crash, never a deserialized garbage executable. Returns the
+    corrupted path."""
+    cache_dir = Path(cache_dir)
+    entries = [p for p in cache_dir.rglob("*")
+               if p.is_file() and p.name != "CACHE_MANIFEST.json"]
+    if not entries:
+        raise ValueError(f"no cache entries under {cache_dir} to corrupt")
+    target = max(entries, key=lambda p: p.stat().st_size)
+    return corrupt_checkpoint(target, mode)
 
 
 @contextlib.contextmanager

@@ -22,7 +22,15 @@ The request-level robustness layer (PR 4) on top of the solve-level one
     work stealing, lane eviction into QUARANTINED on the declared
     sickness causes, dead-lane request rescue onto healthy lanes, and
     outcome-caused probe recovery — all reconstructable from ``"fleet"``
-    manifest records.
+    manifest records;
+  * restart survivability (`registry` + `journal`): ONE authoritative
+    entry registry of every compilable (lane, bucket, tier, variant)
+    jit entry, AOT ``lower().compile()`` warmup through a persistent
+    executable cache namespaced by config + tuning-table content hash
+    (a warm restart pays ZERO fresh compiles), a write-ahead fsync'd
+    request journal with exactly-once replay after SIGKILL
+    (`SVDService.recover`), and zero-downtime `SVDService.reload`
+    (background AOT warm, atomic swap) — README "Restart & cold start".
 
 Quickstart::
 
@@ -43,12 +51,17 @@ from __future__ import annotations
 from .breaker import BreakerState, Brownout, CircuitBreaker
 from .buckets import Bucket, BucketSet, as_bucket
 from .fleet import Fleet, Lane, LaneState
+from .journal import Journal
 from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
+from .registry import (CompileCounter, EntryKey, EntryRegistry,
+                       enable_persistent_cache, jit_entries)
 from .service import ServeConfig, ServeResult, SVDService, Ticket
 
 __all__ = [
     "AdmissionError", "AdmissionQueue", "AdmissionReason", "Bucket",
-    "BucketSet", "BreakerState", "Brownout", "CircuitBreaker", "Fleet",
+    "BucketSet", "BreakerState", "Brownout", "CircuitBreaker",
+    "CompileCounter", "EntryKey", "EntryRegistry", "Fleet", "Journal",
     "Lane", "LaneState", "Request", "ServeConfig", "ServeResult",
-    "SVDService", "Ticket", "as_bucket",
+    "SVDService", "Ticket", "as_bucket", "enable_persistent_cache",
+    "jit_entries",
 ]

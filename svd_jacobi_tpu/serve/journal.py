@@ -1,0 +1,232 @@
+"""Durable request journal — no admitted request is lost to a SIGKILL.
+
+A write-ahead JSONL log of the serving layer's request lifecycle,
+fsync'd per record (the `utils.checkpoint` durability discipline,
+applied per line via `obs.manifest.append_jsonl`):
+
+  * ``admit``    — written BEFORE the request is enqueued (write-ahead:
+    there is no window in which a client holds a ticket for a request
+    the journal has never heard of). Carries everything needed to
+    re-create the request in a fresh process: the oriented input matrix
+    (base64 + SHA-256), the compute flags, the deadline BUDGET and the
+    wall-clock admit time (monotonic clocks do not survive a restart —
+    the remaining budget is re-derived from wall time on replay).
+  * ``dispatch`` — the request was popped by a lane (diagnostic: a
+    dispatched-but-unfinalized request at replay was in flight when the
+    process died).
+  * ``finalize`` — the request reached a terminal status (served,
+    rejected at the queue, rescued, cancelled — every terminal path the
+    service has). Written right after the ticket's exactly-once
+    finalization wins.
+
+**Replay** (`Journal.replay`, driven by `SVDService.recover`): admits
+without a finalize are the journal's debt — each is re-admitted at the
+FRONT of its bucket's queue with its remaining deadline budget intact
+(an already-expired one finalizes DEADLINE loudly instead). Exactly-once
+across the restart boundary is the composition of (a) replay skipping
+finalized ids, (b) the journal REWRITE at recovery (the new journal
+holds exactly the re-admitted requests, attempt-bumped — a second crash
+replays only what is still owed), and (c) `Ticket._finalize_once` inside
+the process. A torn trailing record — the SIGKILL landed mid-append — is
+quarantined by the tolerant reader, never fatal.
+
+The journal is opt-in (``ServeConfig.journal_path``): journaling copies
+every input matrix to host and fsyncs per lifecycle event, a durability
+tax measured in the request path (PROFILE.md item 26).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import itertools
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional
+
+from ..obs.manifest import append_jsonl, read_jsonl_tolerant
+
+JOURNAL_VERSION = 1
+
+
+class JournalState(NamedTuple):
+    """One scan of the journal stream (see `Journal.scan`)."""
+
+    admits: Dict[str, dict]       # id -> latest admit record, admit order
+    dispatched: Dict[str, dict]   # id -> latest dispatch record
+    finalized: Dict[str, str]     # id -> terminal status
+    torn: int                     # quarantined unparseable lines
+
+    @property
+    def unfinalized(self) -> List[dict]:
+        """Admit records still owed a terminal status, in admit order."""
+        return [rec for rid, rec in self.admits.items()
+                if rid not in self.finalized]
+
+
+def _encode_array(a) -> dict:
+    import numpy as np
+    a = np.ascontiguousarray(np.asarray(a))
+    raw = a.tobytes()
+    return {
+        "shape": [int(d) for d in a.shape],
+        "dtype": str(a.dtype),
+        "data_b64": base64.b64encode(raw).decode("ascii"),
+        "data_sha256": hashlib.sha256(raw).hexdigest(),
+    }
+
+
+def decode_array(payload: dict):
+    """Rebuild (and integrity-check) a journaled input matrix. Raises
+    `ValueError` on a checksum mismatch — a corrupted payload must not be
+    silently solved as if it were the client's data."""
+    import numpy as np
+    raw = base64.b64decode(payload["data_b64"])
+    digest = hashlib.sha256(raw).hexdigest()
+    if digest != payload["data_sha256"]:
+        raise ValueError(
+            f"journaled input payload checksum mismatch "
+            f"({digest[:12]}... != {payload['data_sha256'][:12]}...)")
+    return np.frombuffer(raw, dtype=np.dtype(payload["dtype"])).reshape(
+        tuple(payload["shape"])).copy()
+
+
+class Journal:
+    """The write-ahead request journal of one `SVDService` (see module
+    docstring). Thread-SAFE: every append takes the journal's re-entrant
+    lock (and the low-level writer additionally serializes per path and
+    writes each record as one unbuffered line), so concurrent client and
+    worker appends always land whole-line; `rewrite` takes the same
+    lock, and `exclusive()` lets recovery make its scan-then-rewrite
+    compaction atomic against appends."""
+
+    def __init__(self, path):
+        import threading
+        self.path = Path(path)
+        self._seq = itertools.count()
+        # Re-entrant so `exclusive()` callers can still append inside
+        # the critical section; appends and the recovery rewrite all
+        # take it, making scan-then-rewrite atomic against concurrent
+        # lifecycle appends from worker/client threads (a record
+        # appended mid-compaction would otherwise be erased by the
+        # rewrite — a silent durability hole).
+        self._lock = threading.RLock()
+
+    def exclusive(self):
+        """The journal's own lock, for callers that must make a
+        read-modify-rewrite atomic against concurrent appends
+        (`SVDService.recover`'s scan + compaction)."""
+        return self._lock
+
+    # -- writers ------------------------------------------------------------
+
+    def append_admit(self, req, *, attempt: int = 1,
+                     admitted_wall: Optional[float] = None) -> None:
+        """Journal one admitted request — called BEFORE the queue admit
+        (write-ahead). ``admitted_wall`` preserves the ORIGINAL admit
+        time across recovery rewrites so deadline budgets keep decaying
+        from the client's real submit, not from each restart."""
+        rec = {
+            "journal_version": JOURNAL_VERSION,
+            "kind": "admit",
+            "seq": next(self._seq),
+            "id": req.id,
+            "t_wall": (time.time() if admitted_wall is None
+                       else float(admitted_wall)),
+            "attempt": int(attempt),
+            "m": int(req.m), "n": int(req.n),
+            "orig_shape": [int(d) for d in req.orig_shape],
+            "transposed": bool(req.transposed),
+            "bucket": req.bucket.name,
+            "compute_u": bool(req.compute_u),
+            "compute_v": bool(req.compute_v),
+            "degraded": bool(req.degraded),
+            "brownout": str(req.brownout),
+            "deadline_s": (None if req.deadline_s is None
+                           else float(req.deadline_s)),
+            "top_k": None if req.top_k is None else int(req.top_k),
+            "input": _encode_array(req.a),
+        }
+        with self._lock:
+            append_jsonl(self.path, rec)
+
+    def append_dispatch(self, request_id: str, *, lane: int,
+                        batch_id: Optional[str] = None) -> None:
+        with self._lock:
+            append_jsonl(self.path, {
+                "journal_version": JOURNAL_VERSION, "kind": "dispatch",
+                "seq": next(self._seq), "id": str(request_id),
+                "t_wall": time.time(), "lane": int(lane),
+                "batch_id": batch_id})
+
+    def append_finalize(self, request_id: str, status: str) -> None:
+        with self._lock:
+            append_jsonl(self.path, {
+                "journal_version": JOURNAL_VERSION, "kind": "finalize",
+                "seq": next(self._seq), "id": str(request_id),
+                "t_wall": time.time(), "status": str(status)})
+
+    # -- readers ------------------------------------------------------------
+
+    def scan(self, *, quarantine: bool = True) -> JournalState:
+        """Parse the stream (tolerant: torn lines are quarantined to
+        ``<path>.torn`` with a warning, everything parseable counts).
+        Pass ``quarantine=False`` when polling a LIVE journal (e.g. the
+        restart drill watching a serving child): a half-flushed
+        in-flight tail line is not a crash artifact and must not be
+        siphoned into the sidecar on every poll."""
+        admits: Dict[str, dict] = {}
+        dispatched: Dict[str, dict] = {}
+        finalized: Dict[str, str] = {}
+        torn = 0
+        if self.path.exists():
+            records, torn = read_jsonl_tolerant(self.path,
+                                                quarantine=quarantine)
+            for rec in records:
+                kind, rid = rec.get("kind"), rec.get("id")
+                if rid is None:
+                    continue
+                if kind == "admit":
+                    admits[rid] = rec
+                elif kind == "dispatch":
+                    dispatched[rid] = rec
+                elif kind == "finalize":
+                    finalized[rid] = str(rec.get("status"))
+        return JournalState(admits=admits, dispatched=dispatched,
+                            finalized=finalized, torn=torn)
+
+    def replay(self) -> List[dict]:
+        """The journal's debt: admit records with no finalize, in admit
+        order — exactly the requests a restarted service must re-admit."""
+        return self.scan().unfinalized
+
+    # -- recovery rewrite ---------------------------------------------------
+
+    def rewrite(self, admit_records: List[dict]) -> None:
+        """Atomically replace the journal with exactly ``admit_records``
+        (the re-admitted debt, attempt-bumped by the caller): temp file,
+        fsync, rename, directory fsync — the `utils.checkpoint` rename
+        discipline, so a crash mid-rewrite leaves either the old journal
+        or the new one, never a half-written hybrid. Resets the history
+        a second crash would otherwise replay twice."""
+        tmp = self.path.with_name(self.path.name + ".tmp")
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with self._lock:
+            with tmp.open("w") as f:
+                for rec in admit_records:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            try:
+                fd = os.open(str(self.path.parent), os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass  # some filesystems reject directory fsync; best-effort
+            # Fresh sequence numbers follow the rewritten prefix.
+            self._seq = itertools.count(len(admit_records))

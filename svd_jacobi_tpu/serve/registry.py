@@ -1,0 +1,515 @@
+"""Entry registry + AOT compilation + persistent executable cache.
+
+Before this module, THREE places independently approximated "the set of
+programs a serving process compiles": `SVDService.warmup()` walked its
+own (bucket, variant) x lane x tier loops, the analysis serve pass
+hand-listed stepper jits, and `config.RETRACE_BUDGETS` declared entry
+names nothing cross-checked. This module is the one authoritative
+enumeration, and everything else is refactored onto it:
+
+  * `jit_entries()` — the canonical ``entry name -> live jit object``
+    map (exactly the keys of `config.RETRACE_BUDGETS`;
+    `analysis.recompile_guard.default_entries` delegates here, and the
+    AOT001 analysis pass asserts the two sets are EQUAL in both
+    directions, so a new jit entry cannot ship unbudgeted and a stale
+    budget cannot linger undeclared).
+  * `EntryRegistry` — enumerates every compilable
+    ``(lane, bucket, tier, variant)`` serving entry of one service
+    configuration (`entries()`), and for each can produce the exact jit
+    call plan (`aot_plan`: ``(entry_name, jit_fn, ShapeDtypeStruct
+    args, static kwargs)`` tuples derived by the steppers' own
+    `aot_entries` via `jax.eval_shape` — no drift from the executed
+    programs) and compile it AHEAD OF TIME
+    (`aot_compile`: ``jit_fn.lower(*specs, **statics).compile()`` — no
+    sweep is ever executed). `SVDService.warmup()` drives both its AOT
+    phase and its zero-solve execution phase off this enumeration.
+  * **persistent executable cache** (`enable_persistent_cache`): JAX's
+    persistent compilation cache, pointed at a NAMESPACED subdirectory
+    keyed by the `obs.manifest.config_hash` content hash of the solver
+    configuration + the ACTIVE TUNING TABLE's content hash + the
+    jax/jaxlib/backend/device identity (`cache_namespace`). A tuning
+    table regeneration or config change therefore lands in a fresh
+    namespace — stale executables can never be served. Each namespace
+    carries a ``CACHE_MANIFEST.json``; a manifest that fails to parse
+    or disagrees with the expected identity means the directory was
+    corrupted or reused, and the whole namespace is QUARANTINED (renamed
+    aside) with a loud `RuntimeWarning` — fresh compilation, never a
+    crash, never a mismatched executable. Individual corrupt cache
+    ENTRIES are degraded by JAX itself to a fresh compile with a
+    warning (`jax._src.compiler._cache_read`), which
+    `resilience.chaos.corrupt_compile_cache` exists to prove.
+
+**Measuring cold starts.** In current JAX the
+``/jax/core/compile/backend_compile_duration`` monitoring event wraps
+``compile_or_get_cached`` — it fires on persistent-cache HITS too. The
+honest "fresh compilations" count is therefore ``backend_compiles -
+cache_hits`` (`CompileCounter.fresh`), which is what the restart
+acceptance asserts is ZERO on a warm cache and what the "coldstart"
+manifest record breaks down per entry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+from pathlib import Path
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from .buckets import Bucket, BucketSet
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+CACHE_MANIFEST_NAME = "CACHE_MANIFEST.json"
+
+
+def jit_entries() -> Dict[str, object]:
+    """The authoritative ``entry name -> live jit object`` map — one name
+    per `config.RETRACE_BUDGETS` key. `analysis.recompile_guard` resolves
+    its guard entries here and the AOT001 pass asserts exact two-way
+    coverage against the budgets, so this enumeration IS the declared
+    compile surface of the package."""
+    from .. import solver
+    from ..parallel import sharded
+    return {
+        # Fused one-shot entries (svd() / the escalation ladder).
+        "solver._svd_padded": solver._svd_padded,
+        "solver._svd_pallas": solver._svd_pallas,
+        "solver._svd_pallas_donated": solver._svd_pallas_donated,
+        "sharded._svd_sharded_jit": sharded._svd_sharded_jit,
+        # Host-stepped serving entries (SweepStepper).
+        "solver._precondition_qr_jit": solver._precondition_qr_jit,
+        "solver._sweep_step_pallas_jit": solver._sweep_step_pallas_jit,
+        "solver._finish_pallas_jit": solver._finish_pallas_jit,
+        "solver._nonfinite_probe_jit": solver._nonfinite_probe_jit,
+        "solver._sweep_step_jit": solver._sweep_step_jit,
+        "solver._finish_jit": solver._finish_jit,
+        # Batched (coalesced-dispatch) lane: fused + stepper entries.
+        "solver._svd_pallas_batched": solver._svd_pallas_batched,
+        "solver._svd_padded_batched": solver._svd_padded_batched,
+        "solver._precondition_qr_batched_jit":
+            solver._precondition_qr_batched_jit,
+        "solver._sweep_step_pallas_batched_jit":
+            solver._sweep_step_pallas_batched_jit,
+        "solver._sweep_step_xla_batched_jit":
+            solver._sweep_step_xla_batched_jit,
+        "solver._finish_pallas_batched_jit":
+            solver._finish_pallas_batched_jit,
+        "solver._finish_xla_batched_jit": solver._finish_xla_batched_jit,
+        "solver._nonfinite_probe_batched_jit":
+            solver._nonfinite_probe_batched_jit,
+        # Top-k / tall lane stage jits.
+        "solver._tsqr_jit": solver._tsqr_jit,
+        "solver._tsqr_batched_jit": solver._tsqr_batched_jit,
+        "solver._sketch_project_jit": solver._sketch_project_jit,
+        "solver._sketch_project_batched_jit":
+            solver._sketch_project_batched_jit,
+        "solver._lift_q_jit": solver._lift_q_jit,
+        "solver._lift_q_batched_jit": solver._lift_q_batched_jit,
+    }
+
+
+class CompileCounter:
+    """Context manager counting backend compile requests and
+    persistent-cache hits over its lifetime via JAX's monitoring stream.
+    ``fresh`` = compiles the cache did NOT serve (the cold-start cost);
+    see the module docstring for why the subtraction is needed."""
+
+    def __init__(self):
+        self.backend_compiles = 0
+        self.cache_hits = 0
+        self._on = False
+
+    @property
+    def fresh(self) -> int:
+        return max(0, self.backend_compiles - self.cache_hits)
+
+    def _on_duration(self, name: str, duration: float, **kw) -> None:
+        if self._on and name == _COMPILE_EVENT:
+            self.backend_compiles += 1
+
+    def _on_event(self, name: str, **kw) -> None:
+        if self._on and name == _CACHE_HIT_EVENT:
+            self.cache_hits += 1
+
+    def __enter__(self) -> "CompileCounter":
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(
+            self._on_duration)
+        jax.monitoring.register_event_listener(self._on_event)
+        self._on = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # Gate off first: if unregistration is unavailable (private jax
+        # API moved) the still-registered bound methods go inert instead
+        # of mutating an exited counter forever.
+        self._on = False
+        try:
+            from jax._src import monitoring as _m
+            _m._unregister_event_duration_listener_by_callback(
+                self._on_duration)
+            _m._unregister_event_listener_by_callback(self._on_event)
+        except Exception:
+            pass
+
+
+class EntryKey(NamedTuple):
+    """One compilable serving entry: the (lane, bucket, tier, variant)
+    coordinate of a distinct executable set. ``tier`` is None for the
+    single-dispatch lane and a static batch tier otherwise; the variant
+    is the compute-flag pair (the sigma-only brownout variant flips both
+    off — static jit arguments, hence a distinct compile)."""
+
+    lane: int
+    bucket: Bucket
+    tier: Optional[int]
+    compute_u: bool
+    compute_v: bool
+
+    @property
+    def name(self) -> str:
+        vec = "vec" if (self.compute_u or self.compute_v) else "novec"
+        tier = "" if self.tier is None else f"/t{self.tier}"
+        return f"l{self.lane}/{self.bucket.name}/{vec}{tier}"
+
+    @property
+    def device_free(self) -> "EntryKey":
+        """The lane-independent coordinate (AOT lowering carries no
+        device pinning, so one compile covers every lane's cache)."""
+        return self._replace(lane=0)
+
+
+class EntryRegistry:
+    """The authoritative enumeration of one service configuration's
+    compilable entries (see module docstring). Built either from a live
+    `SVDService` (`for_service`) or from the raw pieces — which is how
+    `SVDService.reload` pre-warms a NEW bucket set before swapping it
+    in, and how the AOT001 analysis pass enumerates without a service."""
+
+    def __init__(self, buckets: BucketSet, solver_map: dict,
+                 tiers_map: dict, base_solver, *, max_batch: int = 1,
+                 lanes: int = 1, default_tiers: Tuple[int, ...] = (1,)):
+        self.buckets = buckets
+        self._solver_map = dict(solver_map)
+        self._tiers_map = dict(tiers_map)
+        self._base = base_solver
+        self.max_batch = int(max_batch)
+        self.lanes = int(lanes)
+        self._default_tiers = tuple(default_tiers)
+        # Bucket affinity, mirroring fleet routing: declaration order
+        # (the BucketSet's cost-sorted order) modulo lane count.
+        self._home = {b: i % self.lanes for i, b in enumerate(buckets)}
+
+    @classmethod
+    def for_service(cls, service) -> "EntryRegistry":
+        cfg = service.config
+        return cls(service.buckets, service._bucket_solver,
+                   service._bucket_tiers, cfg.solver,
+                   max_batch=cfg.max_batch, lanes=cfg.lanes,
+                   default_tiers=service._tiers)
+
+    # -- enumeration --------------------------------------------------------
+
+    def home(self, bucket: Bucket) -> int:
+        return self._home.get(bucket, 0)
+
+    def solver_for(self, bucket: Bucket):
+        return self._solver_map.get(bucket, self._base)
+
+    def tiers_for(self, bucket: Bucket) -> Tuple[int, ...]:
+        return tuple(self._tiers_map.get(bucket, self._default_tiers))
+
+    def reachable_tiers(self, bucket: Bucket) -> Tuple[int, ...]:
+        """The batch tiers a coalesced dispatch of this bucket can snap
+        to under ``max_batch`` (each is a distinct compile)."""
+        if self.max_batch <= 1:
+            return ()
+        tiers = self.tiers_for(bucket)
+        cap = min(self.max_batch, tiers[-1])
+        return tuple(sorted({min(t for t in tiers if t >= c)
+                             for c in range(2, cap + 1)}))
+
+    def entries(self, *, sigma_only: bool = True) -> Tuple[EntryKey, ...]:
+        """Deterministic enumeration of every compilable entry, in
+        warmup dispatch order: home-lane single dispatches first (the
+        submit-path warm lane), then sibling lanes, then the batched
+        tiers — per bucket, per compute variant (full factors plus the
+        sigma-only brownout variant unless ``sigma_only=False``)."""
+        variants = [(True, True)] + ([(False, False)] if sigma_only
+                                     else [])
+        out: List[EntryKey] = []
+        for b in self.buckets:
+            for cu, cv in variants:
+                out.append(EntryKey(self.home(b), b, None, cu, cv))
+        if self.lanes > 1:
+            for lane in range(self.lanes):
+                for b in self.buckets:
+                    if lane == self.home(b):
+                        continue
+                    for cu, cv in variants:
+                        out.append(EntryKey(lane, b, None, cu, cv))
+        if self.max_batch > 1:
+            for lane in range(self.lanes):
+                for b in self.buckets:
+                    for cu, cv in variants:
+                        for tier in self.reachable_tiers(b):
+                            out.append(EntryKey(lane, b, tier, cu, cv))
+        return tuple(out)
+
+    # -- the AOT compile plan ----------------------------------------------
+
+    def aot_plan(self, key: EntryKey) -> List[tuple]:
+        """The exact jit call plan of one entry: ``(entry_name, jit_fn,
+        args, kwargs)`` with `jax.ShapeDtypeStruct` args, covering the
+        bucket family's pre-stage (TSQR / sketch), the core stepper's
+        whole loop (via `SweepStepper.aot_entries` /
+        `BatchedSweepStepper.aot_entries`), and the factor lift — every
+        program the live dispatch path will request, none it won't.
+        Nothing is executed; shapes come from `jax.eval_shape` over the
+        live helpers."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from .. import solver
+        b = key.bucket
+        scfg = self.solver_for(b)
+        batched = key.tier is not None
+        # Mirror service._core_flags: the top-k lane solves B^T, whose
+        # left factor is A's right one — the flags swap.
+        ccu, ccv = ((key.compute_v, key.compute_u) if b.kind == "topk"
+                    else (key.compute_u, key.compute_v))
+        dtype = jnp.dtype(b.dtype)
+        shape = (b.m, b.n) if not batched else (key.tier, b.m, b.n)
+        a_spec = jax.ShapeDtypeStruct(shape, dtype)
+        plan: List[tuple] = []
+        lift_q_spec = None
+        if b.kind == "tall":
+            fn = (solver._tsqr_batched_jit if batched else solver._tsqr_jit)
+            name = ("solver._tsqr_batched_jit" if batched
+                    else "solver._tsqr_jit")
+            kwargs = dict(chunk=scfg.tsqr_chunk)
+            plan.append((name, fn, (a_spec,), kwargs))
+            q_s, r_s, _ = jax.eval_shape(
+                functools.partial(fn, **kwargs), a_spec)
+            core_spec, lift_q_spec = r_s, q_s
+        elif b.kind == "topk":
+            l = min(b.k + int(scfg.oversample), b.n)
+            fn = (solver._sketch_project_batched_jit if batched
+                  else solver._sketch_project_jit)
+            name = ("solver._sketch_project_batched_jit" if batched
+                    else "solver._sketch_project_jit")
+            kwargs = dict(l=l, power_iters=int(scfg.power_iters),
+                          chunk=scfg.tsqr_chunk, seed=0)
+            plan.append((name, fn, (a_spec,), kwargs))
+            q_s, bt_s, _ = jax.eval_shape(
+                functools.partial(fn, **kwargs), a_spec)
+            core_spec, lift_q_spec = bt_s, q_s
+        else:
+            core_spec = a_spec
+        # The core stepper: constructed on a zeros array of the CORE
+        # shape (post pre-stage) — construction resolves every static
+        # exactly as the live dispatch does and costs one allocation,
+        # no compile, no sweep.
+        zeros = jnp.zeros(core_spec.shape, core_spec.dtype)
+        cls = (solver.BatchedSweepStepper if batched
+               else solver.SweepStepper)
+        st = cls(zeros, compute_u=ccu, compute_v=ccv, config=scfg)
+        stepper_plan = list(st.aot_entries())
+        plan += stepper_plan
+        if b.kind in ("tall", "topk") and key.compute_u:
+            # The factor lift (service._post_core): U = Q @ Z. Z's spec
+            # comes from the finish entry's abstract result — tall lifts
+            # the core's U, top-k the core's V truncated to the bucket's
+            # rank class.
+            fin_name, fin_fn, fin_args, fin_kwargs = stepper_plan[-2]
+            u_s, s_s, v_s = jax.eval_shape(
+                functools.partial(fin_fn, **fin_kwargs), *fin_args)
+            z_s = u_s if b.kind == "tall" else v_s
+            if z_s is not None:
+                if b.kind == "topk":
+                    z_s = jax.ShapeDtypeStruct(
+                        z_s.shape[:-1] + (b.k,), z_s.dtype)
+                lf = (solver._lift_q_batched_jit if batched
+                      else solver._lift_q_jit)
+                lname = ("solver._lift_q_batched_jit" if batched
+                         else "solver._lift_q_jit")
+                plan.append((lname, lf, (lift_q_spec, z_s), {}))
+        return plan
+
+    def aot_compile(self, key: EntryKey) -> dict:
+        """Ahead-of-time compile one entry's whole plan via
+        ``jit_fn.lower(*specs, **statics).compile()`` — populating (or
+        hitting) the persistent compilation cache without executing a
+        sweep. Returns the per-entry coldstart stats the "coldstart"
+        manifest record carries."""
+        t0 = time.perf_counter()
+        names = []
+        with CompileCounter() as cc:
+            for name, fn, args, kwargs in self.aot_plan(key):
+                fn.lower(*args, **kwargs).compile()
+                names.append(name)
+        dt = time.perf_counter() - t0
+        return {"entry": key.name, "jits": names,
+                "time_s": float(dt),
+                "backend_compiles": int(cc.backend_compiles),
+                "cache_hits": int(cc.cache_hits),
+                "fresh_compiles": int(cc.fresh),
+                "cache_hit": cc.fresh == 0}
+
+    def aot_warm(self, *, sigma_only: bool = True,
+                 progress: Optional[Callable[[dict], None]] = None
+                 ) -> List[dict]:
+        """AOT-compile every enumerated entry, deduplicating the
+        lane axis (the lowered executables carry no device pinning, so
+        one compile per (bucket, tier, variant) covers the fleet).
+        Returns the per-entry stats list for the coldstart record."""
+        seen = set()
+        out = []
+        for key in self.entries(sigma_only=sigma_only):
+            if key.device_free in seen:
+                continue
+            seen.add(key.device_free)
+            info = self.aot_compile(key)
+            out.append(info)
+            if progress is not None:
+                progress(info)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Persistent executable cache management.
+
+
+def cache_namespace(base_solver, *, buckets=None) -> Tuple[str, dict]:
+    """The cache namespace of one solver configuration: the
+    `obs.manifest.config_hash` content hash over the base solver config,
+    the ACTIVE tuning table's id + content hash (a table regeneration
+    must invalidate — resolved knobs are static jit args), and the
+    jax/jaxlib/backend/device identity. Returns ``(hash16, meta)`` with
+    ``meta`` the full identity dict written to ``CACHE_MANIFEST.json``.
+    The bucket SET is deliberately excluded: adding a bucket adds
+    executables, it does not invalidate existing ones (so
+    `SVDService.reload` keeps its warm cache)."""
+    import dataclasses
+
+    import jax
+    import jaxlib
+
+    from ..obs import manifest as _manifest
+    from ..tune import tables as _tables
+    del buckets  # documented exclusion; accepted for call-site symmetry
+    table = _tables.active_table()
+    devices = jax.devices()
+    meta = {
+        "solver_config": {
+            k: (v if v is None or isinstance(v, (bool, int, float, str))
+                else str(v))
+            for k, v in dataclasses.asdict(base_solver).items()},
+        "table_id": table.table_id,
+        "table_sha256": table.sha256,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": devices[0].platform if devices else "unknown",
+        "device_kind": devices[0].device_kind if devices else "unknown",
+    }
+    meta["config_sha256"] = _manifest.config_hash(meta)
+    return meta["config_sha256"][:16], meta
+
+
+def _fsync_write(path: Path, data: str) -> None:
+    with path.open("w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def verify_cache(ns_dir, meta: dict) -> bool:
+    """Validate a namespace directory's ``CACHE_MANIFEST.json`` against
+    the expected identity. A missing directory or manifest is simply a
+    cold cache (True). A manifest that fails to parse, or that declares
+    a DIFFERENT identity than the hash-named directory it lives in, means
+    the cache was corrupted or reused across configs: the whole namespace
+    is quarantined (renamed aside, never deleted) with a loud
+    `RuntimeWarning`, and the caller starts a fresh one — fall back to
+    compilation, never crash, never serve a mismatched executable.
+    Returns False when the namespace was quarantined."""
+    ns_dir = Path(ns_dir)
+    mf = ns_dir / CACHE_MANIFEST_NAME
+    if not ns_dir.exists() or not mf.exists():
+        return True
+    problem = None
+    try:
+        found = json.loads(mf.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        problem = f"manifest unreadable ({e})"
+        found = None
+    if found is not None and found.get("config_sha256") != \
+            meta.get("config_sha256"):
+        problem = (f"manifest identity "
+                   f"{str(found.get('config_sha256'))[:12]}... != expected "
+                   f"{str(meta.get('config_sha256'))[:12]}...")
+    if problem is None:
+        return True
+    quarantine = ns_dir.with_name(
+        ns_dir.name + f".quarantined-{os.getpid()}-{int(time.time())}")
+    try:
+        ns_dir.rename(quarantine)
+    except OSError:
+        quarantine = "(rename failed; left in place)"
+    warnings.warn(
+        f"persistent compile cache {ns_dir} is stale or corrupt "
+        f"({problem}); quarantined to {quarantine} and falling back to "
+        f"fresh compilation", RuntimeWarning, stacklevel=2)
+    return False
+
+
+def enable_persistent_cache(cache_dir, base_solver) -> Tuple[Path, dict]:
+    """Point JAX's persistent compilation cache at the namespaced
+    subdirectory of ``cache_dir`` for this configuration (see
+    `cache_namespace`), with the min-compile-time/min-entry-size gates
+    opened so every serving executable is cached (the defaults skip
+    sub-second compiles — most of a CPU warmup). Verifies (and if needed
+    quarantines) the namespace first, writes its manifest, and resets
+    JAX's in-process cache handle so the new directory takes effect
+    immediately. Returns ``(namespace_path, identity_meta)`` — the meta
+    is the one actually enabled (callers record its ``config_sha256``
+    rather than re-deriving, which could race a table change)."""
+    import jax
+    ns, meta = cache_namespace(base_solver)
+    ns_dir = Path(cache_dir) / ns
+    verify_cache(ns_dir, meta)
+    ns_dir.mkdir(parents=True, exist_ok=True)
+    mf = ns_dir / CACHE_MANIFEST_NAME
+    if not mf.exists():
+        _fsync_write(mf, json.dumps(meta, indent=2, sort_keys=True) + "\n")
+    # The compilation-cache dir is PROCESS-GLOBAL jax state: enabling a
+    # second namespace re-points every already-constructed service's
+    # future AOT compiles at THIS directory, so their warm restarts
+    # would find their own namespace empty. There is no per-service
+    # scope to offer — detect the hijack and say so loudly.
+    prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if prev not in (None, "", str(ns_dir)):
+        warnings.warn(
+            f"persistent compile cache re-pointed from {prev!r} to "
+            f"{str(ns_dir)!r}: the jax compilation-cache dir is "
+            "process-global, so executables of any service still using "
+            "the previous namespace will now land here and its warm "
+            "restart will pay fresh compiles. Run one cache-enabled "
+            "SVDService per process.", RuntimeWarning, stacklevel=2)
+    jax.config.update("jax_compilation_cache_dir", str(ns_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # knob absent on this jax; size gating stays default
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass  # private API moved; the dir applies from first init instead
+    return ns_dir, meta

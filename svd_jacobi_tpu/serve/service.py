@@ -30,6 +30,15 @@ layer on top of PR 3's solve-level one:
     service history reconstructs from the same manifest stream the rest
     of the tooling reads; `healthz`/`ready` expose live probes.
 
+  * **restart survivability** (`registry` + `journal`): every
+    compilable (lane, bucket, tier, variant) jit entry is enumerated by
+    ONE registry that `warmup` AOT-compiles through a persistent
+    executable cache (a restarted process warms from cache hits — zero
+    fresh compiles), and with a journal configured every admitted
+    request is write-ahead logged so `recover()` re-admits a killed
+    process's unfinalized requests exactly-once; `reload()` swaps in a
+    new bucket set with zero downtime (background AOT warm).
+
 With ``lanes == 1`` (the default) the worker is a single thread: the
 device executes one solve at a time anyway, and a serial worker makes
 every breaker/brownout transition deterministic. With ``lanes > 1`` the
@@ -46,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import re
 import sys
 import threading
 import time
@@ -142,6 +152,26 @@ class ServeConfig:
     # keeps them in memory only (`SVDService.records`).
     manifest_path: Optional[str] = None
     max_records: int = 1024
+    # --- restart survivability (serve.registry / serve.journal) ----------
+    # Durable request journal: a write-ahead JSONL log (fsync per record)
+    # of admit/dispatch/finalize events — every admitted request is
+    # journaled BEFORE it is enqueued and marked at finalize, so a
+    # SIGKILL'd process re-admits its unfinalized requests on restart
+    # (`SVDService.recover`) at queue front with deadline budgets intact.
+    # None disables (no durability promise). Journaling copies each input
+    # to host and fsyncs per lifecycle event — a measured durability tax
+    # (PROFILE.md item 26).
+    journal_path: Optional[str] = None
+    # Root directory of the persistent executable cache: warmup's AOT
+    # compiles land in ``<dir>/<config-hash>/`` via JAX's persistent
+    # compilation cache (`registry.enable_persistent_cache`; the
+    # namespace hash covers the solver config, the ACTIVE tuning table's
+    # content hash, and the jax/backend identity — a table regeneration
+    # or config change can never serve a stale executable), so a
+    # restarted worker's warmup is cache hits instead of fresh compiles.
+    # None disables the cache (and AOT warmup by default; see
+    # ``SVDService.warmup(aot=...)``).
+    compile_cache_dir: Optional[str] = None
     # --- request coalescing (the micro-batched solve lane) ---
     # Up to ``max_batch`` same-bucket requests are popped per dispatch and
     # solved as ONE batched solve (`solver.BatchedSweepStepper`): the
@@ -231,24 +261,14 @@ class SVDService:
         if config.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got "
                              f"{config.max_batch}")
-        self.buckets = BucketSet(config.buckets)
         # Tuning-table resolution, ONCE per bucket at declaration: every
         # dispatch path (all lanes — they inherit this map) reads the
         # per-bucket resolved solver config instead of re-resolving per
         # request, and `batch_tiers="auto"` takes each bucket's measured
-        # tier set from the same table.
-        self._bucket_solver = self.buckets.resolve_solver_configs(
-            config.solver)
-        if config.batch_tiers == "auto":
-            self._bucket_tiers = self.buckets.resolved_batch_tiers()
-            tiers = tuple(sorted(set(
-                t for ts in self._bucket_tiers.values() for t in ts)))
-        else:
-            tiers = tuple(sorted(set(int(t) for t in config.batch_tiers)))
-            self._bucket_tiers = {b: tiers for b in self.buckets}
-        if not tiers or tiers[0] < 1:
-            raise ValueError(f"batch_tiers must be a non-empty set of "
-                             f"positive ints, got {config.batch_tiers!r}")
+        # tier set from the same table. Factored out so `reload` can
+        # resolve a NEW bucket set identically before the atomic swap.
+        (self.buckets, self._bucket_solver, self._bucket_tiers,
+         tiers) = self._resolve_bucket_maps(config)
         if config.batch_window_s < 0:
             raise ValueError("batch_window_s must be >= 0")
         if config.lanes < 1:
@@ -275,6 +295,47 @@ class SVDService:
         # the queues, breakers, worker threads, and — in fleet mode —
         # the supervisor. Built last: it reads config/buckets above.
         self.fleet = Fleet(self)
+        # The entry registry: the ONE authoritative enumeration of every
+        # compilable (lane, bucket, tier, variant) jit entry — warmup
+        # (both its AOT and zero-solve phases), reload's pre-warm, and
+        # the AOT001 analysis pass all walk this instead of private
+        # approximations (serve.registry module docstring).
+        from .registry import EntryRegistry
+        self.registry = EntryRegistry.for_service(self)
+        self._cache_ns = None
+        self._cache_hash: Optional[str] = None
+        if config.compile_cache_dir is not None:
+            from . import registry as _registry
+            self._cache_ns, meta = _registry.enable_persistent_cache(
+                config.compile_cache_dir, config.solver)
+            self._cache_hash = meta["config_sha256"]
+        # Durable request journal (write-ahead; see `recover`).
+        from .journal import Journal
+        self.journal = (Journal(config.journal_path)
+                        if config.journal_path is not None else None)
+        # request_id -> Ticket of journal-recovered requests (`recover`).
+        self.recovered: dict = {}
+        self._last_reload_error: Optional[str] = None
+
+    @staticmethod
+    def _resolve_bucket_maps(config: ServeConfig):
+        """Declaration-time bucket resolution: the bucket set, its
+        per-bucket tuning-table-resolved solver configs, and the
+        coalescing tier maps — shared by `__init__` and `reload` so a
+        reloaded bucket set resolves exactly like a declared one."""
+        buckets = BucketSet(config.buckets)
+        bucket_solver = buckets.resolve_solver_configs(config.solver)
+        if config.batch_tiers == "auto":
+            bucket_tiers = buckets.resolved_batch_tiers()
+            tiers = tuple(sorted(set(
+                t for ts in bucket_tiers.values() for t in ts)))
+        else:
+            tiers = tuple(sorted(set(int(t) for t in config.batch_tiers)))
+            bucket_tiers = {b: tiers for b in buckets}
+        if not tiers or tiers[0] < 1:
+            raise ValueError(f"batch_tiers must be a non-empty set of "
+                             f"positive ints, got {config.batch_tiers!r}")
+        return buckets, bucket_solver, bucket_tiers, tiers
 
     # -- lane-0 views (the whole service when lanes == 1) -------------------
 
@@ -385,32 +446,84 @@ class SVDService:
                                lane=lane.index)
 
     def warmup(self, *, sigma_only: bool = True,
-               timeout: float = 600.0) -> None:
-        """Compile every bucket's solve variants before real traffic: one
-        zeros solve per bucket and (default) per compute variant. Zeros
-        deflate immediately — the solve itself is one sweep — so the cost
-        is essentially the compiles. This matters for the SIGMA_ONLY
-        brownout: its compute flags are STATIC jit arguments, so without
-        warmup the first degraded dispatch per bucket pays a fresh
-        compile mid-overload, exactly when the worker can least afford
-        it. Call after `start()`; the warmup requests flow through the
-        normal path and appear in the manifest like any other. Raises
-        RuntimeError on any non-OK warmup outcome — a warmup that
+               timeout: float = 600.0,
+               aot: Optional[bool] = None) -> None:
+        """Compile every registry entry before real traffic, in (up to)
+        two phases driven by the ONE authoritative enumeration
+        (`self.registry.entries()` — every (lane, bucket, tier, variant)
+        the dispatch paths can request):
+
+          1. **AOT** (default iff ``compile_cache_dir`` is set, override
+             with ``aot=``): each entry's whole jit plan is compiled via
+             ``jit.lower(specs).compile()`` — no sweep executes — which
+             populates (or, on a restart, HITS) the persistent
+             executable cache. Per-entry compile-vs-cache-hit timing is
+             appended as ONE schema-versioned ``"coldstart"`` manifest
+             record, so every restart's cold-start cost is measurable
+             from the stream; an entry already in the persistent cache
+             costs a deserialization, not a compile — that IS the skip.
+          2. **Execution**: one zeros solve per entry through the normal
+             dispatch paths (zeros deflate immediately — the solve is
+             one sweep), so the live per-lane jit caches are warm too.
+             After phase 1 these solves' compile requests are served by
+             the persistent cache.
+
+        The sigma-only variants matter for the SIGMA_ONLY brownout: its
+        compute flags are STATIC jit arguments, so without warmup the
+        first degraded dispatch per bucket pays a fresh compile
+        mid-overload, exactly when the worker can least afford it. Call
+        after `start()`; the home-lane warmup requests flow through the
+        normal submit path and appear in the manifest like any other.
+        Raises RuntimeError on any non-OK warmup outcome — a warmup that
         silently failed would mean serving real traffic uncompiled (and,
         worse, with warmup failures already counted into the breaker)."""
+        from . import registry as _registry
+        if aot is None:
+            aot = self.config.compile_cache_dir is not None
+        t_start = time.perf_counter()
+        entry_infos: list = []
+        with _registry.CompileCounter() as cc:
+            aot_s = 0.0
+            if aot:
+                t0 = time.perf_counter()
+                entry_infos = self.registry.aot_warm(sigma_only=sigma_only)
+                aot_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            self._exec_warm(sigma_only=sigma_only, timeout=timeout)
+            exec_s = time.perf_counter() - t0
+        if aot:
+            from .. import obs
+            self._store(obs.manifest.build_coldstart(
+                entries=entry_infos,
+                total_s=time.perf_counter() - t_start,
+                backend_compiles=cc.backend_compiles,
+                cache_hits=cc.cache_hits, fresh_compiles=cc.fresh,
+                cache_dir=(None if self._cache_ns is None
+                           else str(self._cache_ns)),
+                config_sha256=self._cache_hash,
+                aot_s=float(aot_s), exec_s=float(exec_s),
+                lanes=self.fleet.size))
+
+    def _exec_warm(self, *, sigma_only: bool, timeout: float) -> None:
+        """Warmup phase 2: one zeros solve per registry entry. Home-lane
+        single dispatches go through the normal submit path (sequential
+        — a burst of warmup submits would raise the queue fill into the
+        brownout rungs and get the full-SVD variant degraded before it
+        ever compiled; deadline_s=inf overrides any default_deadline_s
+        and is exempt from the budget cap, so neither can expire or
+        refuse the compile warmup exists to front-load). Sibling-lane
+        and batched-tier entries use direct zero solves pinned to their
+        lane (a deterministic tier-T dispatch cannot be forced through
+        the admission queue without racing the batching window) — so the
+        first affinity move, steal, rescue, or coalesced dispatch is not
+        a compile stall mid-traffic."""
         import jax.numpy as jnp
+        import numpy as _np
+
         from ..solver import SolveStatus
-        variants = [(True, True)] + ([(False, False)] if sigma_only else [])
-        # Sequential (one in flight at a time): a burst of warmup submits
-        # would itself raise the queue fill into the brownout rungs and
-        # get the full-SVD variant degraded to sigma-only before it ever
-        # compiled. deadline_s=inf: NO deadline, overriding any
-        # default_deadline_s and exempt from the budget cap — neither a
-        # short default nor a small max_deadline_budget_s may be allowed
-        # to expire or refuse the compile warmup exists to front-load
-        # (client-side `result(timeout)` still bounds the wait).
-        for b in self.buckets:
-            for cu, cv in variants:
+        for key in self.registry.entries(sigma_only=sigma_only):
+            b, cu, cv = key.bucket, key.compute_u, key.compute_v
+            if key.tier is None and key.lane == self.registry.home(b):
                 rid = f"warmup-{b.name}-{'vec' if cu else 'novec'}"
                 res = self.submit(jnp.zeros((b.m, b.n), jnp.dtype(b.dtype)),
                                   compute_u=cu, compute_v=cv,
@@ -431,52 +544,300 @@ class SVDService:
                         f"variant (status={status}, degraded="
                         f"{res.degraded}, path={res.path}, breaker now "
                         f"{self.breaker.state().value})")
-        # Fleet mode: affinity routed each bucket's warmup submit to its
-        # HOME lane only — also pre-compile every (bucket, variant)
-        # against every OTHER lane's device (direct zero solves, like
-        # the batched warmup below), so the first affinity move, steal,
-        # or rescue onto a sibling lane is not a compile stall in the
-        # middle of a failover.
-        if self.fleet.size > 1:
-            for lane in self.fleet.lanes:
-                for b in self.buckets:
-                    for cu, cv in variants:
-                        res = self._direct_zero_solve(lane, b, cu, cv)
-                        if res.status_enum() is not SolveStatus.OK:
-                            raise RuntimeError(
-                                f"fleet warmup (lane {lane.index}, "
-                                f"bucket {b.name}, vec={cu}/{cv}) did "
-                                f"not solve OK: "
-                                f"{res.status_enum().name}")
-        # Batched tiers: pre-compile every (bucket, tier, variant) the
-        # coalescing worker can dispatch — incl. the sigma-only brownout
-        # variants — so the FIRST coalesced dispatch is not a compile
-        # stall mid-traffic. Direct zero-stack solves (a deterministic
-        # tier-T dispatch cannot be forced through the admission queue
-        # without racing the batching window); all-zero members deflate in
-        # one sweep, so the cost is the compiles. In fleet mode, once per
-        # LANE (each lane runs its own per-device executables).
-        if self.config.max_batch > 1:
-            import numpy as _np
-            for lane in self.fleet.lanes:
-                for b in self.buckets:
-                    tiers = self._tiers_for(b)
-                    cap = min(self.config.max_batch, tiers[-1])
-                    reachable = sorted({min(t for t in tiers if t >= c)
-                                        for c in range(2, cap + 1)})
-                    for cu, cv in variants:
-                        for tier in reachable:
-                            res = self._direct_zero_solve(lane, b, cu, cv,
-                                                          batch=tier)
-                            codes = [int(c)
-                                     for c in _np.asarray(res.status)]
-                            if any(c != int(SolveStatus.OK)
-                                   for c in codes):
-                                raise RuntimeError(
-                                    f"batched warmup (lane "
-                                    f"{lane.index}, bucket {b.name}, "
-                                    f"tier {tier}, vec={cu}/{cv}) did "
-                                    f"not solve OK: statuses {codes}")
+            elif key.tier is None:
+                lane = self.fleet.lanes[key.lane]
+                res = self._direct_zero_solve(lane, b, cu, cv)
+                if res.status_enum() is not SolveStatus.OK:
+                    raise RuntimeError(
+                        f"fleet warmup (lane {lane.index}, bucket "
+                        f"{b.name}, vec={cu}/{cv}) did not solve OK: "
+                        f"{res.status_enum().name}")
+            else:
+                lane = self.fleet.lanes[key.lane]
+                res = self._direct_zero_solve(lane, b, cu, cv,
+                                              batch=key.tier)
+                codes = [int(c) for c in _np.asarray(res.status)]
+                if any(c != int(SolveStatus.OK) for c in codes):
+                    raise RuntimeError(
+                        f"batched warmup (lane {lane.index}, bucket "
+                        f"{b.name}, tier {key.tier}, vec={cu}/{cv}) did "
+                        f"not solve OK: statuses {codes}")
+
+    # -- restart survivability ---------------------------------------------
+
+    def recover(self) -> dict:
+        """Replay the durable request journal of a PREVIOUS process: every
+        journaled-but-unfinalized request is re-admitted at the FRONT of
+        its bucket's lane queue (it already waited its turn before the
+        crash) with its remaining wall-clock deadline budget intact —
+        a request whose deadline already expired finalizes DEADLINE
+        loudly instead, a corrupt payload or unroutable bucket ERROR,
+        never a silent drop. Exactly-once across the restart: replay
+        skips finalized ids, the journal is atomically REWRITTEN to hold
+        exactly the re-admitted debt (attempt-bumped, original admit
+        times preserved so budgets keep decaying from the client's real
+        submit), and in-process double finalization is already
+        `Ticket._finalize_once`'s guarantee. Returns (and stores in
+        ``self.recovered``) ``{request_id: Ticket}`` — the restarted
+        process serves these like any other request. Call between
+        construction and first traffic (before or right after
+        `start()`)."""
+        if self.journal is None:
+            raise ValueError("recover() requires ServeConfig.journal_path")
+        from .journal import decode_array
+        tickets: dict = {}
+        queued: list = []     # (lane, req, admit_record) in admit order
+        terminal: list = []   # (ticket, rec, status, error) — applied last
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        # Scan + compaction are ATOMIC against concurrent appends (the
+        # journal's own lock): a request finalized or submitted while we
+        # compact would otherwise have its fsync'd record erased by the
+        # rewrite — a silent durability hole. Requeueing happens only
+        # AFTER the compacted journal is on disk, so no recovered
+        # request can finalize before its admit record is settled.
+        with self.journal.exclusive():
+            state = self.journal.scan()
+            # Auto request-ids count from 0 in EVERY process; the journal
+            # (and the manifest) key by id, so a fresh process reusing a
+            # journaled id would fold two distinct requests into one
+            # exactly-once slot — a finalize of the new one erases the
+            # recovered one's debt. Advance the counter past every id the
+            # dead process minted (finalized ones included: their serve
+            # records persist even after compaction drops their admits).
+            auto = [int(m.group(1)) for m in
+                    (re.match(r"^r(\d+)$", rid) for rid in state.admits)
+                    if m is not None]
+            if auto:
+                self._seq = itertools.count(max(auto) + 1)
+            debt = state.unfinalized
+            for rec in debt:
+                rid = rec["id"]
+                ticket = Ticket(rid)
+                tickets[rid] = ticket
+                deadline_s = rec.get("deadline_s")
+                try:
+                    a = decode_array(rec["input"])
+                except Exception as e:
+                    terminal.append((ticket, rec, "ERROR",
+                                     f"journal payload: {e}"))
+                    continue
+                if deadline_s is not None:
+                    remaining = rec["t_wall"] + float(deadline_s) - now_wall
+                    if remaining <= 0:
+                        # The promise expired with the dead process —
+                        # honor the budget, loudly, without a sweep.
+                        terminal.append((ticket, rec, "DEADLINE", None))
+                        continue
+                bucket = self.buckets.route(rec["m"], rec["n"],
+                                            str(a.dtype),
+                                            top_k=rec.get("top_k"))
+                if bucket is None:
+                    terminal.append((
+                        ticket, rec, "ERROR",
+                        f"journaled bucket {rec.get('bucket')} no "
+                        f"longer routable in this configuration"))
+                    continue
+                req = Request(
+                    id=rid, a=a, m=int(rec["m"]), n=int(rec["n"]),
+                    orig_shape=tuple(rec["orig_shape"]),
+                    transposed=bool(rec["transposed"]), bucket=bucket,
+                    compute_u=bool(rec["compute_u"]),
+                    compute_v=bool(rec["compute_v"]),
+                    degraded=bool(rec.get("degraded", False)),
+                    brownout=str(rec.get("brownout", "FULL")),
+                    deadline=(None if deadline_s is None
+                              else now_mono + remaining),
+                    deadline_s=deadline_s, submitted=now_mono,
+                    cancel=ticket._cancel, ticket=ticket,
+                    top_k=rec.get("top_k"), rank_mode=bucket.kind)
+                try:
+                    lane = self.fleet.route(bucket)
+                except AdmissionError as e:
+                    terminal.append((ticket, rec, "ERROR", e.detail))
+                    continue
+                queued.append((lane, req, rec))
+            # Terminalize the expired/corrupt/unroutable debt BEFORE the
+            # rewrite erases its admit records: each gets its finalize
+            # (and serve manifest record) on disk first, so a crash at
+            # any point leaves either admit+finalize (not replayed) or
+            # no trace at all — never an admit silently dropped without
+            # its terminal record (the re-entrant journal lock admits
+            # the nested finalize appends).
+            for ticket, rec, status, error in terminal:
+                self._recover_terminal(ticket, rec, status, error=error)
+            # Compact to exactly the re-admitted debt (attempt-bumped,
+            # original admit times kept): a second crash replays only
+            # what is still owed, finalized history is gone.
+            self.journal.rewrite([
+                {**rec, "attempt": int(rec.get("attempt", 1)) + 1,
+                 "seq": i}
+                for i, (_, _, rec) in enumerate(queued)])
+        # Requeue in REVERSE admit order: each lands at the queue FRONT,
+        # so the oldest journaled request ends up first — recovered FIFO.
+        # A refused requeue (queue already closed) finalizes loudly; its
+        # compacted admit record pairs with the finalize, so it is not
+        # replayed again either.
+        for lane, req, rec in reversed(queued):
+            if not lane.queue.requeue(req):
+                self._recover_terminal(req.ticket, rec, "CANCELLED")
+        survivors = [rec for _, _, rec in queued]
+        self.recovered = tickets
+        dispatched = [rec["id"] for _, _, rec in queued
+                      if rec["id"] in state.dispatched]
+        self._record_fleet(event="journal_recover", lane=None,
+                           count=len(survivors),
+                           request_ids=[r["id"] for r in survivors],
+                           was_in_flight=dispatched,
+                           terminalized=sum(1 for t in tickets.values()
+                                            if t.done()),
+                           torn=state.torn)
+        return tickets
+
+    def _recover_terminal(self, ticket: Ticket, rec: dict,
+                          status_name: str,
+                          error: Optional[str] = None) -> bool:
+        """Terminalize a journal-recovered request WITHOUT re-admitting
+        it (expired deadline, corrupt payload, unroutable bucket) —
+        loud: a serve record with path="recovery", a journal finalize,
+        never a silent drop."""
+        from ..solver import SolveStatus
+        result = ServeResult(
+            u=None, s=None, v=None,
+            status=(None if error is not None
+                    else SolveStatus[status_name]),
+            error=error, sweeps=0, bucket=rec.get("bucket"),
+            queue_wait_s=0.0, solve_time_s=None, path="recovery",
+            degraded=bool(rec.get("degraded", False)), request_id=rec["id"])
+        if not ticket._finalize_once(result):
+            return False
+        self._journal_finalize(rec["id"], status_name)
+        self._bump("served", f"status:{status_name}", "path:recovery")
+        self._record(
+            request_id=rec["id"],
+            orig_shape=tuple(rec.get("orig_shape", (0, 0))),
+            dtype=str(rec.get("input", {}).get("dtype", "?")),
+            bucket=rec.get("bucket"), queue_wait_s=0.0, solve_time_s=None,
+            status=status_name, path="recovery",
+            breaker=self.breaker.state().value,
+            brownout=str(rec.get("brownout", "FULL")), degraded=False,
+            deadline_s=rec.get("deadline_s"), error=error,
+            k=rec.get("top_k"))
+        return True
+
+    def reload(self, *, buckets=None, solver: Optional[SVDConfig] = None,
+               batch_tiers=None, sigma_only: bool = True,
+               warm: bool = True,
+               background: bool = True) -> threading.Event:
+        """Zero-downtime configuration reload: resolve a NEW bucket set
+        (and/or solver config / coalescing tiers) exactly like
+        declaration time, AOT-warm its registry entries in the
+        BACKGROUND (pure ``lower().compile()`` — nothing executes, live
+        traffic keeps flowing), then atomically swap the routing maps
+        under the service lock. Requests already queued against an OLD
+        bucket keep serving: the old per-bucket resolved configs are
+        retained in the merged map (so their jit keys — and executables
+        — are unchanged), and the old executables simply drain from the
+        jit caches as traffic moves. Lanes and max_batch are fixed at
+        construction and cannot be reloaded.
+
+        Returns a `threading.Event` set when the swap has completed (or
+        the reload failed — check ``self._last_reload_error``; a failed
+        reload changes NOTHING and the event still sets so callers never
+        hang). ``background=False`` runs inline and returns the already-
+        set event."""
+        import dataclasses as _dc
+        overrides = {k: v for k, v in (("buckets", buckets),
+                                       ("solver", solver),
+                                       ("batch_tiers", batch_tiers))
+                     if v is not None}
+        if not overrides:
+            raise ValueError("reload() needs at least one of buckets= / "
+                             "solver= / batch_tiers=")
+        new_cfg = _dc.replace(self.config, **overrides)
+        done = threading.Event()
+
+        def _work():
+            from . import registry as _registry
+            from .registry import EntryRegistry
+            repointed = False
+            try:
+                (nb, nsolver, ntiers_map,
+                 ntiers) = self._resolve_bucket_maps(new_cfg)
+                new_registry = EntryRegistry(
+                    nb, nsolver, ntiers_map, new_cfg.solver,
+                    max_batch=new_cfg.max_batch, lanes=new_cfg.lanes,
+                    default_tiers=ntiers)
+                new_ns, new_hash = self._cache_ns, self._cache_hash
+                if (new_cfg.compile_cache_dir is not None
+                        and "solver" in overrides):
+                    # A solver change is a different cache namespace
+                    # (its hash covers the solver config): re-point the
+                    # persistent cache BEFORE the warm, so the new
+                    # executables land where the next restart of the
+                    # new config will look for them.
+                    new_ns, meta = _registry.enable_persistent_cache(
+                        new_cfg.compile_cache_dir, new_cfg.solver)
+                    new_hash = meta["config_sha256"]
+                    repointed = True
+                infos = (new_registry.aot_warm(sigma_only=sigma_only)
+                         if warm else [])
+                with self._lock:
+                    old_solver = self._bucket_solver
+                    old_tiers = self._bucket_tiers
+                    # Drain grace is ONE generation deep: buckets current
+                    # at this swap keep their resolved configs (their
+                    # in-flight requests finish under them), anything
+                    # older was drained during the previous generation —
+                    # without the cut the maps grow by every retired
+                    # bucket per reload, forever. New declarations win
+                    # on collision.
+                    live = set(self.buckets)
+                    self.buckets = nb
+                    self._bucket_solver = {
+                        **{b: c for b, c in old_solver.items()
+                           if b in live}, **nsolver}
+                    self._bucket_tiers = {
+                        **{b: t for b, t in old_tiers.items()
+                           if b in live}, **ntiers_map}
+                    self._tiers = ntiers
+                    self.config = new_cfg
+                    self.registry = new_registry
+                    self._cache_ns, self._cache_hash = new_ns, new_hash
+                    self.fleet._bucket_home = {
+                        b: i % self.fleet.size for i, b in enumerate(nb)}
+                self._last_reload_error = None
+                self._bump("reloads")
+                self._record_fleet(
+                    event="reload", lane=None,
+                    buckets=[b.name for b in nb],
+                    warmed=len(infos),
+                    fresh_compiles=sum(i["fresh_compiles"]
+                                       for i in infos))
+            except Exception as e:
+                self._last_reload_error = f"{type(e).__name__}: {e}"
+                self._bump("reload_errors")
+                if repointed and self.config.compile_cache_dir is not None:
+                    # The cache dir was already re-pointed for the new
+                    # solver; restore the OLD config's namespace so the
+                    # unswapped service keeps caching where it reads.
+                    try:
+                        _registry.enable_persistent_cache(
+                            self.config.compile_cache_dir,
+                            self.config.solver)
+                    except Exception:
+                        pass
+                print(f"svdj-serve: reload failed (nothing swapped): "
+                      f"{self._last_reload_error}", file=sys.stderr)
+            finally:
+                done.set()
+
+        if background:
+            threading.Thread(target=_work, name="svdj-serve-reload",
+                             daemon=True).start()
+        else:
+            _work()
+        return done
 
     def __enter__(self) -> "SVDService":
         return self.start()
@@ -608,6 +969,7 @@ class SVDService:
         if deadline_s is not None and math.isinf(deadline_s):
             deadline_s = None
         brown = self._brownout()
+        journaled = False
         try:
             if not self.ready():
                 raise AdmissionError(AdmissionReason.SHUTDOWN,
@@ -667,6 +1029,16 @@ class SVDService:
             # next ACTIVE one (lane 0 always, when lanes == 1). Raises
             # NO_LANE when the whole fleet is quarantined.
             lane = self.fleet.route(bucket)
+            if self.journal is not None:
+                # WRITE-AHEAD: journal before the enqueue, so there is
+                # no window in which a client holds a ticket for a
+                # request the journal never heard of. A journal write
+                # failure propagates loudly (the request is NOT admitted
+                # — a durability promise that cannot be recorded must
+                # not be made). A post-journal queue rejection appends a
+                # finalize record below so replay never resurrects it.
+                self.journal.append_admit(req)
+                journaled = True
             lane.queue.admit(req)
             if lane.state is not LaneState.ACTIVE:
                 # Admission raced an eviction: evict() flips the state
@@ -679,6 +1051,8 @@ class SVDService:
                     self.fleet.rescue_requests(lane, stranded,
                                                cause="admit_race")
         except AdmissionError as e:
+            if journaled:
+                self._journal_finalize(rid, f"REJECTED_{e.reason.name}")
             self._bump("rejected", f"rejected:{e.reason.value}")
             self._record(request_id=rid, orig_shape=orig_shape, dtype=dtype,
                          bucket=None, queue_wait_s=0.0, solve_time_s=None,
@@ -815,6 +1189,7 @@ class SVDService:
                             lane=lane.index)
 
     def _serve_one(self, lane: Lane, req: Request) -> None:
+        from ..resilience import chaos
         from ..solver import SolveStatus
         t_pop = time.monotonic()
         queue_wait = t_pop - req.submitted
@@ -826,6 +1201,11 @@ class SVDService:
                 # publish-and-check shares stop()'s lock, so one side
                 # always sets the cancel event.
                 req.cancel.set()
+        self._journal_dispatch([req], lane)
+        # The armed process-kill fires AFTER the dispatch is journaled:
+        # the durable state a restarted service replays is exactly "this
+        # request was in flight when the process died".
+        chaos.maybe_sigkill()
         try:
             if req.cancel.is_set():
                 # Cancelled while queued: terminal without spending a solve.
@@ -952,6 +1332,9 @@ class SVDService:
                    default=batch_size)
         with self._lock:
             lane.in_flight = list(live)
+        self._journal_dispatch(live, lane, batch_id=batch_id)
+        from ..resilience import chaos
+        chaos.maybe_sigkill()   # after journaling, like _serve_one
         try:
             cu = any(r.compute_u and not r.degraded for r in live)
             cv = any(r.compute_v and not r.degraded for r in live)
@@ -1372,6 +1755,7 @@ class SVDService:
         only the first writer may count."""
         if not req.ticket._finalize_once(result):
             return False
+        self._journal_finalize(req.id, status_name)
         self._bump("served", f"status:{status_name}",
                    *(["path:ladder"] if path == "ladder" else []),
                    *(["degraded"] if req.degraded else []),
@@ -1412,6 +1796,37 @@ class SVDService:
             result=result, queue_wait=wait, solve_time=None,
             path="rescue", breaker_state=breaker.state(),
             lane=None if lane is None else lane.index)
+
+    def _journal_dispatch(self, reqs, lane: Lane,
+                          batch_id: Optional[str] = None) -> None:
+        """Best-effort dispatch journaling: a journal I/O failure here
+        must not kill the worker (the admit record — the durability
+        promise — is already on disk; the dispatch record is recovery
+        diagnostics)."""
+        if self.journal is None:
+            return
+        try:
+            for r in reqs:
+                self.journal.append_dispatch(r.id, lane=lane.index,
+                                             batch_id=batch_id)
+        except Exception as e:
+            self._bump("journal_errors")
+            print(f"svdj-serve: journal dispatch append failed: {e}",
+                  file=sys.stderr)
+
+    def _journal_finalize(self, request_id: str, status: str) -> None:
+        """Best-effort finalize journaling (see `_journal_dispatch`): a
+        lost finalize record means one extra replay next restart, which
+        exactly-once finalization absorbs — a crashed worker would be
+        strictly worse."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append_finalize(request_id, status)
+        except Exception as e:
+            self._bump("journal_errors")
+            print(f"svdj-serve: journal finalize append failed: {e}",
+                  file=sys.stderr)
 
     def _bump(self, *keys: str) -> None:
         with self._lock:

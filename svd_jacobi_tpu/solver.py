@@ -1952,6 +1952,94 @@ class SweepStepper(_SweepControlMixin):
                          sweeps=state.sweeps, off_rel=state.off_rel,
                          status=status)
 
+    def aot_entries(self):
+        """Every jit entry this stepper's solve loop will dispatch, as
+        ``(entry_name, jit_fn, args, kwargs)`` with `jax.ShapeDtypeStruct`
+        args — lowerable and compilable AHEAD OF TIME
+        (``jit_fn.lower(*args, **kwargs).compile()``) without executing a
+        single sweep. This is the serving entry registry's AOT lane
+        (`serve.registry`): the enumeration must track the real dispatch
+        sites of `init`/`step`/`finish`/`_status` exactly, so the shapes
+        are derived with `jax.eval_shape` over the SAME helpers the live
+        path runs (they cannot drift from the executed programs), and the
+        statics are this stepper's own resolved values. ``entry_name``
+        is the `config.RETRACE_BUDGETS` key of each jit."""
+        f32s = jax.ShapeDtypeStruct((), jnp.float32)
+        a_spec = jax.ShapeDtypeStruct((self.m, self.n), self.input_dtype)
+        k = self.nblocks // 2
+        entries = []
+        if self._kernel_path:
+            if self._precondition:
+                entries.append(("solver._precondition_qr_jit",
+                                _precondition_qr_jit, (a_spec,), {}))
+                q1_s, _, order_s, work_s = jax.eval_shape(
+                    _precondition_qr, a_spec)
+            else:
+                q1_s = order_s = None
+                work_s = a_spec
+            top_s, bot_s = jax.eval_shape(
+                lambda w: _blockify(w, self.n_pad, self.nblocks), work_s)
+            if self._accumulate:
+                vtop_s, vbot_s = jax.eval_shape(
+                    lambda: _blockify(
+                        jnp.eye(self.n_pad, dtype=self.input_dtype),
+                        self.n_pad, self.nblocks))
+            else:
+                vtop_s = vbot_s = jax.ShapeDtypeStruct(
+                    (k, 0, top_s.shape[2]), self.input_dtype)
+            entries.append((
+                "solver._sweep_step_pallas_jit", _sweep_step_pallas_jit,
+                (top_s, bot_s, vtop_s, vbot_s, f32s),
+                dict(with_v=self._accumulate,
+                     polish=bool(self.config.kernel_polish),
+                     interpret=not pb.supported())))
+            refine = (self.config.sigma_refine
+                      if self.config.sigma_refine is not None
+                      else (self.compute_u or self.compute_v))
+            entries.append((
+                "solver._finish_pallas_jit", _finish_pallas_jit,
+                (top_s, bot_s, vtop_s, vbot_s, work_s, q1_s, order_s),
+                dict(n=self.n, compute_u=self.compute_u,
+                     compute_v=self.compute_v, full_u=self.full_matrices,
+                     precondition=self._precondition,
+                     refine=bool(refine))))
+        else:
+            top_s, bot_s = jax.eval_shape(
+                lambda: _blockify(
+                    jnp.zeros((self.m, self.n), self.input_dtype),
+                    self.n_pad, self.nblocks))
+            if self.compute_v:
+                vtop_s, vbot_s = jax.eval_shape(
+                    lambda: _blockify(
+                        jnp.eye(self.n_pad, dtype=self.input_dtype),
+                        self.n_pad, self.nblocks))
+            else:
+                vtop_s = vbot_s = jax.ShapeDtypeStruct(
+                    (k, 0, top_s.shape[2]), self.input_dtype)
+            # The hybrid method compiles one sweep program per STAGE
+            # (bulk gram-eigh/abs + polish qr-svd/rel are distinct static
+            # keys) — mirror `_phase` over the stages the loop can visit.
+            phases = ([("gram-eigh", "abs"), ("qr-svd", self.criterion)]
+                      if self.method == "hybrid"
+                      else [(self.method, self.criterion)])
+            for method, criterion in phases:
+                entries.append((
+                    "solver._sweep_step_jit", _sweep_step_jit,
+                    (top_s, bot_s, vtop_s, vbot_s),
+                    dict(with_v=self.compute_v,
+                         precision=self.config.matmul_precision,
+                         gram_dtype_name=self.gram_dtype_name,
+                         method=method, criterion=criterion)))
+            entries.append((
+                "solver._finish_jit", _finish_jit,
+                (top_s, bot_s, vtop_s, vbot_s),
+                dict(n=self.n, compute_u=self.compute_u,
+                     compute_v=self.compute_v,
+                     full_u=self.full_matrices)))
+        entries.append(("solver._nonfinite_probe_jit",
+                        _nonfinite_probe_jit, (top_s, bot_s, f32s), {}))
+        return tuple(entries)
+
 
 @jax.jit
 def _nonfinite_probe_jit(top, bot, off_rel):
@@ -2426,3 +2514,105 @@ class BatchedSweepStepper(_SweepControlMixin):
         return SVDResult(u=u, s=s, v=(v if self.compute_v else None),
                          sweeps=sweeps_vec, off_rel=state.off_rel,
                          status=status)
+
+    def aot_entries(self):
+        """Batched twin of `SweepStepper.aot_entries`: the jit entries of
+        one coalesced (B, m, n) dispatch as ``(entry_name, jit_fn, args,
+        kwargs)`` with `jax.ShapeDtypeStruct` args — ahead-of-time
+        lowerable/compilable without running a sweep (the serving entry
+        registry's AOT lane). Shapes follow `init`/`step`/`finish`/
+        `_member_statuses` via `jax.eval_shape` over the live helpers."""
+        f32s = jax.ShapeDtypeStruct((), jnp.float32)
+        offv = jax.ShapeDtypeStruct((self.batch,), jnp.float32)
+        a_spec = jax.ShapeDtypeStruct((self.batch, self.m, self.n),
+                                      self.input_dtype)
+        k = self.nblocks // 2
+        entries = []
+        if self._kernel_path:
+            if self._precondition:
+                entries.append(("solver._precondition_qr_batched_jit",
+                                _precondition_qr_batched_jit, (a_spec,),
+                                {}))
+                q1_s, _, order_s, work_s = jax.eval_shape(
+                    jax.vmap(_precondition_qr), a_spec)
+            else:
+                q1_s = order_s = None
+                work_s = a_spec
+            top_s, bot_s = jax.eval_shape(
+                lambda w: tuple(map(_stack_members, _blockify_batched(
+                    w, self.n_pad, self.nblocks))), work_s)
+            if self._accumulate:
+                vtop_s, vbot_s = jax.eval_shape(
+                    lambda: tuple(map(_stack_members, _blockify_batched(
+                        jnp.broadcast_to(
+                            jnp.eye(self.n_pad, dtype=self.input_dtype),
+                            (self.batch, self.n_pad, self.n_pad)),
+                        self.n_pad, self.nblocks))))
+            else:
+                vtop_s = vbot_s = jax.ShapeDtypeStruct(
+                    (self.batch * k, 0, top_s.shape[2]), self.input_dtype)
+            entries.append((
+                "solver._sweep_step_pallas_batched_jit",
+                _sweep_step_pallas_batched_jit,
+                (top_s, bot_s, vtop_s, vbot_s, f32s),
+                dict(batch=self.batch, with_v=self._accumulate,
+                     polish=bool(self.config.kernel_polish),
+                     interpret=not pb.supported())))
+            refine = (self.config.sigma_refine
+                      if self.config.sigma_refine is not None
+                      else (self.compute_u or self.compute_v))
+            entries.append((
+                "solver._finish_pallas_batched_jit",
+                _finish_pallas_batched_jit,
+                (top_s, bot_s, vtop_s, vbot_s, work_s, q1_s, order_s),
+                dict(batch=self.batch, n=self.n, compute_u=self.compute_u,
+                     compute_v=self.compute_v,
+                     precondition=self._precondition,
+                     refine=bool(refine))))
+            # The per-member status probe reshapes the stacked pairs back
+            # to member-major (B, k, m, b) — mirror `_member_statuses`.
+            kp = top_s.shape[0] // self.batch
+            ptop = jax.ShapeDtypeStruct((self.batch, kp) + top_s.shape[1:],
+                                        self.input_dtype)
+            pbot = jax.ShapeDtypeStruct((self.batch, kp) + bot_s.shape[1:],
+                                        self.input_dtype)
+            entries.append(("solver._nonfinite_probe_batched_jit",
+                            _nonfinite_probe_batched_jit,
+                            (ptop, pbot, offv), {}))
+        else:
+            top_s, bot_s = jax.eval_shape(
+                lambda: _blockify_batched(
+                    jnp.zeros((self.batch, self.m, self.n),
+                              self.input_dtype),
+                    self.n_pad, self.nblocks))
+            if self.compute_v:
+                vtop_s, vbot_s = jax.eval_shape(
+                    lambda: _blockify_batched(
+                        jnp.broadcast_to(
+                            jnp.eye(self.n_pad, dtype=self.input_dtype),
+                            (self.batch, self.n_pad, self.n_pad)),
+                        self.n_pad, self.nblocks))
+            else:
+                vtop_s = vbot_s = jax.ShapeDtypeStruct(
+                    (self.batch, k, 0, top_s.shape[3]), self.input_dtype)
+            phases = ([("gram-eigh", "abs"), ("qr-svd", self.criterion)]
+                      if self.method == "hybrid"
+                      else [(self.method, self.criterion)])
+            for method, criterion in phases:
+                entries.append((
+                    "solver._sweep_step_xla_batched_jit",
+                    _sweep_step_xla_batched_jit,
+                    (top_s, bot_s, vtop_s, vbot_s),
+                    dict(with_v=self.compute_v,
+                         precision=self.config.matmul_precision,
+                         gram_dtype_name=self.gram_dtype_name,
+                         method=method, criterion=criterion)))
+            entries.append((
+                "solver._finish_xla_batched_jit", _finish_xla_batched_jit,
+                (top_s, bot_s, vtop_s, vbot_s),
+                dict(n=self.n, compute_u=self.compute_u,
+                     compute_v=self.compute_v)))
+            entries.append(("solver._nonfinite_probe_batched_jit",
+                            _nonfinite_probe_batched_jit,
+                            (top_s, bot_s, offv), {}))
+        return tuple(entries)

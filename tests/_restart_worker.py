@@ -1,0 +1,165 @@
+"""Subprocess driver for the restart-survivability lane
+(tests/test_restart.py): a serving process that can be SIGKILL'd
+mid-load and a resume process that recovers the journal — run as
+
+    python tests/_restart_worker.py serve  --journal J [--cache C] ...
+    python tests/_restart_worker.py resume --journal J [--cache C]
+    python tests/_restart_worker.py cachecheck --cache C [--corrupt]
+
+``serve`` arms `chaos.sigkill_at_dispatch(--kill-after)`: the process
+journals its admitted requests, serves until the armed dispatch, then
+takes a REAL SIGKILL (no cleanup, no final snapshot) with requests
+queued and in flight. ``resume`` builds a fresh service on the same
+journal, replays it, serves every recovered request, and prints one JSON
+line of results. ``cachecheck`` proves the persistent-cache fallback:
+warm the cache, (optionally) corrupt an entry, and report whether the
+solve still succeeds, what warning fired, and the fresh-compile count.
+"""
+
+import argparse
+import json
+import os
+import sys
+import warnings
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+BUCKET = (48, 32, "float32")
+
+
+def _service(args, **overrides):
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.serve import ServeConfig, SVDService
+    kw = dict(
+        buckets=(BUCKET,),
+        solver=SVDConfig(pair_solver="pallas"),
+        journal_path=args.journal,
+        compile_cache_dir=args.cache,
+        max_queue_depth=64,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    kw.update(overrides)
+    return SVDService(ServeConfig(**kw))
+
+
+def cmd_serve(args) -> int:
+    import numpy as np
+
+    from svd_jacobi_tpu.resilience import chaos
+    svc = _service(args)
+    svc.start()
+    if args.warmup:
+        svc.warmup(timeout=300.0)
+    # Slow every dispatch a little so the parent-observable window
+    # between "journaled" and "finalized" is wide; deterministic.
+    slow = chaos.slow_solve(args.slow_s, shots=args.requests)
+    slow.__enter__()
+    chaos.sigkill_at_dispatch(args.kill_after)
+    rng = np.random.default_rng(args.seed)
+    tickets = []
+    for i in range(args.requests):
+        a = rng.standard_normal((40, 30)).astype(np.float32)
+        tickets.append(svc.submit(a, deadline_s=args.deadline_s,
+                                  request_id=f"req-{i:02d}"))
+    print(json.dumps({"submitted": [t.request_id for t in tickets]}),
+          flush=True)
+    # Block until the armed SIGKILL lands (it will: the worker dispatches
+    # request after request). If it somehow does not, exit 3 loudly.
+    for t in tickets:
+        t.result(timeout=300.0)
+    return 3
+
+
+def cmd_resume(args) -> int:
+    import time
+    t_proc = time.perf_counter()
+    from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+    with RecompileGuard() as guard:
+        svc = _service(args)
+        tickets = svc.recover()
+        svc.start()
+        first_done_s = None
+        results = {}
+        for rid, t in tickets.items():
+            res = t.result(timeout=300.0)
+            if first_done_s is None:
+                first_done_s = time.perf_counter() - t_proc
+            results[rid] = (res.status.name if res.status is not None
+                            else f"ERROR:{res.error}")
+        svc.stop(drain=True, timeout=60.0)
+    from svd_jacobi_tpu.serve import Journal
+    state = Journal(args.journal).scan()
+    print(json.dumps({
+        "resumed": sorted(tickets),
+        "results": results,
+        "first_result_s": first_done_s,
+        "journal_finalized": state.finalized,
+        "journal_unfinalized": [r["id"] for r in state.unfinalized],
+        "backend_compiles": guard.backend_compiles,
+        "cache_hits": guard.cache_hits,
+        "fresh_backend_compiles": guard.fresh_backend_compiles(),
+    }), flush=True)
+    return 0
+
+
+def cmd_cachecheck(args) -> int:
+    import numpy as np
+
+    from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+    from svd_jacobi_tpu.resilience import chaos
+    if args.corrupt:
+        chaos.corrupt_compile_cache(args.corrupt_dir or args.cache,
+                                    mode=args.corrupt_mode)
+    caught = []
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with RecompileGuard() as guard:
+            svc = _service(args, journal_path=None)
+            svc.start()
+            svc.warmup(timeout=300.0)
+            rng = np.random.default_rng(0)
+            a = rng.standard_normal((40, 30)).astype(np.float32)
+            res = svc.submit(a).result(timeout=120.0)
+            svc.stop()
+        caught = [str(x.message) for x in w]
+    print(json.dumps({
+        "status": res.status.name,
+        "warnings": caught,
+        "backend_compiles": guard.backend_compiles,
+        "cache_hits": guard.cache_hits,
+        "fresh_backend_compiles": guard.fresh_backend_compiles(),
+    }), flush=True)
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("mode", choices=["serve", "resume", "cachecheck"])
+    p.add_argument("--journal", default=None)
+    p.add_argument("--cache", default=None)
+    p.add_argument("--requests", type=int, default=4)
+    p.add_argument("--kill-after", type=int, default=2)
+    p.add_argument("--deadline-s", type=float, default=300.0)
+    p.add_argument("--slow-s", type=float, default=0.05)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--warmup", action="store_true")
+    p.add_argument("--corrupt", action="store_true")
+    p.add_argument("--corrupt-mode", default="flip")
+    p.add_argument("--corrupt-dir", default=None,
+                   help="dir to corrupt (default: --cache root)")
+    args = p.parse_args()
+    if args.mode == "serve":
+        return cmd_serve(args)
+    if args.mode == "resume":
+        return cmd_resume(args)
+    return cmd_cachecheck(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

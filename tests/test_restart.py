@@ -1,0 +1,546 @@
+"""Restart-survivable serving: entry registry + AOT persistent cache +
+durable request journal (tests/test_restart.py).
+
+The acceptance pair this file proves:
+
+  * a RESTARTED process with a warm persistent executable cache serves
+    with ZERO fresh compilations (``backend_compiles - cache_hits == 0``
+    under `RecompileGuard` — in current JAX the backend-compile event
+    fires on cache hits too, so the subtraction is the honest count);
+  * a SIGKILL'd service under load resumes every journaled unfinalized
+    request EXACTLY ONCE after restart — no lost requests, no duplicate
+    finalizations.
+
+Subprocess lanes (`-m chaos`) drive tests/_restart_worker.py: real
+SIGKILL, real process boundaries (an in-process "restart" would be faked
+by the live jit caches). In-process lanes cover the registry/budget
+bijection (AOT001 + its seeded fixtures), journal mechanics (write-ahead
+order, torn-line quarantine, checksums, atomic rewrite), recovery
+semantics (queue-front re-admission, wall-clock deadline decay, loud
+terminalization of expired/corrupt debt), zero-downtime reload, and the
+"coldstart" manifest record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu import config as sj_config
+from svd_jacobi_tpu.analysis import aot_checks
+from svd_jacobi_tpu.obs import manifest
+from svd_jacobi_tpu.serve import (EntryRegistry, Journal, Request,
+                                  ServeConfig, SVDService, Ticket)
+from svd_jacobi_tpu.serve import journal as journal_mod
+from svd_jacobi_tpu.serve import registry as serve_registry
+from svd_jacobi_tpu.utils import matgen
+
+_WORKER = Path(__file__).parent / "_restart_worker.py"
+
+_BUCKETS = ((48, 32, "float32"), (64, 48, "float32"))
+
+
+def _cfg(**over):
+    base = dict(buckets=_BUCKETS,
+                solver=SVDConfig(pair_solver="pallas"),
+                max_queue_depth=32,
+                brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _run_worker(*argv, timeout=400.0):
+    return subprocess.run(
+        [sys.executable, str(_WORKER), *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=timeout, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+# ---------------------------------------------------------------------------
+# AOT001: registry <-> RETRACE_BUDGETS bijection (+ seeded fixtures).
+
+
+@pytest.mark.serve
+class TestAOT001:
+    def test_registry_budget_bijection_clean(self):
+        assert aot_checks.check_budget_coverage() == []
+
+    def test_plan_names_clean(self):
+        assert aot_checks.check_plan_names() == []
+
+    def test_seeded_missing_registry_entry_fires(self):
+        """A budget whose entry the registry does not enumerate is dead
+        declaration — AOT001 must fire (the seeded fixture)."""
+        budgets = {**sj_config.RETRACE_BUDGETS, "solver._phantom_jit": 1}
+        findings = aot_checks.check_budget_coverage(budgets=budgets)
+        assert [f.code for f in findings] == ["AOT001"]
+        assert "solver._phantom_jit" in findings[0].where
+
+    def test_seeded_unbudgeted_registry_entry_fires(self):
+        entries = dict(serve_registry.jit_entries())
+        dropped = "solver._tsqr_jit"
+        budgets = {k: v for k, v in sj_config.RETRACE_BUDGETS.items()
+                   if k != dropped}
+        findings = aot_checks.check_budget_coverage(budgets=budgets,
+                                                    entries=entries)
+        assert [f.code for f in findings] == ["AOT001"]
+        assert dropped in findings[0].where
+
+    def test_seeded_unbudgeted_plan_name_fires(self):
+        budgets = {k: v for k, v in sj_config.RETRACE_BUDGETS.items()
+                   if k != "solver._sketch_project_jit"}
+        findings = aot_checks.check_plan_names(budgets=budgets)
+        assert findings and all(f.code == "AOT001" for f in findings)
+
+    def test_analysis_main_wires_aot_pass(self, capsys):
+        """The `aot` pass is selectable through `python -m
+        svd_jacobi_tpu.analysis` (in-process: the pass is pure
+        set-comparison + eval_shape, no fresh backend needed)."""
+        from svd_jacobi_tpu.analysis.__main__ import main as analysis_main
+        rc = analysis_main(["--passes", "aot", "--report-dir", "off"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(out)["passes"]["aot"] is True
+
+
+# ---------------------------------------------------------------------------
+# The entry registry.
+
+
+@pytest.mark.serve
+class TestEntryRegistry:
+    def test_enumeration_deterministic_and_complete(self):
+        svc = SVDService(_cfg(max_batch=4, batch_tiers=(1, 4)))
+        keys = svc.registry.entries()
+        assert keys == svc.registry.entries()      # deterministic
+        names = [k.name for k in keys]
+        assert len(set(names)) == len(names)       # unique coordinates
+        # Per bucket: vec + novec singles, plus the tier-4 batched pair.
+        assert sum(1 for k in keys if k.tier is None) == 4
+        assert sum(1 for k in keys if k.tier == 4) == 4
+        # sigma_only=False drops the novec variants.
+        assert all(k.compute_u for k in
+                   svc.registry.entries(sigma_only=False))
+
+    def test_reachable_tiers_respect_max_batch(self):
+        svc = SVDService(_cfg(max_batch=3, batch_tiers=(1, 2, 8)))
+        b = svc.buckets.buckets[0]
+        # Batches of 2..3 snap to tiers {2, 8-capped-by-3 -> 8? no:
+        # reachable = {min tier >= c for c in 2..3} = {2, 8}.
+        assert svc.registry.reachable_tiers(b) == (2, 8)
+
+    def test_aot_plan_names_are_budgeted(self):
+        svc = SVDService(_cfg(max_batch=4, batch_tiers=(1, 4)))
+        for key in svc.registry.entries():
+            for name, fn, args, kwargs in svc.registry.aot_plan(key):
+                assert name in sj_config.RETRACE_BUDGETS, (key.name, name)
+
+    def test_rank_families_plan_stage_jits(self):
+        svc = SVDService(_cfg(buckets=((256, 32, "float32", "tall"),
+                                       (96, 96, "float32", "topk", 8))))
+        plans = {k.bucket.kind: [p[0] for p in svc.registry.aot_plan(k)]
+                 for k in svc.registry.entries(sigma_only=False)}
+        assert "solver._tsqr_jit" in plans["tall"]
+        assert "solver._lift_q_jit" in plans["tall"]
+        assert "solver._sketch_project_jit" in plans["topk"]
+        assert "solver._lift_q_jit" in plans["topk"]
+
+    def test_aot_compile_then_live_serve_matches(self):
+        """An AOT-compiled entry's programs must be the ones the live
+        dispatch requests: after aot_compile, serving a request through
+        the same bucket keeps every stepper entry within its retrace
+        budget (the plan cannot drift from the executed path)."""
+        from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+        svc = SVDService(_cfg(buckets=((40, 24, "float32"),)))
+        key = svc.registry.entries(sigma_only=False)[0]
+        svc.registry.aot_compile(key)
+        with RecompileGuard() as guard:
+            guard.expect("solver._sweep_step_pallas_jit", problems=1)
+            with svc:
+                res = svc.submit(matgen.random_dense(
+                    40, 24, seed=3, dtype=jnp.float32)).result(300.0)
+        assert res.status is not None and res.status.name == "OK"
+        assert guard.check() == []
+
+
+# ---------------------------------------------------------------------------
+# Journal mechanics.
+
+
+def _mk_request(svc, rid="jr-0", m=40, n=30, deadline_s=None, seed=0):
+    bucket = svc.buckets.route(m, n, "float32")
+    ticket = Ticket(rid)
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((m, n)).astype(np.float32)
+    now = time.monotonic()
+    return Request(id=rid, a=a, m=m, n=n, orig_shape=(m, n),
+                   transposed=False, bucket=bucket, compute_u=True,
+                   compute_v=True, degraded=False,
+                   deadline=(None if deadline_s is None
+                             else now + deadline_s),
+                   deadline_s=deadline_s, submitted=now,
+                   cancel=ticket._cancel, ticket=ticket)
+
+
+@pytest.mark.serve
+class TestJournal:
+    def test_lifecycle_roundtrip(self, tmp_path):
+        svc = SVDService(_cfg())
+        j = Journal(tmp_path / "j.jsonl")
+        req = _mk_request(svc, "jr-1", deadline_s=5.0)
+        j.append_admit(req)
+        j.append_dispatch("jr-1", lane=0)
+        state = j.scan()
+        assert list(state.admits) == ["jr-1"]
+        assert "jr-1" in state.dispatched
+        assert [r["id"] for r in state.unfinalized] == ["jr-1"]
+        j.append_finalize("jr-1", "OK")
+        state = j.scan()
+        assert state.finalized == {"jr-1": "OK"}
+        assert state.unfinalized == []
+        # The journaled payload reconstructs bit-exactly.
+        a = journal_mod.decode_array(state.admits["jr-1"]["input"])
+        np.testing.assert_array_equal(a, np.asarray(req.a))
+
+    def test_payload_checksum_mismatch_raises(self, tmp_path):
+        svc = SVDService(_cfg())
+        j = Journal(tmp_path / "j.jsonl")
+        j.append_admit(_mk_request(svc, "jr-2"))
+        rec = j.scan().admits["jr-2"]
+        rec["input"]["data_sha256"] = "0" * 64
+        with pytest.raises(ValueError, match="checksum"):
+            journal_mod.decode_array(rec["input"])
+
+    def test_torn_trailing_line_quarantined(self, tmp_path):
+        svc = SVDService(_cfg())
+        path = tmp_path / "j.jsonl"
+        j = Journal(path)
+        j.append_admit(_mk_request(svc, "jr-3"))
+        with path.open("a") as f:
+            f.write('{"kind": "admit", "id": "torn", "trunc')  # no \n
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            state = j.scan()
+        assert state.torn == 1
+        assert list(state.admits) == ["jr-3"]   # history survives
+        assert (tmp_path / "j.jsonl.torn").exists()
+        assert any("quarantined" in str(x.message) for x in w)
+        # The crash-safe appender inserts a newline first, so the next
+        # record can never concatenate into the torn fragment.
+        j.append_finalize("jr-3", "OK")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert j.scan().finalized == {"jr-3": "OK"}
+
+    def test_rewrite_is_atomic_compaction(self, tmp_path):
+        svc = SVDService(_cfg())
+        j = Journal(tmp_path / "j.jsonl")
+        for i in range(3):
+            j.append_admit(_mk_request(svc, f"jr-{i}", seed=i))
+        keep = [j.scan().admits["jr-1"]]
+        j.rewrite(keep)
+        state = j.scan()
+        assert list(state.admits) == ["jr-1"]
+        assert not (tmp_path / "j.jsonl.tmp").exists()
+
+    def test_manifest_load_tolerates_torn_tail(self, tmp_path):
+        path = tmp_path / "manifest.jsonl"
+        rec = manifest.build_fleet(event="probe", lane=0, ok=True)
+        manifest.append(path, rec)
+        with path.open("a") as f:
+            f.write('{"kind": "serve", "trunc')
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            records = manifest.load(path)
+        assert len(records) == 1 and records[0]["kind"] == "fleet"
+        assert any("quarantined" in str(x.message) for x in w)
+        # Appending after the torn tail self-repairs the stream.
+        manifest.append(path, rec)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert len(manifest.load(path)) == 2
+
+    def test_coldstart_record_roundtrip(self, tmp_path):
+        rec = manifest.build_coldstart(
+            entries=[{"entry": "l0/48x32:float32/vec", "time_s": 1.25,
+                      "cache_hit": True, "backend_compiles": 4,
+                      "cache_hits": 4, "fresh_compiles": 0,
+                      "jits": ["solver._sweep_step_pallas_jit"]}],
+            total_s=2.5, backend_compiles=8, cache_hits=8,
+            fresh_compiles=0, cache_dir="/tmp/x",
+            config_sha256="ab" * 32)
+        manifest.validate(rec)
+        path = manifest.append(tmp_path / "m.jsonl", rec)
+        loaded = manifest.load(path)[0]
+        assert loaded["kind"] == "coldstart"
+        assert loaded["fresh_compiles"] == 0
+        assert "coldstart" in manifest.summarize(loaded)
+        with pytest.raises(ValueError):
+            manifest.validate({**rec, "entries": [{"entry": 1}]})
+
+
+# ---------------------------------------------------------------------------
+# Recovery semantics (in-process: journal written by hand, replayed by a
+# fresh service — the subprocess SIGKILL lane covers the real kill).
+
+
+@pytest.mark.serve
+class TestRecover:
+    def test_recover_readmits_serves_and_compacts(self, tmp_path):
+        jpath = tmp_path / "j.jsonl"
+        writer = SVDService(_cfg(journal_path=str(jpath)))
+        j = writer.journal
+        for i in range(3):
+            j.append_admit(_mk_request(writer, f"rq-{i}", seed=i,
+                                       deadline_s=600.0))
+        j.append_finalize("rq-0", "OK")       # already served pre-crash
+        svc = SVDService(_cfg(journal_path=str(jpath)))
+        tickets = svc.recover()
+        assert sorted(tickets) == ["rq-1", "rq-2"]
+        # Queue front, admit order preserved.
+        assert [r.id for r in svc.queue._q] == ["rq-1", "rq-2"]
+        # Journal compacted to exactly the debt, attempts bumped.
+        state = Journal(jpath).scan()
+        assert sorted(state.admits) == ["rq-1", "rq-2"]
+        assert all(r["attempt"] == 2 for r in state.admits.values())
+        with svc:
+            for t in tickets.values():
+                res = t.result(timeout=300.0)
+                assert res.status is not None and res.status.name == "OK"
+        final = Journal(jpath).scan()
+        assert final.finalized == {"rq-1": "OK", "rq-2": "OK"}
+        assert final.unfinalized == []
+        rec = [r for r in svc.records()
+               if r.get("event") == "journal_recover"]
+        assert rec and rec[0]["count"] == 2
+
+    def test_expired_deadline_terminalizes_loudly(self, tmp_path):
+        jpath = tmp_path / "j.jsonl"
+        writer = SVDService(_cfg(journal_path=str(jpath)))
+        req = _mk_request(writer, "rq-exp", deadline_s=5.0)
+        # The original admit was 60 wall-seconds ago: the 5 s budget is
+        # long spent — recovery must honor it, not resurrect it.
+        writer.journal.append_admit(req, admitted_wall=time.time() - 60.0)
+        svc = SVDService(_cfg(journal_path=str(jpath)))
+        tickets = svc.recover()
+        res = tickets["rq-exp"].result(timeout=5.0)
+        assert res.status is not None and res.status.name == "DEADLINE"
+        recs = [r for r in svc.records()
+                if r.get("kind") == "serve" and r.get("path") == "recovery"]
+        assert recs and recs[0]["status"] == "DEADLINE"
+        assert Journal(jpath).scan().unfinalized == []
+
+    def test_corrupt_payload_terminalizes_error(self, tmp_path):
+        jpath = tmp_path / "j.jsonl"
+        writer = SVDService(_cfg(journal_path=str(jpath)))
+        writer.journal.append_admit(_mk_request(writer, "rq-bad",
+                                                deadline_s=600.0))
+        records, _ = manifest.read_jsonl_tolerant(jpath)
+        records[0]["input"]["data_sha256"] = "0" * 64
+        jpath.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        svc = SVDService(_cfg(journal_path=str(jpath)))
+        tickets = svc.recover()
+        res = tickets["rq-bad"].result(timeout=5.0)
+        assert res.error is not None and "checksum" in res.error
+
+    def test_recover_advances_auto_request_ids(self, tmp_path):
+        """A restarted process's auto-id counter restarts at r00000; a
+        new submit must never reuse a journaled id (the journal and the
+        manifest key exactly-once accounting by id)."""
+        jpath = tmp_path / "j.jsonl"
+        writer = SVDService(_cfg(journal_path=str(jpath)))
+        for i in range(3):
+            writer.journal.append_admit(
+                _mk_request(writer, f"r{i:05d}", seed=i, deadline_s=600.0))
+        writer.journal.append_finalize("r00001", "OK")
+        svc = SVDService(_cfg(journal_path=str(jpath)))
+        tickets = svc.recover()
+        assert sorted(tickets) == ["r00000", "r00002"]
+        with svc:
+            for t in tickets.values():
+                t.result(timeout=300.0)
+            svc.submit(matgen.random_dense(40, 30, seed=9,
+                                           dtype=jnp.float32)
+                       ).result(timeout=300.0)
+        state = Journal(jpath).scan()
+        fresh = sorted(set(state.admits) - {"r00000", "r00002"})
+        # Past EVERY journaled id — the finalized r00001 included.
+        assert fresh == ["r00003"]
+
+    def test_write_ahead_submit_and_finalize(self, tmp_path):
+        """The live submit path journals before enqueue and finalizes
+        after the ticket wins — the whole lifecycle lands on disk."""
+        jpath = tmp_path / "j.jsonl"
+        with SVDService(_cfg(journal_path=str(jpath))) as svc:
+            res = svc.submit(matgen.random_dense(40, 30, seed=5,
+                                                 dtype=jnp.float32),
+                             request_id="live-0").result(timeout=300.0)
+        assert res.status is not None and res.status.name == "OK"
+        state = Journal(jpath).scan()
+        assert list(state.admits) == ["live-0"]
+        assert "live-0" in state.dispatched
+        assert state.finalized == {"live-0": "OK"}
+
+
+# ---------------------------------------------------------------------------
+# Zero-downtime reload.
+
+
+@pytest.mark.serve
+class TestReload:
+    def test_reload_swaps_bucket_set_atomically(self):
+        with SVDService(_cfg(buckets=((48, 32, "float32"),))) as svc:
+            ok = svc.submit(matgen.random_dense(40, 30, seed=1,
+                                                dtype=jnp.float32))
+            assert ok.result(300.0).status.name == "OK"
+            # 100x80 fits no declared bucket yet.
+            with pytest.raises(Exception):
+                svc.submit(matgen.random_dense(100, 80, seed=2,
+                                               dtype=jnp.float32))
+            done = svc.reload(buckets=((48, 32, "float32"),
+                                       (112, 80, "float32")),
+                              warm=False)
+            assert done.wait(60.0)
+            assert svc._last_reload_error is None
+            res = svc.submit(matgen.random_dense(100, 80, seed=3,
+                                                 dtype=jnp.float32)
+                             ).result(timeout=300.0)
+            assert res.status is not None and res.status.name == "OK"
+            # The old bucket still serves (drain grace).
+            res2 = svc.submit(matgen.random_dense(40, 30, seed=4,
+                                                  dtype=jnp.float32)
+                              ).result(timeout=300.0)
+            assert res2.status.name == "OK"
+            assert svc.stats().get("reloads") == 1
+            assert any(r.get("event") == "reload" for r in svc.records())
+
+    def test_failed_reload_swaps_nothing(self):
+        with SVDService(_cfg(buckets=((48, 32, "float32"),))) as svc:
+            before = svc.buckets.buckets
+            done = svc.reload(buckets=("not-a-bucket-spec",), warm=False,
+                              background=False)
+            assert done.is_set()
+            assert svc._last_reload_error is not None
+            assert svc.buckets.buckets == before
+            res = svc.submit(matgen.random_dense(40, 30, seed=6,
+                                                 dtype=jnp.float32)
+                             ).result(timeout=300.0)
+            assert res.status.name == "OK"
+
+
+# ---------------------------------------------------------------------------
+# The subprocess acceptance lanes: real SIGKILL, real restart, real
+# persistent cache across a process boundary.
+
+
+@pytest.mark.chaos
+class TestSigkillResume:
+    def test_sigkill_under_load_resumes_exactly_once(self, tmp_path):
+        jpath = tmp_path / "journal.jsonl"
+        serve = _run_worker("serve", "--journal", str(jpath),
+                            "--requests", "3", "--kill-after", "2")
+        assert serve.returncode == -9, (serve.returncode,
+                                        serve.stderr[-2000:])
+        state = Journal(jpath).scan()
+        debt = [r["id"] for r in state.unfinalized]
+        finalized_before = dict(state.finalized)
+        assert debt, "the kill must strand unfinalized requests"
+        assert finalized_before, "the kill must come after some service"
+        resume = _run_worker("resume", "--journal", str(jpath))
+        assert resume.returncode == 0, resume.stderr[-2000:]
+        out = json.loads(resume.stdout.strip().splitlines()[-1])
+        # Every journaled unfinalized request resumed, none lost.
+        assert sorted(out["resumed"]) == sorted(debt)
+        assert all(s == "OK" for s in out["results"].values())
+        # Exactly-once: nothing finalized twice across the boundary, and
+        # nothing is still owed.
+        assert not set(out["results"]) & set(finalized_before)
+        assert out["journal_unfinalized"] == []
+        assert sorted(out["journal_finalized"]) == sorted(debt)
+
+
+@pytest.mark.chaos
+class TestPersistentCacheRestart:
+    def test_restart_cold_warm_corrupt_lifecycle(self, tmp_path):
+        """THE cold-start acceptance, one cache directory, three
+        restarts (each a real subprocess — an in-process 'restart' would
+        be faked by the live jit caches): (1) cold — fresh compiles;
+        (2) warm — the restarted process warms up and serves with ZERO
+        fresh compilations (every backend compile served by the
+        persistent cache); (3) a corrupted cache entry degrades to a
+        LOUD warning + fresh recompile, never a crash or a garbage
+        executable."""
+        cache = str(tmp_path / "cache")
+        cold = _run_worker("cachecheck", "--cache", cache)
+        assert cold.returncode == 0, cold.stderr[-2000:]
+        cold_out = json.loads(cold.stdout.strip().splitlines()[-1])
+        assert cold_out["status"] == "OK"
+        assert cold_out["fresh_backend_compiles"] > 0
+        warm = _run_worker("cachecheck", "--cache", cache)
+        assert warm.returncode == 0, warm.stderr[-2000:]
+        warm_out = json.loads(warm.stdout.strip().splitlines()[-1])
+        assert warm_out["status"] == "OK"
+        assert warm_out["fresh_backend_compiles"] == 0, warm_out
+        assert warm_out["cache_hits"] > 0
+        hurt = _run_worker("cachecheck", "--cache", cache, "--corrupt")
+        assert hurt.returncode == 0, hurt.stderr[-2000:]
+        out = json.loads(hurt.stdout.strip().splitlines()[-1])
+        assert out["status"] == "OK"
+        assert any("compilation cache" in w for w in out["warnings"]), \
+            out["warnings"]
+        assert out["fresh_backend_compiles"] > 0
+
+    def test_stale_cache_manifest_quarantined(self, tmp_path):
+        """A namespace whose CACHE_MANIFEST disagrees with its expected
+        identity is quarantined with a loud warning — never served."""
+        ns = tmp_path / "ns"
+        ns.mkdir()
+        (ns / serve_registry.CACHE_MANIFEST_NAME).write_text(
+            json.dumps({"config_sha256": "different"}))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            ok = serve_registry.verify_cache(
+                ns, {"config_sha256": "expected"})
+        assert ok is False
+        assert not ns.exists()          # renamed aside
+        assert any("quarantined" in str(x.message) for x in w)
+        quarantined = list(tmp_path.glob("ns.quarantined-*"))
+        assert len(quarantined) == 1
+        # An unreadable manifest takes the same lane.
+        ns2 = tmp_path / "ns2"
+        ns2.mkdir()
+        (ns2 / serve_registry.CACHE_MANIFEST_NAME).write_text("{trunc")
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            assert serve_registry.verify_cache(
+                ns2, {"config_sha256": "expected"}) is False
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestRestartDrill:
+    def test_cli_restart_drill_loses_nothing(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "svd_jacobi_tpu.cli", "serve-demo",
+             "--restart-drill", "--drill-requests", "4",
+             "--report-dir", "off"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=600.0, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, (out.stdout[-2000:],
+                                     out.stderr[-2000:])
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        assert summary["lost"] == []
+        assert summary["resumed"] >= len(summary["unfinalized_at_kill"])
+        assert summary["cold_start_s"] is not None
