@@ -54,6 +54,14 @@ Usage:
                                   against one cache dir; the warm row
                                   must report ZERO fresh compiles —
                                   PROFILE.md item 26)
+         --serve-twophase        (the don't-recompute ledger, all
+                                  same-session A/B: sigma-phase and
+                                  promote-to-full latency vs a cold
+                                  full solve, svd_update vs cold on a
+                                  rank-1-perturbed input, and the
+                                  result-cache hit row with its
+                                  zero-dispatch proof — PROFILE.md
+                                  item 27)
          --tuning-table=PATH     (pin a measured tuning table for every
                                   "auto" knob; =off bypasses tables —
                                   the builtin hand-picked heuristics.
@@ -388,6 +396,165 @@ def _serve_throughput(flags) -> None:
         }))
 
 
+def _serve_twophase(flags) -> None:
+    """--serve-twophase: the don't-recompute ledger (PROFILE.md item
+    27), one JSON row per lane, all same-session A/B on one live
+    service + solver:
+
+      * sigma-phase latency vs full-phase latency (what σ-first defers);
+      * promote-to-full latency vs a COLD full solve of the same
+        request — the >= 2x acceptance (promotion resumes the retained
+        stage; a cold solve pays every sweep again);
+      * `solver.svd_update` on a rank-r-perturbed input vs a cold
+        `solver.svd` — the >= 3x acceptance (warm start enters
+        near-diagonal; PROFILE.md item 4's convergence class);
+      * result-cache hit latency, with the zero-dispatch proof
+        (lane dispatch count unchanged across the hit).
+
+    Flags: --bucket=MxN:dtype (default 256x256:float32)
+           --reps=K            (median-of-K per row, default 5)
+           --update-n=N        (solver-level update A/B size, 512)
+           --pair-solver=NAME  (solver lane, default auto)
+    """
+    import os
+    import statistics
+
+    import jax
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from svd_jacobi_tpu.serve import as_bucket
+    bucket = as_bucket(flags.get("bucket", "256x256:float32"))
+    if bucket.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+    if "tuning-table" in flags:
+        from svd_jacobi_tpu import tune
+        tune.set_active_table(flags["tuning-table"])
+
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu import SVDConfig, solver
+    from svd_jacobi_tpu.serve import ServeConfig, SVDService
+    from svd_jacobi_tpu.utils import matgen
+
+    reps = int(flags.get("reps", "5"))
+    solver_cfg = SVDConfig(pair_solver=flags.get("pair-solver", "auto"))
+    dev = str(jax.devices()[0])
+    dt = jnp.dtype(bucket.dtype)
+    mats = [np.asarray(matgen.random_dense(bucket.m, bucket.n,
+                                           seed=4000 + i, dtype=dt))
+            for i in range(2 * reps + 1)]
+
+    cfg = ServeConfig(
+        buckets=(bucket,), solver=solver_cfg, max_queue_depth=64,
+        result_cache_bytes=256 << 20,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    svc = SVDService(cfg).start()
+    try:
+        svc.warmup(timeout=1800.0)
+
+        def _serve_lap(i, phase):
+            t0 = time.perf_counter()
+            t = svc.submit(mats[i], phase=phase)
+            res = t.result(timeout=1800.0)
+            dt_submit = time.perf_counter() - t0
+            assert res.error is None and res.status.name == "OK", res
+            return dt_submit, t
+
+        # Distinct inputs per rep: a repeated byte-identical full submit
+        # would be served by the result cache and time the WRONG thing.
+        full_s, sigma_s, promote_s = [], [], []
+        for i in range(reps):
+            d_full, _ = _serve_lap(1 + i, "full")
+            full_s.append(d_full)
+            d_sig, ticket = _serve_lap(1 + reps + i, "sigma")
+            sigma_s.append(d_sig)
+            t0 = time.perf_counter()
+            rp = ticket.promote(timeout=1800.0)
+            _force((rp.u, rp.s, rp.v))
+            promote_s.append(time.perf_counter() - t0)
+            assert rp.status.name == "OK"
+        full_t = statistics.median(full_s)
+        sigma_t = statistics.median(sigma_s)
+        promote_t = statistics.median(promote_s)
+        print(json.dumps({
+            "metric": f"serve_sigma_latency_{bucket.name}",
+            "value": round(sigma_t * 1e3, 2), "unit": "ms",
+            "full_ms": round(full_t * 1e3, 2),
+            "sigma_over_full": round(sigma_t / full_t, 3),
+            "reps": reps, "device": dev}))
+        print(json.dumps({
+            "metric": f"serve_promote_speedup_{bucket.name}",
+            "value": round(full_t / promote_t, 2),
+            "unit": "x vs cold full solve",
+            "promote_ms": round(promote_t * 1e3, 2),
+            "cold_full_ms": round(full_t * 1e3, 2),
+            "sigma_plus_promote_over_full":
+                round((sigma_t + promote_t) / full_t, 3),
+            "ok": full_t / promote_t >= 2.0,
+            "reps": reps, "device": dev}))
+
+        # Result-cache hit: mats[1] completed a clean full solve above —
+        # resubmit the SAME bytes; the hit must finalize at admission
+        # with the lane dispatch count unchanged.
+        dispatches = svc.fleet.lanes[0].dispatches
+        hit_s = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            t = svc.submit(mats[1])
+            res = t.result(timeout=60.0)
+            hit_s.append(time.perf_counter() - t0)
+            assert res.path == "cache", res.path
+        zero_dispatch = svc.fleet.lanes[0].dispatches == dispatches
+        print(json.dumps({
+            "metric": f"serve_cache_hit_latency_{bucket.name}",
+            "value": round(statistics.median(hit_s) * 1e3, 3),
+            "unit": "ms",
+            "vs_cold_full_x": round(full_t / statistics.median(hit_s), 1),
+            "zero_dispatch": zero_dispatch,
+            "ok": zero_dispatch,
+            "reps": reps, "device": dev}))
+    finally:
+        svc.stop(drain=False, timeout=60.0)
+
+    # Solver-level evolving-matrix A/B: cold svd vs warm-started
+    # svd_update on a rank-1-perturbed input (same session, same jits —
+    # both lanes warmed before timing).
+    n_upd = int(flags.get("update-n", "512"))
+    rng = np.random.default_rng(42)
+    a0 = jnp.asarray(rng.standard_normal((n_upd, n_upd)).astype(dt))
+    pert = (rng.standard_normal((n_upd, 1))
+            @ rng.standard_normal((1, n_upd))).astype(dt)
+    a_new = a0 + jnp.asarray(0.01 * pert / n_upd)
+    prior = solver.svd(a0, config=solver_cfg)
+    _force((prior.u, prior.s, prior.v))
+    cold_fn = lambda: solver.svd(a_new, config=solver_cfg)
+    warm_fn = lambda: solver.svd_update(prior, a_new, config=solver_cfg)
+    _force(cold_fn().s), _force(warm_fn().s)      # compile both lanes
+    cold_s, warm_s, sweeps = [], [], {}
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rc = cold_fn()
+        _force((rc.u, rc.s, rc.v))
+        cold_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        rw = warm_fn()
+        _force((rw.u, rw.s, rw.v))
+        warm_s.append(time.perf_counter() - t0)
+        sweeps = {"cold": int(rc.sweeps), "warm": int(rw.sweeps)}
+    cold_t, warm_t = (statistics.median(cold_s), statistics.median(warm_s))
+    print(json.dumps({
+        "metric": f"svd_update_speedup_{n_upd}",
+        "value": round(cold_t / warm_t, 2),
+        "unit": "x vs cold solve",
+        "cold_ms": round(cold_t * 1e3, 2),
+        "warm_ms": round(warm_t * 1e3, 2),
+        "sweeps": sweeps,
+        "ok": cold_t / warm_t >= 3.0,
+        "reps": reps, "device": dev}))
+
+
 def _sweep(passthrough) -> None:
     """Run every SWEEP_CONFIGS row in a fresh subprocess, forwarding all
     other flags verbatim (--reps, --oracle, --baseline keep their
@@ -494,6 +661,9 @@ def main() -> None:
         return
     if "serve-throughput" in flags:
         _serve_throughput(flags)
+        return
+    if "serve-twophase" in flags:
+        _serve_twophase(flags)
         return
     if "sweep" in flags:
         _sweep([f for f in sys.argv[1:]

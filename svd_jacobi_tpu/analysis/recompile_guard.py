@@ -264,6 +264,9 @@ def run_serve_sequence() -> tuple:
     r_findings, r_report = run_serve_rank_case()
     findings += r_findings
     report["rank"] = r_report
+    p_findings, p_report = run_serve_promote_case()
+    findings += p_findings
+    report["promote"] = p_report
     return findings, report
 
 
@@ -433,6 +436,78 @@ def run_serve_fleet_case(expected_problems: Optional[int] = None,
                      f"{report['serve_statuses']} — the retrace "
                      f"measurement is not trustworthy on a failing solve"),
             suggestion="fix the fleet serving solve path first"))
+    return findings, report
+
+
+# Two-phase (σ-then-promote) contract: a sigma-phase dispatch runs the
+# SAME sweep entries as a full one but terminates through the
+# sigma-first extraction (`solver._sigma_from_state_jit`, bucket-shaped
+# key), and `Ticket.promote` resumes the retained stage through the SAME
+# finish jits a full dispatch would have compiled — so the whole
+# σ/promote traffic pattern stays once-per-bucket: one sigma-extraction
+# compile and one finish compile per bucket, never per request, never
+# per promote.
+_PROMOTE_ENTRIES = ("solver._precondition_qr_jit",
+                    "solver._sweep_step_pallas_jit",
+                    "solver._sigma_from_state_jit",
+                    "solver._finish_pallas_jit",
+                    "solver._nonfinite_probe_jit")
+
+
+def run_serve_promote_case(expected_problems: Optional[int] = None,
+                           buckets: Optional[tuple] = None) -> tuple:
+    """The two-phase half of the serve retrace contract: a two-bucket
+    service fed two distinct request shapes per bucket, each submitted
+    ``phase="sigma"`` and then PROMOTED to full U/V, everything
+    repeated — the sigma-extraction jit and the finish jits must compile
+    once per bucket (RETRACE001 otherwise; repeats and promotes are pure
+    cache hits). This is the compile-cache side of the promote
+    acceptance: a promote is never a fresh solve, so it can never be a
+    fresh compile either once its bucket is warm.
+
+    ``expected_problems`` under-declares every budget and ``buckets``
+    substitutes FRESH problems — the seeded failing fixture (tests prove
+    the guard fires; a warm cache would mask a leak)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..serve import ServeConfig, SVDService
+    from ..utils import matgen
+
+    buckets = _SERVE_SEQUENCE_BUCKETS if buckets is None else tuple(buckets)
+    problems = (len(buckets) if expected_problems is None
+                else int(expected_problems))
+    shapes = [((m, n), (m - 4, n - 8)) for m, n, _ in buckets]
+    cfg = ServeConfig(
+        buckets=buckets,
+        solver=SVDConfig(pair_solver="pallas"),
+        max_queue_depth=8,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    statuses = []
+    with RecompileGuard() as guard:
+        for entry in _PROMOTE_ENTRIES:
+            guard.expect(entry, problems=problems)
+        with SVDService(cfg) as svc:
+            for _ in range(2):   # repeats must be pure cache hits
+                for group in shapes:
+                    tickets = [
+                        svc.submit(matgen.random_dense(
+                            m, n, seed=m * 313 + n, dtype=jnp.float32),
+                            phase="sigma")
+                        for m, n in group]
+                    for t in tickets:
+                        statuses.append(t.result(timeout=600.0).status)
+                        statuses.append(t.promote(timeout=600.0).status)
+        findings = guard.check()
+        report = guard.report()
+    report["serve_statuses"] = [getattr(s, "name", None) for s in statuses]
+    if any(s is None or s.name != "OK" for s in statuses):
+        findings.append(Finding(
+            code="RETRACE001", where="serve.run_serve_promote_case",
+            message=(f"σ-then-promote serve sequence produced non-OK "
+                     f"statuses {report['serve_statuses']} — the retrace "
+                     f"measurement is not trustworthy on a failing solve"),
+            suggestion="fix the two-phase serving path first"))
     return findings, report
 
 

@@ -313,6 +313,20 @@ RETRACE_BUDGETS = {
     "solver._sketch_project_batched_jit": 1,
     "solver._lift_q_jit": 1,
     "solver._lift_q_batched_jit": 1,
+    # Warm-start lane (solver.svd(v0=...) / svd_update): the pre-rotation
+    # B = A @ V0 and the factor composition V = V0 @ W — one compile per
+    # problem shape, never per update (a prior-factor leak into either
+    # key would retrace every incremental solve).
+    "solver._apply_v0_jit": 1,
+    "solver._compose_v0_jit": 1,
+    # Two-phase serving's sigma-first extraction (serve.SVDService
+    # phase="sigma"): sigma read straight off the retained sweep state,
+    # deferring the finish stage until promotion. Bucket-shaped like the
+    # stepper entries — once per bucket, never per request
+    # (analysis.recompile_guard.run_serve_promote_case proves it over
+    # sigma-then-promote request streams).
+    "solver._sigma_from_state_jit": 1,
+    "solver._sigma_from_state_batched_jit": 1,
 }
 
 # Batch-size tiers of the serving layer's coalesced dispatch

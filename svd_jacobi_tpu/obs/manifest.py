@@ -99,6 +99,12 @@ _SERVE_SCHEMA: Dict[str, Any] = {
     # required schema fields. In fleet mode (ServeConfig.lanes > 1) a
     # ``lane`` extra key carries the dispatching lane index.
 }
+# Two-phase serving fields of the serve record — optional (pre-σ-first
+# streams lack them) but type-checked when present (`validate`).
+_SERVE_PHASE_FIELDS: Dict[str, Any] = {
+    "phase": str,                       # "full" | "sigma" | "promote"
+    "promoted_from": (str, type(None)),
+}
 # Autotuner search records ("tune", written by tune.search per searched
 # shape): the full measured grid — baseline knobs/time, every candidate
 # point's knobs/time/ok, and the winning knob set — plus the id/hash of
@@ -139,6 +145,23 @@ _COLDSTART_SCHEMA: Dict[str, Any] = {
     "config_sha256": (str, type(None)),
 }
 _COLDSTART_ENTRY_FIELDS = {"entry": str, "time_s": _NUM, "cache_hit": bool}
+# Result-cache / promotion-store events ("cache", written by
+# serve.SVDService around serve.cache): one record per store / hit /
+# evict / invalidate of the content-addressed result cache and per
+# retain / promote / release / evict / rescue of the sigma-phase
+# promotion store, so the whole don't-recompute history (which request
+# hit, what was evicted under the byte budget, when a client
+# invalidated) reconstructs from the manifest stream. ``store`` names
+# which store ("result" | "promotion"); ``digest``/``request_id`` are
+# event-dependent (an invalidate-all has neither).
+_CACHE_SCHEMA: Dict[str, Any] = {
+    "store": str,                 # "result" | "promotion"
+    "event": str,                 # store|hit|evict|invalidate|retain|
+                                  # promote|release|rescue
+    "request_id": (str, type(None)),
+    "digest": (str, type(None)),  # SHA-256 input digest (result store)
+    "bytes": (int, type(None)),   # entry size (store/retain/evict)
+}
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
 
@@ -264,7 +287,9 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
                 batch_size: Optional[int] = None,
                 batch_tier: Optional[int] = None,
                 rank_mode: str = "full",
-                k: Optional[int] = None, **extra) -> dict:
+                k: Optional[int] = None,
+                phase: str = "full",
+                promoted_from: Optional[str] = None, **extra) -> dict:
     """Assemble a schema-valid per-request serving record
     (`serve.SVDService`). ``batch_id``/``batch_size``/``batch_tier``
     identify a COALESCED dispatch (micro-batched solve lane): every
@@ -274,7 +299,11 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
     ``rank_mode`` is the workload family the request dispatched through
     ("full" | "tall" | "topk") and ``k`` the requested top-k rank (None
     unless rank_mode is "topk") — together they make the truncated-
-    workload traffic reconstructable from the stream. ``extra``
+    workload traffic reconstructable from the stream. ``phase`` is the
+    two-phase serving stage this record closes ("full" | "sigma" |
+    "promote"); a "promote" record carries ``promoted_from`` — the
+    sigma-phase request id whose retained solve state it resumed — so a
+    σ-then-promote pair reconstructs from the stream. ``extra``
     (degraded, deadline_s, sweeps, error, ...) rides along like in
     `build`."""
     record = {
@@ -296,6 +325,33 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
         "batch_tier": None if batch_tier is None else int(batch_tier),
         "rank_mode": str(rank_mode),
         "k": None if k is None else int(k),
+        "phase": str(phase),
+        "promoted_from": (None if promoted_from is None
+                          else str(promoted_from)),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
+def build_cache(*, store: str, event: str,
+                request_id: Optional[str] = None,
+                digest: Optional[str] = None,
+                nbytes: Optional[int] = None, **extra) -> dict:
+    """Assemble a schema-valid cache event record (`serve.cache` via
+    `serve.SVDService`): see ``_CACHE_SCHEMA`` for the store/event
+    vocabulary. ``extra`` (count, evicted_of, lane, ...) rides along
+    like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "cache",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "store": str(store),
+        "event": str(event),
+        "request_id": None if request_id is None else str(request_id),
+        "digest": None if digest is None else str(digest),
+        "bytes": None if nbytes is None else int(nbytes),
     }
     record.update(extra)
     validate(record)
@@ -424,6 +480,15 @@ def validate(record: dict) -> None:
                           errors)
     elif record.get("kind") == "serve":
         _check_fields(record, _SERVE_SCHEMA, "record", errors)
+        # Two-phase fields are optional-by-forward-compatibility
+        # (records written before the σ-first lane lack them) but
+        # type-checked when present: "phase" names the serving stage the
+        # record closes, "promoted_from" the sigma request a promote
+        # resumed.
+        _check_fields({k: record[k] for k in _SERVE_PHASE_FIELDS
+                       if k in record},
+                      {k: t for k, t in _SERVE_PHASE_FIELDS.items()
+                       if k in record}, "record", errors)
     elif record.get("kind") == "tune":
         _check_fields(record, _TUNE_SCHEMA, "record", errors)
         for i, p in enumerate(record.get("grid") or []):
@@ -433,6 +498,8 @@ def validate(record: dict) -> None:
                               f"a 'knobs' dict")
     elif record.get("kind") == "fleet":
         _check_fields(record, _FLEET_SCHEMA, "record", errors)
+    elif record.get("kind") == "cache":
+        _check_fields(record, _CACHE_SCHEMA, "record", errors)
     elif record.get("kind") == "coldstart":
         _check_fields(record, _COLDSTART_SCHEMA, "record", errors)
         for i, e in enumerate(record.get("entries") or []):
@@ -665,6 +732,18 @@ def summarize(record: dict) -> str:
             line += (f"  elapsed={record.get('elapsed_s', float('nan')):.2f}s"
                      f" budget={record.get('budget_s', float('nan')):.2f}s")
         return line
+    if record.get("kind") == "cache":
+        line = (f"cache {record.get('store', '?')}/{record.get('event', '?')}"
+                f" @ {record.get('timestamp', '?')}")
+        if record.get("request_id") is not None:
+            line += f"  req={record['request_id']}"
+        if record.get("digest") is not None:
+            line += f"  digest={str(record['digest'])[:12]}"
+        if record.get("bytes") is not None:
+            line += f"  {record['bytes']} B"
+        if record.get("count") is not None:
+            line += f"  count={record['count']}"
+        return line
     if record.get("kind") == "serve":
         req = record.get("request", {})
         wait = record.get("queue_wait_s", float("nan"))
@@ -678,6 +757,13 @@ def summarize(record: dict) -> str:
                 f" breaker={record.get('breaker', '?')}"
                 f" brownout={record.get('brownout', '?')}"
                 f" wait={wait * 1e3:.1f}ms solve={solve_s}")
+        if record.get("phase", "full") != "full":
+            # Two-phase branch: a sigma-first request shows its phase; a
+            # promote shows which sigma request's retained state it
+            # resumed — the σ-then-promote pair pairs up in the stream.
+            line += f" phase={record['phase']}"
+            if record.get("promoted_from"):
+                line += f"<-{record['promoted_from']}"
         if record.get("rank_mode", "full") != "full":
             # Top-k / tall workload branch: a truncated request shows its
             # rank, a tall one its TSQR routing — the summarizer's view

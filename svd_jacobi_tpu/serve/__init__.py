@@ -30,7 +30,14 @@ The request-level robustness layer (PR 4) on top of the solve-level one
     (a warm restart pays ZERO fresh compiles), a write-ahead fsync'd
     request journal with exactly-once replay after SIGKILL
     (`SVDService.recover`), and zero-downtime `SVDService.reload`
-    (background AOT warm, atomic swap) — README "Restart & cold start".
+    (background AOT warm, atomic swap) — README "Restart & cold start";
+  * two-phase σ-first serving + content-addressed result cache
+    (`cache`): ``submit(phase="sigma")`` returns σ at interactive
+    latency with the solve's checkpointed stage retained under a byte
+    budget, ``Ticket.promote()`` resumes the SAME solve to full U/V
+    (never a fresh solve), and byte-identical full-phase resubmits
+    finalize at admission with zero dispatch — README "Two-phase &
+    incremental serving".
 
 Quickstart::
 
@@ -50,6 +57,7 @@ from __future__ import annotations
 
 from .breaker import BreakerState, Brownout, CircuitBreaker
 from .buckets import Bucket, BucketSet, as_bucket
+from .cache import PromotionError, PromotionStore, ResultCache
 from .fleet import Fleet, Lane, LaneState
 from .journal import Journal
 from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
@@ -61,7 +69,7 @@ __all__ = [
     "AdmissionError", "AdmissionQueue", "AdmissionReason", "Bucket",
     "BucketSet", "BreakerState", "Brownout", "CircuitBreaker",
     "CompileCounter", "EntryKey", "EntryRegistry", "Fleet", "Journal",
-    "Lane", "LaneState", "Request", "ServeConfig", "ServeResult",
-    "SVDService", "Ticket", "as_bucket", "enable_persistent_cache",
-    "jit_entries",
+    "Lane", "LaneState", "PromotionError", "PromotionStore", "Request",
+    "ResultCache", "ServeConfig", "ServeResult", "SVDService", "Ticket",
+    "as_bucket", "enable_persistent_cache", "jit_entries",
 ]

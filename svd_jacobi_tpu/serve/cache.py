@@ -1,0 +1,292 @@
+"""Content-addressed result cache + byte-budgeted promotion store.
+
+The don't-recompute-what-you-know half of the serving layer (ROADMAP
+"Two-phase lazy-vector serving + streaming updates"), two stores:
+
+**ResultCache** — completed full decompositions keyed by content: the
+SHA-256 digest of the submitted input bytes plus everything that shapes
+the answer (oriented shape, dtype, compute flags, top-k rank, routed
+bucket, and the bucket's resolved solver-config hash — the PR 9
+`config_hash` discipline, so a tuning-table or config change can never
+serve a stale result). A hit finalizes the request in O(ms) with ZERO
+solver dispatch, checked on a digest fast-path at admission so a hit
+never occupies a queue slot (`SVDService.submit`). Explicit invalidation
+(`invalidate(digest)` — the client's "this matrix changed" signal, or
+`invalidate()` for everything) plus byte-budget LRU eviction keep it
+bounded; every store/hit/evict/invalidate appends a schema-versioned
+``"cache"`` manifest record (`obs.manifest.build_cache`).
+
+**PromotionStore** — the retained solve state of sigma-phase requests
+(`submit(phase="sigma")`): the preconditioned triangle L (+ Q1/order),
+the converged column stacks, and the ACCUMULATED ROTATION PRODUCT of the
+sweep loop — everything `Ticket.promote()` needs to resume the SAME
+solve from its checkpointed stage to full U/V (one finish-stage
+dispatch; never a fresh solve). Byte-budgeted LRU with explicit release;
+a promote after eviction raises `PromotionError` loudly (the client can
+always fall back to a full re-submit — which the ResultCache may then
+serve). States are process-local device arrays: they do NOT survive a
+restart (the journal re-solves a recovered sigma request instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, List, Optional
+
+
+class PromotionError(RuntimeError):
+    """Loud promotion failure: no retained state for the request (never
+    a sigma-phase request, state evicted/released, non-OK sigma solve,
+    or a restarted process). The caller's recourse is a fresh full
+    submit — possibly a result-cache hit."""
+
+
+def _nbytes(x) -> int:
+    return int(getattr(x, "nbytes", 0) or 0)
+
+
+def tree_nbytes(*xs) -> int:
+    """Total byte size of a loose collection of arrays/Nones (the stores'
+    budget accounting; nested dicts of arrays count their values)."""
+    total = 0
+    for x in xs:
+        if x is None:
+            continue
+        if isinstance(x, dict):
+            total += tree_nbytes(*x.values())
+        elif isinstance(x, (tuple, list)):
+            total += tree_nbytes(*x)
+        else:
+            total += _nbytes(x)
+    return total
+
+
+@dataclasses.dataclass
+class PromotionState:
+    """Everything needed to resume one sigma-phase solve to full U/V.
+
+    ``kind`` selects the resume path:
+
+      * ``"state"`` — the checkpointed stepper stage: single-form
+        (member-sliced, for coalesced dispatches) column/rotation stacks
+        plus the preconditioning factors; promotion runs the SAME finish
+        jits the full-phase dispatch would have (`solver._finish_pallas_jit`
+        / `_finish_jit` — already bucket-compiled), then the bucket
+        family's lift and the request's slice.
+      * ``"result"`` — the factors already exist (a sigma request served
+        on the escalation-ladder path, whose fused solve computes them
+        anyway): promotion returns them with no device work at all.
+    """
+
+    kind: str                     # "state" | "result"
+    bucket: Any                   # serve.buckets.Bucket
+    # -- request identity (for the promote-time slice + manifest record)
+    m: int
+    n: int
+    transposed: bool
+    compute_u: bool               # the REQUEST's factor flags
+    compute_v: bool
+    top_k: Optional[int]
+    digest: Optional[str]         # input digest when the cache computed one
+    lane: int
+    # -- kind="state": the checkpointed stage -----------------------------
+    path: str = "kernel"          # "kernel" | "xla" (which finish jit)
+    top: Any = None
+    bot: Any = None
+    vtop: Any = None
+    vbot: Any = None
+    work: Any = None              # preconditioned triangle L (kernel path)
+    q1: Any = None
+    order: Any = None
+    core_n: int = 0               # the CORE problem's logical n
+    precondition: bool = False
+    refine: bool = False
+    core_u: bool = False          # the CORE solve's compute flags
+    core_v: bool = False
+    lift: Any = None              # _pre_core context (tall/topk families)
+    off_rel: float = 0.0
+    sweeps: int = 0
+    # -- kind="result": the finished factors ------------------------------
+    u: Any = None
+    s: Any = None
+    v: Any = None
+    # Terminal solve status (the retained sweep loop's own — promotion
+    # re-reports it; a SolveStatus code array or int).
+    status: Any = None
+    created: float = dataclasses.field(default_factory=time.monotonic)
+    nbytes: int = 0
+
+    def measure(self) -> "PromotionState":
+        self.nbytes = tree_nbytes(self.top, self.bot, self.vtop, self.vbot,
+                                  self.work, self.q1, self.order, self.lift,
+                                  self.u, self.s, self.v)
+        return self
+
+
+class PromotionStore:
+    """Byte-budgeted LRU of `PromotionState`s, keyed by request id.
+
+    ``put`` returns the ids it evicted to fit (the service records each
+    as a "cache" manifest event, kind promotion/evict — an evicted
+    client's promote fails LOUDLY, never silently serves stale factors).
+    A state larger than the whole budget is refused (returned as its own
+    eviction) rather than silently wedging the store."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._d: "OrderedDict[str, PromotionState]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.stats = {"put": 0, "promoted": 0, "released": 0, "evicted": 0,
+                      "missing": 0}
+
+    def put(self, request_id: str, ps: PromotionState) -> List[str]:
+        ps.measure()
+        evicted: List[str] = []
+        with self._lock:
+            if self.max_bytes <= 0 or ps.nbytes > self.max_bytes:
+                # Retaining nothing is a loud contract (promote raises);
+                # report the refused state as an eviction of its own id.
+                self.stats["evicted"] += 1
+                return [request_id]
+            old = self._d.pop(request_id, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._d and self._bytes + ps.nbytes > self.max_bytes:
+                rid, victim = self._d.popitem(last=False)
+                self._bytes -= victim.nbytes
+                evicted.append(rid)
+                self.stats["evicted"] += 1
+            self._d[request_id] = ps
+            self._bytes += ps.nbytes
+            self.stats["put"] += 1
+        return evicted
+
+    def take(self, request_id: str) -> PromotionState:
+        """Pop the state for promotion; `PromotionError` when absent."""
+        with self._lock:
+            ps = self._d.pop(request_id, None)
+            if ps is None:
+                self.stats["missing"] += 1
+                raise PromotionError(
+                    f"no promotion state retained for request "
+                    f"{request_id!r} (not a sigma-phase request, already "
+                    f"promoted/released, evicted under the byte budget, "
+                    f"or the serving process restarted)")
+            self._bytes -= ps.nbytes
+            self.stats["promoted"] += 1
+            return ps
+
+    def release(self, request_id: str) -> bool:
+        """Explicitly drop a retained state (the client will never
+        promote); True when something was held."""
+        with self._lock:
+            ps = self._d.pop(request_id, None)
+            if ps is None:
+                return False
+            self._bytes -= ps.nbytes
+            self.stats["released"] += 1
+            return True
+
+    def __contains__(self, request_id: str) -> bool:
+        with self._lock:
+            return request_id in self._d
+
+    def retag_lane(self, lane_index: int, new_lane: int = -1) -> List[str]:
+        """Promotion-state rescue on lane eviction (`fleet.Fleet.evict`):
+        re-tag every state held for an evicted lane so the stream shows
+        who was rescued. The retained arrays themselves stay valid — they
+        are process-local (committed to a device whose runtime is still
+        alive even when its LANE is quarantined), and the promote-time
+        finish jits run wherever the caller dispatches them. Returns the
+        re-tagged request ids."""
+        with self._lock:
+            out = []
+            for rid, ps in self._d.items():
+                if ps.lane == lane_index:
+                    ps.lane = new_lane
+                    out.append(rid)
+            return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._d), "bytes": self._bytes,
+                    "max_bytes": self.max_bytes, **self.stats}
+
+
+class ResultCache:
+    """Byte-budgeted LRU of finished host-side factor sets, keyed by
+    ``(input digest, identity string)`` — see the module docstring for
+    what the identity covers. Values are host numpy arrays (a hit must
+    not depend on any device's health) plus the terminal metadata the
+    finalize needs."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._d: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "stores": 0, "evicted": 0,
+                      "invalidated": 0}
+
+    @staticmethod
+    def entry_nbytes(entry: dict) -> int:
+        return tree_nbytes(entry.get("u"), entry.get("s"), entry.get("v"))
+
+    def get(self, key: tuple) -> Optional[dict]:
+        with self._lock:
+            entry = self._d.get(key)
+            if entry is None:
+                self.stats["misses"] += 1
+                return None
+            self._d.move_to_end(key)
+            self.stats["hits"] += 1
+            return entry
+
+    def put(self, key: tuple, entry: dict) -> "tuple[bool, List[tuple]]":
+        """Store one entry; returns ``(stored, evicted_keys)``. An entry
+        larger than the whole budget is REFUSED (``stored`` False, no
+        stats bump) — the caller must not record a store that never
+        happened."""
+        nb = self.entry_nbytes(entry)
+        evicted: List[tuple] = []
+        with self._lock:
+            if self.max_bytes <= 0 or nb > self.max_bytes:
+                return False, evicted
+            old = self._d.pop(key, None)
+            if old is not None:
+                self._bytes -= self.entry_nbytes(old)
+            while self._d and self._bytes + nb > self.max_bytes:
+                k, victim = self._d.popitem(last=False)
+                self._bytes -= self.entry_nbytes(victim)
+                evicted.append(k)
+                self.stats["evicted"] += 1
+            self._d[key] = entry
+            self._bytes += nb
+            self.stats["stores"] += 1
+        return True, evicted
+
+    def invalidate(self, digest: Optional[str] = None) -> int:
+        """Drop every entry of one input digest (the client's "this
+        matrix changed" signal), or everything when ``digest`` is None.
+        Returns the number of entries dropped."""
+        with self._lock:
+            if digest is None:
+                n = len(self._d)
+                self._d.clear()
+                self._bytes = 0
+            else:
+                victims = [k for k in self._d if k[0] == digest]
+                for k in victims:
+                    self._bytes -= self.entry_nbytes(self._d.pop(k))
+                n = len(victims)
+            self.stats["invalidated"] += n
+            return n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._d), "bytes": self._bytes,
+                    "max_bytes": self.max_bytes, **self.stats}

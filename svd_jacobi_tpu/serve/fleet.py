@@ -306,6 +306,15 @@ class Fleet:
                 # rescued (long-terminal) request as in flight forever.
                 lane.in_flight = []
         self.rescue_requests(lane, rescued, cause=cause)
+        # Promotion-state rescue: retained sigma-phase states of the
+        # evicted lane stay promotable (they are process-local arrays;
+        # the promote-time finish jits run wherever the caller
+        # dispatches), but the stream must show who was carried across
+        # the eviction — one "cache" rescue event per retained state.
+        for rid in self.service.promotions.retag_lane(lane.index):
+            self.service._record_cache("promotion", "rescue",
+                                       request_id=rid, lane=lane.index,
+                                       cause=cause)
         self.service._record_fleet(event="healthz", lane=None,
                                    healthz=self.healthz())
 

@@ -66,23 +66,45 @@ class JournalState(NamedTuple):
                 if rid not in self.finalized]
 
 
-def _encode_array(a) -> dict:
+def _encode_array(a, mode: str = "full") -> dict:
+    """Journal payload for one input matrix. ``mode="full"`` carries the
+    bytes (base64 — ~21 MB per 2048² float32 request, PROFILE.md item
+    26's documented durability tax) so a crashed request is re-solvable;
+    ``mode="digest"`` journals only the SHA-256 + shape/dtype — the tax
+    drops to O(100 B), but the bytes are NOT recoverable and a crashed
+    request replays as a loud ERROR instead of a re-solve
+    (`decode_array`)."""
     import numpy as np
+    if mode not in ("full", "digest"):
+        raise ValueError(f"journal payload mode must be 'full' or "
+                         f"'digest', got {mode!r}")
     a = np.ascontiguousarray(np.asarray(a))
     raw = a.tobytes()
-    return {
+    payload = {
         "shape": [int(d) for d in a.shape],
         "dtype": str(a.dtype),
-        "data_b64": base64.b64encode(raw).decode("ascii"),
         "data_sha256": hashlib.sha256(raw).hexdigest(),
     }
+    if mode == "full":
+        payload["data_b64"] = base64.b64encode(raw).decode("ascii")
+    return payload
 
 
 def decode_array(payload: dict):
     """Rebuild (and integrity-check) a journaled input matrix. Raises
     `ValueError` on a checksum mismatch — a corrupted payload must not be
-    silently solved as if it were the client's data."""
+    silently solved as if it were the client's data — and on a
+    digest-only payload (``journal_payload="digest"``), whose bytes are
+    gone by design: recovery finalizes that request ERROR loudly
+    (path="recovery"), never silently."""
     import numpy as np
+    if "data_b64" not in payload:
+        raise ValueError(
+            f"digest-only journal payload (sha256="
+            f"{str(payload.get('data_sha256'))[:12]}..., shape="
+            f"{tuple(payload.get('shape', ()))}): the input bytes were "
+            f"not journaled (ServeConfig.journal_payload='digest') and "
+            f"cannot be recovered")
     raw = base64.b64decode(payload["data_b64"])
     digest = hashlib.sha256(raw).hexdigest()
     if digest != payload["data_sha256"]:
@@ -123,11 +145,14 @@ class Journal:
     # -- writers ------------------------------------------------------------
 
     def append_admit(self, req, *, attempt: int = 1,
-                     admitted_wall: Optional[float] = None) -> None:
+                     admitted_wall: Optional[float] = None,
+                     payload_mode: str = "full") -> None:
         """Journal one admitted request — called BEFORE the queue admit
         (write-ahead). ``admitted_wall`` preserves the ORIGINAL admit
         time across recovery rewrites so deadline budgets keep decaying
-        from the client's real submit, not from each restart."""
+        from the client's real submit, not from each restart.
+        ``payload_mode`` selects the input encoding (`_encode_array`):
+        "full" bytes or "digest" fingerprint-only."""
         rec = {
             "journal_version": JOURNAL_VERSION,
             "kind": "admit",
@@ -147,7 +172,8 @@ class Journal:
             "deadline_s": (None if req.deadline_s is None
                            else float(req.deadline_s)),
             "top_k": None if req.top_k is None else int(req.top_k),
-            "input": _encode_array(req.a),
+            "phase": str(getattr(req, "phase", "full")),
+            "input": _encode_array(req.a, payload_mode),
         }
         with self._lock:
             append_jsonl(self.path, rec)

@@ -85,6 +85,14 @@ class Request:
     # Workload family of the routed bucket ("full" | "tall" | "topk"),
     # recorded per-request in the serve manifest (`rank_mode`).
     rank_mode: str = "full"
+    # Two-phase serving (`submit(phase=...)`): "full" solves to U/Σ/V as
+    # always; "sigma" returns σ only and RETAINS the solve's checkpointed
+    # stage for `Ticket.promote()` (serve.cache.PromotionStore).
+    phase: str = "full"
+    # SHA-256 of the oriented input bytes, computed at admission when the
+    # content-addressed result cache is enabled (None otherwise): the
+    # finalize path stores a successful full result under it.
+    digest: Optional[str] = None
 
 
 class AdmissionQueue:

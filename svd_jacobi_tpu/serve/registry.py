@@ -108,6 +108,15 @@ def jit_entries() -> Dict[str, object]:
             solver._sketch_project_batched_jit,
         "solver._lift_q_jit": solver._lift_q_jit,
         "solver._lift_q_batched_jit": solver._lift_q_batched_jit,
+        # Warm-start lane (svd(v0=...) / svd_update): pre-rotation and
+        # exact factor composition around the existing entry points.
+        "solver._apply_v0_jit": solver._apply_v0_jit,
+        "solver._compose_v0_jit": solver._compose_v0_jit,
+        # Two-phase serving's sigma-first extraction: sigma read off the
+        # retained sweep state, deferring the finish stage to promotion.
+        "solver._sigma_from_state_jit": solver._sigma_from_state_jit,
+        "solver._sigma_from_state_batched_jit":
+            solver._sigma_from_state_batched_jit,
     }
 
 
@@ -177,8 +186,10 @@ class EntryKey(NamedTuple):
 
     @property
     def device_free(self) -> "EntryKey":
-        """The lane-independent coordinate (AOT lowering carries no
-        device pinning, so one compile covers every lane's cache)."""
+        """The lane-independent coordinate; `EntryRegistry.aot_warm`
+        pairs it with the lane's DEVICE to dedup — lanes sharing a
+        device share executables, lanes with distinct devices each get
+        their own pinned compile."""
         return self._replace(lane=0)
 
 
@@ -191,7 +202,8 @@ class EntryRegistry:
 
     def __init__(self, buckets: BucketSet, solver_map: dict,
                  tiers_map: dict, base_solver, *, max_batch: int = 1,
-                 lanes: int = 1, default_tiers: Tuple[int, ...] = (1,)):
+                 lanes: int = 1, default_tiers: Tuple[int, ...] = (1,),
+                 lane_devices: Optional[list] = None):
         self.buckets = buckets
         self._solver_map = dict(solver_map)
         self._tiers_map = dict(tiers_map)
@@ -199,6 +211,15 @@ class EntryRegistry:
         self.max_batch = int(max_batch)
         self.lanes = int(lanes)
         self._default_tiers = tuple(default_tiers)
+        # Per-lane device assignment (fleet mode pins each lane's working
+        # set with device_put): AOT plans carry the lane's device as a
+        # SingleDeviceSharding on every spec, so `lower().compile()`
+        # warms the per-lane executable caches too — not just
+        # device-unpinned programs whose zero-solve dispatches would
+        # otherwise pay the per-lane compiles live. None entries (or a
+        # missing list) keep the device-free lowering (lanes == 1).
+        self._lane_devices = (list(lane_devices)
+                              if lane_devices is not None else None)
         # Bucket affinity, mirroring fleet routing: declaration order
         # (the BucketSet's cost-sorted order) modulo lane count.
         self._home = {b: i % self.lanes for i, b in enumerate(buckets)}
@@ -209,7 +230,8 @@ class EntryRegistry:
         return cls(service.buckets, service._bucket_solver,
                    service._bucket_tiers, cfg.solver,
                    max_batch=cfg.max_batch, lanes=cfg.lanes,
-                   default_tiers=service._tiers)
+                   default_tiers=service._tiers,
+                   lane_devices=[l.device for l in service.fleet.lanes])
 
     # -- enumeration --------------------------------------------------------
 
@@ -261,6 +283,33 @@ class EntryRegistry:
 
     # -- the AOT compile plan ----------------------------------------------
 
+    def lane_device(self, lane: int):
+        """The device lane ``lane`` pins its working set to (None when
+        unpinned — single-lane services and registries built without a
+        fleet, e.g. the analysis passes)."""
+        if self._lane_devices is None or lane >= len(self._lane_devices):
+            return None
+        return self._lane_devices[lane]
+
+    @staticmethod
+    def _pin_spec(spec, device):
+        """Attach a lane's device to one ShapeDtypeStruct as a
+        SingleDeviceSharding, so the AOT lowering compiles the SAME
+        device-pinned executable the live dispatch (whose inputs went
+        through ``jax.device_put(x, lane.device)``) will request. Falls
+        back to the unpinned spec on a jax without sharded
+        ShapeDtypeStruct construction."""
+        if spec is None or device is None:
+            return spec
+        import jax
+        try:
+            from jax.sharding import SingleDeviceSharding
+            return jax.ShapeDtypeStruct(spec.shape, spec.dtype,
+                                        sharding=SingleDeviceSharding(
+                                            device))
+        except (ImportError, TypeError):
+            return spec
+
     def aot_plan(self, key: EntryKey) -> List[tuple]:
         """The exact jit call plan of one entry: ``(entry_name, jit_fn,
         args, kwargs)`` with `jax.ShapeDtypeStruct` args, covering the
@@ -269,7 +318,11 @@ class EntryRegistry:
         `BatchedSweepStepper.aot_entries`), and the factor lift — every
         program the live dispatch path will request, none it won't.
         Nothing is executed; shapes come from `jax.eval_shape` over the
-        live helpers."""
+        live helpers. When the registry carries per-lane devices (fleet
+        mode), every spec is pinned to ``key.lane``'s device
+        (`_pin_spec`), so the compiled executable matches the one the
+        live dispatch — whose inputs went through ``device_put(x,
+        lane.device)`` — will request from the persistent cache."""
         import functools
 
         import jax
@@ -325,8 +378,11 @@ class EntryRegistry:
             # The factor lift (service._post_core): U = Q @ Z. Z's spec
             # comes from the finish entry's abstract result — tall lifts
             # the core's U, top-k the core's V truncated to the bucket's
-            # rank class.
-            fin_name, fin_fn, fin_args, fin_kwargs = stepper_plan[-2]
+            # rank class. Looked up by NAME (the plan's tail also carries
+            # the nonfinite probe and the sigma-first extraction, so a
+            # positional pick would grab the wrong entry).
+            fin_name, fin_fn, fin_args, fin_kwargs = next(
+                e for e in stepper_plan if "finish" in e[0])
             u_s, s_s, v_s = jax.eval_shape(
                 functools.partial(fin_fn, **fin_kwargs), *fin_args)
             z_s = u_s if b.kind == "tall" else v_s
@@ -339,6 +395,11 @@ class EntryRegistry:
                 lname = ("solver._lift_q_batched_jit" if batched
                          else "solver._lift_q_jit")
                 plan.append((lname, lf, (lift_q_spec, z_s), {}))
+        dev = self.lane_device(key.lane)
+        if dev is not None:
+            plan = [(name, fn,
+                     tuple(self._pin_spec(s, dev) for s in args), kwargs)
+                    for name, fn, args, kwargs in plan]
         return plan
 
     def aot_compile(self, key: EntryKey) -> dict:
@@ -364,16 +425,22 @@ class EntryRegistry:
     def aot_warm(self, *, sigma_only: bool = True,
                  progress: Optional[Callable[[dict], None]] = None
                  ) -> List[dict]:
-        """AOT-compile every enumerated entry, deduplicating the
-        lane axis (the lowered executables carry no device pinning, so
-        one compile per (bucket, tier, variant) covers the fleet).
-        Returns the per-entry stats list for the coldstart record."""
+        """AOT-compile every enumerated entry, deduplicating the lane
+        axis BY DEVICE: lanes sharing a device (or a registry with no
+        lane devices at all) share executables, so one compile per
+        (bucket, tier, variant, device) covers the fleet — and with
+        distinct per-lane devices the plan's pinned specs warm each
+        lane's own executables too, not just device-unpinned programs
+        (whose zero-solve dispatches would otherwise pay the per-lane
+        compiles live). Returns the per-entry stats list for the
+        coldstart record."""
         seen = set()
         out = []
         for key in self.entries(sigma_only=sigma_only):
-            if key.device_free in seen:
+            dedup = (key.device_free, self.lane_device(key.lane))
+            if dedup in seen:
                 continue
-            seen.add(key.device_free)
+            seen.add(dedup)
             info = self.aot_compile(key)
             out.append(info)
             if progress is not None:

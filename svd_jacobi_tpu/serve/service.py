@@ -93,10 +93,15 @@ class ServeResult(NamedTuple):
 class Ticket:
     """Client handle: blocks on `result`, requests cancellation with
     `cancel` (cooperative — takes effect at the next sweep boundary, or
-    at dispatch when still queued)."""
+    at dispatch when still queued). A sigma-phase ticket
+    (``submit(phase="sigma")``) additionally carries `promote` — resume
+    the SAME retained solve to full U/V — and `release` — drop the
+    retained state when the factors will never be wanted."""
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str, service=None, phase: str = "full"):
         self.request_id = request_id
+        self.phase = phase
+        self._service = service
         self._done = threading.Event()
         self._result: Optional[ServeResult] = None
         self._cancel = threading.Event()
@@ -107,6 +112,33 @@ class Ticket:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def promote(self, timeout: Optional[float] = None) -> ServeResult:
+        """Resume THIS sigma-phase request's retained solve to full
+        U/Σ/V — never a fresh solve: the promotion runs the finish-stage
+        jits (already bucket-compiled) on the checkpointed column/
+        rotation stacks, or returns the already-finished factors when
+        the sigma dispatch went through a fused path (escalation ladder,
+        mixed coalesced batch). Blocks up to ``timeout`` for the sigma
+        result first. Raises `serve.cache.PromotionError` when no state
+        is retained (not a sigma request, already promoted/released,
+        evicted under the byte budget, non-OK sigma solve, or a
+        restarted process) — the loud fallback is a fresh full submit,
+        which the result cache may then serve."""
+        from .cache import PromotionError
+        if self._service is None:
+            raise PromotionError(
+                f"ticket {self.request_id!r} is not promotable (no "
+                f"owning service — e.g. a journal-recovered handle)")
+        sigma = self.result(timeout)
+        return self._service._promote(self, sigma)
+
+    def release(self) -> bool:
+        """Drop the retained promotion state (the factors will never be
+        wanted); True when something was held."""
+        if self._service is None:
+            return False
+        return self._service._release_promotion(self.request_id)
 
     def _finalize_once(self, result: ServeResult) -> bool:
         """Install the terminal result EXACTLY once; False when another
@@ -162,6 +194,13 @@ class ServeConfig:
     # to host and fsyncs per lifecycle event — a measured durability tax
     # (PROFILE.md item 26).
     journal_path: Optional[str] = None
+    # Journal payload mode: "full" journals the input BYTES (base64 —
+    # ~21 MB per 2048² float32 request, item 26's dominant cost) so a
+    # crashed request replays as a re-solve; "digest" journals only the
+    # SHA-256 + shape/dtype — the per-request tax drops to O(100 B), and
+    # a crashed request whose bytes are gone finalizes ERROR
+    # path="recovery" LOUDLY on replay, never silently.
+    journal_payload: str = "full"
     # Root directory of the persistent executable cache: warmup's AOT
     # compiles land in ``<dir>/<config-hash>/`` via JAX's persistent
     # compilation cache (`registry.enable_persistent_cache`; the
@@ -246,6 +285,20 @@ class ServeConfig:
     # unhealthy — evicted with its queued requests rescued — instead of
     # wedging the service behind it. None disables.
     ladder_watchdog_s: Optional[float] = None
+    # --- two-phase serving + result cache (serve.cache module) -----------
+    # Byte budget of the `PromotionStore` retaining sigma-phase solve
+    # state (`submit(phase="sigma")` -> `Ticket.promote()`): column/
+    # rotation stacks + preconditioning factors per retained request,
+    # LRU-evicted under the budget (an evicted client's promote raises
+    # `PromotionError` loudly). 0 disables retention — sigma requests
+    # still serve σ, promotion always raises.
+    promotion_store_bytes: int = 256 * 1024 * 1024
+    # Byte budget of the content-addressed `ResultCache`: completed full
+    # decompositions keyed by SHA-256 input digest + bucket + resolved
+    # solver-config hash; a hit finalizes at admission with ZERO solver
+    # dispatch and no queue slot. 0 disables (no digesting — the exact
+    # pre-cache submit path).
+    result_cache_bytes: int = 0
 
 
 class SVDService:
@@ -282,6 +335,9 @@ class SVDService:
         if config.lane_failure_threshold < 1 or config.lane_open_threshold < 1:
             raise ValueError("lane_failure_threshold and "
                              "lane_open_threshold must be >= 1")
+        if config.journal_payload not in ("full", "digest"):
+            raise ValueError(f"journal_payload must be 'full' or "
+                             f"'digest', got {config.journal_payload!r}")
         self._tiers = tiers
         self.config = config
         self._records: list = []
@@ -309,6 +365,20 @@ class SVDService:
             self._cache_ns, meta = _registry.enable_persistent_cache(
                 config.compile_cache_dir, config.solver)
             self._cache_hash = meta["config_sha256"]
+        # Two-phase serving + content-addressed result cache: the
+        # PromotionStore retains sigma-phase solve state for
+        # `Ticket.promote`; the ResultCache finalizes byte-identical
+        # resubmits at admission with zero dispatch (serve.cache module
+        # docstring). Both byte-budgeted LRU, both observable through
+        # "cache" manifest records.
+        from .cache import PromotionStore, ResultCache
+        self.promotions = PromotionStore(config.promotion_store_bytes)
+        self.result_cache = ResultCache(config.result_cache_bytes)
+        # Per-bucket resolved-config content hashes (the PR 9
+        # `config_hash` discipline) for the result-cache key — memoized
+        # at first use, cleared by `reload`'s swap (a reloaded solver
+        # config must never serve a stale cached result).
+        self._bucket_cfg_hash: dict = {}
         # Durable request journal (write-ahead; see `recover`).
         from .journal import Journal
         self.journal = (Journal(config.journal_path)
@@ -613,7 +683,7 @@ class SVDService:
             debt = state.unfinalized
             for rec in debt:
                 rid = rec["id"]
-                ticket = Ticket(rid)
+                ticket = Ticket(rid, self, str(rec.get("phase", "full")))
                 tickets[rid] = ticket
                 deadline_s = rec.get("deadline_s")
                 try:
@@ -650,7 +720,8 @@ class SVDService:
                               else now_mono + remaining),
                     deadline_s=deadline_s, submitted=now_mono,
                     cancel=ticket._cancel, ticket=ticket,
-                    top_k=rec.get("top_k"), rank_mode=bucket.kind)
+                    top_k=rec.get("top_k"), rank_mode=bucket.kind,
+                    phase=str(rec.get("phase", "full")))
                 try:
                     lane = self.fleet.route(bucket)
                 except AdmissionError as e:
@@ -722,7 +793,7 @@ class SVDService:
             breaker=self.breaker.state().value,
             brownout=str(rec.get("brownout", "FULL")), degraded=False,
             deadline_s=rec.get("deadline_s"), error=error,
-            k=rec.get("top_k"))
+            k=rec.get("top_k"), phase=str(rec.get("phase", "full")))
         return True
 
     def reload(self, *, buckets=None, solver: Optional[SVDConfig] = None,
@@ -804,6 +875,10 @@ class SVDService:
                     self.config = new_cfg
                     self.registry = new_registry
                     self._cache_ns, self._cache_hash = new_ns, new_hash
+                    # Result-cache identity memo: a reloaded solver
+                    # config re-hashes at next use — old entries' keys
+                    # simply never match again (LRU drains them).
+                    self._bucket_cfg_hash = {}
                     self.fleet._bucket_home = {
                         b: i % self.fleet.size for i, b in enumerate(nb)}
                 self._last_reload_error = None
@@ -876,6 +951,8 @@ class SVDService:
             "in_flight": in_flight,
             "stats": stats,
             "fleet": self.fleet.healthz(),
+            "result_cache": self.result_cache.snapshot(),
+            "promotions": self.promotions.snapshot(),
         }
 
     def records(self) -> list:
@@ -904,7 +981,8 @@ class SVDService:
     def submit(self, a, *, compute_u: bool = True, compute_v: bool = True,
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
-               top_k: Optional[int] = None) -> Ticket:
+               top_k: Optional[int] = None,
+               phase: str = "full") -> Ticket:
         """Admit one request: returns a `Ticket` or raises
         `AdmissionError` (reason: SHUTDOWN | NO_BUCKET | BROWNOUT_SHED |
         QUEUE_FULL | DEADLINE_BUDGET). ``deadline_s`` is relative to now;
@@ -919,7 +997,18 @@ class SVDService:
         (n, k)), solved through the randomized range-finder lane of a
         "topk" bucket whose rank class covers k (`buckets` module
         docstring; no declared topk bucket -> NO_BUCKET). Clamped to
-        min(m, n). The accuracy contract is `solver.svd_topk`'s."""
+        min(m, n). The accuracy contract is `solver.svd_topk`'s.
+
+        ``phase="sigma"`` is the two-phase lane: the response carries σ
+        only (u/v None — interactive latency, the finish stage's factor
+        recombination/refinement matmuls are DEFERRED), and the solve's
+        checkpointed stage is retained under the promotion byte budget
+        so `Ticket.promote()` can resume it to full U/V later; the
+        compute flags declare which factors a promote should produce.
+        With the result cache enabled (``result_cache_bytes > 0``), a
+        full-phase submit whose input digest + config identity hits a
+        completed prior result finalizes HERE — zero solver dispatch, no
+        queue slot — and the ticket returns already done."""
         import math
 
         import jax
@@ -953,6 +1042,9 @@ class SVDService:
             # A rank beyond min(m, n) adds only exact-zero sigmas —
             # clamp, so clients need not know the orientation rules.
             top_k = min(top_k, int(min(a.shape)))
+        if phase not in ("full", "sigma"):
+            raise ValueError(f"phase must be 'full' or 'sigma', got "
+                             f"{phase!r}")
         rid = request_id or f"r{next(self._seq):05d}"
         orig_shape = tuple(int(d) for d in a.shape)
         transposed = a.shape[0] < a.shape[1]
@@ -1006,13 +1098,31 @@ class SVDService:
                     AdmissionReason.NONFINITE_INPUT,
                     "input contains NaN/Inf — rejected before any solve "
                     "is spent (resilience.guard policy)")
+            # Content-addressed fast-path: with the result cache on,
+            # digest the oriented input and try to finalize HERE — a hit
+            # costs zero solver dispatch and no queue slot, so it also
+            # (deliberately) bypasses the SHED rung below: serving it
+            # adds no load. Only full-phase requests consult the cache;
+            # the promotion store is the sigma phase's own reuse lane.
+            digest = None
+            if self.result_cache.max_bytes > 0:
+                digest = self._input_digest(a)
+                if phase == "full":
+                    hit = self._cache_lookup(
+                        rid, digest, bucket, m=m, n=n,
+                        orig_shape=orig_shape,
+                        transposed=transposed, compute_u=compute_u,
+                        compute_v=compute_v, top_k=top_k, brown=brown,
+                        deadline_s=deadline_s)
+                    if hit is not None:
+                        return hit
             if brown is Brownout.SHED:
                 raise AdmissionError(
                     AdmissionReason.BROWNOUT_SHED,
                     f"queue fill {self.queue.depth()}/"
                     f"{self.queue.max_depth} at shed threshold")
             now = time.monotonic()
-            ticket = Ticket(rid)
+            ticket = Ticket(rid, self, phase)
             req = Request(
                 id=rid, a=a, m=m, n=n, orig_shape=orig_shape,
                 transposed=transposed, bucket=bucket,
@@ -1024,7 +1134,8 @@ class SVDService:
                           else now + float(deadline_s)),
                 deadline_s=deadline_s, submitted=now,
                 cancel=ticket._cancel, ticket=ticket,
-                top_k=top_k, rank_mode=bucket.kind)
+                top_k=top_k, rank_mode=bucket.kind,
+                phase=phase, digest=digest)
             # Bucket-affinity routing: the bucket's home lane, or the
             # next ACTIVE one (lane 0 always, when lanes == 1). Raises
             # NO_LANE when the whole fleet is quarantined.
@@ -1037,7 +1148,8 @@ class SVDService:
                 # — a durability promise that cannot be recorded must
                 # not be made). A post-journal queue rejection appends a
                 # finalize record below so replay never resurrects it.
-                self.journal.append_admit(req)
+                self.journal.append_admit(
+                    req, payload_mode=self.config.journal_payload)
                 journaled = True
             lane.queue.admit(req)
             if lane.state is not LaneState.ACTIVE:
@@ -1061,10 +1173,145 @@ class SVDService:
                          brownout=brown.name, degraded=False,
                          deadline_s=deadline_s, error=e.detail,
                          rank_mode="topk" if top_k is not None else "full",
-                         k=top_k)
+                         k=top_k, phase=phase)
             raise
         self._bump("submitted")
         return ticket
+
+    # -- content-addressed result cache (serve.cache.ResultCache) -----------
+
+    @staticmethod
+    def _input_digest(a) -> str:
+        """SHA-256 of the ORIENTED input bytes (host pull for device
+        arrays — the cache trades one D2H copy per submit for whole
+        skipped solves on every byte-identical resubmit)."""
+        import hashlib
+
+        import numpy as _np
+        return hashlib.sha256(
+            _np.ascontiguousarray(_np.asarray(a)).tobytes()).hexdigest()
+
+    def _cfg_hash_for(self, bucket) -> str:
+        """Content hash of the bucket's declaration-time resolved solver
+        config — the PR 9 `config_hash` discipline in the cache key: a
+        config or tuning-table change resolves to a different hash, so a
+        stale result can never be served (memo cleared on `reload`)."""
+        h = self._bucket_cfg_hash.get(bucket)
+        if h is None:
+            from .. import obs
+            h = obs.manifest.config_hash(self._solver_for(bucket))
+            self._bucket_cfg_hash[bucket] = h
+        return h
+
+    def _cache_key(self, digest: str, bucket, *, m: int, n: int,
+                   transposed: bool, compute_u: bool, compute_v: bool,
+                   top_k: Optional[int]) -> tuple:
+        """The result-cache identity: everything that shapes the answer.
+        The digest covers the oriented bytes and ``(m, n)`` their
+        LOGICAL shape (byte-identical buffers reshaped differently can
+        route to the same padded bucket — their factors differ);
+        ``transposed`` keeps an A-vs-Aᵀ client pair from sharing; the
+        bucket + resolved-config hash cover routing and every solver
+        knob; the flags/k cover which factors exist at what rank."""
+        return (digest, int(m), int(n), bucket.name,
+                self._cfg_hash_for(bucket),
+                bool(transposed), bool(compute_u), bool(compute_v),
+                None if top_k is None else int(top_k))
+
+    def _cache_store(self, *, request_id: str, digest: str, bucket,
+                     m: int, n: int, transposed: bool, compute_u: bool,
+                     compute_v: bool, top_k: Optional[int],
+                     u, s, v, status, sweeps: int) -> None:
+        """The ONE result-cache store path (full-phase finalize AND
+        promote): host-copy the factors, store under the content key,
+        and record the event — but only when the cache actually took
+        the entry (an over-budget entry is refused; recording a store
+        that never happened would make the stream lie)."""
+        import numpy as _np
+        entry = {
+            "u": None if u is None else _np.asarray(u),
+            "s": _np.asarray(s),
+            "v": None if v is None else _np.asarray(v),
+            "status": int(status),
+            "sweeps": int(sweeps),
+        }
+        key = self._cache_key(digest, bucket, m=m, n=n,
+                              transposed=transposed, compute_u=compute_u,
+                              compute_v=compute_v, top_k=top_k)
+        stored, evicted = self.result_cache.put(key, entry)
+        if stored:
+            self._record_cache(
+                "result", "store", request_id=request_id, digest=digest,
+                nbytes=self.result_cache.entry_nbytes(entry))
+        for k_ev in evicted:
+            self._record_cache("result", "evict", digest=k_ev[0])
+
+    def _cache_lookup(self, rid: str, digest: str, bucket, *,
+                      m: int, n: int,
+                      orig_shape, transposed: bool, compute_u: bool,
+                      compute_v: bool, top_k: Optional[int], brown,
+                      deadline_s) -> Optional[Ticket]:
+        """The admission fast-path: a cache hit finalizes the request
+        right here — an O(ms) host-copy finalize, zero solver dispatch,
+        no queue slot — with a "cache" hit event and an ordinary "serve"
+        record (path="cache") in the stream. None on miss."""
+        from ..solver import SolveStatus
+        key = self._cache_key(digest, bucket, m=m, n=n,
+                              transposed=transposed,
+                              compute_u=compute_u, compute_v=compute_v,
+                              top_k=top_k)
+        entry = self.result_cache.get(key)
+        if entry is None:
+            return None
+        ticket = Ticket(rid, self, "full")
+        result = ServeResult(
+            u=entry["u"], s=entry["s"], v=entry["v"],
+            status=SolveStatus(int(entry["status"])), error=None,
+            sweeps=int(entry["sweeps"]), bucket=bucket.name,
+            queue_wait_s=0.0, solve_time_s=0.0, path="cache",
+            degraded=False, request_id=rid)
+        ticket._finalize_once(result)
+        self._record_cache("result", "hit", request_id=rid, digest=digest)
+        self._bump("submitted", "served", "cache_hits", "status:OK",
+                   "path:cache")
+        self._record(request_id=rid, orig_shape=orig_shape,
+                     dtype=bucket.dtype, bucket=bucket.name,
+                     queue_wait_s=0.0, solve_time_s=0.0, status="OK",
+                     path="cache", breaker=self.breaker.state().value,
+                     brownout=brown.name, degraded=False,
+                     deadline_s=deadline_s, sweeps=int(entry["sweeps"]),
+                     rank_mode=bucket.kind, k=top_k)
+        return ticket
+
+    def _maybe_cache_result(self, req: Request, result: ServeResult,
+                            status_name: str, path: str) -> None:
+        """Store a completed full-phase OK result under its content key
+        (called from `_finalize` after the exactly-once write wins).
+        Only clean base/ladder full solves are cacheable: degraded,
+        partial (DEADLINE/CANCELLED), errored, or sigma-phase outcomes
+        must never satisfy a future full request."""
+        if (req.digest is None or req.phase == "sigma" or req.degraded
+                or status_name != "OK"
+                or path in ("rejected", "recovery", "rescue")
+                or result.s is None):
+            return
+        self._cache_store(request_id=req.id, digest=req.digest,
+                          bucket=req.bucket, m=req.m, n=req.n,
+                          transposed=req.transposed,
+                          compute_u=req.compute_u,
+                          compute_v=req.compute_v, top_k=req.top_k,
+                          u=result.u, s=result.s, v=result.v,
+                          status=int(result.status),
+                          sweeps=int(result.sweeps))
+
+    def invalidate_cached(self, digest: Optional[str] = None) -> int:
+        """Explicit cache invalidation — the client's "this matrix
+        changed" signal (one input digest) or a full flush (None).
+        Returns the number of entries dropped; appends one "cache"
+        invalidate event either way."""
+        n = self.result_cache.invalidate(digest)
+        self._record_cache("result", "invalidate", digest=digest, count=n)
+        return n
 
     # -- worker -------------------------------------------------------------
 
@@ -1237,6 +1484,11 @@ class SVDService:
             path, _ = lane.breaker.begin()
             cu = req.compute_u and not req.degraded
             cv = req.compute_v and not req.degraded
+            # Sigma phase: the solve still accumulates rotations (the
+            # request's own flags — promotion needs them) but terminates
+            # sigma-first, capturing the checkpointed stage here.
+            cap = ({} if (req.phase == "sigma" and not req.degraded)
+                   else None)
             t0 = time.monotonic()
             error = None
             r = None
@@ -1244,7 +1496,8 @@ class SVDService:
                 if path == "ladder":
                     r = self._solve_ladder(lane, req, cu, cv)
                 else:
-                    r = self._solve_base(lane, req, cu, cv)
+                    r = self._solve_base(lane, req, cu, cv,
+                                         sigma_capture=cap)
                 status = r.status_enum()
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
@@ -1262,6 +1515,19 @@ class SVDService:
                 status_name = "ERROR"
             else:
                 u, s, v, sweeps = self._slice(req, r, cu, cv)
+                if (req.phase == "sigma" and not req.degraded
+                        and status is SolveStatus.OK):
+                    # Retain the promotion state: the captured stage on
+                    # the base path, or the already-finished factors on
+                    # the fused ladder path (kind="result" — promote
+                    # then costs nothing).
+                    payload = None if cap is None else cap.get("payload")
+                    self._retain_promotion(
+                        req, lane, payload=payload,
+                        lift=None if cap is None else cap.get("lift"),
+                        factors=(u, s, v), status=status, sweeps=sweeps)
+                if req.phase == "sigma":
+                    u = v = None
                 result = ServeResult(
                     u=u, s=s, v=v, status=status, error=None, sweeps=sweeps,
                     bucket=req.bucket.name, queue_wait_s=queue_wait,
@@ -1338,6 +1604,15 @@ class SVDService:
         try:
             cu = any(r.compute_u and not r.degraded for r in live)
             cv = any(r.compute_v and not r.degraded for r in live)
+            # A batch whose EVERY member defers (sigma phase, degraded,
+            # or factor-free) terminates sigma-first with ONE payload per
+            # member (`BatchedSweepStepper.sigma_finish`); a mixed batch
+            # runs the full batched finish and sigma members retain
+            # their already-finished factors instead (kind="result").
+            all_sigma = all((rq.phase == "sigma") or rq.degraded
+                            or not (rq.compute_u or rq.compute_v)
+                            for rq in live)
+            cap = {} if all_sigma else None
             deadlines = [r.deadline for r in live if r.deadline is not None]
             deadline = min(deadlines) if deadlines else None
             should_cancel = lambda: all(r.cancel.is_set() for r in live)
@@ -1346,7 +1621,8 @@ class SVDService:
             r = None
             try:
                 r = self._solve_batched(lane, live, bucket, tier, cu, cv,
-                                        deadline, should_cancel)
+                                        deadline, should_cancel,
+                                        sigma_capture=cap)
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
             solve_time = time.monotonic() - t0
@@ -1406,6 +1682,18 @@ class SVDService:
                 # members — the same loud PARTIAL result the serial
                 # lane's mid-solve control stops produce.
                 u, s, v, sweeps_j = self._slice_member(req, r, j, cu, cv)
+                if (req.phase == "sigma" and not req.degraded
+                        and status_j is SolveStatus.OK):
+                    payload = lift_j = None
+                    if cap is not None and cap.get("payloads"):
+                        payload = cap["payloads"][j]
+                        lift_j = self._member_lift(cap.get("lift"), j)
+                    self._retain_promotion(
+                        req, lane, payload=payload, lift=lift_j,
+                        factors=(u, s, v), status=status_j,
+                        sweeps=sweeps_j)
+                if req.phase == "sigma":
+                    u = v = None
                 result = ServeResult(
                     u=u, s=s, v=v, status=status_j, error=None,
                     sweeps=sweeps_j, bucket=req.bucket.name,
@@ -1423,11 +1711,14 @@ class SVDService:
                 lane.in_flight = []
 
     def _solve_batched(self, lane, live, bucket, tier, cu, cv, deadline,
-                       should_cancel):
+                       should_cancel, sigma_capture: Optional[dict] = None):
         """One coalesced dispatch: pad each member to the bucket, stack,
         zero-pad the tail slots to the batch tier (exact — an all-zero
         member deflates in one sweep), and run the batched host-stepped
-        solve under the composed control."""
+        solve under the composed control. With ``sigma_capture`` (an
+        all-sigma batch) the finish stage defers: one member-sliced
+        promotion payload per member lands in the capture dict
+        (cf. `_solve_base`)."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -1470,6 +1761,16 @@ class SVDService:
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
+            # Explicit sigma_refine runs the full batched finish (see
+            # `_solve_base`) — sigma members retain finished factors.
+            if ((sigma_capture is not None or not (ccu or ccv))
+                    and not bool(scfg.sigma_refine)):
+                res, payloads = st.sigma_finish(state)
+                if sigma_capture is not None:
+                    sigma_capture["payloads"] = payloads
+                    sigma_capture["lift"] = lift
+                return self._post_core(bucket, lift, res, cu, cv,
+                                       batched=True)
             return self._post_core(bucket, lift, st.finish(state),
                                    cu, cv, batched=True)
         finally:
@@ -1595,16 +1896,33 @@ class SVDService:
         state = self._place(st.init(), lane)
         while st.should_continue(state):
             state = st.step(state)
-        r = st.finish(state)
+        # Factor-free variants terminate sigma-first, exactly like the
+        # live dispatch paths (`_solve_base`) — so the warmup compiles
+        # the sigma-extraction jits the brownout/sigma-phase traffic
+        # will actually request, not a finish variant it never runs.
+        # (With explicit sigma_refine the live paths run the full
+        # finish instead — mirror that here or warmup under-compiles.)
+        r = (st.sigma_finish(state)[0]
+             if not (ccu or ccv) and not bool(scfg.sigma_refine)
+             else st.finish(state))
         return self._post_core(bucket, lift, r, cu, cv,
                                batched=batch is not None)
 
-    def _solve_base(self, lane: Lane, req: Request, cu: bool, cv: bool):
+    def _solve_base(self, lane: Lane, req: Request, cu: bool, cv: bool,
+                    sigma_capture: Optional[dict] = None):
         """The normal path: pad to the bucket, run the bucket family's
         pre-stage (`_pre_core`: TSQR for tall, sketch+project for topk,
         identity for full), then the host-stepped solver under
         cooperative control — one control check (and one lane heartbeat)
-        per sweep — and the family's lift (`_post_core`)."""
+        per sweep — and the family's lift (`_post_core`).
+
+        Sigma-first termination: with ``sigma_capture`` given (a
+        sigma-phase request) — or whenever NO factors are wanted (the
+        SIGMA_ONLY brownout rung and factor-free submits reuse the sigma
+        phase verbatim) — the finish stage's recombination/refinement
+        matmuls are SKIPPED: σ is read straight off the converged stacks
+        (`SweepStepper.sigma_finish`) and the checkpointed stage lands
+        in ``sigma_capture`` for `Ticket.promote` to resume later."""
         import jax.numpy as jnp
 
         from ..resilience import chaos
@@ -1642,6 +1960,19 @@ class SVDService:
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
+            # Explicit SVDConfig(sigma_refine=True) runs the FULL finish
+            # even for sigma/factor-free termination: the compensated
+            # refinement needs the recombined factors, and sigma-first
+            # would silently serve unrefined σ the operator asked to
+            # refine. Sigma-phase requests then retain the finished
+            # factors (kind="result") instead of a deferred stage.
+            if ((sigma_capture is not None or not (ccu or ccv))
+                    and not bool(scfg.sigma_refine)):
+                res, payload = st.sigma_finish(state)
+                if sigma_capture is not None:
+                    sigma_capture["payload"] = payload
+                    sigma_capture["lift"] = lift
+                return self._post_core(req.bucket, lift, res, cu, cv)
             return self._post_core(req.bucket, lift, st.finish(state),
                                    cu, cv)
         finally:
@@ -1717,6 +2048,159 @@ class SVDService:
             u, v = v, u
         return u, s, v, int(r.sweeps)
 
+    # -- two-phase promotion (serve.cache.PromotionStore) -------------------
+
+    @staticmethod
+    def _member_lift(lift: Optional[dict], j: int) -> Optional[dict]:
+        """Member ``j``'s slice of a batched pre-stage lift context (the
+        range basis Q and the stage health flag are member-major)."""
+        if lift is None:
+            return None
+        return {"kind": lift["kind"], "q": lift["q"][j],
+                "nf": lift["nf"][j]}
+
+    def _retain_promotion(self, req: Request, lane: Lane, *,
+                          payload: Optional[dict], lift: Optional[dict],
+                          factors: tuple, status, sweeps: int) -> None:
+        """Retain one OK sigma-phase solve for `Ticket.promote`: the
+        deferred-finish payload when the dispatch terminated sigma-first
+        (kind="state"), else — fused ladder path, mixed coalesced batch
+        — the already-sliced factors (kind="result"). A solve that
+        accumulated no rotation product (flags off) retains nothing:
+        there is nothing to resume, and promote says so loudly."""
+        from .cache import PromotionState
+        common = dict(bucket=req.bucket, m=req.m, n=req.n,
+                      transposed=req.transposed, compute_u=req.compute_u,
+                      compute_v=req.compute_v, top_k=req.top_k,
+                      digest=req.digest, lane=lane.index)
+        if payload is not None and payload.get("promotable"):
+            ps = PromotionState(
+                kind="state", path=payload["path"], top=payload["top"],
+                bot=payload["bot"], vtop=payload["vtop"],
+                vbot=payload["vbot"], work=payload["work"],
+                q1=payload["q1"], order=payload["order"],
+                core_n=payload["n"], precondition=payload["precondition"],
+                refine=payload["refine"], core_u=payload["compute_u"],
+                core_v=payload["compute_v"], lift=lift,
+                off_rel=payload["off_rel"], sweeps=payload["sweeps"],
+                status=payload["status"], **common)
+        else:
+            u, s, v = factors
+            if u is None and v is None:
+                return    # nothing a promote could add (flags off)
+            ps = PromotionState(kind="result", u=u, s=s, v=v,
+                                status=int(status), sweeps=int(sweeps),
+                                **common)
+        evicted = self.promotions.put(req.id, ps)
+        if req.id not in evicted:
+            self._bump("promotion_retained")
+            self._record_cache("promotion", "retain", request_id=req.id,
+                               nbytes=ps.nbytes, lane=lane.index)
+        for rid in evicted:
+            self._bump("promotion_evicted")
+            self._record_cache("promotion", "evict", request_id=rid)
+
+    def _promote(self, ticket: Ticket, sigma: ServeResult) -> ServeResult:
+        """Resume a retained sigma-phase solve to full U/Σ/V (the
+        `Ticket.promote` body): pop the state exactly-once, run the SAME
+        already-compiled finish jits on the checkpointed stage (or
+        return the already-finished factors, kind="result"), lift
+        through the bucket family's pre-stage context, slice to the
+        request — never a sweep, never a fresh solve. Appends a "cache"
+        promote event plus an ordinary "serve" record whose ``phase`` is
+        "promote" and whose ``promoted_from`` names the sigma request it
+        resumed."""
+        from .cache import PromotionError
+        from ..solver import SolveStatus
+        rid = ticket.request_id
+        if ticket.phase != "sigma":
+            raise PromotionError(
+                f"request {rid!r} was not submitted with phase='sigma' "
+                f"(nothing was retained to resume)")
+        if sigma.status is not SolveStatus.OK or sigma.error is not None:
+            # take() below would also miss (non-OK solves retain
+            # nothing); say why instead of a generic "no state".
+            raise PromotionError(
+                f"sigma-phase request {rid!r} did not solve OK "
+                f"(status={getattr(sigma.status, 'name', None)}, "
+                f"error={sigma.error!r}); promote has nothing to resume "
+                f"— fall back to a full re-submit")
+        ps = self.promotions.take(rid)   # raises PromotionError if gone
+        t0 = time.perf_counter()
+        if ps.kind == "result":
+            u, s, v = ps.u, ps.s, ps.v
+            status = SolveStatus(int(ps.status))
+            sweeps = int(ps.sweeps)
+        else:
+            from .. import solver
+            r = solver.finish_from_payload(dict(
+                path=ps.path, top=ps.top, bot=ps.bot, vtop=ps.vtop,
+                vbot=ps.vbot, work=ps.work, q1=ps.q1, order=ps.order,
+                n=ps.core_n, compute_u=ps.core_u, compute_v=ps.core_v,
+                full_u=False, precondition=ps.precondition,
+                refine=ps.refine, v0=None, status=ps.status,
+                sweeps=ps.sweeps, off_rel=ps.off_rel))
+            r = self._post_core(ps.bucket, ps.lift, r,
+                                ps.compute_u, ps.compute_v)
+            u, s, v, sweeps = self._slice_ps(ps, r)
+            status = r.status_enum()
+        solve_time = time.perf_counter() - t0
+        pid = f"{rid}+p"
+        result = ServeResult(
+            u=u, s=s, v=v, status=status, error=None, sweeps=sweeps,
+            bucket=ps.bucket.name, queue_wait_s=0.0,
+            solve_time_s=solve_time, path="base", degraded=False,
+            request_id=pid)
+        self._record_cache("promotion", "promote", request_id=rid)
+        # A promoted result IS a clean full solve of these bytes — store
+        # it so a byte-identical full resubmit after a σ→promote flow
+        # hits instead of re-solving (same admission guard as
+        # `_maybe_cache_result`: clean OK full factors only).
+        if (ps.digest is not None and status is SolveStatus.OK
+                and s is not None and self.result_cache.max_bytes > 0):
+            self._cache_store(request_id=pid, digest=ps.digest,
+                              bucket=ps.bucket, m=ps.m, n=ps.n,
+                              transposed=ps.transposed,
+                              compute_u=ps.compute_u,
+                              compute_v=ps.compute_v, top_k=ps.top_k,
+                              u=u, s=s, v=v, status=int(status),
+                              sweeps=sweeps)
+        self._bump("served", "promotions", f"status:{status.name}")
+        orig_shape = ((ps.n, ps.m) if ps.transposed else (ps.m, ps.n))
+        self._record(request_id=pid, orig_shape=orig_shape,
+                     dtype=ps.bucket.dtype, bucket=ps.bucket.name,
+                     queue_wait_s=0.0, solve_time_s=solve_time,
+                     status=status.name, path="base",
+                     breaker=self.breaker.state().value, brownout="FULL",
+                     degraded=False, deadline_s=None, sweeps=sweeps,
+                     rank_mode=ps.bucket.kind, k=ps.top_k,
+                     phase="promote", promoted_from=rid)
+        return result
+
+    @staticmethod
+    def _slice_ps(ps, r):
+        """`_slice` over a PromotionState's retained request identity
+        (the Request object is long gone by promote time)."""
+        k = min(ps.m, ps.n)
+        if ps.top_k is not None:
+            k = min(k, ps.top_k)
+        u = (r.u[:ps.m, :k]
+             if (ps.compute_u and r.u is not None) else None)
+        s = r.s[:k]
+        v = (r.v[:ps.n, :k]
+             if (ps.compute_v and r.v is not None) else None)
+        if ps.transposed:
+            u, v = v, u
+        return u, s, v, int(r.sweeps)
+
+    def _release_promotion(self, request_id: str) -> bool:
+        ok = self.promotions.release(request_id)
+        if ok:
+            self._bump("promotion_released")
+            self._record_cache("promotion", "release",
+                               request_id=request_id)
+        return ok
+
     # -- bookkeeping --------------------------------------------------------
 
     def _control_result(self, req: Request, status_name: str,
@@ -1753,12 +2237,21 @@ class SVDService:
         request can legitimately be finalized twice-over — once by the
         rescue path, once by a sick worker that eventually woke up — and
         only the first writer may count."""
+        # Cache BEFORE the exactly-once install: the client unblocks the
+        # moment the ticket flips, and a resubmit racing in must find
+        # the entry already stored. Storing on the losing side of a
+        # rescue race is harmless — the guard admits only clean
+        # base/ladder OK results, which are correct for these bytes no
+        # matter which finalizer won the ticket.
+        self._maybe_cache_result(req, result, status_name, path)
         if not req.ticket._finalize_once(result):
             return False
         self._journal_finalize(req.id, status_name)
         self._bump("served", f"status:{status_name}",
                    *(["path:ladder"] if path == "ladder" else []),
                    *(["degraded"] if req.degraded else []),
+                   *([f"phase:{req.phase}"] if req.phase != "full"
+                     else []),
                    *([f"rank_mode:{req.rank_mode}"]
                      if req.rank_mode != "full" else []))
         self._record(
@@ -1771,7 +2264,7 @@ class SVDService:
             sweeps=result.sweeps, error=result.error,
             batch_id=batch_id, batch_size=batch_size,
             batch_tier=batch_tier, lane=lane,
-            rank_mode=req.rank_mode, k=req.top_k)
+            rank_mode=req.rank_mode, k=req.top_k, phase=req.phase)
         return True
 
     def _finalize_rescue(self, req: Request, status_name: str,
@@ -1844,7 +2337,9 @@ class SVDService:
                 batch_tier: Optional[int] = None,
                 lane: Optional[int] = None,
                 rank_mode: str = "full",
-                k: Optional[int] = None) -> None:
+                k: Optional[int] = None,
+                phase: str = "full",
+                promoted_from: Optional[str] = None) -> None:
         from .. import obs
         record = obs.manifest.build_serve(
             request_id=request_id, m=orig_shape[0], n=orig_shape[1],
@@ -1857,8 +2352,21 @@ class SVDService:
             sweeps=sweeps, error=error, batch_id=batch_id,
             batch_size=batch_size, batch_tier=batch_tier,
             lane=(None if lane is None else int(lane)),
-            rank_mode=str(rank_mode), k=(None if k is None else int(k)))
+            rank_mode=str(rank_mode), k=(None if k is None else int(k)),
+            phase=str(phase), promoted_from=promoted_from)
         self._store(record)
+
+    def _record_cache(self, store: str, event: str, *,
+                      request_id: Optional[str] = None,
+                      digest: Optional[str] = None,
+                      nbytes: Optional[int] = None, **extra) -> None:
+        """Append one schema-versioned "cache" record (result-cache
+        store/hit/evict/invalidate, promotion retain/promote/release/
+        evict/rescue) to the same stream as the "serve" records."""
+        from .. import obs
+        self._store(obs.manifest.build_cache(
+            store=store, event=event, request_id=request_id,
+            digest=digest, nbytes=nbytes, **extra))
 
     def _record_fleet(self, *, event: str, lane: Optional[int] = None,
                       **extra) -> None:

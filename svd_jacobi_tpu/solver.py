@@ -1231,6 +1231,7 @@ def svd(
     compute_v: bool = True,
     full_matrices: bool = False,
     config: SVDConfig | None = None,
+    v0=None,
 ) -> SVDResult:
     """One-sided block-Jacobi SVD: ``a = u @ diag(s) @ v.T``.
 
@@ -1241,6 +1242,11 @@ def svd(
         the SVD_OPTIONS surface matching lib/JacobiMethods.cuh:25-29.
       full_matrices: return U as (m, m) instead of economy (m, min(m, n)).
       config: solver configuration (block size, tolerance, sweeps, dtypes).
+      v0: optional (n, n) ORTHONORMAL warm-start right factor (a prior
+        solve's ``v`` of a nearby matrix — see `svd_update`): the solve
+        runs on ``A @ v0``, which enters near-diagonal, and the returned
+        ``v`` composes ``v0`` back in exactly. Requires m >= n (wide
+        warm starts go through `svd_update`, which transposes).
 
     Returns:
       SVDResult(u, s, v, sweeps, off_rel) with s descending.
@@ -1251,6 +1257,9 @@ def svd(
     if a.ndim != 2:
         raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
     m, n = a.shape
+    if v0 is not None:
+        v0 = _check_v0(v0, m, n)
+        a = _apply_v0_jit(a, v0)
     if m < n:
         r = svd(a.T, compute_u=compute_v, compute_v=compute_u,
                 full_matrices=full_matrices, config=config)
@@ -1266,11 +1275,15 @@ def svd(
                   else (u is not None or v is not None))
         if refine and (u is not None or v is not None):
             # Parity with the Pallas path and the mesh solver: the XLA
-            # block solvers run on A directly, so the working matrix IS a.
+            # block solvers run on A directly, so the working matrix IS a
+            # (on a warm start, the pre-rotated A @ v0 — whose sigmas are
+            # A's own, v0 being orthonormal).
             u, s, v = _refine_xla_jit(a, u, s, v, n=n,
                                       with_u=u is not None,
                                       with_v=v is not None,
                                       full_u=bool(full_matrices))
+    if v0 is not None and v is not None:
+        v = _compose_v0_jit(v0, v)
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
                      status=status)
 
@@ -1284,6 +1297,105 @@ def _refine_xla_jit(a, u, s, v, *, n, with_u, with_v, full_u):
     if with_v:
         v = v2
     return u, s, v
+
+
+# ---------------------------------------------------------------------------
+# Warm-started solves (ROADMAP "Two-phase lazy-vector serving + streaming
+# updates"): seed the Jacobi loop with a prior right factor V0. The working
+# matrix enters as B = A @ V0 — near-diagonal when V0 is (close to) A's
+# right factor, so the per-round threshold skipping collapses the already-
+# orthogonal subspace and the loop converges in 1-2 sweeps instead of the
+# 10+ a cold solve pays (PROFILE.md item 4's quadratic-convergence data;
+# item 27 measures the warm-vs-cold sweep counts). Factors compose
+# EXACTLY: B = U S W^T gives A = B V0^T = U S (V0 W)^T, so V = V0 @ W —
+# valid for every solve path on B (preconditioned or not), which is why
+# the warm start is two matmuls around the existing entry points instead
+# of a new solver mode.
+
+
+@jax.jit
+def _apply_v0_jit(a, v0):
+    """The warm-start pre-rotation ``B = A @ V0`` at HIGHEST precision
+    (V0 must be orthonormal — the factor composition below is exact only
+    then; a prior solve's ``v`` is orthonormal to working precision)."""
+    with scope("warm_start"):
+        hi = jax.lax.Precision.HIGHEST
+        acc = jnp.promote_types(a.dtype, jnp.float32)
+        return jnp.matmul(a.astype(acc), v0.astype(acc),
+                          precision=hi).astype(a.dtype)
+
+
+@jax.jit
+def _compose_v0_jit(v0, v):
+    """The warm-start factor composition ``V = V0 @ W`` (see module
+    comment above `_apply_v0_jit`)."""
+    with scope("warm_start"):
+        hi = jax.lax.Precision.HIGHEST
+        acc = jnp.promote_types(v.dtype, jnp.float32)
+        return jnp.matmul(v0.astype(acc), v.astype(acc),
+                          precision=hi).astype(v.dtype)
+
+
+def _check_v0(v0, m: int, n: int):
+    """Validate a warm-start factor's shape/orientation (values are NOT
+    checked — orthonormality is the caller's contract, and verifying it
+    would cost the n^3 Gram product the warm start exists to avoid)."""
+    v0 = jnp.asarray(v0)
+    if v0.ndim != 2 or v0.shape != (n, n):
+        raise ValueError(
+            f"v0 must be the (n, n) = ({n}, {n}) right factor of a prior "
+            f"solve of this problem, got shape {tuple(v0.shape)}")
+    if m < n:
+        raise ValueError(
+            "v0 warm starts require a tall (m >= n) input; for a wide "
+            "problem use svd_update(prior, a_new), which handles the "
+            "orientation (the transposed problem warm-starts from "
+            "prior.u)")
+    return v0
+
+
+def svd_update(
+    prior: SVDResult,
+    a_new,
+    *,
+    compute_u: bool = True,
+    compute_v: bool = True,
+    config: SVDConfig | None = None,
+) -> SVDResult:
+    """SVD of an UPDATED matrix, warm-started from a prior decomposition
+    of a nearby one — the evolving-matrix workload (a user x feature
+    matrix taking a rank-r update between solves). ``prior`` is the
+    `SVDResult` of the previous solve (its right factor ``v`` — ``u``
+    for wide inputs — must have been computed); ``a_new`` is the updated
+    matrix of the SAME shape.
+
+    The solve runs `svd(a_new, v0=prior_factor)`: the prior factor
+    pre-rotates the input near-diagonal, the existing convergence
+    criterion does the rest (correctness never depends on how near —
+    a v0 from an unrelated matrix just converges cold-slow), and the
+    per-round threshold skipping collapses the untouched subspace, so a
+    rank-r-perturbed input converges in 1-2 sweeps instead of 10+
+    (measured: PROFILE.md item 27; pinned by the warm-start sweep-count
+    regression test)."""
+    a_new = jnp.asarray(a_new)
+    if a_new.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got shape {a_new.shape}")
+    m, n = a_new.shape
+    if m < n:
+        r = svd_update(
+            SVDResult(u=prior.v, s=prior.s, v=prior.u, sweeps=prior.sweeps,
+                      off_rel=prior.off_rel, status=prior.status),
+            a_new.T, compute_u=compute_v, compute_v=compute_u,
+            config=config)
+        return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
+                         off_rel=r.off_rel, status=r.status)
+    if prior.v is None:
+        raise ValueError(
+            "svd_update needs the prior solve's right factor (prior.v is "
+            "None — for a wide prior, its u); re-solve with compute_v=True "
+            "or fall back to a cold svd()")
+    return svd(a_new, compute_u=compute_u, compute_v=compute_v,
+               config=config, v0=prior.v)
 
 
 # ---------------------------------------------------------------------------
@@ -1632,7 +1744,8 @@ class SweepStepper(_SweepControlMixin):
     """
 
     def __init__(self, a, *, compute_u: bool = True, compute_v: bool = True,
-                 full_matrices: bool = False, config: SVDConfig | None = None):
+                 full_matrices: bool = False, config: SVDConfig | None = None,
+                 v0=None):
         if config is None:
             config = SVDConfig()
         a = jnp.asarray(a)
@@ -1642,6 +1755,16 @@ class SweepStepper(_SweepControlMixin):
         if m < n:
             raise ValueError("SweepStepper requires m >= n; pass a.T and "
                              "swap u/v (as svd() does)")
+        # Warm start (see `_apply_v0_jit`): the stepper solves A @ v0 —
+        # near-diagonal for a prior factor of a nearby matrix, so the
+        # host loop exits after 1-2 sweeps — and `finish` composes v0
+        # back into V exactly. The stepper's working input (and therefore
+        # `input_digest` — checkpoint validation fingerprints what the
+        # sweeps actually run on) is the PRE-ROTATED matrix.
+        self._v0 = None
+        if v0 is not None:
+            self._v0 = _check_v0(v0, m, n)
+            a = _apply_v0_jit(a, self._v0)
         self.a, self.m, self.n = a, m, n
         # Retained past a donate_input release (checkpoint fingerprints
         # and resume read the dtype after self.a is gone).
@@ -1942,15 +2065,51 @@ class SweepStepper(_SweepControlMixin):
                 q1, order, n=self.n, compute_u=self.compute_u,
                 compute_v=self.compute_v, full_u=self.full_matrices,
                 precondition=self._precondition, refine=bool(refine))
-            return SVDResult(u=u, s=s, v=v, sweeps=state.sweeps,
-                             off_rel=state.off_rel, status=status)
-        u, s, v = _finish_jit(
-            state.top, state.bot, state.vtop, state.vbot, n=self.n,
+        else:
+            u, s, v = _finish_jit(
+                state.top, state.bot, state.vtop, state.vbot, n=self.n,
+                compute_u=self.compute_u, compute_v=self.compute_v,
+                full_u=self.full_matrices)
+            v = v if self.compute_v else None
+        if self._v0 is not None and v is not None:
+            v = _compose_v0_jit(self._v0, v)
+        return SVDResult(u=u, s=s, v=v, sweeps=state.sweeps,
+                         off_rel=state.off_rel, status=status)
+
+    def sigma_finish(self, state: SweepState):
+        """Sigma-first termination: the two-phase serving layer's cheap
+        half. Returns ``(result, payload)`` — ``result`` is a sigma-only
+        `SVDResult` (u/v None; sigma read straight off the converged
+        stacks via `_sigma_from_state_jit`, skipping the finish stage's
+        recombination/refinement matmuls entirely) and ``payload`` is
+        everything `finish_from_payload` needs to resume THIS solve to
+        full U/V later: the retained column/rotation stacks, the
+        preconditioning factors, and the finish statics. ``payload
+        ["promotable"]`` is False when the solve accumulated no rotation
+        product (compute flags off — the brownout sigma-only rung),
+        in which case promotion has nothing to resume from."""
+        status = self._status(state)
+        if self._kernel_path:
+            q1, order, work = self._precond_state()
+            path = "kernel"
+        else:
+            q1 = order = work = None
+            path = "xla"
+        s = _sigma_from_state_jit(state.top, state.bot, n=self.n)
+        refine = (self.config.sigma_refine
+                  if self.config.sigma_refine is not None
+                  else (self.compute_u or self.compute_v))
+        payload = dict(
+            path=path, top=state.top, bot=state.bot, vtop=state.vtop,
+            vbot=state.vbot, work=work, q1=q1, order=order, n=self.n,
             compute_u=self.compute_u, compute_v=self.compute_v,
-            full_u=self.full_matrices)
-        return SVDResult(u=u, s=s, v=(v if self.compute_v else None),
-                         sweeps=state.sweeps, off_rel=state.off_rel,
-                         status=status)
+            full_u=self.full_matrices,
+            precondition=bool(getattr(self, "_precondition", False)),
+            refine=bool(refine), v0=self._v0,
+            promotable=bool(self.compute_u or self.compute_v),
+            status=status, sweeps=state.sweeps, off_rel=state.off_rel)
+        return (SVDResult(u=None, s=s, v=None, sweeps=state.sweeps,
+                          off_rel=state.off_rel, status=status), payload)
 
     def aot_entries(self):
         """Every jit entry this stepper's solve loop will dispatch, as
@@ -2038,6 +2197,13 @@ class SweepStepper(_SweepControlMixin):
                      full_u=self.full_matrices)))
         entries.append(("solver._nonfinite_probe_jit",
                         _nonfinite_probe_jit, (top_s, bot_s, f32s), {}))
+        # Two-phase serving's sigma-first extraction: a sigma-phase (or
+        # factor-free) dispatch reads sigma off the converged stacks and
+        # defers the finish stage, so the serve path requests this entry
+        # instead of (or before) the finish jit — same bucket-shaped key.
+        entries.append(("solver._sigma_from_state_jit",
+                        _sigma_from_state_jit, (top_s, bot_s),
+                        dict(n=self.n)))
         return tuple(entries)
 
 
@@ -2072,6 +2238,71 @@ def _finish_jit(top, bot, vtop, vbot, *, n, compute_u, compute_v, full_u):
     u, s, v = _postprocess(a_work, v_work, n, compute_u=compute_u,
                            full_u=full_u, dtype=top.dtype)
     return u, s, v
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _sigma_from_state_jit(top, bot, *, n):
+    """Sigma straight off a converged sweep state's column stacks — the
+    two-phase serving layer's sigma-first extraction (`serve.SVDService`
+    with ``phase="sigma"``): the rotated columns' norms ARE the singular
+    values, so sigma is served without the finish stage's factor
+    recombination/refinement matmuls (those run later — on the SAME
+    retained state — only if the client promotes). Padded columns are
+    exactly zero and sort to the back; the [:n] slice drops them.
+    Accuracy is the sweep loop's own (~sqrt(m)*eps class); the promoted
+    result's sigma additionally gets the finish-stage compensated
+    refinement."""
+    with scope("postprocess"):
+        a_work = _deblockify(top, bot)
+        acc = jnp.promote_types(a_work.dtype, jnp.float32)
+        s_all = jnp.linalg.norm(a_work.astype(acc), axis=0)
+        s = -jnp.sort(-s_all)[:n]
+        return s.astype(a_work.dtype)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _sigma_from_state_batched_jit(top, bot, *, n):
+    """`_sigma_from_state_jit` vmapped over member-major (B, k, m, b)
+    stacks (the coalesced sigma-phase dispatch; the service reshapes the
+    kernel lane's stacked (B*k, m, b) layout to member-major first —
+    the same reshape `_nonfinite_probe_batched_jit` takes)."""
+    def one(t, b):
+        with scope("postprocess"):
+            a_work = _deblockify(t, b)
+            acc = jnp.promote_types(a_work.dtype, jnp.float32)
+            s_all = jnp.linalg.norm(a_work.astype(acc), axis=0)
+            return (-jnp.sort(-s_all)[:n]).astype(a_work.dtype)
+
+    return jax.vmap(one)(top, bot)
+
+
+def finish_from_payload(payload: dict) -> SVDResult:
+    """Resume a deferred finish stage (`SweepStepper.sigma_finish` /
+    `BatchedSweepStepper.sigma_finish` payloads) to full U/Σ/V — the
+    promotion half of two-phase serving. Runs the SAME already-compiled
+    finish jits the full-phase dispatch would have (`_finish_pallas_jit`
+    / `_finish_jit`, single-form: batched members arrive member-sliced),
+    so promotion costs the finish-stage matmuls only — never a sweep,
+    never a fresh solve. The terminal status/sweeps/off_rel are the
+    retained sweep loop's own; a warm-started payload composes its v0
+    back in exactly, like `SweepStepper.finish`."""
+    p = payload
+    if p["path"] == "kernel":
+        u, s, v = _finish_pallas_jit(
+            p["top"], p["bot"], p["vtop"], p["vbot"], p["work"], p["q1"],
+            p["order"], n=p["n"], compute_u=p["compute_u"],
+            compute_v=p["compute_v"], full_u=p["full_u"],
+            precondition=p["precondition"], refine=p["refine"])
+    else:
+        u, s, v = _finish_jit(
+            p["top"], p["bot"], p["vtop"], p["vbot"], n=p["n"],
+            compute_u=p["compute_u"], compute_v=p["compute_v"],
+            full_u=p["full_u"])
+        v = v if p["compute_v"] else None
+    if p.get("v0") is not None and v is not None:
+        v = _compose_v0_jit(p["v0"], v)
+    return SVDResult(u=u, s=s, v=v, sweeps=p["sweeps"],
+                     off_rel=p["off_rel"], status=p["status"])
 
 
 @partial(jax.jit, static_argnames=("with_v", "polish", "interpret"))
@@ -2515,6 +2746,65 @@ class BatchedSweepStepper(_SweepControlMixin):
                          sweeps=sweeps_vec, off_rel=state.off_rel,
                          status=status)
 
+    def sigma_finish(self, state: BatchSweepState):
+        """Batched sigma-first termination (cf. `SweepStepper.
+        sigma_finish`): returns ``(result, payloads)`` — a sigma-only
+        batched `SVDResult` plus ONE deferred-finish payload PER MEMBER,
+        each member-sliced into the SINGLE stepper's state form so
+        `finish_from_payload` resumes it through the single finish jits
+        (already bucket-compiled by the uncoalesced dispatch path; the
+        batched preconditioning factors slice per member the same way)."""
+        status_codes = self._member_statuses(state)
+        sweeps_vec = self.member_sweeps(state)
+        off = state.off_rel
+        if self._kernel_path:
+            q1, order, work = self._precond_state()
+            kp = state.top.shape[0] // self.batch
+            top_m = state.top.reshape((self.batch, kp) + state.top.shape[1:])
+            bot_m = state.bot.reshape((self.batch, kp) + state.bot.shape[1:])
+            kv = state.vtop.shape[0] // self.batch if self._accumulate else 0
+            if self._accumulate:
+                vtop_m = state.vtop.reshape(
+                    (self.batch, kv) + state.vtop.shape[1:])
+                vbot_m = state.vbot.reshape(
+                    (self.batch, kv) + state.vbot.shape[1:])
+            else:
+                vtop_m = vbot_m = None
+            path = "kernel"
+        else:
+            q1 = order = work = None
+            top_m, bot_m = state.top, state.bot
+            vtop_m, vbot_m = state.vtop, state.vbot
+            path = "xla"
+        s = _sigma_from_state_batched_jit(top_m, bot_m, n=self.n)
+        refine = (self.config.sigma_refine
+                  if self.config.sigma_refine is not None
+                  else (self.compute_u or self.compute_v))
+        promotable = bool(self.compute_u or self.compute_v)
+        payloads = []
+        for j in range(self.batch):
+            if path == "kernel" and not self._accumulate:
+                k = self.nblocks // 2
+                vt = vb = jnp.zeros((k, 0, top_m.shape[-1]),
+                                    self.input_dtype)
+            else:
+                vt, vb = vtop_m[j], vbot_m[j]
+            payloads.append(dict(
+                path=path, top=top_m[j], bot=bot_m[j], vtop=vt, vbot=vb,
+                work=None if work is None else work[j],
+                q1=None if q1 is None else q1[j],
+                order=None if order is None else order[j],
+                n=self.n, compute_u=self.compute_u,
+                compute_v=self.compute_v, full_u=False,
+                precondition=bool(getattr(self, "_precondition", False)),
+                refine=bool(refine), v0=None, promotable=promotable,
+                status=jnp.int32(int(status_codes[j])),
+                sweeps=jnp.int32(int(sweeps_vec[j])), off_rel=off[j]))
+        return (SVDResult(u=None, s=s, v=None,
+                          sweeps=jnp.asarray(sweeps_vec, jnp.int32),
+                          off_rel=off,
+                          status=jnp.asarray(status_codes)), payloads)
+
     def aot_entries(self):
         """Batched twin of `SweepStepper.aot_entries`: the jit entries of
         one coalesced (B, m, n) dispatch as ``(entry_name, jit_fn, args,
@@ -2579,6 +2869,13 @@ class BatchedSweepStepper(_SweepControlMixin):
             entries.append(("solver._nonfinite_probe_batched_jit",
                             _nonfinite_probe_batched_jit,
                             (ptop, pbot, offv), {}))
+            # Two-phase serving's batched sigma-first extraction: the
+            # sigma-phase dispatch reads sigma off the member-major
+            # stacks and defers the finish stage (cf. the single
+            # stepper's aot_entries).
+            entries.append(("solver._sigma_from_state_batched_jit",
+                            _sigma_from_state_batched_jit, (ptop, pbot),
+                            dict(n=self.n)))
         else:
             top_s, bot_s = jax.eval_shape(
                 lambda: _blockify_batched(
@@ -2615,4 +2912,9 @@ class BatchedSweepStepper(_SweepControlMixin):
             entries.append(("solver._nonfinite_probe_batched_jit",
                             _nonfinite_probe_batched_jit,
                             (top_s, bot_s, offv), {}))
+            # Batched sigma-first extraction (the XLA batched stacks are
+            # member-major (B, k, m, b) already — no reshape).
+            entries.append(("solver._sigma_from_state_batched_jit",
+                            _sigma_from_state_batched_jit, (top_s, bot_s),
+                            dict(n=self.n)))
         return tuple(entries)

@@ -338,7 +338,10 @@ class TestAnalysisReport:
         with pytest.raises(ValueError, match="passes"):
             manifest.validate(rec)
 
+    @pytest.mark.slow
     def test_cli_fast_passes_exit_zero(self, tmp_path):
+        """Subprocess boot of `python -m svd_jacobi_tpu.analysis` — slow
+        lane (the pass logic itself is covered in-process above)."""
         import os
         import subprocess
         env = dict(os.environ)
@@ -370,3 +373,36 @@ def test_cli_all_passes_exit_zero(tmp_path):
         capture_output=True, text=True, env=env,
         cwd=Path(__file__).parent.parent, timeout=600)
     assert p.returncode == 0, p.stderr[-1500:]
+
+
+class TestServePromoteRetraceContract:
+    """The two-phase half of the serve retrace contract: σ-then-promote
+    request streams keep the once-per-bucket compile budget (the sigma
+    extraction and the finish jits compile once per bucket, promotes are
+    pure cache hits) — and the guard demonstrably fires when the budget
+    is under-declared."""
+
+    def test_promote_case_within_budget(self):
+        from svd_jacobi_tpu.analysis.recompile_guard import \
+            run_serve_promote_case
+        findings, report = run_serve_promote_case()
+        assert findings == [], [f.message for f in findings]
+        assert all(s == "OK" for s in report["serve_statuses"])
+        # The sigma extraction genuinely ran (and compiled once per
+        # bucket, not zero times — a silent full-phase fallback would
+        # also 'pass' the budget).
+        assert report["new_traces"]["solver._sigma_from_state_jit"] == 2
+        assert report["new_traces"]["solver._finish_pallas_jit"] == 2
+
+    def test_underdeclared_promote_budget_fires(self):
+        """Seeded failing fixture: FRESH buckets with every budget
+        under-declared at 1 — the per-bucket compiles must surface as
+        RETRACE001 (what a per-request or per-promote leak looks
+        like)."""
+        from svd_jacobi_tpu.analysis.recompile_guard import \
+            run_serve_promote_case
+        findings, _ = run_serve_promote_case(
+            expected_problems=1,
+            buckets=((52, 36, "float32"), (84, 52, "float32")))
+        assert findings, "under-declared promote budget must fire"
+        assert all(f.code == "RETRACE001" for f in findings)

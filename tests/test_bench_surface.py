@@ -14,6 +14,13 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
+# A solve-carrying bench row costs a full cold-cache subprocess run —
+# slow lane. The parse-time flag rejections below stay tier-1 (they
+# exit before any compile).
+_row = pytest.mark.slow
+
 BENCH = str(Path(__file__).resolve().parent.parent / "bench.py")
 
 
@@ -25,6 +32,7 @@ def _run(*args):
         capture_output=True, text=True, env=env, timeout=600)
 
 
+@_row
 def test_bench_stepped_row():
     p = _run("96", "--novec", "--no-baseline", "--reps=1", "--stepped")
     assert p.returncode == 0, p.stderr[-500:]
@@ -33,6 +41,7 @@ def test_bench_stepped_row():
     assert row["sweeps"] >= 1 and row["value"] > 0
 
 
+@_row
 def test_bench_fused_gen_row():
     p = _run("96", "--novec", "--no-baseline", "--reps=1", "--fused-gen")
     assert p.returncode == 0, p.stderr[-500:]
@@ -52,6 +61,7 @@ def test_bench_fused_gen_stepped_conflict():
     assert "incompatible" in (p.stderr + p.stdout)
 
 
+@_row
 def test_bench_donate_stepped_row():
     """The 30208^2 recipe's flag combination, exercised end-to-end at toy
     size: stepped solve, input released after init, sigma still correct

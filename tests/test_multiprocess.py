@@ -20,6 +20,11 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+# Each test here boots TWO fresh Python processes that recompile the full
+# solver stack from cold caches — the cost IS the scenario. Slow lane;
+# run with `-m slow` (or no marker filter).
+pytestmark = pytest.mark.slow
+
 
 def _free_port():
     s = socket.socket()

@@ -278,3 +278,88 @@ class TestPhaseInfo:
         info = st.phase_info(st.init())
         assert info.stage in ("bulk", "single")
         assert isinstance(info.tol, float)
+
+
+class TestCacheRecords:
+    """The "cache" manifest kind (result cache + promotion store events)
+    and the serve record's two-phase fields round-trip through
+    build -> validate -> append -> load -> summarize."""
+
+    def test_build_cache_round_trip(self, tmp_path):
+        from svd_jacobi_tpu.obs import manifest
+        path = tmp_path / "m.jsonl"
+        for store, event in (("result", "hit"), ("result", "store"),
+                             ("result", "evict"), ("result", "invalidate"),
+                             ("promotion", "retain"),
+                             ("promotion", "promote"),
+                             ("promotion", "release"),
+                             ("promotion", "evict"),
+                             ("promotion", "rescue")):
+            rec = manifest.build_cache(
+                store=store, event=event, request_id="r1",
+                digest="ab" * 32, nbytes=1024)
+            manifest.validate(rec)
+            manifest.append(path, rec)
+        loaded = manifest.load(path)
+        assert len(loaded) == 9
+        for rec in loaded:
+            manifest.validate(rec)
+            line = manifest.summarize(rec)
+            assert line.startswith("cache ")
+            assert "req=r1" in line and "1024 B" in line
+
+    def test_build_cache_optional_fields(self):
+        from svd_jacobi_tpu.obs import manifest
+        rec = manifest.build_cache(store="result", event="invalidate",
+                                   count=3)
+        manifest.validate(rec)
+        assert rec["request_id"] is None and rec["digest"] is None
+        assert "count=3" in manifest.summarize(rec)
+
+    def test_build_cache_rejects_bad_types(self):
+        from svd_jacobi_tpu.obs import manifest
+        rec = manifest.build_cache(store="result", event="hit")
+        rec["bytes"] = "many"
+        with pytest.raises(ValueError, match="bytes"):
+            manifest.validate(rec)
+
+    def test_serve_phase_fields_round_trip(self, tmp_path):
+        from svd_jacobi_tpu.obs import manifest
+        path = tmp_path / "m.jsonl"
+        sig = manifest.build_serve(
+            request_id="rs", m=32, n=32, dtype="float32", bucket="b32",
+            queue_wait_s=0.0, solve_time_s=0.1, status="OK", path="base",
+            breaker="closed", brownout="FULL", degraded=False,
+            deadline_s=None, phase="sigma")
+        pro = manifest.build_serve(
+            request_id="rs+p", m=32, n=32, dtype="float32", bucket="b32",
+            queue_wait_s=0.0, solve_time_s=0.01, status="OK", path="base",
+            breaker="closed", brownout="FULL", degraded=False,
+            deadline_s=None, phase="promote", promoted_from="rs")
+        for rec in (sig, pro):
+            manifest.validate(rec)
+            manifest.append(path, rec)
+        l_sig, l_pro = manifest.load(path)
+        assert l_sig["phase"] == "sigma" and l_sig["promoted_from"] is None
+        assert l_pro["promoted_from"] == "rs"
+        assert "phase=sigma" in manifest.summarize(l_sig)
+        assert "phase=promote<-rs" in manifest.summarize(l_pro)
+        # The default phase stays out of the summary line (unchanged
+        # rendering for the whole pre-two-phase stream).
+        full = manifest.build_serve(
+            request_id="rf", m=32, n=32, dtype="float32", bucket="b32",
+            queue_wait_s=0.0, solve_time_s=0.1, status="OK", path="base",
+            breaker="closed", brownout="FULL", degraded=False,
+            deadline_s=None)
+        assert "phase=" not in manifest.summarize(full)
+
+    def test_serve_phase_wrong_type_rejected(self):
+        from svd_jacobi_tpu.obs import manifest
+        rec = manifest.build_serve(
+            request_id="rx", m=8, n=8, dtype="float32", bucket="b",
+            queue_wait_s=0.0, solve_time_s=None, status="OK", path="base",
+            breaker="closed", brownout="FULL", degraded=False,
+            deadline_s=None)
+        rec["phase"] = 7
+        with pytest.raises(ValueError, match="phase"):
+            manifest.validate(rec)

@@ -446,7 +446,12 @@ class TestReload:
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 class TestSigkillResume:
+    """Real-subprocess SIGKILL drill — full cold-cache recompiles per
+    process, so it rides the slow lane (`-m 'chaos and slow'`); the
+    in-process recover/journal contracts above stay tier-1."""
+
     def test_sigkill_under_load_resumes_exactly_once(self, tmp_path):
         jpath = tmp_path / "journal.jsonl"
         serve = _run_worker("serve", "--journal", str(jpath),
@@ -473,6 +478,7 @@ class TestSigkillResume:
 
 @pytest.mark.chaos
 class TestPersistentCacheRestart:
+    @pytest.mark.slow
     def test_restart_cold_warm_corrupt_lifecycle(self, tmp_path):
         """THE cold-start acceptance, one cache directory, three
         restarts (each a real subprocess — an in-process 'restart' would
@@ -544,3 +550,60 @@ class TestRestartDrill:
         assert summary["lost"] == []
         assert summary["resumed"] >= len(summary["unfinalized_at_kill"])
         assert summary["cold_start_s"] is not None
+
+
+class TestJournalPayloadModes:
+    """ServeConfig.journal_payload: "digest" journals the SHA-256 +
+    shape/dtype instead of the base64 bytes (PROFILE.md item 26's
+    dominant per-request tax) — and a digest-only request whose bytes
+    are gone finalizes ERROR path="recovery" LOUDLY on replay, never
+    silently."""
+
+    def test_digest_mode_shrinks_the_journal(self, tmp_path):
+        full_p, dig_p = tmp_path / "full.jsonl", tmp_path / "dig.jsonl"
+        writer = SVDService(_cfg(journal_path=str(full_p)))
+        req = _mk_request(writer, "jp-0", seed=3)
+        writer.journal.append_admit(req, payload_mode="full")
+        Journal(dig_p).append_admit(req, payload_mode="digest")
+        full_size, dig_size = (full_p.stat().st_size,
+                               dig_p.stat().st_size)
+        # 40x30 f32 = 4.7 KiB raw -> ~6.3 KiB of base64; the digest
+        # record drops the payload to O(100 B) of metadata.
+        assert dig_size < full_size / 5
+        rec = Journal(dig_p).scan().admits["jp-0"]
+        assert "data_b64" not in rec["input"]
+        assert len(rec["input"]["data_sha256"]) == 64
+        assert rec["input"]["shape"] == [40, 30]
+
+    def test_digest_mode_recovery_is_loudly_error(self, tmp_path):
+        jpath = tmp_path / "j.jsonl"
+        writer = SVDService(_cfg(journal_path=str(jpath),
+                                 journal_payload="digest"))
+        writer.journal.append_admit(_mk_request(writer, "jp-1",
+                                                deadline_s=600.0),
+                                    payload_mode="digest")
+        svc = SVDService(_cfg(journal_path=str(jpath)))
+        tickets = svc.recover()
+        res = tickets["jp-1"].result(timeout=5.0)
+        assert res.error is not None
+        assert "digest-only" in res.error
+        recs = [r for r in svc.records()
+                if r.get("kind") == "serve" and r.get("path") == "recovery"]
+        assert recs and recs[0]["status"] == "ERROR"
+        # The debt is settled (finalized), not replayed forever.
+        assert Journal(jpath).scan().unfinalized == []
+
+    def test_submit_journals_in_configured_mode(self, tmp_path):
+        jpath = tmp_path / "j.jsonl"
+        with SVDService(_cfg(journal_path=str(jpath),
+                             journal_payload="digest")) as svc:
+            rng = np.random.default_rng(7)
+            a = rng.standard_normal((40, 30)).astype(np.float32)
+            svc.submit(a, request_id="jp-2").result(timeout=300.0)
+        rec = Journal(jpath).scan().admits["jp-2"]
+        assert "data_b64" not in rec["input"]
+
+    def test_invalid_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="journal_payload"):
+            SVDService(_cfg(journal_path=str(tmp_path / "j.jsonl"),
+                            journal_payload="compressed"))

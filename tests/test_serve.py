@@ -698,3 +698,245 @@ class TestServeDemoCli:
 
 def test_brownout_enum_order():
     assert Brownout.FULL < Brownout.SIGMA_ONLY < Brownout.SHED
+
+
+class TestTwoPhaseServing:
+    """Two-phase σ-first serving: `submit(phase="sigma")` returns σ only
+    and retains the solve's checkpointed stage; `Ticket.promote()`
+    resumes THAT solve to full U/V (never a fresh solve); the
+    content-addressed result cache finalizes byte-identical resubmits at
+    admission with zero dispatch."""
+
+    def test_sigma_then_promote_matches_oracle(self):
+        a = _mat(30, 24, seed=501)
+        with SVDService(_cfg()) as svc:
+            t = svc.submit(a, phase="sigma")
+            rs = t.result(timeout=120.0)
+            assert rs.status is SolveStatus.OK
+            assert rs.u is None and rs.v is None
+            np.testing.assert_allclose(
+                np.asarray(rs.s), np.linalg.svd(np.asarray(a),
+                                                compute_uv=False),
+                rtol=0, atol=1e-8)
+            rp = t.promote(timeout=120.0)
+            assert rp.status is SolveStatus.OK
+            assert rp.request_id != t.request_id
+            rec = (np.asarray(rp.u) * np.asarray(rp.s)) @ np.asarray(rp.v).T
+            np.testing.assert_allclose(rec, np.asarray(a), atol=5e-12)
+            # The σ-then-promote pair reconstructs from the stream.
+            serve = [r for r in svc.records() if r.get("kind") == "serve"]
+            assert serve[-2]["phase"] == "sigma"
+            assert serve[-1]["phase"] == "promote"
+            assert serve[-1]["promoted_from"] == t.request_id
+            events = [(r["store"], r["event"]) for r in svc.records()
+                      if r.get("kind") == "cache"]
+            assert ("promotion", "retain") in events
+            assert ("promotion", "promote") in events
+
+    def test_promote_is_exactly_once_and_release_drops(self):
+        from svd_jacobi_tpu.serve import PromotionError
+        a = _mat(30, 24, seed=502)
+        with SVDService(_cfg()) as svc:
+            t = svc.submit(a, phase="sigma")
+            t.result(timeout=120.0)
+            assert t.promote(timeout=120.0).status is SolveStatus.OK
+            with pytest.raises(PromotionError):
+                t.promote(timeout=5.0)
+            t2 = svc.submit(a + 1.0, phase="sigma")
+            t2.result(timeout=120.0)
+            assert t2.release() is True
+            assert t2.release() is False
+            with pytest.raises(PromotionError):
+                t2.promote(timeout=5.0)
+            # A full-phase ticket was never promotable.
+            t3 = svc.submit(a, request_id="full-one")
+            t3.result(timeout=120.0)
+            with pytest.raises(PromotionError):
+                t3.promote(timeout=5.0)
+
+    def test_byte_budget_eviction_is_loud(self):
+        from svd_jacobi_tpu.serve import PromotionError
+        a = _mat(30, 24, seed=503)
+        with SVDService(_cfg(promotion_store_bytes=1)) as svc:
+            t = svc.submit(a, phase="sigma")
+            assert t.result(timeout=120.0).status is SolveStatus.OK
+            with pytest.raises(PromotionError, match="evicted|retained"):
+                t.promote(timeout=5.0)
+            events = [(r["store"], r["event"]) for r in svc.records()
+                      if r.get("kind") == "cache"]
+            assert ("promotion", "evict") in events
+            assert ("promotion", "retain") not in events
+
+    def test_wide_input_promote_restores_orientation(self):
+        a = _mat(24, 30, seed=504)   # wide: the service transposes
+        with SVDService(_cfg()) as svc:
+            t = svc.submit(a, phase="sigma")
+            t.result(timeout=120.0)
+            rp = t.promote(timeout=120.0)
+            assert np.asarray(rp.u).shape == (24, 24)
+            assert np.asarray(rp.v).shape == (30, 24)
+            rec = (np.asarray(rp.u) * np.asarray(rp.s)) @ np.asarray(rp.v).T
+            np.testing.assert_allclose(rec, np.asarray(a), atol=5e-12)
+
+    def test_explicit_sigma_refine_forces_full_finish(self, monkeypatch):
+        """SVDConfig(sigma_refine=True) must NOT be silently dropped by
+        the sigma-first termination: the compensated refinement needs
+        the recombined factors, so factor-free and sigma-phase
+        dispatches run the full finish stage (sigma requests retain the
+        finished factors — promote still works, for free)."""
+        from svd_jacobi_tpu import solver as _solver
+        called = []
+        orig = _solver.SweepStepper.sigma_finish
+        monkeypatch.setattr(
+            _solver.SweepStepper, "sigma_finish",
+            lambda st, state: (called.append(1), orig(st, state))[1])
+        cfg = _cfg(solver=SVDConfig(block_size=4, sigma_refine=True))
+        a = _mat(30, 24, seed=546)
+        with SVDService(cfg) as svc:
+            r = svc.submit(a, compute_u=False,
+                           compute_v=False).result(timeout=120.0)
+            assert r.status is SolveStatus.OK
+            t = svc.submit(a, phase="sigma")
+            assert t.result(timeout=120.0).status is SolveStatus.OK
+            rp = t.promote(timeout=120.0)
+            assert rp.status is SolveStatus.OK
+            rec = (np.asarray(rp.u) * np.asarray(rp.s)) @ np.asarray(rp.v).T
+            np.testing.assert_allclose(rec, np.asarray(a), atol=5e-12)
+        assert called == []    # refined σ comes from the full finish
+
+    def test_degraded_brownout_reuses_sigma_phase_without_retention(self):
+        """A SIGMA_ONLY-degraded full request serves σ through the SAME
+        sigma-first termination but retains nothing — its solve
+        accumulated no rotation product, so there is nothing to
+        resume."""
+        from svd_jacobi_tpu.serve import PromotionError
+        cfg = _cfg(max_queue_depth=10, brownout_sigma_only_at=0.3,
+                   brownout_shed_at=2.0)
+        with SVDService(cfg) as svc:
+            with chaos.stuck_backend(shots=1, max_stall_s=3.0):
+                first = svc.submit(_mat(16, 16, seed=505))  # stalls worker
+                time.sleep(0.1)
+                fillers = [svc.submit(_mat(16, 16, seed=506 + i))
+                           for i in range(4)]
+                degraded = svc.submit(_mat(30, 24, seed=512))
+                res = degraded.result(timeout=300.0)
+                for t in [first] + fillers:
+                    t.result(timeout=300.0)
+            assert res.degraded and res.u is None and res.v is None
+            assert np.isfinite(np.asarray(res.s)).all()
+            retained = [r for r in svc.records()
+                        if r.get("kind") == "cache"
+                        and r.get("request_id") == degraded.request_id
+                        and r["event"] == "retain"]
+            assert retained == []
+
+    def test_batched_all_sigma_promotes_per_member(self):
+        cfg = _cfg(max_batch=4, batch_window_s=2.0, batch_tiers=(1, 4),
+                   max_queue_depth=16)
+        mats = [_mat(30, 24, seed=520 + i) for i in range(4)]
+        with SVDService(cfg) as svc:
+            tickets = [svc.submit(m, phase="sigma") for m in mats]
+            results = [t.result(timeout=300.0) for t in tickets]
+            assert all(r.status is SolveStatus.OK for r in results)
+            tiers = {r.get("batch_tier") for r in svc.records()
+                     if r.get("kind") == "serve"
+                     and r.get("phase") == "sigma"}
+            assert 4 in tiers    # genuinely coalesced
+            for t, m in zip(tickets, mats):
+                rp = t.promote(timeout=120.0)
+                rec = ((np.asarray(rp.u) * np.asarray(rp.s))
+                       @ np.asarray(rp.v).T)
+                np.testing.assert_allclose(rec, np.asarray(m), atol=5e-12)
+
+    def test_mixed_batch_sigma_member_promotes_from_result(self):
+        cfg = _cfg(max_batch=2, batch_window_s=2.0, batch_tiers=(1, 2),
+                   max_queue_depth=16)
+        a_full, a_sig = _mat(30, 24, seed=530), _mat(30, 24, seed=531)
+        with SVDService(cfg) as svc:
+            tf = svc.submit(a_full)
+            ts = svc.submit(a_sig, phase="sigma")
+            rf, rs = tf.result(timeout=300.0), ts.result(timeout=300.0)
+            assert rf.u is not None and rs.u is None
+            rp = ts.promote(timeout=120.0)
+            rec = (np.asarray(rp.u) * np.asarray(rp.s)) @ np.asarray(rp.v).T
+            np.testing.assert_allclose(rec, np.asarray(a_sig), atol=5e-12)
+
+
+class TestResultCache:
+    def test_hit_finalizes_with_zero_dispatch(self):
+        a = _mat(30, 24, seed=540)
+        with SVDService(_cfg(result_cache_bytes=16 << 20)) as svc:
+            r1 = svc.submit(a).result(timeout=120.0)
+            before = svc.fleet.lanes[0].dispatches
+            t2 = svc.submit(a)
+            assert t2.done()          # finalized AT admission
+            r2 = t2.result(timeout=1.0)
+            assert svc.fleet.lanes[0].dispatches == before
+            assert r2.path == "cache" and r2.status is SolveStatus.OK
+            np.testing.assert_allclose(np.asarray(r2.s), np.asarray(r1.s))
+            np.testing.assert_allclose(np.asarray(r2.u), np.asarray(r1.u))
+            assert svc.stats().get("cache_hits") == 1
+            serve = [r for r in svc.records() if r.get("kind") == "serve"]
+            assert serve[-1]["path"] == "cache"
+
+    def test_identity_covers_flags_and_orientation(self):
+        a = _mat(30, 24, seed=541)
+        with SVDService(_cfg(result_cache_bytes=16 << 20)) as svc:
+            svc.submit(a).result(timeout=120.0)
+            # Different factor flags: a miss (distinct identity).
+            r = svc.submit(a, compute_u=False, compute_v=False).result(
+                timeout=120.0)
+            assert r.path != "cache"
+            # The transposed twin must NOT share the entry.
+            rt = svc.submit(np.asarray(a).T.copy()).result(timeout=120.0)
+            assert rt.path != "cache"
+            assert np.asarray(rt.u).shape[0] == 24
+
+    def test_identity_covers_logical_shape(self):
+        """Byte-identical buffers under DIFFERENT logical shapes can
+        route to the same padded bucket — their factors differ, so the
+        key must carry (m, n) or the second shape would be served the
+        first one's decomposition."""
+        buf = np.asarray(_mat(24, 24, seed=545)).reshape(-1)
+        a1 = buf.reshape(24, 24)
+        a2 = buf.reshape(32, 18)       # same bytes, same (32,32) bucket
+        with SVDService(_cfg(result_cache_bytes=16 << 20)) as svc:
+            r1 = svc.submit(a1).result(timeout=120.0)
+            assert r1.status is SolveStatus.OK
+            r2 = svc.submit(a2).result(timeout=120.0)
+            assert r2.path != "cache"
+            assert np.asarray(r2.u).shape == (32, 18)
+            rec = (np.asarray(r2.u) * np.asarray(r2.s)) @ np.asarray(r2.v).T
+            np.testing.assert_allclose(rec, a2, atol=5e-12)
+
+    def test_invalidate_then_resolve(self):
+        a = _mat(30, 24, seed=542)
+        with SVDService(_cfg(result_cache_bytes=16 << 20)) as svc:
+            svc.submit(a).result(timeout=120.0)
+            assert svc.invalidate_cached() == 1
+            r = svc.submit(a).result(timeout=120.0)
+            assert r.path == "base"
+            events = [(x["store"], x["event"]) for x in svc.records()
+                      if x.get("kind") == "cache"]
+            assert ("result", "invalidate") in events
+            assert events.count(("result", "store")) == 2
+
+    def test_degraded_and_partial_results_never_cached(self):
+        a = _mat(30, 24, seed=543)
+        with SVDService(_cfg(result_cache_bytes=16 << 20,
+                             default_deadline_s=1e-9)) as svc:
+            r = svc.submit(a).result(timeout=120.0)
+            assert r.status is SolveStatus.DEADLINE
+            stores = [x for x in svc.records() if x.get("kind") == "cache"
+                      and x["event"] == "store"]
+            assert stores == []
+
+    def test_cache_disabled_by_default(self):
+        a = _mat(30, 24, seed=544)
+        with SVDService(_cfg()) as svc:
+            svc.submit(a).result(timeout=120.0)
+            r2 = svc.submit(a).result(timeout=120.0)
+            assert r2.path == "base"
+            assert not [x for x in svc.records()
+                        if x.get("kind") == "cache"
+                        and x["store"] == "result"]

@@ -13,9 +13,16 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).resolve().parent.parent
 
 from svd_jacobi_tpu.obs import manifest  # noqa: E402
+
+# Each smoke boots the real CLI/bench driver as a fresh subprocess
+# (cold jit caches, full recompile) — slow lane; the in-process
+# telemetry contracts live in test_obs.py and stay tier-1.
+pytestmark = pytest.mark.slow
 
 
 def _run(cmd, cwd=None):

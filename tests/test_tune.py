@@ -436,7 +436,12 @@ def _run_bench(*args, env_extra=None):
         capture_output=True, text=True, env=env, timeout=600)
 
 
+@pytest.mark.slow
 class TestBenchSatellites:
+    """bench.py subprocess smokes (fresh process per run = full cold
+    recompile) — slow lane; the retry logic itself is unit-style and
+    cheap, the subprocess boot is the cost."""
+
     def test_transient_failure_retries_once_and_notes_it(self):
         p = _run_bench("64", "--novec", "--no-baseline", "--reps=1",
                        "--retry-backoff-s=0",

@@ -1,0 +1,124 @@
+"""Warm-started solves (`solver.svd(v0=...)` / `solver.svd_update`):
+the don't-recompute-what-you-know lane of ROADMAP "Two-phase lazy-vector
+serving + streaming updates".
+
+The load-bearing regression here is the SWEEP-COUNT pin (PROFILE.md
+item 27 / item 4's quadratic-convergence class): a rank-1-perturbed 512²
+input warm-started from the prior right factor converges in <= 3 sweeps
+where a cold solve takes >= 8, on BOTH the Pallas(-interpret) kernel
+lane and the XLA block lane. Correctness is the existing convergence
+criterion's — the factor composition V = V0 @ W is exact — so the rest
+of the file pins the API contract (orientation handling, validation,
+graceful degradation on an unrelated v0).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from svd_jacobi_tpu import SVDConfig, solver
+from svd_jacobi_tpu.solver import SolveStatus
+
+
+def _rank1_pair(n=512, seed=42, scale=0.01, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    u1 = rng.standard_normal((n, 1)).astype(dtype)
+    v1 = rng.standard_normal((1, n)).astype(dtype)
+    return a, (a + scale * (u1 @ v1) / n).astype(dtype)
+
+
+def _resid(r, a):
+    return np.abs(np.asarray(r.u) @ np.diag(np.asarray(r.s))
+                  @ np.asarray(r.v).T - np.asarray(a)).max()
+
+
+class TestWarmStartSweepContract:
+    """The measured claim behind the whole warm-start lane, pinned on
+    both solver lanes: 'pallas' is the (interpret-mode on CPU) kernel
+    path, 'qr-svd' the XLA block path."""
+
+    @pytest.mark.parametrize("method", ["pallas", "qr-svd"])
+    def test_rank1_perturbed_512_converges_in_3_sweeps(self, method):
+        cfg = SVDConfig(pair_solver=method)
+        a, a_new = _rank1_pair()
+        prior = solver.svd(jnp.asarray(a), config=cfg)
+        assert prior.status_enum() is SolveStatus.OK
+        cold = solver.svd(jnp.asarray(a_new), config=cfg)
+        warm = solver.svd_update(prior, jnp.asarray(a_new), config=cfg)
+        assert warm.status_enum() is SolveStatus.OK
+        assert int(cold.sweeps) >= 8, (
+            f"cold solve converged in {int(cold.sweeps)} sweeps — the "
+            f"fixture no longer exercises the warm-start win")
+        assert int(warm.sweeps) <= 3, (
+            f"warm start took {int(warm.sweeps)} sweeps (cold: "
+            f"{int(cold.sweeps)}) — the PROFILE item 27 convergence "
+            f"contract regressed")
+        # Same answer, to the solve's own accuracy class.
+        assert _resid(warm, a_new) < 5e-5
+        np.testing.assert_allclose(
+            np.asarray(warm.s), np.asarray(cold.s), rtol=1e-4, atol=1e-4)
+
+
+class TestWarmStartAPI:
+    CFG = SVDConfig(pair_solver="qr-svd")
+
+    def test_v0_composition_is_exact(self):
+        a, a_new = _rank1_pair(n=96, seed=7)
+        prior = solver.svd(jnp.asarray(a), config=self.CFG)
+        warm = solver.svd(jnp.asarray(a_new), v0=prior.v, config=self.CFG)
+        assert _resid(warm, a_new) < 1e-4
+        # V is orthonormal after composition (V = V0 @ W, both factors
+        # orthonormal).
+        v = np.asarray(warm.v)
+        np.testing.assert_allclose(v.T @ v, np.eye(v.shape[1]), atol=1e-4)
+
+    def test_wide_update_transposes_through_prior_u(self):
+        a, a_new = _rank1_pair(n=80, seed=9)
+        a_w, a_new_w = a[:60].copy(), a_new[:60].copy()   # (60, 80) wide
+        prior = solver.svd(jnp.asarray(a_w), config=self.CFG)
+        warm = solver.svd_update(prior, jnp.asarray(a_new_w),
+                                 config=self.CFG)
+        assert np.asarray(warm.u).shape == (60, 60)
+        assert np.asarray(warm.v).shape == (80, 60)
+        assert _resid(warm, a_new_w) < 1e-4
+
+    def test_unrelated_v0_still_correct_just_slow(self):
+        """Correctness never depends on HOW near the warm start is: an
+        unrelated orthonormal v0 converges cold-slow but exactly."""
+        a, _ = _rank1_pair(n=64, seed=11)
+        q, _ = np.linalg.qr(np.random.default_rng(3).standard_normal(
+            (64, 64)).astype(np.float32))
+        warm = solver.svd(jnp.asarray(a), v0=jnp.asarray(q),
+                          config=self.CFG)
+        assert warm.status_enum() is SolveStatus.OK
+        assert _resid(warm, a) < 1e-4
+
+    def test_v0_shape_and_orientation_validation(self):
+        a, _ = _rank1_pair(n=48, seed=13)
+        with pytest.raises(ValueError, match="right factor"):
+            solver.svd(jnp.asarray(a), v0=jnp.zeros((24, 24)))
+        with pytest.raises(ValueError, match="tall"):
+            # (24, 48) wide input: direct v0 warm starts require m >= n.
+            solver.svd(jnp.asarray(a[:24]),
+                       v0=jnp.eye(48, dtype=jnp.float32))
+
+    def test_update_requires_prior_factor(self):
+        a, a_new = _rank1_pair(n=48, seed=17)
+        prior = solver.svd(jnp.asarray(a), compute_v=False,
+                           config=self.CFG)
+        with pytest.raises(ValueError, match="prior"):
+            solver.svd_update(prior, jnp.asarray(a_new), config=self.CFG)
+
+    def test_stepper_v0_finish_composes(self):
+        from svd_jacobi_tpu.solver import SweepStepper
+        a, a_new = _rank1_pair(n=64, seed=19)
+        prior = solver.svd(jnp.asarray(a), config=self.CFG)
+        st = SweepStepper(jnp.asarray(a_new), v0=prior.v, config=self.CFG)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        r = st.finish(state)
+        assert int(r.sweeps) <= 4    # near-diagonal entry
+        assert _resid(r, a_new) < 1e-4
