@@ -8,8 +8,13 @@ it:
     # render every record of a manifest (newest last)
     python scripts/telemetry_summary.py reports/manifest.jsonl
 
-    # render only the last record
+    # render only the last record / only one kind
     python scripts/telemetry_summary.py reports/manifest.jsonl --last
+    python scripts/telemetry_summary.py reports/manifest.jsonl --kind serve
+
+    # SLO report reconstructed from the "serve" records (per-bucket
+    # p50/p99, deadline-miss/shed counts, error-budget burn)
+    python scripts/telemetry_summary.py reports/manifest.jsonl --slo
 
     # diff two records (by index into one file, or across two files);
     # negative indices count from the end, like Python
@@ -27,14 +32,23 @@ import importlib.util
 import sys
 from pathlib import Path
 
-# Load obs/manifest.py directly by file path: importing the package would
-# execute svd_jacobi_tpu/__init__.py, which pulls in the solver and jax —
-# exactly the dependency this host-side tool promises not to need.
-_MANIFEST = (Path(__file__).resolve().parent.parent / "svd_jacobi_tpu"
-             / "obs" / "manifest.py")
-_spec = importlib.util.spec_from_file_location("_svdj_manifest", _MANIFEST)
-manifest = importlib.util.module_from_spec(_spec)
-_spec.loader.exec_module(manifest)
+# Load obs/manifest.py (and obs/registry.py, for --slo) directly by file
+# path: importing the package would execute svd_jacobi_tpu/__init__.py,
+# which pulls in the solver and jax — exactly the dependency this
+# host-side tool promises not to need. Both modules are stdlib-only.
+_OBS_DIR = (Path(__file__).resolve().parent.parent / "svd_jacobi_tpu"
+            / "obs")
+
+
+def _load(name: str, filename: str):
+    spec = importlib.util.spec_from_file_location(name, _OBS_DIR / filename)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+manifest = _load("_svdj_manifest", "manifest.py")
+registry = _load("_svdj_registry", "registry.py")
 
 
 def main(argv=None) -> int:
@@ -45,6 +59,17 @@ def main(argv=None) -> int:
                    help="second manifest for a cross-file --diff")
     p.add_argument("--last", action="store_true",
                    help="render only the newest record")
+    p.add_argument("--kind", default=None, metavar="KIND",
+                   help="render only records of this kind (one of "
+                        "the registered manifest kinds, e.g. serve / "
+                        "fleet / cache / coldstart / tune)")
+    p.add_argument("--slo", action="store_true",
+                   help="render the SLO report reconstructed from the "
+                        "manifest's 'serve' records (per-bucket p50/p99 "
+                        "latency, deadline-miss/shed counts, rolling "
+                        "error-budget burn)")
+    p.add_argument("--slo-objective", type=float, default=0.99,
+                   help="availability objective for the --slo burn rate")
     p.add_argument("--diff", nargs=2, type=int, metavar=("I", "J"),
                    help="diff record I against record J (indices into the "
                         "manifest; with two files, I indexes the first and "
@@ -58,6 +83,28 @@ def main(argv=None) -> int:
     if not records:
         print(f"{args.manifest}: empty manifest", file=sys.stderr)
         return 1
+
+    if args.slo:
+        snap = registry.slo_from_records(records,
+                                         objective=args.slo_objective)
+        if not snap["buckets"]:
+            print(f"{args.manifest}: no 'serve' records to build an SLO "
+                  f"report from", file=sys.stderr)
+            return 1
+        print(registry.render_slo(snap))
+        return 0
+
+    if args.kind is not None:
+        known = sorted(manifest.KINDS)
+        if args.kind not in known:
+            print(f"unknown --kind {args.kind!r} (registered kinds: "
+                  f"{known})", file=sys.stderr)
+            return 2
+        records = [r for r in records if r.get("kind") == args.kind]
+        if not records:
+            print(f"{args.manifest}: no {args.kind!r} records",
+                  file=sys.stderr)
+            return 1
 
     if args.validate:
         for i, rec in enumerate(records):

@@ -20,7 +20,8 @@ import sys
 import time
 from pathlib import Path
 
-PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot")
+PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot",
+              "obs")
 
 
 def _parse_args(argv):
@@ -87,6 +88,14 @@ def main(argv=None) -> int:
             # the registry's AOT plan dispatches is budgeted.
             from . import aot_checks
             findings, report = aot_checks.run_all()
+            return findings, report
+        if name == "obs":
+            # The serving flight recorder's free-when-off contract
+            # (OBS002): metrics-off HLO byte-identical, zero registry
+            # mutations on the metrics-off hot path, idle-overhead
+            # budget.
+            from . import obs_checks
+            findings, report = obs_checks.run_all()
             return findings, report
         findings, report = recompile_guard.run_default_sequence()
         return findings, report

@@ -1,0 +1,216 @@
+"""OBS002 — the serving flight recorder is FREE when off.
+
+PR 11 gives the serve layer a live metrics registry, span timelines, and
+SLO accounting (`obs.registry` / `obs.spans`), all behind
+``ServeConfig.metrics`` (off by default). This pass proves the
+off-by-default guarantee three ways, each a checkable contract rather
+than a promise in a docstring:
+
+  1. **Metrics-off HLO byte-identity** — the recorder is host-side only
+     and must never leak into a trace: every entry probe's telemetry-off
+     lowering is byte-identical whether or not a live `MetricsRegistry`
+     + `SpanRecorder` exist and are being mutated at trace time. This
+     EXTENDS the existing telemetry equivalence pass (HLO003, which the
+     check also re-runs per probe): HLO003 proves the in-graph event
+     stream is a static-flag property; OBS002 proves the NEW host-side
+     recorder adds no trace dependency on top.
+  2. **Zero registry mutations on the metrics-off hot path** — every
+     registry mutation (any instance) bumps a process-global counter
+     (`obs.registry.mutation_total`); a metrics-off serve sequence
+     (admit -> dispatch -> solve -> finalize, plus a rejected submit)
+     must leave it unmoved. ``seed_leak=True`` is the seeded failing
+     fixture: it runs the SAME sequence with the recorder secretly
+     enabled, and the detector MUST fire (tests prove the check can
+     fail, not just that it passes).
+  3. **Idle-overhead budget** — with the recorder ON, the observability
+     surface itself must stay cheap: a registry mutation is budgeted at
+     ``MUTATION_BUDGET_S`` and a full /metrics scrape (collectors +
+     render) at ``SCRAPE_BUDGET_S``, both measured here. Generous
+     CPU-CI budgets — the REAL overhead number is measured end-to-end
+     by ``bench.py --serve-metrics-overhead`` (PROFILE.md item 28);
+     this check is the regression tripwire in the analysis sequence.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from . import Finding
+
+# Generous single-op budgets (CPU CI with noisy neighbors): a registry
+# mutation is a dict update under one lock; a scrape renders ~100 series
+# plus collector refreshes. Regressions worth catching are 10-100x.
+MUTATION_BUDGET_S = 200e-6
+SCRAPE_BUDGET_S = 0.25
+
+
+def _probes():
+    from . import entries
+    # Two representative entries are enough for byte-identity: the
+    # Pallas kernel path and the padded XLA path (HLO003 already runs
+    # over every probe in the hlo pass).
+    probes = entries.single_device_probes(include_f64=False)
+    by_name = {p.name: p for p in probes}
+    picked = [by_name[n] for n in ("pallas", "padded_qr") if n in by_name]
+    return picked or probes[:2]
+
+
+def check_metrics_off_hlo() -> List[Finding]:
+    """OBS002 check 1: metrics-off HLO byte-identity (see module
+    docstring) + the HLO003 telemetry equivalence re-run per probe."""
+    from ..obs.registry import MetricsRegistry
+    from ..obs.spans import SpanRecorder
+    from . import hlo_checks
+
+    findings: List[Finding] = []
+    for probe in _probes():
+        off = probe.with_kwargs(
+            **({probe.telemetry_key: False} if probe.telemetry_key
+               else {}))
+        baseline = off.lower().as_text()
+        # A live, actively-mutated recorder must not perturb lowering.
+        reg = MetricsRegistry()
+        reg.inc("svdj_obs002_probe_total", bucket="x")
+        reg.observe("svdj_obs002_probe_seconds", 0.001)
+        rec = SpanRecorder()
+        rec.event("obs002", "admit")
+        with_recorder = off.lower().as_text()
+        if with_recorder != baseline:
+            findings.append(Finding(
+                code="OBS002", where=probe.name,
+                message=("metrics-off lowering changed while a live "
+                         "MetricsRegistry/SpanRecorder existed — the "
+                         "flight recorder leaked into the trace"),
+                suggestion=("the recorder is host-side only; remove "
+                            "whatever reads registry/span state inside "
+                            "a traced function")))
+        findings += [
+            Finding(code="OBS002", where=f.where, message=f.message,
+                    suggestion=f.suggestion)
+            for f in hlo_checks.check_telemetry_invariance(probe)]
+    return findings
+
+
+def run_metrics_off_case(seed_leak: bool = False) -> tuple:
+    """OBS002 check 2: a metrics-off serve sequence performs ZERO
+    registry mutations (process-global counter delta). ``seed_leak``
+    flips the recorder ON for the same sequence — the seeded failing
+    fixture proving the detector fires. Returns (findings, report)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..obs import registry as obsreg
+    from ..serve import AdmissionError, ServeConfig, SVDService
+    from ..utils import matgen
+
+    cfg = ServeConfig(
+        buckets=((32, 32, "float64"),), solver=SVDConfig(block_size=4),
+        max_queue_depth=4, metrics=bool(seed_leak),
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    before = obsreg.mutation_total()
+    statuses = []
+    with SVDService(cfg) as svc:
+        for seed in (11, 12):
+            a = matgen.random_dense(30, 30, seed=seed, dtype=jnp.float64)
+            statuses.append(
+                svc.submit(a).result(timeout=600.0).status)
+        try:
+            # A rejected submit crosses the admission instrumentation
+            # sites too — the off path must stay silent there as well.
+            svc.submit(jnp.zeros((3000, 3000), jnp.float64))
+        except AdmissionError:
+            pass
+        text = svc.metrics_text()
+    delta = obsreg.mutation_total() - before
+    report = {"mutation_delta": delta, "seed_leak": bool(seed_leak),
+              "statuses": [getattr(s, "name", None) for s in statuses],
+              "metrics_text_head": text.splitlines()[0] if text else ""}
+    findings: List[Finding] = []
+    if delta != 0:
+        # Fires on the seeded fixture too (seed_leak simulates exactly
+        # the unguarded-instrumentation leak this detector exists for —
+        # tests prove the check CAN fail, not just that it passes).
+        findings.append(Finding(
+            code="OBS002", where="serve.metrics_off",
+            message=(f"metrics-off serve sequence performed {delta} "
+                     f"registry mutation(s) — the flight recorder is "
+                     f"not free when off"),
+            suggestion=("every instrumentation site must guard on "
+                        "`self.metrics is not None`; find the unguarded "
+                        "one")))
+    if seed_leak and delta == 0:
+        findings.append(Finding(
+            code="OBS002", where="serve.metrics_off",
+            message=("seeded leak fixture produced zero mutations — the "
+                     "detector itself is broken (a real leak would pass "
+                     "unnoticed)"),
+            suggestion="check obs.registry.mutation_total accounting"))
+    if any(getattr(s, "name", None) != "OK" for s in statuses):
+        findings.append(Finding(
+            code="OBS002", where="serve.metrics_off",
+            message=(f"metrics-off sequence produced non-OK statuses "
+                     f"{report['statuses']} — the measurement is not "
+                     f"trustworthy on a failing solve"),
+            suggestion="fix the serving solve path first"))
+    return findings, report
+
+
+def check_idle_overhead(mutations: int = 20_000, scrapes: int = 20
+                        ) -> tuple:
+    """OBS002 check 3: the recorder-ON surface stays within its measured
+    budgets (per-mutation and per-scrape). Returns (findings, report)."""
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..serve import ServeConfig, SVDService
+    from ..utils import matgen
+
+    cfg = ServeConfig(
+        buckets=((32, 32, "float64"),), solver=SVDConfig(block_size=4),
+        metrics=True, brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    findings: List[Finding] = []
+    with SVDService(cfg) as svc:
+        # One real request so the scrape renders a populated registry.
+        a = matgen.random_dense(24, 24, seed=13, dtype=jnp.float64)
+        svc.submit(a).result(timeout=600.0)
+        t0 = time.perf_counter()
+        for i in range(mutations):
+            svc.metrics.inc("svdj_obs002_idle_total", lane=i % 4)
+        per_mutation = (time.perf_counter() - t0) / mutations
+        t0 = time.perf_counter()
+        for _ in range(scrapes):
+            text = svc.metrics_text()
+        per_scrape = (time.perf_counter() - t0) / scrapes
+        series = sum(1 for ln in text.splitlines()
+                     if ln and not ln.startswith("#"))
+    report = {"per_mutation_s": per_mutation, "per_scrape_s": per_scrape,
+              "series_rendered": series,
+              "mutation_budget_s": MUTATION_BUDGET_S,
+              "scrape_budget_s": SCRAPE_BUDGET_S}
+    if per_mutation > MUTATION_BUDGET_S:
+        findings.append(Finding(
+            code="OBS002", where="registry.mutation",
+            message=(f"registry mutation costs {per_mutation * 1e6:.1f} "
+                     f"us (budget {MUTATION_BUDGET_S * 1e6:.0f} us) — "
+                     f"the hot-path tax regressed"),
+            suggestion=("keep mutations one dict update under one lock; "
+                        "move derived values to scrape-time collectors")))
+    if per_scrape > SCRAPE_BUDGET_S:
+        findings.append(Finding(
+            code="OBS002", where="registry.scrape",
+            message=(f"/metrics scrape costs {per_scrape:.3f} s (budget "
+                     f"{SCRAPE_BUDGET_S} s) over {series} series"),
+            suggestion="check the scrape-time collectors for heavy work"))
+    return findings, report
+
+
+def run_all() -> tuple:
+    """The OBS002 pass body (analysis.__main__ 'obs'): all three checks.
+    Returns (findings, report)."""
+    findings = check_metrics_off_hlo()
+    off_findings, off_report = run_metrics_off_case()
+    findings += off_findings
+    idle_findings, idle_report = check_idle_overhead()
+    findings += idle_findings
+    return findings, {"metrics_off": off_report, "idle": idle_report}

@@ -32,6 +32,14 @@ Usage:
         — the measured autotuner: benchmark the knob grid on the attached
         backend and write a versioned tuning table (tune.search; pin the
         result with --tuning-table=PATH on any run).
+
+    python -m svd_jacobi_tpu.cli metrics reports/manifest.jsonl
+        [--slo] [--timeline REQUEST_ID]
+        — one-shot flight-recorder dump reconstructed OFFLINE from the
+        manifest records: Prometheus text exposition by default, the SLO
+        report with --slo, one request's span timeline with --timeline
+        (obs.registry / obs.spans; the live equivalents are the
+        service's /metrics listener and SVDService.timeline()).
 """
 
 from __future__ import annotations
@@ -625,10 +633,66 @@ def _restart_drill(args) -> int:
     return 0
 
 
+def metrics_dump(argv) -> int:
+    """`metrics` subcommand: render the flight recorder's view of a
+    manifest OFFLINE — the Prometheus exposition (default), the SLO
+    report (--slo), or one request's reconstructed span timeline
+    (--timeline ID). Host-side work only: the registry/span modules are
+    stdlib-only and the records are plain JSONL."""
+    p = argparse.ArgumentParser(
+        prog="svd-metrics",
+        description="One-shot flight-recorder dump from a JSONL manifest "
+                    "(obs.registry.registry_from_manifest).")
+    p.add_argument("manifest", help="manifest file (JSONL)")
+    p.add_argument("--slo", action="store_true",
+                   help="render the SLO report instead of the Prometheus "
+                        "exposition")
+    p.add_argument("--slo-objective", type=float, default=0.99)
+    p.add_argument("--timeline", default=None, metavar="REQUEST_ID",
+                   help="render one request's span timeline "
+                        "reconstructed from the manifest records")
+    args = p.parse_args(argv)
+    from svd_jacobi_tpu.obs import manifest as _manifest
+    from svd_jacobi_tpu.obs import registry as _registry
+    records = _manifest.load(args.manifest)
+    if not records:
+        print(f"{args.manifest}: empty manifest", file=sys.stderr)
+        return 1
+    if args.timeline is not None:
+        from svd_jacobi_tpu.obs import spans as _spans
+        events = _spans.timeline_from_manifest(records, args.timeline)
+        if not events:
+            print(f"{args.manifest}: no events for request "
+                  f"{args.timeline!r}", file=sys.stderr)
+            return 1
+        t0 = events[0]["t_wall"]
+        print(f"request {args.timeline} timeline ({len(events)} event(s), "
+              f"reconstructed offline):")
+        for ev in events:
+            extra = " ".join(f"{k}={v}" for k, v in ev.items()
+                             if k not in ("name", "t_wall") and v is not None)
+            print(f"  +{(ev['t_wall'] - t0) * 1e3:9.2f}ms "
+                  f"{ev['name']:<10}{(' ' + extra) if extra else ''}")
+        return 0
+    if args.slo:
+        snap = _registry.slo_from_records(records,
+                                          objective=args.slo_objective)
+        if not snap["buckets"]:
+            print(f"{args.manifest}: no 'serve' records to build an SLO "
+                  f"report from", file=sys.stderr)
+            return 1
+        print(_registry.render_slo(snap))
+        return 0
+    sys.stdout.write(_registry.registry_from_manifest(records).render())
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve-demo":
         return serve_demo(argv[1:])
+    if argv and argv[0] == "metrics":
+        return metrics_dump(argv[1:])
     if argv and argv[0] == "tune":
         # `cli.py tune ...` — the measured-autotuner subcommand
         # (regenerates a tuning table; see `python -m svd_jacobi_tpu.tune`).

@@ -23,12 +23,27 @@ report file, main.cu:1586-1669):
 
 `obs.trace(dir)` wraps `jax.profiler` traces robustly (creates the dir,
 warns instead of raising when the profiler is unavailable).
+
+The serving flight recorder adds two live pillars on top (both
+stdlib-only at import, loadable without jax):
+
+  * `obs.registry` — the in-process metrics registry (counters / gauges
+    / explicit-bucket histograms with Prometheus text exposition), SLO
+    accounting (`SLOTracker`), and offline reconstruction of both from
+    the manifest stream (`registry_from_manifest`, `slo_from_records`).
+  * `obs.spans` — per-request trace timelines (`SpanRecorder` live,
+    `timeline_from_manifest` offline) and the `XprofWindow` hook that
+    captures a `jax.profiler` trace of exactly one request's
+    dispatch..finish window.
 """
 
-from . import manifest, metrics, scopes
+from . import manifest, metrics, registry, scopes, spans
 from .metrics import capture, emit, enabled
+from .registry import MetricsRegistry, SLOTracker
 from .scopes import scope
+from .spans import SpanRecorder
 from .trace import trace
 
-__all__ = ["manifest", "metrics", "scopes", "capture", "emit", "enabled",
-           "scope", "trace"]
+__all__ = ["manifest", "metrics", "registry", "scopes", "spans",
+           "capture", "emit", "enabled", "scope", "trace",
+           "MetricsRegistry", "SLOTracker", "SpanRecorder"]

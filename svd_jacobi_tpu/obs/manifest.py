@@ -32,7 +32,7 @@ import hashlib
 import json
 import threading
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, NamedTuple, Optional
 
 SCHEMA_VERSION = 1
 
@@ -459,8 +459,77 @@ def _check_fields(obj, spec, where: str, errors: List[str]) -> None:
                           f"{type(obj[key]).__name__}")
 
 
+def _validate_analysis(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _ANALYSIS_SCHEMA, "record", errors)
+    for i, p in enumerate(record.get("passes") or []):
+        _check_fields(p, _PASS_FIELDS, f"record.passes[{i}]", errors)
+
+
+def _validate_retry(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _RETRY_SCHEMA, "record", errors)
+    for i, at in enumerate(record.get("attempts") or []):
+        _check_fields(at, _ATTEMPT_FIELDS, f"record.attempts[{i}]",
+                      errors)
+
+
+def _validate_serve(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _SERVE_SCHEMA, "record", errors)
+    # Two-phase fields are optional-by-forward-compatibility
+    # (records written before the σ-first lane lack them) but
+    # type-checked when present: "phase" names the serving stage the
+    # record closes, "promoted_from" the sigma request a promote
+    # resumed.
+    _check_fields({k: record[k] for k in _SERVE_PHASE_FIELDS
+                   if k in record},
+                  {k: t for k, t in _SERVE_PHASE_FIELDS.items()
+                   if k in record}, "record", errors)
+
+
+def _validate_tune(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _TUNE_SCHEMA, "record", errors)
+    for i, p in enumerate(record.get("grid") or []):
+        if not isinstance(p, dict) or not isinstance(p.get("knobs"),
+                                                     dict):
+            errors.append(f"record.grid[{i}]: expected an object with "
+                          f"a 'knobs' dict")
+
+
+def _validate_fleet(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _FLEET_SCHEMA, "record", errors)
+
+
+def _validate_cache(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _CACHE_SCHEMA, "record", errors)
+
+
+def _validate_coldstart(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _COLDSTART_SCHEMA, "record", errors)
+    for i, e in enumerate(record.get("entries") or []):
+        _check_fields(e, _COLDSTART_ENTRY_FIELDS,
+                      f"record.entries[{i}]", errors)
+
+
+def _validate_solve(record: dict, errors: List[str]) -> None:
+    """The solve-record shape ("cli"/"bench" — and the forward-compat
+    fallback for kinds this version does not know)."""
+    _check_fields(record, _SOLVE_SCHEMA, "record", errors)
+    for i, st in enumerate(record.get("stages") or []):
+        _check_fields(st, _STAGE_FIELDS, f"record.stages[{i}]", errors)
+    if isinstance(record.get("solve"), dict):
+        _check_fields(record["solve"], _SOLVE_REQUIRED, "record.solve",
+                      errors)
+    tel = record.get("telemetry")
+    if tel is not None:
+        for i, ev in enumerate(tel):
+            _check_fields(ev, _EVENT_REQUIRED, f"record.telemetry[{i}]",
+                          errors)
+
+
 def validate(record: dict) -> None:
-    """Raise ValueError listing every schema violation (empty = valid)."""
+    """Raise ValueError listing every schema violation (empty = valid).
+    Per-kind validation dispatches through the `KINDS` registry; a kind
+    the registry does not know falls back to the solve-record shape
+    (forward compatibility — the original behavior, byte-for-byte)."""
     errors: List[str] = []
     _check(isinstance(record, dict), errors, "record: not an object")
     if not isinstance(record, dict):
@@ -469,56 +538,20 @@ def validate(record: dict) -> None:
     if record.get("schema_version") not in (None, SCHEMA_VERSION):
         errors.append(f"record.schema_version: {record['schema_version']} "
                       f"!= supported {SCHEMA_VERSION}")
-    if record.get("kind") == "analysis":
-        _check_fields(record, _ANALYSIS_SCHEMA, "record", errors)
-        for i, p in enumerate(record.get("passes") or []):
-            _check_fields(p, _PASS_FIELDS, f"record.passes[{i}]", errors)
-    elif record.get("kind") == "retry":
-        _check_fields(record, _RETRY_SCHEMA, "record", errors)
-        for i, at in enumerate(record.get("attempts") or []):
-            _check_fields(at, _ATTEMPT_FIELDS, f"record.attempts[{i}]",
-                          errors)
-    elif record.get("kind") == "serve":
-        _check_fields(record, _SERVE_SCHEMA, "record", errors)
-        # Two-phase fields are optional-by-forward-compatibility
-        # (records written before the σ-first lane lack them) but
-        # type-checked when present: "phase" names the serving stage the
-        # record closes, "promoted_from" the sigma request a promote
-        # resumed.
-        _check_fields({k: record[k] for k in _SERVE_PHASE_FIELDS
-                       if k in record},
-                      {k: t for k, t in _SERVE_PHASE_FIELDS.items()
-                       if k in record}, "record", errors)
-    elif record.get("kind") == "tune":
-        _check_fields(record, _TUNE_SCHEMA, "record", errors)
-        for i, p in enumerate(record.get("grid") or []):
-            if not isinstance(p, dict) or not isinstance(p.get("knobs"),
-                                                         dict):
-                errors.append(f"record.grid[{i}]: expected an object with "
-                              f"a 'knobs' dict")
-    elif record.get("kind") == "fleet":
-        _check_fields(record, _FLEET_SCHEMA, "record", errors)
-    elif record.get("kind") == "cache":
-        _check_fields(record, _CACHE_SCHEMA, "record", errors)
-    elif record.get("kind") == "coldstart":
-        _check_fields(record, _COLDSTART_SCHEMA, "record", errors)
-        for i, e in enumerate(record.get("entries") or []):
-            _check_fields(e, _COLDSTART_ENTRY_FIELDS,
-                          f"record.entries[{i}]", errors)
-    else:
-        _check_fields(record, _SOLVE_SCHEMA, "record", errors)
-        for i, st in enumerate(record.get("stages") or []):
-            _check_fields(st, _STAGE_FIELDS, f"record.stages[{i}]", errors)
-        if isinstance(record.get("solve"), dict):
-            _check_fields(record["solve"], _SOLVE_REQUIRED, "record.solve",
-                          errors)
-        tel = record.get("telemetry")
-        if tel is not None:
-            for i, ev in enumerate(tel):
-                _check_fields(ev, _EVENT_REQUIRED, f"record.telemetry[{i}]",
-                              errors)
+    kind = _kind_for(record)
+    (kind.validator if kind is not None else _validate_solve)(record,
+                                                              errors)
     if errors:
         raise ValueError("invalid manifest record: " + "; ".join(errors))
+
+
+def _kind_for(record: dict):
+    """The record's registered kind row, or None for the solve-shape
+    fallback. A non-string (even unhashable — a list-valued "kind" is
+    well-formed JSON) falls back like any unknown kind, matching the
+    pre-registry if/elif behavior instead of raising TypeError."""
+    kind = record.get("kind")
+    return KINDS.get(kind) if isinstance(kind, str) else None
 
 
 # Per-path append locks: concurrent appends from worker/client threads
@@ -641,143 +674,156 @@ def load(path, *, quarantine: bool = True) -> List[dict]:
     return records
 
 
-def summarize(record: dict) -> str:
-    """One human-readable block per record (telemetry_summary's renderer)."""
-    if record.get("kind") == "analysis":
-        env = record.get("environment", {})
-        lines = [
-            f"analysis run @ {record.get('timestamp', '?')}  "
-            f"backend={env.get('backend')} "
-            f"({env.get('device_count')}x {env.get('device_kind')})",
-        ]
-        for p in record.get("passes") or []:
-            n = len(p.get("findings") or [])
-            lines.append(f"  pass {p.get('name', '?'):<10} "
-                        f"{'ok' if p.get('ok') else 'FAIL':<4} "
-                        f"{n} finding(s)  {p.get('time_s', 0.0):7.2f} s")
-        lines.append(f"  overall: {'ok' if record.get('ok') else 'FAIL'} "
-                     f"({record.get('findings_total', 0)} findings)")
-        return "\n".join(lines)
-    if record.get("kind") == "retry":
-        dim = record.get("dimension", {})
-        lines = [
-            f"retry episode @ {record.get('timestamp', '?')}  "
-            f"matrix {dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
-            f"final={record.get('final_status')}",
-        ]
-        for at in record.get("attempts") or []:
-            off = at.get("off_norm")
-            off_s = f"{off:.3e}" if isinstance(off, float) else "n/a"
-            lines.append(f"  attempt {at.get('rung', '?'):<18} "
-                         f"{at.get('status', '?'):<11} "
-                         f"sweeps={at.get('sweeps', '?'):>3} off={off_s}  "
-                         f"{at.get('time_s', 0.0):7.2f} s")
-        return "\n".join(lines)
-    if record.get("kind") == "tune":
-        dim = record.get("dimension", {})
-        base = record.get("baseline", {})
-        bt = base.get("time_s")
-        lines = [
-            f"tune search @ {record.get('timestamp', '?')}  "
-            f"{dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
-            f"table={record.get('table_id')} "
-            f"({str(record.get('table_sha256', ''))[:12]})",
-            f"  baseline {base.get('knobs', {})}  "
-            + (f"{bt:.4f} s" if isinstance(bt, float) else "n/a"),
-        ]
-        for p in record.get("grid") or []:
-            t = p.get("time_s")
-            t_s = f"{t:.4f} s" if isinstance(t, float) else \
-                (p.get("note") or "n/a")
-            lines.append(f"  point {p.get('knobs', {})}  {t_s}")
-        lines.append(f"  winner {record.get('winner', {})}")
-        return "\n".join(lines)
-    if record.get("kind") == "coldstart":
-        hits = sum(1 for e in record.get("entries") or []
-                   if e.get("cache_hit"))
-        total = len(record.get("entries") or [])
-        lines = [
-            f"coldstart @ {record.get('timestamp', '?')}  "
-            f"{record.get('total_s', float('nan')):.2f} s  "
-            f"entries {hits}/{total} cache-hit  "
-            f"fresh_compiles={record.get('fresh_compiles', '?')}"
-            + (f"  cache={record['cache_dir']}"
-               if record.get("cache_dir") else "  (no persistent cache)"),
-        ]
-        for e in record.get("entries") or []:
-            lines.append(
-                f"  entry {e.get('entry', '?'):<36} "
-                f"{e.get('time_s', float('nan')):7.3f} s  "
-                f"{'hit' if e.get('cache_hit') else 'COMPILE'}")
-        return "\n".join(lines)
-    if record.get("kind") == "fleet":
-        lane = record.get("lane")
-        line = (f"fleet {record.get('event', '?')} @ "
-                f"{record.get('timestamp', '?')}"
-                + (f"  lane={lane}" if lane is not None else ""))
-        if record.get("event") == "lane_transition":
-            line += (f"  {record.get('from_state', '?')} -> "
-                     f"{record.get('to_state', '?')} "
-                     f"({record.get('cause', '?')})")
-        elif record.get("event") == "rescue":
-            line += (f"  {record.get('count', '?')} request(s) "
-                     f"{record.get('request_ids', [])}")
-        elif record.get("event") == "steal":
-            line += (f"  {record.get('request_id', '?')} from lane "
-                     f"{record.get('victim', '?')}")
-        elif record.get("event") == "probe":
-            line += (f"  {'ok' if record.get('ok') else 'FAILED'} "
-                     f"({record.get('request_id', '?')})")
-        elif record.get("event") == "ladder_overrun":
-            line += (f"  elapsed={record.get('elapsed_s', float('nan')):.2f}s"
-                     f" budget={record.get('budget_s', float('nan')):.2f}s")
-        return line
-    if record.get("kind") == "cache":
-        line = (f"cache {record.get('store', '?')}/{record.get('event', '?')}"
-                f" @ {record.get('timestamp', '?')}")
-        if record.get("request_id") is not None:
-            line += f"  req={record['request_id']}"
-        if record.get("digest") is not None:
-            line += f"  digest={str(record['digest'])[:12]}"
-        if record.get("bytes") is not None:
-            line += f"  {record['bytes']} B"
-        if record.get("count") is not None:
-            line += f"  count={record['count']}"
-        return line
-    if record.get("kind") == "serve":
-        req = record.get("request", {})
-        wait = record.get("queue_wait_s", float("nan"))
-        solve_t = record.get("solve_time_s")
-        solve_s = "n/a" if solve_t is None else f"{solve_t * 1e3:.1f}ms"
-        line = (f"serve {req.get('id', '?')} @ {record.get('timestamp', '?')}"
-                f"  {req.get('m')}x{req.get('n')} {req.get('dtype')}"
-                f" -> {record.get('bucket') or 'no bucket'}"
-                f" [{record.get('path', '?')}]"
-                f" status={record.get('status', '?')}"
-                f" breaker={record.get('breaker', '?')}"
-                f" brownout={record.get('brownout', '?')}"
-                f" wait={wait * 1e3:.1f}ms solve={solve_s}")
-        if record.get("phase", "full") != "full":
-            # Two-phase branch: a sigma-first request shows its phase; a
-            # promote shows which sigma request's retained state it
-            # resumed — the σ-then-promote pair pairs up in the stream.
-            line += f" phase={record['phase']}"
-            if record.get("promoted_from"):
-                line += f"<-{record['promoted_from']}"
-        if record.get("rank_mode", "full") != "full":
-            # Top-k / tall workload branch: a truncated request shows its
-            # rank, a tall one its TSQR routing — the summarizer's view
-            # of the "Workloads" families.
-            line += f" {record['rank_mode']}"
-            if record.get("k") is not None:
-                line += f"[k={record['k']}]"
-        if record.get("batch_id"):
-            line += (f" batch={record['batch_id']}"
-                     f"[{record.get('batch_size', '?')}"
-                     f"/{record.get('batch_tier', '?')}]")
-        if record.get("error"):
-            line += f"\n  error: {record['error']}"
-        return line
+def _summarize_analysis(record: dict) -> str:
+    env = record.get("environment", {})
+    lines = [
+        f"analysis run @ {record.get('timestamp', '?')}  "
+        f"backend={env.get('backend')} "
+        f"({env.get('device_count')}x {env.get('device_kind')})",
+    ]
+    for p in record.get("passes") or []:
+        n = len(p.get("findings") or [])
+        lines.append(f"  pass {p.get('name', '?'):<10} "
+                    f"{'ok' if p.get('ok') else 'FAIL':<4} "
+                    f"{n} finding(s)  {p.get('time_s', 0.0):7.2f} s")
+    lines.append(f"  overall: {'ok' if record.get('ok') else 'FAIL'} "
+                 f"({record.get('findings_total', 0)} findings)")
+    return "\n".join(lines)
+
+
+def _summarize_retry(record: dict) -> str:
+    dim = record.get("dimension", {})
+    lines = [
+        f"retry episode @ {record.get('timestamp', '?')}  "
+        f"matrix {dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
+        f"final={record.get('final_status')}",
+    ]
+    for at in record.get("attempts") or []:
+        off = at.get("off_norm")
+        off_s = f"{off:.3e}" if isinstance(off, float) else "n/a"
+        lines.append(f"  attempt {at.get('rung', '?'):<18} "
+                     f"{at.get('status', '?'):<11} "
+                     f"sweeps={at.get('sweeps', '?'):>3} off={off_s}  "
+                     f"{at.get('time_s', 0.0):7.2f} s")
+    return "\n".join(lines)
+
+
+def _summarize_tune(record: dict) -> str:
+    dim = record.get("dimension", {})
+    base = record.get("baseline", {})
+    bt = base.get("time_s")
+    lines = [
+        f"tune search @ {record.get('timestamp', '?')}  "
+        f"{dim.get('m')}x{dim.get('n')} {record.get('dtype')}  "
+        f"table={record.get('table_id')} "
+        f"({str(record.get('table_sha256', ''))[:12]})",
+        f"  baseline {base.get('knobs', {})}  "
+        + (f"{bt:.4f} s" if isinstance(bt, float) else "n/a"),
+    ]
+    for p in record.get("grid") or []:
+        t = p.get("time_s")
+        t_s = f"{t:.4f} s" if isinstance(t, float) else \
+            (p.get("note") or "n/a")
+        lines.append(f"  point {p.get('knobs', {})}  {t_s}")
+    lines.append(f"  winner {record.get('winner', {})}")
+    return "\n".join(lines)
+
+
+def _summarize_coldstart(record: dict) -> str:
+    hits = sum(1 for e in record.get("entries") or []
+               if e.get("cache_hit"))
+    total = len(record.get("entries") or [])
+    lines = [
+        f"coldstart @ {record.get('timestamp', '?')}  "
+        f"{record.get('total_s', float('nan')):.2f} s  "
+        f"entries {hits}/{total} cache-hit  "
+        f"fresh_compiles={record.get('fresh_compiles', '?')}"
+        + (f"  cache={record['cache_dir']}"
+           if record.get("cache_dir") else "  (no persistent cache)"),
+    ]
+    for e in record.get("entries") or []:
+        lines.append(
+            f"  entry {e.get('entry', '?'):<36} "
+            f"{e.get('time_s', float('nan')):7.3f} s  "
+            f"{'hit' if e.get('cache_hit') else 'COMPILE'}")
+    return "\n".join(lines)
+
+
+def _summarize_fleet(record: dict) -> str:
+    lane = record.get("lane")
+    line = (f"fleet {record.get('event', '?')} @ "
+            f"{record.get('timestamp', '?')}"
+            + (f"  lane={lane}" if lane is not None else ""))
+    if record.get("event") == "lane_transition":
+        line += (f"  {record.get('from_state', '?')} -> "
+                 f"{record.get('to_state', '?')} "
+                 f"({record.get('cause', '?')})")
+    elif record.get("event") == "rescue":
+        line += (f"  {record.get('count', '?')} request(s) "
+                 f"{record.get('request_ids', [])}")
+    elif record.get("event") == "steal":
+        line += (f"  {record.get('request_id', '?')} from lane "
+                 f"{record.get('victim', '?')}")
+    elif record.get("event") == "probe":
+        line += (f"  {'ok' if record.get('ok') else 'FAILED'} "
+                 f"({record.get('request_id', '?')})")
+    elif record.get("event") == "ladder_overrun":
+        line += (f"  elapsed={record.get('elapsed_s', float('nan')):.2f}s"
+                 f" budget={record.get('budget_s', float('nan')):.2f}s")
+    return line
+
+
+def _summarize_cache(record: dict) -> str:
+    line = (f"cache {record.get('store', '?')}/{record.get('event', '?')}"
+            f" @ {record.get('timestamp', '?')}")
+    if record.get("request_id") is not None:
+        line += f"  req={record['request_id']}"
+    if record.get("digest") is not None:
+        line += f"  digest={str(record['digest'])[:12]}"
+    if record.get("bytes") is not None:
+        line += f"  {record['bytes']} B"
+    if record.get("count") is not None:
+        line += f"  count={record['count']}"
+    return line
+
+
+def _summarize_serve(record: dict) -> str:
+    req = record.get("request", {})
+    wait = record.get("queue_wait_s", float("nan"))
+    solve_t = record.get("solve_time_s")
+    solve_s = "n/a" if solve_t is None else f"{solve_t * 1e3:.1f}ms"
+    line = (f"serve {req.get('id', '?')} @ {record.get('timestamp', '?')}"
+            f"  {req.get('m')}x{req.get('n')} {req.get('dtype')}"
+            f" -> {record.get('bucket') or 'no bucket'}"
+            f" [{record.get('path', '?')}]"
+            f" status={record.get('status', '?')}"
+            f" breaker={record.get('breaker', '?')}"
+            f" brownout={record.get('brownout', '?')}"
+            f" wait={wait * 1e3:.1f}ms solve={solve_s}")
+    if record.get("phase", "full") != "full":
+        # Two-phase branch: a sigma-first request shows its phase; a
+        # promote shows which sigma request's retained state it
+        # resumed — the σ-then-promote pair pairs up in the stream.
+        line += f" phase={record['phase']}"
+        if record.get("promoted_from"):
+            line += f"<-{record['promoted_from']}"
+    if record.get("rank_mode", "full") != "full":
+        # Top-k / tall workload branch: a truncated request shows its
+        # rank, a tall one its TSQR routing — the summarizer's view
+        # of the "Workloads" families.
+        line += f" {record['rank_mode']}"
+        if record.get("k") is not None:
+            line += f"[k={record['k']}]"
+    if record.get("batch_id"):
+        line += (f" batch={record['batch_id']}"
+                 f"[{record.get('batch_size', '?')}"
+                 f"/{record.get('batch_tier', '?')}]")
+    if record.get("error"):
+        line += f"\n  error: {record['error']}"
+    return line
+
+
+def _summarize_solve(record: dict) -> str:
     dim = record.get("dimension", {})
     env = record.get("environment", {})
     solve = record.get("solve", {})
@@ -810,6 +856,77 @@ def summarize(record: dict) -> str:
                          f"[{e.get('path', '?')}/{e.get('stage', '?')}] "
                          f"off={e.get('off_rel', float('nan')):.3e}{extra}")
     return "\n".join(lines)
+
+
+def summarize(record: dict) -> str:
+    """One human-readable block per record (telemetry_summary's renderer).
+    Dispatches through the `KINDS` registry; unknown kinds render through
+    the generic solve-record block (the original behavior)."""
+    kind = _kind_for(record)
+    return (kind.summarizer if kind is not None
+            else _summarize_solve)(record)
+
+
+# -- the KINDS registry -----------------------------------------------------
+# One row per manifest kind: name -> (builder, validator, summarizer).
+# `validate` and `summarize` dispatch through this table instead of
+# if/elif chains, so a NEW kind added without all three pieces is a loud
+# error AT IMPORT (register_kind refuses a partial registration) — not a
+# silent fall-through to the generic solve branch at first render.
+# Unknown kinds (records from a NEWER writer) still fall back to the
+# solve shape in both functions: forward compatibility is unchanged.
+
+class _Kind(NamedTuple):
+    builder: Any            # the build_* function producing this kind
+    validator: Any          # fn(record, errors) appending violations
+    summarizer: Any         # fn(record) -> str
+
+
+KINDS: Dict[str, _Kind] = {}
+
+
+def register_kind(name: str, *, builder, validator, summarizer) -> None:
+    """Register one manifest kind. All three pieces are REQUIRED and the
+    name must be fresh — a kind with a builder but no validator (or
+    summarizer) would validate/render through the generic branch
+    silently, which is exactly the failure mode this registry exists to
+    make loud."""
+    missing = [what for what, fn in (("builder", builder),
+                                     ("validator", validator),
+                                     ("summarizer", summarizer))
+               if fn is None]
+    if missing:
+        raise KeyError(f"manifest kind {name!r} registered without "
+                       f"{'/'.join(missing)} — every kind needs builder, "
+                       f"validator, AND summarizer")
+    if name in KINDS:
+        raise KeyError(f"manifest kind {name!r} already registered")
+    KINDS[name] = _Kind(builder, validator, summarizer)
+
+
+def _build_cli(**kw) -> dict:
+    return build("cli", **kw)
+
+
+def _build_bench(**kw) -> dict:
+    return build("bench", **kw)
+
+
+for _name, _builder, _validator, _summarizer in (
+        ("cli", _build_cli, _validate_solve, _summarize_solve),
+        ("bench", _build_bench, _validate_solve, _summarize_solve),
+        ("analysis", build_analysis, _validate_analysis,
+         _summarize_analysis),
+        ("retry", build_retry, _validate_retry, _summarize_retry),
+        ("serve", build_serve, _validate_serve, _summarize_serve),
+        ("tune", build_tune, _validate_tune, _summarize_tune),
+        ("fleet", build_fleet, _validate_fleet, _summarize_fleet),
+        ("cache", build_cache, _validate_cache, _summarize_cache),
+        ("coldstart", build_coldstart, _validate_coldstart,
+         _summarize_coldstart),
+):
+    register_kind(_name, builder=_builder, validator=_validator,
+                  summarizer=_summarizer)
 
 
 def diff(a: dict, b: dict) -> str:

@@ -1,0 +1,574 @@
+"""Live metrics registry — the serving flight recorder's numeric core.
+
+The serving stack's observability so far was POST-HOC: schema-versioned
+JSONL manifests reconstruct what happened after the fact, but nothing
+answers "what is the service doing RIGHT NOW" — the ROADMAP's
+multi-tenant front-door item explicitly requires "Prometheus-style
+metrics export" before a network API can ship. This module is that
+surface, three pieces:
+
+  * `MetricsRegistry` — a lock-cheap in-process registry of counters,
+    gauges, and explicit-bucket histograms, labeled by whatever the call
+    site declares (bucket/lane/op/phase/path). Mutations are one dict
+    update under one lock (no allocation on the repeat path); gauges
+    that DERIVE from live state (queue depths, lane states, cache
+    sizes) refresh through registered collectors at scrape time instead
+    of taxing the hot path. `render()` emits Prometheus text exposition
+    format 0.0.4.
+  * SLO accounting — `SLOTracker` keeps per-bucket latency quantiles
+    (p50/p99 off a bounded reservoir), deadline-miss / shed / error
+    counters, and a rolling error-budget burn rate
+    (miss_rate / (1 - objective) over the last `window` requests: 1.0 =
+    burning exactly the budget, >1 = on course to blow the SLO).
+  * Offline reconstruction — `registry_from_manifest` and
+    `slo_from_records` rebuild the same series from the JSONL manifest
+    records that already exist (serve/fleet/cache/coldstart), so a
+    manifest can be rendered as a Prometheus dump or an SLO report on a
+    host with no service (and no jax) at all.
+
+Free when off: the registry only exists when `ServeConfig.metrics` is
+True — the off path holds None and never constructs one. Every mutation
+additionally bumps a module-global counter (`mutation_total`), which is
+how the OBS002 analysis pass PROVES the metrics-off hot path performs
+zero registry mutations (the counter is monotonic across all instances;
+a zero delta over an off-path serve sequence is the guarantee).
+
+Deliberately stdlib-only (no jax, no numpy): `scripts/telemetry_summary.py`
+loads this module by file path on hosts without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Monotonic across every registry instance in the process: the OBS002
+# "zero registry mutations on the metrics-off hot path" check snapshots
+# this, runs a metrics-off serve sequence, and asserts a zero delta.
+_MUTATION_LOCK = threading.Lock()
+_MUTATION_TOTAL = 0
+
+
+def _count_mutation() -> None:
+    global _MUTATION_TOTAL
+    with _MUTATION_LOCK:
+        _MUTATION_TOTAL += 1
+
+
+def mutation_total() -> int:
+    """Process-wide count of registry mutations (all instances)."""
+    with _MUTATION_LOCK:
+        return _MUTATION_TOTAL
+
+
+# Latency-oriented default histogram buckets (seconds): sub-ms cache
+# hits through minutes-class cold compiles.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    """Canonical (sorted, stringified) label identity of one series."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class _Hist:
+    """One histogram series: cumulative-bucket counts + sum + count."""
+
+    __slots__ = ("bounds", "counts", "total", "count")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)   # +Inf tail bucket
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                break
+        else:
+            i = len(self.bounds)
+        self.counts[i] += 1
+        self.total += value
+        self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper-bound estimate of the q-quantile from the bucket counts
+        (the standard Prometheus histogram_quantile approximation, minus
+        the intra-bucket interpolation — good enough for a health
+        snapshot). None when empty."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                return (self.bounds[i] if i < len(self.bounds)
+                        else math.inf)
+        return math.inf
+
+
+class _Family:
+    __slots__ = ("name", "kind", "help", "series")
+
+    def __init__(self, name: str, kind: str, help_: str):
+        self.name = name
+        self.kind = kind      # "counter" | "gauge" | "histogram"
+        self.help = help_
+        self.series: Dict[tuple, object] = {}
+
+
+class MetricsRegistry:
+    """Thread-safe in-process metrics registry (see module docstring).
+
+    Families are created lazily at first mutation; a name reused with a
+    different kind raises loudly (a counter silently becoming a gauge
+    would corrupt every scrape after it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: "collections.OrderedDict[str, _Family]" = \
+            collections.OrderedDict()
+        self._collectors: List[Callable[["MetricsRegistry"], None]] = []
+        self._mutations = 0
+
+    # -- mutation API -------------------------------------------------------
+
+    def _family(self, name: str, kind: str, help_: Optional[str]) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            fam = self._families[name] = _Family(name, kind, help_ or "")
+        elif fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {fam.kind}, "
+                f"cannot use as {kind}")
+        elif help_ and not fam.help:
+            fam.help = help_
+        return fam
+
+    def inc(self, name: str, amount: float = 1.0, *,
+            help: Optional[str] = None, **labels) -> None:
+        """Increment a counter series (created at first use)."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "counter", help)
+            fam.series[key] = float(fam.series.get(key, 0.0)) + amount
+            self._mutations += 1
+        _count_mutation()
+
+    def set(self, name: str, value: float, *,
+            help: Optional[str] = None, **labels) -> None:
+        """Set a gauge series to an absolute value."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "gauge", help)
+            fam.series[key] = float(value)
+            self._mutations += 1
+        _count_mutation()
+
+    def observe(self, name: str, value: float, *,
+                buckets: Optional[Tuple[float, ...]] = None,
+                help: Optional[str] = None, **labels) -> None:
+        """Observe one value into a histogram series."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._family(name, "histogram", help)
+            h = fam.series.get(key)
+            if h is None:
+                h = fam.series[key] = _Hist(tuple(buckets or DEFAULT_BUCKETS))
+            h.observe(float(value))
+            self._mutations += 1
+        _count_mutation()
+
+    # -- collectors ---------------------------------------------------------
+
+    def add_collector(self, fn: Callable[["MetricsRegistry"], None]
+                      ) -> Callable[[], None]:
+        """Register a scrape-time refresher for DERIVED gauges (queue
+        depths, lane states, cache sizes): called on every `render` /
+        `snapshot`, so live state is sampled when someone looks instead
+        of taxing the hot path on every change. Returns a remover. A
+        collector that raises is dropped from that scrape only (the
+        scrape must stay serviceable mid-chaos — a dead lane's collector
+        error must not take /metrics down with it)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+        def remove():
+            with self._lock:
+                if fn in self._collectors:
+                    self._collectors.remove(fn)
+        return remove
+
+    def _collect(self) -> List[str]:
+        with self._lock:
+            collectors = list(self._collectors)
+        errors = []
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception as e:   # scrape must survive a sick collector
+                errors.append(f"{type(e).__name__}: {e}")
+        return errors
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def mutations(self) -> int:
+        with self._lock:
+            return self._mutations
+
+    def value(self, name: str, **labels) -> Optional[float]:
+        """Current value of one counter/gauge series (None if absent)."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            v = fam.series.get(_label_key(labels))
+            return None if v is None or isinstance(v, _Hist) else float(v)
+
+    def snapshot(self) -> dict:
+        """{name: {"kind", "series": {label-string: value-or-hist-dict}}}
+        after running the collectors."""
+        self._collect()
+        out = {}
+        with self._lock:
+            for fam in self._families.values():
+                series = {}
+                for key, v in fam.series.items():
+                    lbl = ",".join(f"{k}={val}" for k, val in key)
+                    if isinstance(v, _Hist):
+                        series[lbl] = {"count": v.count, "sum": v.total,
+                                       "p50": v.quantile(0.50),
+                                       "p99": v.quantile(0.99)}
+                    else:
+                        series[lbl] = v
+                out[fam.name] = {"kind": fam.kind, "series": series}
+        return out
+
+    def render(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every family,
+        collectors refreshed first. Collector failures surface as a
+        comment line, never an exception — the scrape stays serviceable
+        under fleet chaos."""
+        errors = self._collect()
+        lines: List[str] = []
+        with self._lock:
+            for fam in self._families.values():
+                if fam.help:
+                    lines.append(f"# HELP {fam.name} {fam.help}")
+                lines.append(f"# TYPE {fam.name} {fam.kind}")
+                for key, v in sorted(fam.series.items()):
+                    base_lbl = ",".join(
+                        f'{k}="{_escape(val)}"' for k, val in key)
+                    if isinstance(v, _Hist):
+                        cum = 0
+                        for i, b in enumerate(v.bounds):
+                            cum += v.counts[i]
+                            le = ((base_lbl + ",") if base_lbl else "")
+                            lines.append(
+                                f'{fam.name}_bucket{{{le}le="{_fmt(b)}"}}'
+                                f' {cum}')
+                        le = ((base_lbl + ",") if base_lbl else "")
+                        lines.append(
+                            f'{fam.name}_bucket{{{le}le="+Inf"}} {v.count}')
+                        suffix = f"{{{base_lbl}}}" if base_lbl else ""
+                        lines.append(f"{fam.name}_sum{suffix} "
+                                     f"{_fmt(v.total)}")
+                        lines.append(f"{fam.name}_count{suffix} {v.count}")
+                    else:
+                        suffix = f"{{{base_lbl}}}" if base_lbl else ""
+                        lines.append(f"{fam.name}{suffix} {_fmt(v)}")
+        for e in errors:
+            lines.append(f"# collector error: {e}")
+        return "\n".join(lines) + "\n"
+
+
+# -- SLO accounting ---------------------------------------------------------
+
+
+class SLOTracker:
+    """Per-bucket latency/outcome accounting for the serving layer.
+
+    ``objective`` is the availability target (fraction of requests that
+    must finish OK within their deadline); the rolling error-budget burn
+    is miss_rate / (1 - objective) over the last ``window`` outcomes —
+    the standard burn-rate framing: 1.0 means the service is spending
+    its budget exactly as fast as it accrues."""
+
+    def __init__(self, objective: float = 0.99, window: int = 512,
+                 reservoir: int = 512):
+        if not (0.0 < objective < 1.0):
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        self.objective = float(objective)
+        self._lock = threading.Lock()
+        self._lat: Dict[str, collections.deque] = {}
+        self._counts: Dict[str, Dict[str, int]] = {}
+        self._window: collections.deque = collections.deque(maxlen=window)
+        self._reservoir = int(reservoir)
+
+    def _bucket_counts(self, bucket: str) -> Dict[str, int]:
+        c = self._counts.get(bucket)
+        if c is None:
+            c = self._counts[bucket] = {
+                "served": 0, "ok": 0, "deadline_miss": 0, "error": 0,
+                "shed": 0}
+        return c
+
+    def observe(self, bucket: str, latency_s: float, *, ok: bool,
+                deadline_miss: bool = False, error: bool = False) -> None:
+        """One finalized request: end-to-end latency + outcome class."""
+        with self._lock:
+            lat = self._lat.get(bucket)
+            if lat is None:
+                lat = self._lat[bucket] = collections.deque(
+                    maxlen=self._reservoir)
+            lat.append(float(latency_s))
+            c = self._bucket_counts(bucket)
+            c["served"] += 1
+            if ok:
+                c["ok"] += 1
+            if deadline_miss:
+                c["deadline_miss"] += 1
+            if error:
+                c["error"] += 1
+            self._window.append(1 if (ok and not deadline_miss) else 0)
+
+    def shed(self, bucket: Optional[str] = None) -> None:
+        """One request rejected at admission for load (shed/queue-full/
+        budget): burns the error budget without a latency sample."""
+        with self._lock:
+            self._bucket_counts(bucket or "_rejected")["shed"] += 1
+            self._window.append(0)
+
+    @staticmethod
+    def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+        if not sorted_vals:
+            return None
+        i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+        return sorted_vals[i]
+
+    def burn_rate(self) -> float:
+        """Rolling error-budget burn (0 = clean, 1 = at budget)."""
+        with self._lock:
+            if not self._window:
+                return 0.0
+            miss = 1.0 - (sum(self._window) / len(self._window))
+        return miss / (1.0 - self.objective)
+
+    def snapshot(self) -> dict:
+        """Per-bucket p50/p99/outcome counts + the rolling burn gauge."""
+        with self._lock:
+            buckets = {}
+            for b, c in self._counts.items():
+                lat = sorted(self._lat.get(b, ()))
+                buckets[b] = {
+                    **c,
+                    "latency_p50_s": self._quantile(lat, 0.50),
+                    "latency_p99_s": self._quantile(lat, 0.99),
+                    "samples": len(lat),
+                }
+            window = list(self._window)
+        miss = (1.0 - sum(window) / len(window)) if window else 0.0
+        return {
+            "objective": self.objective,
+            "window": len(window),
+            "error_budget_burn": miss / (1.0 - self.objective),
+            "buckets": buckets,
+        }
+
+    def export_to(self, reg: MetricsRegistry) -> None:
+        """Refresh the SLO gauges into a registry (collector body)."""
+        snap = self.snapshot()
+        reg.set("svdj_slo_error_budget_burn", snap["error_budget_burn"],
+                help="rolling error-budget burn rate (1.0 = at budget)")
+        for b, c in snap["buckets"].items():
+            for q in ("p50", "p99"):
+                v = c[f"latency_{q}_s"]
+                if v is not None:
+                    reg.set("svdj_slo_latency_seconds", v, bucket=b,
+                            quantile=q,
+                            help="per-bucket end-to-end latency quantile")
+            reg.set("svdj_slo_deadline_miss_total",
+                    c["deadline_miss"], bucket=b,
+                    help="requests finalized DEADLINE per bucket")
+            reg.set("svdj_slo_shed_total", c["shed"], bucket=b,
+                    help="requests shed at admission per bucket")
+
+
+# -- offline reconstruction from manifest records ---------------------------
+
+
+def slo_from_records(records: List[dict], *, objective: float = 0.99
+                     ) -> dict:
+    """SLO snapshot reconstructed from "serve" manifest records alone —
+    the same shape `SLOTracker.snapshot` reports live, so
+    `scripts/telemetry_summary.py --slo` works on any host."""
+    slo = SLOTracker(objective=objective, window=2 ** 31 - 1,
+                     reservoir=2 ** 20)
+    # Load-class rejections burn the error budget; client errors
+    # (NO_BUCKET, NONFINITE_INPUT) and shutdown do not — mirrors the
+    # live SLOTracker feed in serve.service exactly, so a live
+    # healthz()["slo"] and this reconstruction agree on the same
+    # traffic. (Bucket attribution of sheds differs by design: rejected
+    # serve records carry bucket=None, so offline sheds land under
+    # "_rejected".)
+    _SHED_STATUSES = ("REJECTED_BROWNOUT_SHED", "REJECTED_QUEUE_FULL",
+                      "REJECTED_DEADLINE_BUDGET", "REJECTED_NO_LANE")
+    for rec in records:
+        if rec.get("kind") != "serve":
+            continue
+        status = str(rec.get("status", ""))
+        bucket = rec.get("bucket") or "_rejected"
+        if status.startswith("REJECTED_"):
+            if status in _SHED_STATUSES:
+                slo.shed(bucket)
+            continue
+        wait = rec.get("queue_wait_s") or 0.0
+        solve = rec.get("solve_time_s") or 0.0
+        slo.observe(bucket, float(wait) + float(solve),
+                    ok=(status == "OK"),
+                    deadline_miss=(status == "DEADLINE"),
+                    error=(status == "ERROR"))
+    return slo.snapshot()
+
+
+def render_slo(snap: dict) -> str:
+    """Human rendering of an SLO snapshot (live or reconstructed)."""
+    lines = [
+        f"SLO objective {snap['objective']:.3%}  "
+        f"error-budget burn {snap['error_budget_burn']:.2f}x  "
+        f"(window {snap['window']})",
+    ]
+    fmt_ms = lambda v: "n/a" if v is None else f"{v * 1e3:8.1f}ms"
+    for b, c in sorted(snap["buckets"].items()):
+        lines.append(
+            f"  {b:<20} served={c['served']:>5} ok={c['ok']:>5} "
+            f"miss={c['deadline_miss']:>4} shed={c['shed']:>4} "
+            f"err={c['error']:>3}  p50={fmt_ms(c['latency_p50_s'])} "
+            f"p99={fmt_ms(c['latency_p99_s'])}")
+    return "\n".join(lines)
+
+
+def registry_from_manifest(records: List[dict]) -> MetricsRegistry:
+    """Rebuild the flight recorder's counter/histogram series from the
+    JSONL manifest records that already exist (serve / fleet / cache /
+    coldstart) — the ROADMAP's "Prometheus-style metrics export rendered
+    from the manifest records" item, usable with zero live service (and
+    zero jax): `python -m svd_jacobi_tpu.cli metrics reports/manifest.jsonl`.
+    Gauges that only exist live (queue depth, breaker state) are not
+    reconstructable and are simply absent."""
+    reg = MetricsRegistry()
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "serve":
+            status = str(rec.get("status", "?"))
+            bucket = rec.get("bucket") or "none"
+            if status.startswith("REJECTED_"):
+                reg.inc("svdj_requests_rejected_total",
+                        reason=status[len("REJECTED_"):].lower(),
+                        help="requests rejected at admission")
+                continue
+            reg.inc("svdj_requests_finalized_total", status=status,
+                    path=str(rec.get("path", "?")),
+                    phase=str(rec.get("phase", "full")),
+                    help="requests reaching a terminal status")
+            if rec.get("queue_wait_s") is not None:
+                reg.observe("svdj_queue_wait_seconds",
+                            float(rec["queue_wait_s"]), bucket=bucket,
+                            help="admission-to-dispatch queue wait")
+            if rec.get("solve_time_s") is not None:
+                reg.observe("svdj_solve_seconds",
+                            float(rec["solve_time_s"]), bucket=bucket,
+                            help="dispatch-to-finish solve time")
+            if rec.get("sweeps") is not None:
+                reg.inc("svdj_sweeps_total", float(rec["sweeps"]),
+                        bucket=bucket,
+                        help="solver sweeps executed")
+        elif kind == "fleet":
+            event = str(rec.get("event", "?"))
+            lane = rec.get("lane")
+            if event == "lane_transition":
+                reg.inc("svdj_lane_transitions_total",
+                        lane="" if lane is None else str(lane),
+                        to_state=str(rec.get("to_state", "?")),
+                        help="lane state transitions")
+            elif event == "steal":
+                reg.inc("svdj_steals_total",
+                        lane="" if lane is None else str(lane),
+                        help="requests stolen by an idle lane")
+            elif event == "rescue":
+                reg.inc("svdj_rescued_total",
+                        float(rec.get("count", 0) or 0),
+                        lane="" if lane is None else str(lane),
+                        help="requests rescued off an evicted lane")
+            elif event == "probe":
+                reg.inc("svdj_probes_total",
+                        ok=str(bool(rec.get("ok"))).lower(),
+                        lane="" if lane is None else str(lane),
+                        help="quarantined-lane recovery probes")
+        elif kind == "cache":
+            reg.inc("svdj_cache_events_total",
+                    store=str(rec.get("store", "?")),
+                    event=str(rec.get("event", "?")),
+                    help="result-cache / promotion-store events")
+        elif kind == "coldstart":
+            reg.inc("svdj_aot_backend_compiles_total",
+                    float(rec.get("backend_compiles", 0) or 0),
+                    help="AOT warmup backend compile requests")
+            reg.inc("svdj_aot_cache_hits_total",
+                    float(rec.get("cache_hits", 0) or 0),
+                    help="AOT warmup persistent-cache hits")
+            reg.inc("svdj_aot_fresh_compiles_total",
+                    float(rec.get("fresh_compiles", 0) or 0),
+                    help="AOT warmup compiles the cache did not serve")
+    return reg
+
+
+# Minimal structural validator of Prometheus text exposition — used by
+# tests and the chaos-soak acceptance ("the scrape parses as valid
+# Prometheus text"); intentionally strict about line shape, not about
+# semantics.
+import re as _re
+
+_SERIES_RE = _re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[+-]Inf|NaN)$')
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Parse (and validate) a text exposition; raises ValueError on the
+    first malformed line. Returns {series-with-labels: value}."""
+    out: Dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: not valid Prometheus text "
+                             f"exposition: {line!r}")
+        name_labels, _, value = line.rpartition(" ")
+        out[name_labels] = float(value.replace("Inf", "inf"))
+    return out

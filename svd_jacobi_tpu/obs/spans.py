@@ -1,0 +1,255 @@
+"""Per-request trace timelines — one causal story per served request.
+
+The manifest stream records WHAT happened (one "serve" record per
+terminal, "cache"/"fleet" events around it); this module records WHEN,
+as one ordered timeline per request covering every lifecycle edge the
+serve layer crosses:
+
+    admit -> queued -> dispatch -> sweep* -> finish -> finalize
+    (sigma flow additionally: retain -> promote)
+
+Two reconstruction paths, both yielding the same event vocabulary so
+tests can assert they agree:
+
+  * **live** — `SpanRecorder`: the service emits point events as they
+    happen (wall + monotonic clocks); `timeline(request_id)` returns
+    them ordered, `phases(request_id)` pairs them into named durations
+    (queued = admit..dispatch, solve = dispatch..finish), `render` makes
+    a human timeline. Bounded: the recorder keeps the last
+    ``max_requests`` request timelines (LRU) so a long-lived service
+    cannot grow without bound.
+  * **offline** — `timeline_from_manifest(records, request_id)`: the
+    same ordered timeline rebuilt from the JSONL manifest records that
+    already exist (the serve record's finalize timestamp anchored back
+    through queue_wait_s / solve_time_s, plus the request's cache
+    events), so a request's life reconstructs on any host, long after
+    the process died.
+
+`XprofWindow` is the `jax.profiler` trace-session hook: the service arms
+one per request id so the dispatch..finish window of EXACTLY that
+request runs under an XLA profiler trace — a targeted XProf capture
+instead of tracing a whole serving session. Start/stop degrade to
+warnings (profiler unavailable, trace already active, lane quarantined
+mid-arm), never exceptions: observe-only code must not kill the solve it
+observes.
+
+Stdlib-only at import; jax is imported lazily inside `XprofWindow`.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional
+
+# The canonical lifecycle vocabulary, in causal order — the tie-break
+# rank for offline reconstruction, where several events can share one
+# reconstructed timestamp. "sweep" repeats; "retain"/"promote" only
+# appear on the sigma flow; "cache_hit" replaces the dispatch chain on a
+# result-cache hit (and so must rank between admit and finalize).
+EVENT_ORDER = ("admit", "queued", "cache_hit", "dispatch", "sweep",
+               "finish", "retain", "finalize", "promote")
+
+
+class SpanRecorder:
+    """Bounded per-request event timeline store (see module docstring)."""
+
+    def __init__(self, max_requests: int = 256, max_events: int = 4096):
+        self._lock = threading.Lock()
+        self._events: "collections.OrderedDict[str, List[dict]]" = \
+            collections.OrderedDict()
+        self.max_requests = int(max_requests)
+        self.max_events = int(max_events)   # per request (sweep storms)
+
+    def event(self, request_id: str, name: str, **meta) -> None:
+        """Record one point event for a request (both clocks stamped:
+        wall for cross-process correlation with manifest timestamps,
+        monotonic for intra-process durations)."""
+        ev = {"name": str(name), "t_wall": time.time(),
+              "t_mono": time.monotonic()}
+        if meta:
+            ev.update(meta)
+        with self._lock:
+            lst = self._events.get(request_id)
+            if lst is None:
+                lst = self._events[request_id] = []
+                while len(self._events) > self.max_requests:
+                    self._events.popitem(last=False)
+            if len(lst) < self.max_events:
+                lst.append(ev)
+
+    def request_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._events)
+
+    def timeline(self, request_id: str) -> List[dict]:
+        """The request's events, ordered by monotonic time (stable for
+        equal stamps — insertion order breaks ties, which is already
+        causal order at the emission sites)."""
+        with self._lock:
+            events = list(self._events.get(request_id, ()))
+        return sorted(events, key=lambda e: e["t_mono"])
+
+    def phases(self, request_id: str) -> List[dict]:
+        """Named durations derived from the point events:
+        ``queued`` (admit -> dispatch), ``solve`` (dispatch -> finish),
+        ``finalize`` (finish -> finalize), ``promote`` (promote span is
+        a point; duration 0 unless meta carries one)."""
+        tl = self.timeline(request_id)
+        at = {}
+        for ev in tl:
+            at.setdefault(ev["name"], ev["t_mono"])
+        out = []
+        for name, start, end in (("queued", "admit", "dispatch"),
+                                 ("solve", "dispatch", "finish"),
+                                 ("finalize", "finish", "finalize")):
+            if start in at and end in at:
+                out.append({"phase": name,
+                            "start_mono": at[start], "end_mono": at[end],
+                            "duration_s": at[end] - at[start]})
+        return out
+
+    def render(self, request_id: str) -> str:
+        """Human timeline: offsets from the first event, one line per
+        event, sweeps collapsed into one counted line."""
+        tl = self.timeline(request_id)
+        if not tl:
+            return f"{request_id}: no recorded events"
+        t0 = tl[0]["t_mono"]
+        lines = [f"request {request_id} timeline "
+                 f"({len(tl)} event(s)):"]
+        sweeps = [e for e in tl if e["name"] == "sweep"]
+        for ev in tl:
+            if ev["name"] == "sweep":
+                continue
+            extra = " ".join(f"{k}={v}" for k, v in ev.items()
+                             if k not in ("name", "t_wall", "t_mono"))
+            lines.append(f"  +{(ev['t_mono'] - t0) * 1e3:9.2f}ms "
+                         f"{ev['name']:<10}{(' ' + extra) if extra else ''}")
+            if ev["name"] == "dispatch" and sweeps:
+                span = sweeps[-1]["t_mono"] - sweeps[0]["t_mono"]
+                lines.append(f"  +{(sweeps[0]['t_mono'] - t0) * 1e3:9.2f}ms "
+                             f"sweep      x{len(sweeps)} "
+                             f"over {span * 1e3:.2f}ms")
+        return "\n".join(lines)
+
+
+def _parse_ts(ts: str) -> Optional[float]:
+    """ISO-8601 manifest timestamp -> epoch seconds (None if unparseable)."""
+    try:
+        return datetime.datetime.fromisoformat(ts).timestamp()
+    except (TypeError, ValueError):
+        return None
+
+
+def timeline_from_manifest(records: List[dict], request_id: str
+                           ) -> List[dict]:
+    """Rebuild a request's ordered timeline OFFLINE, from manifest
+    records alone (see module docstring). Event names match the live
+    recorder's vocabulary; wall timestamps are reconstructed from each
+    serve record's finalize timestamp anchored back through its
+    queue_wait_s / solve_time_s, so the order (and the durations the
+    record carries) survive even though the intermediate stamps were
+    never persisted. A "promote" serve record (phase="promote",
+    promoted_from=<rid>) attaches to the SIGMA request's timeline, so a
+    sigma-then-promote pair reads as one causal story."""
+    events: List[dict] = []
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "serve":
+            rid = (rec.get("request") or {}).get("id")
+            promoted_from = rec.get("promoted_from")
+            if rid != request_id and promoted_from != request_id:
+                continue
+            t_end = _parse_ts(rec.get("timestamp", "")) or 0.0
+            wait = float(rec.get("queue_wait_s") or 0.0)
+            solve = rec.get("solve_time_s")
+            status = str(rec.get("status", "?"))
+            if rec.get("phase") == "promote":
+                events.append({"name": "promote", "t_wall": t_end,
+                               "status": status, "request_id": rid,
+                               "promoted_from": promoted_from})
+                continue
+            if status.startswith("REJECTED_"):
+                events.append({"name": "admit", "t_wall": t_end,
+                               "status": status, "rejected": True})
+                continue
+            if rec.get("path") == "cache":
+                events.append({"name": "admit", "t_wall": t_end})
+                events.append({"name": "cache_hit", "t_wall": t_end})
+                events.append({"name": "finalize", "t_wall": t_end,
+                               "status": status})
+                continue
+            t_dispatch = t_end - (float(solve) if solve is not None else 0.0)
+            t_admit = t_dispatch - wait
+            events.append({"name": "admit", "t_wall": t_admit})
+            events.append({"name": "queued", "t_wall": t_admit,
+                           "wait_s": wait})
+            if solve is not None:
+                events.append({"name": "dispatch", "t_wall": t_dispatch,
+                               "lane": rec.get("lane"),
+                               "path": rec.get("path")})
+                if rec.get("sweeps"):
+                    events.append({"name": "sweep", "t_wall": t_dispatch,
+                                   "count": int(rec["sweeps"])})
+                events.append({"name": "finish", "t_wall": t_end,
+                               "status": status})
+            events.append({"name": "finalize", "t_wall": t_end,
+                           "status": status,
+                           "phase": rec.get("phase", "full")})
+        elif kind == "cache" and rec.get("request_id") == request_id:
+            t = _parse_ts(rec.get("timestamp", "")) or 0.0
+            ev = rec.get("event")
+            # "hit" and "promote" are NOT re-emitted here: the serve
+            # records (path="cache", phase="promote") already
+            # reconstruct both with richer context, and the cache
+            # record's slightly-earlier timestamp would sort a
+            # duplicate ahead of them.
+            if ev == "retain":
+                events.append({"name": ev, "t_wall": t,
+                               "store": rec.get("store")})
+    # Stable order: wall time, then causal vocabulary rank (events that
+    # reconstruct to the same instant — finish/finalize — keep their
+    # lifecycle order).
+    rank = {n: i for i, n in enumerate(EVENT_ORDER)}
+    return sorted(events, key=lambda e: (e["t_wall"],
+                                         rank.get(e["name"], 99)))
+
+
+class XprofWindow:
+    """One armed `jax.profiler` trace session around a single request's
+    dispatch..finish window (see module docstring). All failure modes
+    degrade to `RuntimeWarning`s."""
+
+    def __init__(self, log_dir):
+        from pathlib import Path
+        self.log_dir = Path(log_dir)
+        self.started = False
+
+    def start(self) -> bool:
+        try:
+            import jax
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(self.log_dir))
+            self.started = True
+        except Exception as e:
+            warnings.warn(
+                f"obs.spans.XprofWindow: profiler unavailable, request "
+                f"runs untraced ({type(e).__name__}: {e})", RuntimeWarning,
+                stacklevel=2)
+        return self.started
+
+    def stop(self) -> None:
+        if not self.started:
+            return
+        self.started = False
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            warnings.warn(f"obs.spans.XprofWindow: stop_trace failed "
+                          f"({type(e).__name__}: {e})", RuntimeWarning,
+                          stacklevel=2)

@@ -128,6 +128,14 @@ class Journal:
         import threading
         self.path = Path(path)
         self._seq = itertools.count()
+        # fsync-latency accounting (the durability tax, live): every
+        # append is one fsync'd write; the flight recorder's
+        # `svdj_journal_fsync_seconds` histogram reads `last_append_s`
+        # right after each call and the scrape-time collector reads the
+        # cumulative pair. Plain floats/ints under the journal lock.
+        self.appends = 0
+        self.append_total_s = 0.0
+        self.last_append_s: Optional[float] = None
         # Re-entrant so `exclusive()` callers can still append inside
         # the critical section; appends and the recovery rewrite all
         # take it, making scan-then-rewrite atomic against concurrent
@@ -142,17 +150,37 @@ class Journal:
         (`SVDService.recover`'s scan + compaction)."""
         return self._lock
 
+    def io_stats(self) -> dict:
+        """Cumulative append/fsync accounting (scrape-time view)."""
+        with self._lock:
+            return {"appends": self.appends,
+                    "append_total_s": self.append_total_s,
+                    "last_append_s": self.last_append_s}
+
+    def _timed_append(self, rec: dict) -> float:
+        t0 = time.perf_counter()
+        append_jsonl(self.path, rec)
+        dt = time.perf_counter() - t0
+        self.appends += 1
+        self.append_total_s += dt
+        self.last_append_s = dt
+        # Returned (not just stored): the caller's histogram sample must
+        # be THIS append's latency — re-reading last_append_s after the
+        # lock is released could observe a concurrent append's value.
+        return dt
+
     # -- writers ------------------------------------------------------------
 
     def append_admit(self, req, *, attempt: int = 1,
                      admitted_wall: Optional[float] = None,
-                     payload_mode: str = "full") -> None:
+                     payload_mode: str = "full") -> float:
         """Journal one admitted request — called BEFORE the queue admit
         (write-ahead). ``admitted_wall`` preserves the ORIGINAL admit
         time across recovery rewrites so deadline budgets keep decaying
         from the client's real submit, not from each restart.
         ``payload_mode`` selects the input encoding (`_encode_array`):
-        "full" bytes or "digest" fingerprint-only."""
+        "full" bytes or "digest" fingerprint-only. Returns this append's
+        fsync latency in seconds (all three writers do)."""
         rec = {
             "journal_version": JOURNAL_VERSION,
             "kind": "admit",
@@ -176,20 +204,20 @@ class Journal:
             "input": _encode_array(req.a, payload_mode),
         }
         with self._lock:
-            append_jsonl(self.path, rec)
+            return self._timed_append(rec)
 
     def append_dispatch(self, request_id: str, *, lane: int,
-                        batch_id: Optional[str] = None) -> None:
+                        batch_id: Optional[str] = None) -> float:
         with self._lock:
-            append_jsonl(self.path, {
+            return self._timed_append({
                 "journal_version": JOURNAL_VERSION, "kind": "dispatch",
                 "seq": next(self._seq), "id": str(request_id),
                 "t_wall": time.time(), "lane": int(lane),
                 "batch_id": batch_id})
 
-    def append_finalize(self, request_id: str, status: str) -> None:
+    def append_finalize(self, request_id: str, status: str) -> float:
         with self._lock:
-            append_jsonl(self.path, {
+            return self._timed_append({
                 "journal_version": JOURNAL_VERSION, "kind": "finalize",
                 "seq": next(self._seq), "id": str(request_id),
                 "t_wall": time.time(), "status": str(status)})

@@ -299,6 +299,21 @@ class ServeConfig:
     # dispatch and no queue slot. 0 disables (no digesting — the exact
     # pre-cache submit path).
     result_cache_bytes: int = 0
+    # --- serving flight recorder (obs.registry / obs.spans) --------------
+    # Live metrics registry + per-request span timelines + SLO
+    # accounting. OFF by default and FREE when off: no registry object
+    # exists, every instrumentation site is behind one None check, and
+    # the OBS002 analysis pass proves zero registry mutations on the
+    # metrics-off hot path (plus metrics-off HLO byte-identity — the
+    # recorder is host-side only and never enters a trace).
+    metrics: bool = False
+    # Start a stdlib HTTP listener serving GET /metrics (Prometheus text
+    # exposition) and /healthz (JSON) on this port at `start()`; 0 binds
+    # an ephemeral port (see `SVDService.http_address`). None disables.
+    metrics_port: Optional[int] = None
+    # SLO availability objective: the error-budget burn gauge reads
+    # miss_rate / (1 - objective) over the rolling outcome window.
+    slo_objective: float = 0.99
 
 
 class SVDService:
@@ -386,6 +401,25 @@ class SVDService:
         # request_id -> Ticket of journal-recovered requests (`recover`).
         self.recovered: dict = {}
         self._last_reload_error: Optional[str] = None
+        # Serving flight recorder (obs.registry / obs.spans): live
+        # metrics + SLO accounting + per-request span timelines. None
+        # when off — the instrumentation sites all guard on that one
+        # attribute, so the off path constructs nothing and mutates
+        # nothing (the OBS002 contract).
+        self.metrics = None
+        self.slo = None
+        self.spans = None
+        if config.metrics:
+            from ..obs.registry import MetricsRegistry, SLOTracker
+            from ..obs.spans import SpanRecorder
+            self.metrics = MetricsRegistry()
+            self.slo = SLOTracker(objective=config.slo_objective)
+            self.spans = SpanRecorder()
+            self.metrics.add_collector(self._collect_metrics)
+        # Armed one-request XProf windows (`capture_request_trace`).
+        self._trace_arms: dict = {}
+        self._http = None
+        self._http_addr: Optional[Tuple[str, int]] = None
 
     @staticmethod
     def _resolve_bucket_maps(config: ServeConfig):
@@ -448,6 +482,8 @@ class SVDService:
             self._accepting = True
             self._drain = True
             self.fleet.start()
+        if self.config.metrics_port is not None and self._http is None:
+            self.start_http(port=self.config.metrics_port)
         return self
 
     def _spawn_worker(self, lane: Lane) -> None:
@@ -502,6 +538,7 @@ class SVDService:
         # is finalized, never stranded.
         if all(not t.is_alive() for t in threads):
             self._cancel_queued()
+        self.stop_http()
 
     def _cancel_queued(self) -> None:
         for lane in self.fleet.lanes:
@@ -561,6 +598,15 @@ class SVDService:
             t0 = time.perf_counter()
             self._exec_warm(sigma_only=sigma_only, timeout=timeout)
             exec_s = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.inc("svdj_aot_backend_compiles_total",
+                             cc.backend_compiles,
+                             help="AOT warmup backend compile requests")
+            self.metrics.inc("svdj_aot_cache_hits_total", cc.cache_hits,
+                             help="AOT warmup persistent-cache hits")
+            self.metrics.inc("svdj_aot_fresh_compiles_total", cc.fresh,
+                             help="AOT warmup compiles the cache "
+                                  "did not serve")
         if aot:
             from .. import obs
             self._store(obs.manifest.build_coldstart(
@@ -940,7 +986,7 @@ class SVDService:
             in_flight = next((r.id for l in self.fleet.lanes
                               for r in l.in_flight), None)
             stats = dict(self._stats)
-        return {
+        out = {
             "ok": alive,
             "ready": self.ready(),
             "breaker": self.breaker.state().value,
@@ -954,6 +1000,12 @@ class SVDService:
             "result_cache": self.result_cache.snapshot(),
             "promotions": self.promotions.snapshot(),
         }
+        if self.slo is not None:
+            # SLO accounting rides the liveness probe: per-bucket
+            # latency quantiles, deadline-miss/shed counts, and the
+            # rolling error-budget burn (flight recorder on only).
+            out["slo"] = self.slo.snapshot()
+        return out
 
     def records(self) -> list:
         """The in-memory per-request "serve" records (newest last)."""
@@ -963,6 +1015,183 @@ class SVDService:
     def stats(self) -> dict:
         with self._lock:
             return dict(self._stats)
+
+    # -- serving flight recorder (obs.registry / obs.spans) -----------------
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the live registry (collectors
+        refreshed), or a one-comment body when the recorder is off —
+        a scrape of a metrics-off service is explicit, not a 404."""
+        if self.metrics is None:
+            return "# svdj metrics disabled (ServeConfig.metrics=False)\n"
+        return self.metrics.render()
+
+    def _collect_metrics(self, reg) -> None:
+        """Scrape-time collector: every DERIVED gauge — queue depth and
+        deadline budget per lane, lane/breaker state, brownout level,
+        cache sizes, journal fsync accounting, SLO quantiles/burn — is
+        sampled when someone scrapes, so live-state changes cost the hot
+        path nothing. Deliberately avoids the service lock (each source
+        has its own); a scrape can never deadlock a finalize."""
+        from .fleet import LaneState as _LS
+        _BREAKER_CODE = {BreakerState.CLOSED: 0, BreakerState.HALF_OPEN: 1,
+                         BreakerState.OPEN: 2}
+        for lane in self.fleet.lanes:
+            li = str(lane.index)
+            reg.set("svdj_queue_depth", lane.queue.depth(), lane=li,
+                    help="queued requests per lane")
+            budget = lane.queue.deadline_budget()
+            if budget != float("inf"):
+                reg.set("svdj_deadline_budget_seconds", budget, lane=li,
+                        help="aggregate remaining deadline budget queued")
+            reg.set("svdj_lane_state",
+                    1.0 if lane.state is _LS.ACTIVE else 0.0, lane=li,
+                    help="1 = ACTIVE, 0 = QUARANTINED")
+            reg.set("svdj_breaker_state",
+                    float(_BREAKER_CODE[lane.breaker.state()]), lane=li,
+                    help="0 = closed, 1 = half-open, 2 = open")
+        reg.set("svdj_brownout_level", float(self._brownout().value),
+                help="0 = FULL, 1 = SIGMA_ONLY, 2 = SHED")
+        for name, snap in (("result_cache", self.result_cache.snapshot()),
+                           ("promotion_store", self.promotions.snapshot())):
+            for key in ("entries", "bytes", "hits", "misses", "stores",
+                        "evictions", "promotes", "retains"):
+                if key in snap:
+                    reg.set(f"svdj_{name}_{key}", float(snap[key]),
+                            help=f"{name.replace('_', ' ')} {key}")
+        if self.journal is not None:
+            io = self.journal.io_stats()
+            reg.set("svdj_journal_appends_total", float(io["appends"]),
+                    help="journal lifecycle appends (each one fsync)")
+            reg.set("svdj_journal_append_seconds_total",
+                    float(io["append_total_s"]),
+                    help="cumulative journal append+fsync time")
+        if self.slo is not None:
+            self.slo.export_to(reg)
+
+    # The span-event emitter every lifecycle site funnels through: one
+    # attribute check on the off path, nothing else.
+    def _span(self, request_id: str, name: str, **meta) -> None:
+        if self.spans is not None:
+            self.spans.event(request_id, name, **meta)
+
+    def _observe_journal_append(self, dt: Optional[float]) -> None:
+        """Feed ONE journal append's fsync latency into the histogram.
+        The duration is the append call's own return value, not a
+        re-read of the journal's shared last-append field — a concurrent
+        append from another thread could have overwritten that between
+        the write and the read."""
+        if self.metrics is not None and dt is not None:
+            self.metrics.observe("svdj_journal_fsync_seconds", dt,
+                                 help="per-append journal fsync latency")
+
+    def timeline(self, request_id: str) -> list:
+        """The request's LIVE span timeline (empty when the recorder is
+        off or the request aged out of the bounded store). The offline
+        equivalent is `obs.spans.timeline_from_manifest(records, id)`."""
+        if self.spans is None:
+            return []
+        return self.spans.timeline(request_id)
+
+    def capture_request_trace(self, request_id: str, log_dir) -> None:
+        """Arm a one-request XProf window: when ``request_id`` is next
+        dispatched, its dispatch..finish window runs under a
+        `jax.profiler` trace into ``log_dir`` — a targeted capture of
+        exactly one request instead of a whole serving session. Arming
+        is best-effort by design: a request dispatched on a QUARANTINED
+        lane (a recovery probe, or an eviction racing the dispatch)
+        skips the capture with a warning instead of raising
+        mid-supervisor-tick, and profiler failures degrade to warnings
+        (`obs.spans.XprofWindow`)."""
+        from ..obs.spans import XprofWindow
+        with self._lock:
+            self._trace_arms[str(request_id)] = XprofWindow(log_dir)
+
+    def _trace_window_for(self, req: Request, lane: Lane):
+        """Pop the armed XProf window for this dispatch (None when not
+        armed). A quarantined dispatching lane — a probe solve, or an
+        eviction that raced the pop — skips the capture LOUDLY-but-
+        gently: profiling is observe-only and must never add an
+        exception to a supervisor tick that is already handling a sick
+        lane."""
+        if not self._trace_arms:      # benign unlocked fast path
+            return None
+        with self._lock:
+            win = self._trace_arms.pop(req.id, None)
+        if win is None:
+            return None
+        if lane.state is not LaneState.ACTIVE:
+            import warnings
+            warnings.warn(
+                f"capture_request_trace({req.id!r}): lane {lane.index} is "
+                f"{lane.state.value}; skipping the XProf capture (the "
+                f"request still serves)", RuntimeWarning, stacklevel=3)
+            return None
+        return win
+
+    # -- /metrics + /healthz HTTP listener (stdlib) -------------------------
+
+    @property
+    def http_address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) of the live metrics listener, or None."""
+        return self._http_addr
+
+    def start_http(self, host: str = "127.0.0.1", port: int = 0
+                   ) -> Tuple[str, int]:
+        """Start the stdlib HTTP listener: GET /metrics returns the
+        Prometheus exposition (content type version=0.0.4), GET /healthz
+        the `healthz()` JSON (inf/nan sanitized to strings). One daemon
+        thread; idempotent; `stop()` shuts it down."""
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        if self._http is not None:
+            return self._http_addr
+        svc = self
+
+        def _json_safe(obj):
+            if isinstance(obj, dict):
+                return {str(k): _json_safe(v) for k, v in obj.items()}
+            if isinstance(obj, (list, tuple)):
+                return [_json_safe(v) for v in obj]
+            if isinstance(obj, float) and (obj != obj or obj in (
+                    float("inf"), float("-inf"))):
+                return str(obj)
+            return obj
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = svc.metrics_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    body = _json.dumps(
+                        _json_safe(svc.healthz())).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes must not spam stderr
+                pass
+
+        self._http = ThreadingHTTPServer((host, int(port)), Handler)
+        self._http_addr = (self._http.server_address[0],
+                           self._http.server_address[1])
+        threading.Thread(target=self._http.serve_forever,
+                         name="svdj-serve-http", daemon=True).start()
+        return self._http_addr
+
+    def stop_http(self) -> None:
+        http, self._http, self._http_addr = self._http, None, None
+        if http is not None:
+            http.shutdown()
+            http.server_close()
 
     # -- admission ----------------------------------------------------------
 
@@ -1062,6 +1291,7 @@ class SVDService:
             deadline_s = None
         brown = self._brownout()
         journaled = False
+        bucket_name: Optional[str] = None   # set once routing succeeds
         try:
             if not self.ready():
                 raise AdmissionError(AdmissionReason.SHUTDOWN,
@@ -1086,6 +1316,7 @@ class SVDService:
                     AdmissionReason.NO_BUCKET,
                     f"{what} fits no declared bucket "
                     f"{[b.name for b in self.buckets]}")
+            bucket_name = bucket.name
             finite = (host_finite if host_finite is not None
                       else bool(jnp.isfinite(a).all()))
             if not finite:
@@ -1148,10 +1379,17 @@ class SVDService:
                 # — a durability promise that cannot be recorded must
                 # not be made). A post-journal queue rejection appends a
                 # finalize record below so replay never resurrects it.
-                self.journal.append_admit(
+                dt_journal = self.journal.append_admit(
                     req, payload_mode=self.config.journal_payload)
                 journaled = True
+                self._observe_journal_append(dt_journal)
             lane.queue.admit(req)
+            if self.metrics is not None:
+                self.metrics.inc("svdj_requests_admitted_total",
+                                 bucket=bucket.name, phase=phase,
+                                 help="requests admitted to a lane queue")
+                self._span(rid, "admit", bucket=bucket.name, phase=phase)
+                self._span(rid, "queued", lane=lane.index)
             if lane.state is not LaneState.ACTIVE:
                 # Admission raced an eviction: evict() flips the state
                 # BEFORE draining, so either its rescue drain saw this
@@ -1166,6 +1404,20 @@ class SVDService:
             if journaled:
                 self._journal_finalize(rid, f"REJECTED_{e.reason.name}")
             self._bump("rejected", f"rejected:{e.reason.value}")
+            if self.metrics is not None:
+                self.metrics.inc("svdj_requests_rejected_total",
+                                 reason=e.reason.value,
+                                 help="requests rejected at admission")
+                self._span(rid, "admit", rejected=True,
+                           reason=e.reason.value)
+                if e.reason in (AdmissionReason.BROWNOUT_SHED,
+                                AdmissionReason.QUEUE_FULL,
+                                AdmissionReason.DEADLINE_BUDGET,
+                                AdmissionReason.NO_LANE):
+                    # Load-class rejections burn the error budget; a
+                    # client error (NO_BUCKET, NONFINITE_INPUT) does not.
+                    self.slo.shed(None if bucket_name is None
+                                  else bucket_name)
             self._record(request_id=rid, orig_shape=orig_shape, dtype=dtype,
                          bucket=None, queue_wait_s=0.0, solve_time_s=None,
                          status=f"REJECTED_{e.reason.name}", path="rejected",
@@ -1274,6 +1526,14 @@ class SVDService:
         self._record_cache("result", "hit", request_id=rid, digest=digest)
         self._bump("submitted", "served", "cache_hits", "status:OK",
                    "path:cache")
+        if self.metrics is not None:
+            self._span(rid, "admit", bucket=bucket.name)
+            self._span(rid, "cache_hit", digest=digest[:12])
+            self._span(rid, "finalize", status="OK", path="cache")
+            self.metrics.inc("svdj_requests_finalized_total", status="OK",
+                             path="cache", phase="full",
+                             help="requests reaching a terminal status")
+            self.slo.observe(bucket.name, 0.0, ok=True)
         self._record(request_id=rid, orig_shape=orig_shape,
                      dtype=bucket.dtype, bucket=bucket.name,
                      queue_wait_s=0.0, solve_time_s=0.0, status="OK",
@@ -1489,6 +1749,17 @@ class SVDService:
             # sigma-first, capturing the checkpointed stage here.
             cap = ({} if (req.phase == "sigma" and not req.degraded)
                    else None)
+            if self.metrics is not None:
+                self.metrics.inc("svdj_dispatches_total", lane=lane.index,
+                                 path=path, help="solver dispatches")
+                self.metrics.observe(
+                    "svdj_queue_wait_seconds", queue_wait,
+                    bucket=req.bucket.name,
+                    help="admission-to-dispatch queue wait")
+                self._span(req.id, "dispatch", lane=lane.index, path=path)
+            win = self._trace_window_for(req, lane)
+            if win is not None:
+                win.start()
             t0 = time.monotonic()
             error = None
             r = None
@@ -1502,6 +1773,9 @@ class SVDService:
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
                 status = None
+            finally:
+                if win is not None:
+                    win.stop()
             solve_time = time.monotonic() - t0
             if status is SolveStatus.CANCELLED:
                 # Client-initiated: neither a success nor a backend failure.
@@ -1599,6 +1873,19 @@ class SVDService:
         with self._lock:
             lane.in_flight = list(live)
         self._journal_dispatch(live, lane, batch_id=batch_id)
+        if self.metrics is not None:
+            self.metrics.inc("svdj_dispatches_total", lane=lane.index,
+                             path="base", help="solver dispatches")
+            self.metrics.inc("svdj_batched_dispatches_total", tier=tier,
+                             help="coalesced batched dispatches")
+            t_d = time.monotonic()
+            for rq in live:
+                self.metrics.observe(
+                    "svdj_queue_wait_seconds", t_d - rq.submitted,
+                    bucket=rq.bucket.name,
+                    help="admission-to-dispatch queue wait")
+                self._span(rq.id, "dispatch", lane=lane.index,
+                           path="base", batch_id=batch_id)
         from ..resilience import chaos
         chaos.maybe_sigkill()   # after journaling, like _serve_one
         try:
@@ -1758,6 +2045,13 @@ class SVDService:
             state = self._place(st.init(), lane)
             while st.should_continue(state):
                 lane.beat()
+                if self.metrics is not None:
+                    # One tick per BATCHED sweep (all members advance
+                    # together); per-member attribution stays with the
+                    # serve records.
+                    self.metrics.inc("svdj_sweeps_total",
+                                     bucket=bucket.name,
+                                     help="solver sweeps executed")
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
@@ -1957,6 +2251,16 @@ class SVDService:
             state = self._place(st.init(), lane)
             while st.should_continue(state):
                 lane.beat()
+                if self.metrics is not None:
+                    # Per-sweep progress off the existing host-stepped
+                    # hook: a counter tick + a span point, NO device
+                    # readback (syncing state here would serialize the
+                    # sweep pipeline on the host link).
+                    self.metrics.inc("svdj_sweeps_total",
+                                     bucket=req.bucket.name,
+                                     help="solver sweeps executed")
+                    self._span(req.id, "sweep",
+                               stage=st.phase_info(state).stage)
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
@@ -2166,6 +2470,14 @@ class SVDService:
                               u=u, s=s, v=v, status=int(status),
                               sweeps=sweeps)
         self._bump("served", "promotions", f"status:{status.name}")
+        if self.metrics is not None:
+            self.metrics.inc("svdj_promotions_total", status=status.name,
+                             kind=ps.kind,
+                             help="sigma-phase promotions resumed")
+            self.metrics.observe("svdj_promote_seconds", solve_time,
+                                 bucket=ps.bucket.name,
+                                 help="promote (finish-resume) latency")
+            self._span(rid, "promote", kind=ps.kind, status=status.name)
         orig_shape = ((ps.n, ps.m) if ps.transposed else (ps.m, ps.n))
         self._record(request_id=pid, orig_shape=orig_shape,
                      dtype=ps.bucket.dtype, bucket=ps.bucket.name,
@@ -2247,6 +2559,29 @@ class SVDService:
         if not req.ticket._finalize_once(result):
             return False
         self._journal_finalize(req.id, status_name)
+        if self.metrics is not None:
+            self.metrics.inc("svdj_requests_finalized_total",
+                             status=status_name, path=path,
+                             phase=req.phase,
+                             help="requests reaching a terminal status")
+            if solve_time is not None:
+                self.metrics.observe("svdj_solve_seconds", solve_time,
+                                     bucket=req.bucket.name,
+                                     help="dispatch-to-finish solve time")
+                self._span(req.id, "finish", status=status_name)
+            latency = queue_wait + (solve_time or 0.0)
+            self.metrics.observe("svdj_request_latency_seconds", latency,
+                                 bucket=req.bucket.name,
+                                 help="end-to-end request latency")
+            if status_name == "DEADLINE":
+                self.metrics.inc("svdj_deadline_miss_total",
+                                 bucket=req.bucket.name,
+                                 help="requests finalized DEADLINE")
+            self._span(req.id, "finalize", status=status_name, path=path)
+            self.slo.observe(req.bucket.name, latency,
+                             ok=(status_name == "OK"),
+                             deadline_miss=(status_name == "DEADLINE"),
+                             error=(status_name == "ERROR"))
         self._bump("served", f"status:{status_name}",
                    *(["path:ladder"] if path == "ladder" else []),
                    *(["degraded"] if req.degraded else []),
@@ -2300,8 +2635,8 @@ class SVDService:
             return
         try:
             for r in reqs:
-                self.journal.append_dispatch(r.id, lane=lane.index,
-                                             batch_id=batch_id)
+                self._observe_journal_append(self.journal.append_dispatch(
+                    r.id, lane=lane.index, batch_id=batch_id))
         except Exception as e:
             self._bump("journal_errors")
             print(f"svdj-serve: journal dispatch append failed: {e}",
@@ -2315,7 +2650,8 @@ class SVDService:
         if self.journal is None:
             return
         try:
-            self.journal.append_finalize(request_id, status)
+            self._observe_journal_append(
+                self.journal.append_finalize(request_id, status))
         except Exception as e:
             self._bump("journal_errors")
             print(f"svdj-serve: journal finalize append failed: {e}",
@@ -2364,6 +2700,13 @@ class SVDService:
         store/hit/evict/invalidate, promotion retain/promote/release/
         evict/rescue) to the same stream as the "serve" records."""
         from .. import obs
+        if self.metrics is not None:
+            self.metrics.inc("svdj_cache_events_total", store=store,
+                             event=event,
+                             help="result-cache / promotion-store events")
+            if request_id is not None and event == "retain":
+                # "promote" gets its richer span from `_promote` itself.
+                self._span(request_id, "retain", store=store)
         self._store(obs.manifest.build_cache(
             store=store, event=event, request_id=request_id,
             digest=digest, nbytes=nbytes, **extra))
@@ -2372,8 +2715,32 @@ class SVDService:
                       **extra) -> None:
         """Append one schema-versioned "fleet" record (lane transitions,
         rescues, steals, probes, healthz snapshots) to the same stream
-        as the per-request "serve" records."""
+        as the per-request "serve" records. With the flight recorder on,
+        the same event feeds the live fleet counters — same series names
+        as `obs.registry.registry_from_manifest` derives offline, so a
+        live scrape and a manifest reconstruction are directly
+        comparable (the chaos-soak test asserts they agree)."""
         from .. import obs
+        if self.metrics is not None:
+            li = "" if lane is None else str(lane)
+            if event == "lane_transition":
+                self.metrics.inc("svdj_lane_transitions_total", lane=li,
+                                 to_state=str(extra.get("to_state", "?")),
+                                 help="lane state transitions")
+            elif event == "steal":
+                self.metrics.inc("svdj_steals_total", lane=li,
+                                 help="requests stolen by an idle lane")
+            elif event == "rescue":
+                self.metrics.inc("svdj_rescued_total",
+                                 float(extra.get("count", 0) or 0),
+                                 lane=li,
+                                 help="requests rescued off an evicted "
+                                      "lane")
+            elif event == "probe":
+                self.metrics.inc("svdj_probes_total",
+                                 ok=str(bool(extra.get("ok"))).lower(),
+                                 lane=li,
+                                 help="quarantined-lane recovery probes")
         self._store(obs.manifest.build_fleet(event=event, lane=lane,
                                              **extra))
 

@@ -1,0 +1,589 @@
+"""The serving flight recorder (`obs.registry` / `obs.spans` + the serve
+layer's instrumentation): live metrics registry with Prometheus
+exposition, per-request span timelines (live AND reconstructed offline
+from manifest records), SLO accounting, the /metrics+/healthz HTTP
+listener, the exporter under fleet chaos, and the OBS002 free-when-off
+contract (zero registry mutations on the metrics-off hot path, seeded
+failing fixture included).
+
+Small f64 buckets keep every solve on the fast XLA block path (the
+test_fleet.py discipline); the conftest backend has 8 virtual CPU
+devices so two lanes really pin to two devices.
+"""
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu.obs import manifest, registry as obsreg, spans as obsspans
+from svd_jacobi_tpu.obs.registry import (MetricsRegistry, SLOTracker,
+                                         parse_prometheus)
+from svd_jacobi_tpu.obs.spans import SpanRecorder, timeline_from_manifest
+from svd_jacobi_tpu.resilience import chaos
+from svd_jacobi_tpu.serve import LaneState, ServeConfig, SVDService
+from svd_jacobi_tpu.utils import matgen
+
+pytestmark = pytest.mark.obs
+
+BUCKETS = ((32, 32, "float64"), (48, 32, "float64"))
+SOLVER = SVDConfig(block_size=4)
+
+
+def _cfg(**over):
+    base = dict(buckets=BUCKETS, solver=SOLVER, max_queue_depth=16,
+                metrics=True, brownout_sigma_only_at=2.0,
+                brownout_shed_at=2.0)
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _mat(m, n, seed):
+    return matgen.random_dense(m, n, seed=seed, dtype=jnp.float64)
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_render_valid_prometheus(self):
+        reg = MetricsRegistry()
+        reg.inc("svdj_test_total", help="a counter", bucket="b32", lane=0)
+        reg.inc("svdj_test_total", 2.0, bucket="b32", lane=0)
+        reg.set("svdj_test_depth", 7, lane=1)
+        for v in (0.003, 0.2, 11.0):
+            reg.observe("svdj_test_seconds", v, bucket="b32")
+        text = reg.render()
+        series = parse_prometheus(text)     # raises on malformed lines
+        assert series['svdj_test_total{bucket="b32",lane="0"}'] == 3.0
+        assert series['svdj_test_depth{lane="1"}'] == 7.0
+        assert series['svdj_test_seconds_count{bucket="b32"}'] == 3.0
+        assert "# TYPE svdj_test_seconds histogram" in text
+        # Cumulative buckets are monotonic and end at +Inf == count.
+        bucket_vals = [v for k, v in sorted(series.items())
+                       if k.startswith("svdj_test_seconds_bucket")]
+        assert series['svdj_test_seconds_bucket{bucket="b32",le="+Inf"}'] \
+            == 3.0
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.inc("svdj_esc_total", reason='he said "no"\nplus\\slash')
+        parse_prometheus(reg.render())
+
+    def test_kind_conflict_is_loud(self):
+        reg = MetricsRegistry()
+        reg.inc("svdj_conflict")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.set("svdj_conflict", 1.0)
+
+    def test_mutation_counter_global_and_instance(self):
+        before = obsreg.mutation_total()
+        reg = MetricsRegistry()
+        reg.inc("svdj_m_total")
+        reg.set("svdj_m_gauge", 1.0)
+        reg.observe("svdj_m_seconds", 0.1)
+        assert reg.mutations == 3
+        assert obsreg.mutation_total() - before == 3
+
+    def test_collectors_refresh_at_render_and_survive_errors(self):
+        reg = MetricsRegistry()
+        state = {"v": 1.0}
+        reg.add_collector(lambda r: r.set("svdj_live_gauge", state["v"]))
+
+        def boom(_r):
+            raise RuntimeError("sick collector")
+        reg.add_collector(boom)
+        assert parse_prometheus(reg.render())["svdj_live_gauge"] == 1.0
+        state["v"] = 5.0
+        text = reg.render()
+        assert parse_prometheus(text)["svdj_live_gauge"] == 5.0
+        assert "collector error" in text       # loud, not fatal
+
+    def test_histogram_quantile_ordering(self):
+        reg = MetricsRegistry()
+        for v in [0.001] * 50 + [0.2] * 45 + [3.0] * 5:
+            reg.observe("svdj_q_seconds", v)
+        snap = reg.snapshot()["svdj_q_seconds"]["series"][""]
+        assert snap["count"] == 100
+        assert snap["p50"] <= snap["p99"]
+
+
+class TestSLOTracker:
+    def test_quantiles_misses_and_burn(self):
+        slo = SLOTracker(objective=0.9, window=10)
+        for _ in range(8):
+            slo.observe("b32", 0.01, ok=True)
+        slo.observe("b32", 5.0, ok=False, deadline_miss=True)
+        slo.shed("b32")
+        snap = slo.snapshot()
+        b = snap["buckets"]["b32"]
+        assert b["served"] == 9 and b["deadline_miss"] == 1
+        assert b["shed"] == 1
+        assert b["latency_p50_s"] <= b["latency_p99_s"]
+        # 2 bad of 10 in the window, objective 0.9 -> burn = 0.2/0.1 = 2
+        assert snap["error_budget_burn"] == pytest.approx(2.0)
+        assert "error-budget burn" in obsreg.render_slo(snap)
+
+    def test_slo_from_records_matches_live_counting(self):
+        recs = []
+        for status, wait, solve in (("OK", 0.01, 0.1), ("OK", 0.0, 0.2),
+                                    ("DEADLINE", 0.5, None)):
+            recs.append(manifest.build_serve(
+                request_id=f"r{len(recs)}", m=32, n=32, dtype="float64",
+                bucket="b32", queue_wait_s=wait, solve_time_s=solve,
+                status=status, path="base", breaker="closed",
+                brownout="FULL"))
+        recs.append(manifest.build_serve(
+            request_id="r9", m=32, n=32, dtype="float64", bucket=None,
+            queue_wait_s=0.0, solve_time_s=None,
+            status="REJECTED_BROWNOUT_SHED", path="rejected",
+            breaker="closed", brownout="SHED"))
+        # A client-error rejection (NO_BUCKET) must NOT burn the budget
+        # offline — mirroring the live SLOTracker feed exactly.
+        recs.append(manifest.build_serve(
+            request_id="r10", m=7, n=7, dtype="float64", bucket=None,
+            queue_wait_s=0.0, solve_time_s=None,
+            status="REJECTED_NO_BUCKET", path="rejected",
+            breaker="closed", brownout="FULL"))
+        snap = obsreg.slo_from_records(recs)
+        b = snap["buckets"]["b32"]
+        assert b["served"] == 3 and b["ok"] == 2
+        assert b["deadline_miss"] == 1
+        assert snap["buckets"]["_rejected"]["shed"] == 1
+
+
+class TestSpanRecorder:
+    def test_order_phases_render_and_bound(self):
+        rec = SpanRecorder(max_requests=2)
+        for name in ("admit", "queued", "dispatch", "sweep", "sweep",
+                     "finish", "finalize"):
+            rec.event("r1", name)
+        tl = rec.timeline("r1")
+        assert [e["name"] for e in tl] == ["admit", "queued", "dispatch",
+                                          "sweep", "sweep", "finish",
+                                          "finalize"]
+        phases = {p["phase"]: p for p in rec.phases("r1")}
+        assert set(phases) == {"queued", "solve", "finalize"}
+        assert phases["solve"]["duration_s"] >= 0
+        text = rec.render("r1")
+        assert "dispatch" in text and "x2" in text
+        # LRU bound: the oldest request ages out.
+        rec.event("r2", "admit")
+        rec.event("r3", "admit")
+        assert rec.timeline("r1") == []
+
+    def test_offline_reconstruction_from_synthetic_records(self):
+        recs = [manifest.build_serve(
+            request_id="rx", m=32, n=32, dtype="float64", bucket="b32",
+            queue_wait_s=0.25, solve_time_s=0.5, status="OK", path="base",
+            breaker="closed", brownout="FULL", sweeps=6, lane=0)]
+        tl = timeline_from_manifest(recs, "rx")
+        names = [e["name"] for e in tl]
+        assert names == ["admit", "queued", "dispatch", "sweep", "finish",
+                         "finalize"]
+        # Durations reconstruct from the record's own fields.
+        by = {e["name"]: e for e in tl}
+        assert by["dispatch"]["t_wall"] - by["admit"]["t_wall"] == \
+            pytest.approx(0.25)
+        assert by["finish"]["t_wall"] - by["dispatch"]["t_wall"] == \
+            pytest.approx(0.5)
+        assert by["sweep"]["count"] == 6
+
+
+class TestLifecycleTimelines:
+    """The PR's acceptance: one request's full lifecycle reconstructs as
+    an ordered span timeline BOTH live and offline from manifest
+    records — for the plain full solve and for the σ→promote flow."""
+
+    CORE = ["admit", "queued", "dispatch", "finish", "finalize"]
+
+    def _core_order(self, names):
+        return [n for n in names if n in self.CORE]
+
+    def test_full_solve_live_and_offline_agree(self):
+        with SVDService(_cfg()) as svc:
+            t = svc.submit(_mat(30, 30, seed=1))
+            assert t.result(timeout=300.0).status.name == "OK"
+            live = [e["name"] for e in svc.timeline(t.request_id)]
+            records = svc.records()
+        assert self._core_order(live) == self.CORE
+        assert live.count("sweep") >= 1
+        # Sweeps sit strictly between dispatch and finish.
+        assert live.index("dispatch") < live.index("sweep") \
+            < live.index("finish")
+        offline = [e["name"]
+                   for e in timeline_from_manifest(records, t.request_id)]
+        assert self._core_order(offline) == self.CORE
+        assert "sweep" in offline
+
+    def test_sigma_promote_flow_live_and_offline(self):
+        with SVDService(_cfg()) as svc:
+            t = svc.submit(_mat(32, 32, seed=2), phase="sigma")
+            sig = t.result(timeout=300.0)
+            assert sig.status.name == "OK" and sig.u is None
+            pro = t.promote(timeout=60.0)
+            assert pro.status.name == "OK" and pro.u is not None
+            live = [e["name"] for e in svc.timeline(t.request_id)]
+            records = svc.records()
+        # Live: the retained state and the promotion both on the SAME
+        # request's timeline, after the solve finished.
+        assert self._core_order(live) == self.CORE
+        assert "retain" in live and "promote" in live
+        assert live.index("retain") < live.index("promote")
+        offline = timeline_from_manifest(records, t.request_id)
+        names = [e["name"] for e in offline]
+        assert self._core_order(names) == self.CORE
+        assert "retain" in names and "promote" in names
+        assert names.index("finalize") < names.index("promote")
+        # The promote event carries its provenance.
+        promo = [e for e in offline if e["name"] == "promote"
+                 and e.get("promoted_from")][0]
+        assert promo["promoted_from"] == t.request_id
+
+    def test_cache_hit_timeline(self):
+        with SVDService(_cfg(result_cache_bytes=1 << 20)) as svc:
+            a = _mat(30, 30, seed=3)
+            svc.submit(a).result(timeout=300.0)
+            t2 = svc.submit(a)
+            assert t2.result(1.0).path == "cache"
+            live = [e["name"] for e in svc.timeline(t2.request_id)]
+            records = svc.records()
+        assert live == ["admit", "cache_hit", "finalize"]
+        offline = [e["name"]
+                   for e in timeline_from_manifest(records, t2.request_id)]
+        # Live and offline must agree on the ORDER, not just membership
+        # (the cache-path events reconstruct to one shared timestamp, so
+        # the causal tie-break rank carries the whole claim).
+        assert offline == live
+
+
+class TestServiceScrape:
+    def test_scrape_has_every_required_family_and_matches_stats(self):
+        with SVDService(_cfg(result_cache_bytes=1 << 20)) as svc:
+            a = _mat(30, 30, seed=4)
+            assert svc.submit(a).result(timeout=300.0).status.name == "OK"
+            svc.submit(a).result(1.0)                     # cache hit
+            svc.submit(_mat(24, 24, seed=5),
+                       phase="sigma").result(timeout=300.0)
+            text = svc.metrics_text()
+            stats = svc.stats()
+            health = svc.healthz()
+        series = parse_prometheus(text)
+        for family in ("svdj_requests_admitted_total",
+                       "svdj_requests_finalized_total",
+                       "svdj_dispatches_total", "svdj_sweeps_total",
+                       "svdj_queue_depth", "svdj_lane_state",
+                       "svdj_breaker_state", "svdj_brownout_level",
+                       "svdj_result_cache_bytes",
+                       "svdj_promotion_store_bytes",
+                       "svdj_cache_events_total",
+                       "svdj_queue_wait_seconds",
+                       "svdj_solve_seconds",
+                       "svdj_request_latency_seconds",
+                       "svdj_slo_error_budget_burn",
+                       "svdj_slo_latency_seconds"):
+            assert any(k.startswith(family) for k in series), family
+        finalized = sum(v for k, v in series.items()
+                        if k.startswith("svdj_requests_finalized_total"))
+        assert finalized == stats["served"]
+        # SLO accounting surfaced through healthz too.
+        assert health["slo"]["buckets"]["32x32:float64"]["ok"] >= 2
+        assert health["slo"]["error_budget_burn"] == 0.0
+
+    def test_rejection_counts_and_burns(self):
+        with SVDService(_cfg(max_queue_depth=1,
+                             brownout_sigma_only_at=0.01,
+                             brownout_shed_at=0.01)) as svc:
+            with chaos.slow_solve(0.15, shots=2):
+                t1 = svc.submit(_mat(30, 30, seed=6))
+                # Wait for the worker to pop t1 (in-flight, slowed)...
+                deadline = time.monotonic() + 30.0
+                while (svc.queue.depth() > 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+                # ...then fill the 1-deep queue; the NEXT submit sheds.
+                t2 = svc.submit(_mat(30, 30, seed=7))
+                from svd_jacobi_tpu.serve import AdmissionError
+                with pytest.raises(AdmissionError):
+                    svc.submit(_mat(30, 30, seed=8))
+                t1.result(timeout=300.0)
+                t2.result(timeout=300.0)
+            series = parse_prometheus(svc.metrics_text())
+        rej = [k for k in series
+               if k.startswith("svdj_requests_rejected_total")]
+        assert rej and sum(series[k] for k in rej) >= 1
+
+    def test_metrics_off_text_and_zero_mutations(self):
+        before = obsreg.mutation_total()
+        with SVDService(_cfg(metrics=False)) as svc:
+            assert svc.submit(
+                _mat(30, 30, seed=8)).result(timeout=300.0).status.name \
+                == "OK"
+            text = svc.metrics_text()
+            assert svc.timeline("anything") == []
+            assert "slo" not in svc.healthz()
+        assert text.startswith("# svdj metrics disabled")
+        assert obsreg.mutation_total() - before == 0
+
+    def test_journal_fsync_histogram(self, tmp_path):
+        with SVDService(_cfg(journal_path=str(tmp_path / "j.jsonl"))) \
+                as svc:
+            assert svc.submit(
+                _mat(30, 30, seed=9)).result(timeout=300.0).status.name \
+                == "OK"
+            series = parse_prometheus(svc.metrics_text())
+        # admit + dispatch + finalize = 3 fsync'd appends observed.
+        assert series.get("svdj_journal_fsync_seconds_count") == 3.0
+        assert series.get("svdj_journal_appends_total") == 3.0
+
+
+class TestHttpListener:
+    def test_metrics_and_healthz_endpoints(self):
+        import http.client
+        with SVDService(_cfg(metrics_port=0)) as svc:
+            host, port = svc.http_address
+            assert svc.submit(
+                _mat(30, 30, seed=10)).result(timeout=300.0).status.name \
+                == "OK"
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.getheader("Content-Type")
+            parse_prometheus(resp.read().decode())
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            health = json.loads(resp.read())
+            assert health["ok"] is True and "slo" in health
+            conn.request("GET", "/nope")
+            assert conn.getresponse().status == 404
+            conn.close()
+        # stop() shut the listener down.
+        assert svc.http_address is None
+
+
+@pytest.mark.chaos
+class TestExporterUnderFleetChaos:
+    """Satellite: kill a lane mid-load; the scrape must stay
+    serviceable, lane-state gauges must transition
+    ACTIVE->QUARANTINED->ACTIVE, and the steal/rescue counters must
+    match the validated fleet manifest records."""
+
+    def test_scrape_serviceable_through_kill_and_recovery(self):
+        cfg = _cfg(lanes=2, supervise_interval_s=0.02,
+                   lane_probe_interval_s=0.05, lane_probe_timeout_s=120.0,
+                   steal=True, max_queue_depth=32)
+        with SVDService(cfg) as svc:
+            def scrape():
+                text = svc.metrics_text()
+                return parse_prometheus(text)
+
+            states = {0: set()}
+            series = scrape()
+            assert series['svdj_lane_state{lane="0"}'] == 1.0
+            states[0].add(1.0)
+            with chaos.kill_lane(0):
+                tickets = [svc.submit(_mat(32, 32, seed=100 + i))
+                           for i in range(6)]
+                deadline = time.monotonic() + 60.0
+                quarantined = False
+                while time.monotonic() < deadline:
+                    series = scrape()        # serviceable THROUGHOUT
+                    states[0].add(series['svdj_lane_state{lane="0"}'])
+                    if svc.fleet.lanes[0].state is LaneState.QUARANTINED:
+                        quarantined = True
+                    if quarantined and svc.fleet.lanes[0].state is \
+                            LaneState.ACTIVE:
+                        break
+                    time.sleep(0.02)
+                results = [t.result(timeout=300.0) for t in tickets]
+            # Every ticket terminal; the gauge saw both states.
+            assert all(r.status is not None or r.error for r in results)
+            assert states[0] == {0.0, 1.0}
+            deadline = time.monotonic() + 60.0
+            while (svc.fleet.lanes[0].state is not LaneState.ACTIVE
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            series = scrape()
+            assert series['svdj_lane_state{lane="0"}'] == 1.0
+            # The acceptance's required families, present mid-soak.
+            for family in ("svdj_queue_depth", "svdj_lane_state",
+                           "svdj_breaker_state", "svdj_brownout_level",
+                           "svdj_result_cache_bytes",
+                           "svdj_promotion_store_bytes",
+                           "svdj_slo_error_budget_burn"):
+                assert any(k.startswith(family) for k in series), family
+            # Live counters == the validated fleet manifest records.
+            records = svc.records()
+            for rec in records:
+                manifest.validate(rec)
+            fleet_recs = [r for r in records if r.get("kind") == "fleet"]
+            rescued_recs = sum(r.get("count", 0) for r in fleet_recs
+                               if r.get("event") == "rescue")
+            steals_recs = sum(1 for r in fleet_recs
+                              if r.get("event") == "steal")
+            transitions_recs = sum(1 for r in fleet_recs
+                                   if r.get("event") == "lane_transition")
+            live_rescued = sum(v for k, v in series.items()
+                               if k.startswith("svdj_rescued_total"))
+            live_steals = sum(v for k, v in series.items()
+                              if k.startswith("svdj_steals_total"))
+            live_transitions = sum(
+                v for k, v in series.items()
+                if k.startswith("svdj_lane_transitions_total"))
+            assert live_rescued == rescued_recs
+            assert live_steals == steals_recs
+            assert live_transitions == transitions_recs
+            # ...and the offline reconstruction derives the same series.
+            offline = obsreg.registry_from_manifest(records)
+            off_series = parse_prometheus(offline.render())
+            assert sum(v for k, v in off_series.items()
+                       if k.startswith("svdj_rescued_total")) \
+                == rescued_recs
+            assert sum(v for k, v in off_series.items()
+                       if k.startswith("svdj_steals_total")) == steals_recs
+
+
+class TestOBS002:
+    def test_pass_is_green(self):
+        from svd_jacobi_tpu.analysis import obs_checks
+        findings, report = obs_checks.run_metrics_off_case()
+        assert findings == [], [f.message for f in findings]
+        assert report["mutation_delta"] == 0
+
+    def test_seeded_leak_fixture_fires(self):
+        from svd_jacobi_tpu.analysis import obs_checks
+        findings, report = obs_checks.run_metrics_off_case(seed_leak=True)
+        assert report["mutation_delta"] > 0
+        assert any("not free when off" in f.message for f in findings)
+
+    def test_metrics_off_hlo_byte_identity(self):
+        from svd_jacobi_tpu.analysis import obs_checks
+        assert obs_checks.check_metrics_off_hlo() == []
+
+    def test_idle_overhead_within_budget(self):
+        from svd_jacobi_tpu.analysis import obs_checks
+        findings, report = obs_checks.check_idle_overhead(
+            mutations=2000, scrapes=5)
+        assert findings == [], [f.message for f in findings]
+        assert report["per_mutation_s"] < obs_checks.MUTATION_BUDGET_S
+
+
+class TestKindsRegistry:
+    def test_partial_registration_is_loud(self):
+        with pytest.raises(KeyError, match="without"):
+            manifest.register_kind("half-baked", builder=lambda: {},
+                                   validator=None,
+                                   summarizer=lambda r: "")
+
+    def test_duplicate_registration_is_loud(self):
+        with pytest.raises(KeyError, match="already registered"):
+            manifest.register_kind("serve", builder=lambda: {},
+                                   validator=lambda r, e: None,
+                                   summarizer=lambda r: "")
+
+    def test_every_kind_has_all_three(self):
+        assert set(manifest.KINDS) >= {"cli", "bench", "analysis", "retry",
+                                       "serve", "tune", "fleet", "cache",
+                                       "coldstart"}
+        for name, kind in manifest.KINDS.items():
+            assert callable(kind.builder), name
+            assert callable(kind.validator), name
+            assert callable(kind.summarizer), name
+
+    def test_non_string_kind_falls_back_not_typeerror(self):
+        # A list-valued "kind" is well-formed JSON; the registry lookup
+        # must fall back to the solve shape (the pre-registry if/elif
+        # behavior), never raise TypeError: unhashable.
+        with pytest.raises(ValueError, match="invalid manifest record"):
+            manifest.validate({"kind": ["serve"]})
+        assert "run @" in manifest.summarize({"kind": ["serve"]})
+
+    def test_unknown_kind_still_falls_back(self):
+        # Forward compatibility: a record from a NEWER writer validates
+        # and renders through the solve branch, exactly as before.
+        rec = manifest.build("cli", m=8, n=8, dtype="float32",
+                             config=SVDConfig(),
+                             solve={"time_s": 1.0, "sweeps": 1,
+                                    "off_norm": 0.0})
+        rec["kind"] = "from-the-future"
+        manifest.validate(rec)
+        assert "from-the-future run @" in manifest.summarize(rec)
+
+
+class TestMetricsDumpCLI:
+    def _manifest(self, tmp_path):
+        with SVDService(_cfg(result_cache_bytes=1 << 20,
+                             manifest_path=str(tmp_path / "m.jsonl"))) \
+                as svc:
+            a = _mat(30, 30, seed=11)
+            t = svc.submit(a)
+            assert t.result(timeout=300.0).status.name == "OK"
+            svc.submit(a).result(1.0)
+        return tmp_path / "m.jsonl", t.request_id
+
+    def test_prometheus_slo_and_timeline_dumps(self, tmp_path, capsys):
+        from svd_jacobi_tpu import cli
+        path, rid = self._manifest(tmp_path)
+        assert cli.main(["metrics", str(path)]) == 0
+        out = capsys.readouterr().out
+        series = parse_prometheus(out)
+        assert any(k.startswith("svdj_requests_finalized_total")
+                   for k in series)
+        assert any(k.startswith("svdj_cache_events_total")
+                   for k in series)
+        assert cli.main(["metrics", str(path), "--slo"]) == 0
+        out = capsys.readouterr().out
+        assert "error-budget burn" in out and "32x32:float64" in out
+        assert cli.main(["metrics", str(path), "--timeline", rid]) == 0
+        out = capsys.readouterr().out
+        assert "admit" in out and "finalize" in out
+
+    def test_empty_manifest_exits_nonzero(self, tmp_path):
+        from svd_jacobi_tpu import cli
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert cli.main(["metrics", str(p)]) == 1
+
+
+class TestTelemetrySummaryScript:
+    def _run(self, *argv):
+        import subprocess
+        import sys
+        from pathlib import Path
+        script = Path(__file__).resolve().parent.parent / "scripts" / \
+            "telemetry_summary.py"
+        return subprocess.run([sys.executable, str(script), *argv],
+                              capture_output=True, text=True, timeout=120)
+
+    def _write_mixed(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        manifest.append(path, manifest.build_serve(
+            request_id="r0", m=32, n=32, dtype="float64", bucket="b32",
+            queue_wait_s=0.0, solve_time_s=0.1, status="OK", path="base",
+            breaker="closed", brownout="FULL"))
+        manifest.append(path, manifest.build_cache(
+            store="result", event="hit", request_id="r0", digest="ab" * 32))
+        manifest.append(path, manifest.build_coldstart(
+            entries=[{"entry": "e", "time_s": 0.1, "cache_hit": True}],
+            total_s=0.2, backend_compiles=1, cache_hits=1,
+            fresh_compiles=0, cache_dir=None, config_sha256=None))
+        return path
+
+    def test_kind_filter(self, tmp_path):
+        path = self._write_mixed(tmp_path)
+        out = self._run(str(path), "--kind", "cache")
+        assert out.returncode == 0
+        assert out.stdout.startswith("cache result/hit")
+        assert "serve r0" not in out.stdout
+        out = self._run(str(path), "--kind", "coldstart")
+        assert out.returncode == 0 and "cache-hit" in out.stdout
+        out = self._run(str(path), "--kind", "nonsense")
+        assert out.returncode == 2 and "registered kinds" in out.stderr
+
+    def test_slo_rendering(self, tmp_path):
+        path = self._write_mixed(tmp_path)
+        out = self._run(str(path), "--slo")
+        assert out.returncode == 0
+        assert "error-budget burn" in out.stdout and "b32" in out.stdout

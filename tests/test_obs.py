@@ -253,6 +253,81 @@ class TestTraceRobustness:
         assert ran
 
 
+class TestTraceFleetInteraction:
+    """obs.trace/XProf + fleet: arming a one-request profiler capture on
+    a lane that gets QUARANTINED must warn and skip — never raise inside
+    a dispatch the supervisor is already nursing (a probe solve on a
+    quarantined lane is the canonical case)."""
+
+    def _svc(self):
+        from svd_jacobi_tpu.serve import ServeConfig, SVDService
+        return SVDService(ServeConfig(
+            buckets=((32, 32, "float64"),),
+            solver=sj.SVDConfig(block_size=4),
+            lanes=2, steal=False, supervise_interval_s=0.02,
+            lane_probe_interval_s=600.0))
+
+    def test_quarantined_lane_capture_warns_and_skips(self, tmp_path):
+        import warnings as _warnings
+
+        from svd_jacobi_tpu.serve.queue import Request
+        from svd_jacobi_tpu.serve.service import Ticket
+        svc = self._svc().start()
+        try:
+            lane = svc.fleet.lanes[0]
+            svc.fleet.evict(lane, "test_forced")
+            ticket = Ticket("rq-traced")
+            req = Request(
+                id="rq-traced", a=np.zeros((32, 32)), m=32, n=32,
+                orig_shape=(32, 32), transposed=False,
+                bucket=list(svc.buckets)[0], compute_u=False,
+                compute_v=False, degraded=False, deadline=None,
+                deadline_s=None, submitted=0.0, cancel=ticket._cancel,
+                ticket=ticket)
+            svc.capture_request_trace("rq-traced", tmp_path / "xprof")
+            with pytest.warns(RuntimeWarning, match="quarantined"):
+                win = svc._trace_window_for(req, lane)
+            assert win is None                    # skipped, not raised
+            # The arm is consumed: a later healthy dispatch of the same
+            # id does not resurrect a stale capture.
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("error")
+                assert svc._trace_window_for(req, lane) is None
+        finally:
+            svc.stop(drain=False, timeout=30.0)
+
+    def test_probe_on_quarantined_lane_survives_armed_capture(self):
+        """End to end: arm a capture for the recovery PROBE itself (it
+        dispatches on the quarantined lane by design); the probe must
+        still run, the lane must still recover — the capture is simply
+        skipped with a warning, never an exception mid-supervisor-tick."""
+        import time as _time
+        import warnings as _warnings
+
+        from svd_jacobi_tpu.serve import LaneState, ServeConfig, SVDService
+        svc = SVDService(ServeConfig(
+            buckets=((32, 32, "float64"),),
+            solver=sj.SVDConfig(block_size=4),
+            lanes=2, steal=False, supervise_interval_s=0.02,
+            lane_probe_interval_s=0.05, lane_probe_timeout_s=120.0)).start()
+        try:
+            # Probe ids are deterministic: the first probe on lane 0 is
+            # "probe-l0-0".
+            svc.capture_request_trace("probe-l0-0", "/tmp/xprof-na")
+            with _warnings.catch_warnings(record=True) as caught:
+                _warnings.simplefilter("always")
+                svc.fleet.evict(svc.fleet.lanes[0], "test_forced")
+                deadline = _time.monotonic() + 60.0
+                while (svc.fleet.lanes[0].state is not LaneState.ACTIVE
+                       and _time.monotonic() < deadline):
+                    _time.sleep(0.02)
+            assert svc.fleet.lanes[0].state is LaneState.ACTIVE
+            assert any("quarantined" in str(w.message) for w in caught
+                       if issubclass(w.category, RuntimeWarning))
+        finally:
+            svc.stop(drain=False, timeout=30.0)
+
+
 class TestPhaseInfo:
     def test_public_accessor_tracks_hybrid_stages(self):
         a = matgen.random_dense(48, 48, dtype=jnp.float64, seed=9)
