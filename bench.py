@@ -90,6 +90,17 @@ Usage:
                                   against the full solve on the input
                                   padded to square; emits a
                                   tall_vs_pad_speedup row)
+         --pair-solver=NAME      (pin the solver lane; a non-auto pin
+                                  makes the baseline OUR OWN auto-routed
+                                  solve and emits a pair_solver_speedup
+                                  A/B row — e.g.
+                                  --pair-solver=block_rotation for the
+                                  MXU-native blocked-rotation lane vs
+                                  the current kernel)
+
+Every solve row carries ``mfu``: measured GFLOP/s over the device's
+f32-effective peak (bench._PEAK_FLOPS, keyed by device kind; CPU rows
+use a documented rough estimate and say so via "peak_est").
 """
 
 from __future__ import annotations
@@ -100,9 +111,41 @@ import time
 
 import numpy as np
 
-# TPU v5e single-chip peak: 197 TFLOP/s bf16. The solver's MXU work runs
-# f32-in/f32-acc (bf16x6 passes) => f32-effective peak ~= 197/6 ~= 32.8 TF/s.
-_PEAK_F32_EFF = 197e12 / 6
+# f32-effective peak FLOP/s by device kind (keys normalized like
+# tune.tables.normalize_device_kind), for the per-row MFU field — the
+# headline metric of the ROADMAP "attack the 1.7% MFU" item. TPU entries
+# are the chip's bf16 MXU peak / 6: the solver's f32-HIGHEST matmuls run
+# as bf16x6 passes, so that is the peak this workload could reach. The
+# "cpu" entry is a DOCUMENTED ROUGH ESTIMATE for the 2-core bench
+# container (2 cores x ~8 f32 FLOP/cycle FMA+AVX x ~3 GHz ~= 48 GFLOP/s):
+# CPU MFU rows are comparable across rounds, not absolute truth. Unknown
+# device kinds fall back to the CPU estimate with a "peak_est" note in
+# the row so an uncalibrated MFU can never pass silently as a measured
+# one.
+_PEAK_FLOPS = {
+    "tpu-v5-lite": 197e12 / 6,
+    "tpu-v5e": 197e12 / 6,
+    "tpu-v5p": 459e12 / 6,
+    "tpu-v4": 275e12 / 6,
+    "tpu-v6-lite": 918e12 / 6,
+    "tpu-v6e": 918e12 / 6,
+    "cpu": 48e9,
+}
+
+
+def _peak_flops(device_kind: str):
+    """(peak_flops, estimated?) for one device kind."""
+    from svd_jacobi_tpu.tune.tables import normalize_device_kind
+    kind = normalize_device_kind(device_kind)
+    if kind in _PEAK_FLOPS:
+        return _PEAK_FLOPS[kind], kind == "cpu"
+    return _PEAK_FLOPS["cpu"], True
+
+
+def _mfu(gflops: float, device_kind: str):
+    """(mfu, estimated?) of a measured GFLOP/s rate on this device."""
+    peak, est = _peak_flops(device_kind)
+    return round(gflops * 1e9 / peak, 4), est
 
 
 def _force(tree):
@@ -901,7 +944,24 @@ def main() -> None:
     # n^2 buffers — the difference between fitting and OOM at 30000^2).
     # --block-size=K / --mixed-bulk: the block-width and mixed-regime
     # sweeps of PROFILE.md run through the same bench harness.
+    # --pair-solver=NAME pins the solver lane; a non-auto pin (and no
+    # other comparison row in flight) turns the baseline into OUR OWN
+    # auto-routed solve — the lane A/B row (the block_rotation
+    # acceptance comparison "vs the current lane").
+    pair_solver = flags.get("pair-solver", "auto")
+    pair_ab = (pair_solver != "auto" and top_k is None and not tall_vs_pad
+               and attempted_baseline)
+    if pair_ab and "stepped" in flags:
+        # The A/B row is a LANE comparison; folding the host-stepped
+        # loop's per-sweep dispatch overhead into "ours" against a fused
+        # baseline would misattribute stepping cost to the lane (same
+        # policy as --top-k/--tall-vs-pad).
+        raise SystemExit("--pair-solver A/B rows are fused-lane "
+                         "comparisons; not combinable with --stepped "
+                         "(use --no-baseline to time a stepped pinned "
+                         "lane without the A/B row)")
     cfg = sj.SVDConfig(
+        pair_solver=pair_solver,
         precondition=flags.get("precondition", "auto"),
         block_size=(int(flags["block-size"]) if "block-size" in flags
                     else None),
@@ -1016,6 +1076,52 @@ def main() -> None:
             (t_ours, t_base), (r, _), errs = _time_interleaved(
                 [ours, base_fn], a, reps=reps)
             return t_ours, t_base, r, errs[0], name
+        if pair_ab:
+            # Lane A/B: baseline = what "auto" routes this shape to
+            # today (same session, same input, interleaved timing) —
+            # UNLESS auto already routes to the pinned lane (a tuning
+            # table can ship that verdict, e.g. default-r03's CPU
+            # medium block_rotation row), in which case the comparison
+            # falls back to the next kernel lane so the row never
+            # measures a lane against itself.
+            import dataclasses as _dc
+            from svd_jacobi_tpu import solver as _solver
+            auto_cfg = _dc.replace(cfg, pair_solver="auto")
+            routed = _solver._resolve_options(
+                a if m >= n else a.T, auto_cfg, not novec)[2]
+            if routed == pair_solver:
+                # Next kernel lane valid for this dtype (pallas computes
+                # f32 rotations — an f64 run pinning qr-svd must not
+                # crash the baseline; precondition is a kernel-lane mode,
+                # so the XLA fallbacks drop it back to auto).
+                if pair_solver != "pallas" and dtype != jnp.float64:
+                    base_lane = "pallas"
+                elif pair_solver != "hybrid":
+                    base_lane = "hybrid"
+                else:
+                    base_lane = "qr-svd"
+                base_cfg = _dc.replace(cfg, pair_solver=base_lane)
+                if base_lane in ("hybrid", "qr-svd"):
+                    # Kernel-lane-only modes must not crash the XLA
+                    # fallback baseline (precondition='on', mixed_bulk,
+                    # bulk_bf16 all raise off the kernel path).
+                    base_cfg = _dc.replace(
+                        base_cfg,
+                        precondition=("auto" if base_cfg.precondition in
+                                      ("on", "double")
+                                      else base_cfg.precondition),
+                        mixed_bulk=None, bulk_bf16=None)
+                name = f"svd() {base_lane} lane same shape (auto already " \
+                       f"routes {pair_solver})"
+            else:
+                base_cfg = auto_cfg
+                name = f"svd() auto lane ({routed}) same shape"
+            base_fn = lambda x: sj.svd(x, compute_u=not novec,
+                                       compute_v=not novec,
+                                       config=base_cfg)
+            (t_ours, t_base), (r, _), errs = _time_interleaved(
+                [ours, base_fn], a, reps=reps)
+            return t_ours, t_base, r, errs[0], name
         if baseline == "numpy":
             an = np.asarray(a)
             (t_ours, t_base), (r, _), errs = _time_interleaved(
@@ -1107,9 +1213,13 @@ def main() -> None:
     else:
         flops = 4.0 * m * n**2 + 8.0 * n**3
     gflops = flops / t_ours / 1e9
+    device_kind = jax.devices()[0].device_kind
+    mfu, mfu_est = _mfu(gflops, device_kind)
     tag = "_novec" if novec else ""
     lane = ("_topk_k%d" % top_k if top_k is not None
             else "_tall" if tall_vs_pad else "")
+    if pair_solver != "auto":
+        lane += f"_{pair_solver}"
     row = {
         "metric": f"svd{lane}_{m}x{n}_{dtype_name}{tag}_gflops",
         "value": round(gflops, 2),
@@ -1120,14 +1230,35 @@ def main() -> None:
         "baseline_time_s": (round(t_base, 4) if t_base is not None else None),
         "baseline": (base_name if t_base is not None or not attempted_baseline
                      else f"{base_name}: FAILED TO COMPILE/RUN"),
-        "sweeps": int(r.sweeps),
-        "mfu": round(gflops * 1e9 / _PEAK_F32_EFF, 4),
+        "sweeps": int(r.sweeps) if np.ndim(r.sweeps) == 0 else int(
+            np.max(np.asarray(r.sweeps))),
+        "mfu": mfu,
         "device": str(jax.devices()[0]),
         **extras,
     }
+    if mfu_est:
+        row["peak_est"] = ("documented CPU-class estimate "
+                           "(bench._PEAK_FLOPS) — MFU comparable across "
+                           "rounds, not absolute")
     if retried is not None:
         row["retried"] = retried
     print(json.dumps(row))
+    if pair_ab and row["vs_baseline"] is not None:
+        # The lane A/B as its own parseable row: end-to-end speedup of
+        # the pinned pair-solver lane over what "auto" routes to today
+        # (the block_rotation acceptance row at 512^2-2048^2).
+        base_gflops = flops / t_base / 1e9
+        print(json.dumps({
+            "metric": f"pair_solver_speedup_{m}x{n}_{dtype_name}"
+                      f"_{pair_solver}",
+            "value": row["vs_baseline"],
+            "unit": f"x vs {base_name}",
+            "time_s": row["time_s"],
+            "auto_time_s": row["baseline_time_s"],
+            "mfu": mfu,
+            "auto_mfu": _mfu(base_gflops, device_kind)[0],
+            "sigma_err_vs_oracle": extras.get("sigma_err"),
+        }))
     if top_k is not None and row["vs_baseline"] is not None:
         # The lane's raison d'etre, as its own parseable row: end-to-end
         # speedup of the truncated solve over the full one at the same

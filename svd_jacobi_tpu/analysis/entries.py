@@ -67,7 +67,10 @@ def _single_probe(name: str, a, config: SVDConfig, *, compute_u=True,
         full_matrices=full_matrices)
     entry_id = {"padded": "solver._svd_padded",
                 "pallas": ("solver._svd_pallas_donated" if config.donate_input
-                           else "solver._svd_pallas")}[entry]
+                           else "solver._svd_pallas"),
+                "block_rotation": ("solver._svd_block_rotation_donated"
+                                   if config.donate_input
+                                   else "solver._svd_block_rotation")}[entry]
     return EntryProbe(name=name, fn=fn, args=(a_in,), kwargs=kwargs,
                       entry_id=entry_id)
 
@@ -78,6 +81,7 @@ def _batched_probe(name: str, a, config: SVDConfig, *, compute_u=True,
     entry, fn, a_in, kwargs = solver._plan_entry_batched(
         a, config, compute_u=compute_u, compute_v=compute_v)
     entry_id = {"pallas_batched": "solver._svd_pallas_batched",
+                "block_rotation_batched": "solver._svd_block_rotation_batched",
                 "padded_batched": "solver._svd_padded_batched"}[entry]
     return EntryProbe(name=name, fn=fn, args=(a_in,), kwargs=kwargs,
                       entry_id=entry_id, telemetry_key=None)
@@ -114,6 +118,12 @@ def single_device_probes(include_f64: Optional[bool] = None) -> List[EntryProbe]
         # lane emits no in-graph events).
         _batched_probe("pallas_batched", jnp.zeros((3, 48, 32), jnp.float32),
                        SVDConfig(pair_solver="pallas")),
+        # The MXU-native blocked-rotation lane (eigh-accumulated bulk
+        # rounds + kernel polish): single-device — its collective budget
+        # is declared ZERO (config.COLLECTIVE_BUDGET
+        # ["pallas_block_rotation"]).
+        _single_probe("pallas_block_rotation", a32,
+                      SVDConfig(pair_solver="block_rotation")),
     ]
     probes += sketch_probes()
     if include_f64:

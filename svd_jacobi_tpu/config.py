@@ -43,8 +43,11 @@ class SVDConfig:
     # "auto": the Pallas device-kernel path ("pallas") for f32/bf16 inputs
     # that are large enough to block (the TPU fast path; runs under the
     # Pallas interpreter on CPU), qr-svd for f64 (gesvj-class high relative
-    # accuracy) and for tiny inputs.
-    pair_solver: str = "auto"  # "auto" | "pallas" | "qr-svd" | "gram-eigh" | "hybrid"
+    # accuracy) and for tiny inputs; the tuning tables may route eligible
+    # classes to "block_rotation" (the MXU-native blocked-rotation lane:
+    # eigh-accumulated bulk rounds + kernel polish, ops/block_rotate.py).
+    pair_solver: str = "auto"  # "auto" | "pallas" | "block_rotation" |
+    #                            "qr-svd" | "gram-eigh" | "hybrid"
     # --- Pallas-path options (pair_solver="pallas") ---
     # QR preconditioning: norm-sort columns, factor A P = Q1 R, run Jacobi
     # on L = R^T (Drmac-style: graded triangular factors converge in ~25%
@@ -226,6 +229,15 @@ COLLECTIVE_BUDGET = {
     "pallas_batched": {"collective_permute": 0, "all_reduce": 0,
                        "all_gather": 0, "all_to_all": 0,
                        "reduce_scatter": 0},
+    # The single-device blocked-rotation entry (solver._svd_block_rotation
+    # — the MXU-native accumulate-into-J + rank-2b-GEMM lane): its bulk
+    # and polish phase loops are single-device matmul/eigh/kernel chains;
+    # a collective of any kind appearing here would mean mesh machinery
+    # leaked into the fused lane. Asserted on the lowered module like the
+    # batched entry.
+    "pallas_block_rotation": {"collective_permute": 0, "all_reduce": 0,
+                              "all_gather": 0, "all_to_all": 0,
+                              "reduce_scatter": 0},
     # The sketch/TSQR stage jits of the top-k and tall lanes
     # (solver._sketch_project_jit / _tsqr_jit): single-device matmul/QR
     # chains — zero collectives of any kind, always (on a mesh the
@@ -265,6 +277,17 @@ RETRACE_BUDGETS = {
     "solver._svd_padded": 1,
     "solver._svd_pallas": 1,
     "solver._svd_pallas_donated": 1,
+    # Blocked-rotation lane (pair_solver="block_rotation"): the fused
+    # entries and the host-stepped bulk-sweep twins. Same once-per-
+    # problem-key contract as the pallas lane; a block_rotation bucket
+    # legitimately counts TWO sweep-entry problems (its bulk entry here
+    # plus the shared pallas polish entry), which the serve registry
+    # enumerates.
+    "solver._svd_block_rotation": 1,
+    "solver._svd_block_rotation_donated": 1,
+    "solver._svd_block_rotation_batched": 1,
+    "solver._sweep_step_block_jit": 1,
+    "solver._sweep_step_block_batched_jit": 1,
     "sharded._svd_sharded_jit": 1,
     # Serving-layer entries — the host-stepped kernel sweeps that
     # `serve.SVDService` drives. Every request is padded to one of the
@@ -390,4 +413,9 @@ HOT_SCOPES = {
     "tsqr": ("ops/sketch.py", "tsqr"),
     "sketch": ("ops/sketch.py", "sketch_project"),
     "lift": ("solver.py", "_lift_q"),
+    # The blocked-rotation lane's subproblem solve (accumulate the inner
+    # Jacobi cycle's rotations into one orthogonal factor J): the hot
+    # region that replaces the latency-bound per-step rotation chain
+    # during the bulk phase.
+    "block_solve": ("ops/block_rotate.py", "accumulate"),
 }

@@ -1,0 +1,114 @@
+"""MXU-native blocked-rotation accumulate + apply (pair_solver="block_rotation").
+
+The rotation kernel of the Pallas lane is latency-bound (PROFILE.md
+item 1): every tournament round pays a sequential chain of b elementwise
+rotation steps whose per-step cost is ~constant whatever the panel count,
+so at 2048^2 f32 the MXU sees ~1.7% utilization (BENCH_r04). This module
+restructures the round the way cuSOLVER's gesvdj and the Brent-Luk
+blocked-Jacobi formulation do:
+
+  * `accumulate` — solve each block pair's FULL 2b x 2b Gram subproblem
+    on-chip and accumulate every rotation of the inner cycle into ONE
+    orthogonal 2b x 2b factor J. The inner cycle is delegated to the
+    batched symmetric eigendecomposition: on TPU, XLA's `eigh` IS a
+    cyclic Jacobi iteration (matmul-heavy MXU work), i.e. the full inner
+    Jacobi cycle run to convergence with the rotations accumulated into
+    the eigenvector factor. J is then permuted/sign-fixed nearest to the
+    identity (the small-angle condition that keeps the outer tournament
+    convergent — see `ops.blockwise._nearest_identity_order`) and
+    re-orthogonalized to the f32 floor with one Newton-Schulz step, so
+    hundreds of applied factors cannot erode U/V.
+  * `apply_factor` — apply J to the two m x b column panels (and the
+    matching V panels) as ONE rank-2b matmul per pair, batched along the
+    pair axis: the MXU sees (m, 2b) x (2b, 2b) GEMMs stacked over all
+    n/(2b) pairs of the round, instead of 2b-1 latency-bound rotation
+    steps each touching the panel. The contraction honors the mixed-store
+    gate: ``x3`` runs the bf16x3 split product (3 native bf16 passes,
+    ~eps_bf16^2 error — safe in the bulk phase, whose state the f32
+    polish re-converges) so bf16 accumulation composes.
+
+Because the subproblem solve is eigh-quality it converges only to the
+ABSOLUTE (sigma_max-relative) class — couplings between small-norm
+columns are left at the eigh floor. The lane therefore runs these rounds
+as a BULK phase against the abs statistic and hands the endgame to the
+existing scalar-accurate rotation kernel (`ops.rounds.iterate` — the
+fallback lane), which restores dgesvj-class relative accuracy; the sweep
+machinery lives in `ops.rounds.sweep_block` / `iterate_block`.
+
+Numerically SINGULAR input caveat (shared with the abs-class XLA lanes —
+hybrid/gram-eigh/qr-svd, whose column-read factor shows the same
+property): the factor read off the rotated COLUMNS (V on the
+preconditioned path) is orthonormal on numerically-LIVE columns only.
+The bulk's large-angle factors are applied as f32 GEMMs, and a
+dead-column output (true content below ~eps*sigma_max of its panel) is
+the cancellation residue of large terms — noise whose common component
+parallels the dead columns; the pallas lane's exactly-scaled tiny
+angles never cancel, which is why it alone keeps dead columns
+orthonormal. Sigma accuracy, the residual, U (the rotation-product
+side), and live-column V orthogonality are unaffected —
+`utils.validation`'s `v_orth_live`/`u_orth_live` are the meaningful
+metrics there, exactly as documented for the XLA lanes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _apply_einsum(x, q, *, x3=False):
+    """The rank-2b panel contraction ``x @ q`` at one of two regimes:
+    f32 HIGHEST (the default), or the bf16x3 split product
+    hi@hi + lo@hi + hi@lo (~eps_bf16^2 error, 3 native MXU passes — the
+    mixed-store composition regime). The split is `rounds._split_bf16`
+    (ONE copy of the numerically subtle bit-mask construction; imported
+    lazily — rounds imports this module at its own top level)."""
+    if x3:
+        from .rounds import _split_bf16
+        xh, xl = _split_bf16(x.astype(jnp.float32))
+        qh, ql = _split_bf16(q.astype(jnp.float32))
+        f = lambda p, w: jnp.einsum("kmi,kij->kmj", p, w,
+                                    preferred_element_type=jnp.float32)
+        return f(xh, qh) + (f(xl, qh) + f(xh, ql))
+    return jnp.einsum("kmi,kij->kmj", x, q,
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+
+
+def accumulate(g: jax.Array) -> jax.Array:
+    """Accumulated orthogonal factors J of a round's Gram panel stack.
+
+    ``g``: (k, 2b, 2b) symmetric Gram panels (one per block pair).
+    Returns (k, 2b, 2b) f32 J with ``X @ J`` exactly orthogonalizing each
+    pair's 2b columns to the subproblem solve's accuracy: the full inner
+    Jacobi cycle on the Gram subproblem (batched `eigh` — XLA's TPU eigh
+    is a cyclic Jacobi iteration accumulating rotations into the
+    eigenvector factor), nearest-identity ordered (small-angle outer
+    convergence; descending eigenvalues embed de-Rijk norm sorting before
+    the reorder) and Newton-Schulz re-orthogonalized to the f32 floor.
+    """
+    from ..obs.scopes import scope
+    from . import blockwise
+    with scope("block_solve"):
+        _, q = jnp.linalg.eigh(g.astype(jnp.float32))
+        q = blockwise._nearest_identity_order(q)
+        return blockwise._newton_schulz_polish(
+            q, jax.lax.Precision.HIGHEST)
+
+
+def apply_factor(top, bot, vtop, vbot, q, *, x3=False):
+    """Apply one round's accumulated factors to the panel stacks as ONE
+    rank-2b GEMM per pair: ``[top|bot] @ q`` (and the V stacks alongside),
+    batched along the pair axis. ``vtop``/``vbot`` may be None (NoVec).
+    This is the whole point of the lane: the 2b-1 rotation steps of the
+    inner cycle never touch the m-height panels — the panels see exactly
+    one matmul per pair per round."""
+    b = top.shape[-1]
+    xn = _apply_einsum(jnp.concatenate([top, bot], axis=-1), q,
+                       x3=x3).astype(top.dtype)
+    top, bot = xn[..., :b], xn[..., b:]
+    if vtop is not None:
+        vn = _apply_einsum(jnp.concatenate([vtop, vbot], axis=-1), q,
+                           x3=x3).astype(vtop.dtype)
+        vtop, vbot = vn[..., :b], vn[..., b:]
+    return top, bot, vtop, vbot

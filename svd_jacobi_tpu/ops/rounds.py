@@ -36,6 +36,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import block_rotate as br
 from . import pallas_apply as pa
 from . import pallas_blocks as pb
 from . import pallas_gram as pg
@@ -103,7 +104,8 @@ def _einsum(a, b, spec, bf16=False, x3=False):
 
 
 def panel_stats(g: jax.Array, dmax2: jax.Array,
-                members=None) -> Tuple[jax.Array, jax.Array]:
+                members=None, criterion: str = "rel"
+                ) -> Tuple[jax.Array, jax.Array]:
     """(masked, unmasked) max scaled coupling of a Gram panel stack.
 
     ``masked`` deflates columns whose squared norm is below
@@ -117,12 +119,33 @@ def panel_stats(g: jax.Array, dmax2: jax.Array,
     matrix ``members[0][j]``; ``dmax2`` is then a per-matrix vector and
     BOTH returned statistics are per-matrix segment maxima — one matrix's
     couplings (or NaNs) never enter a neighbor's statistic.
+
+    ``criterion``: "rel" is the dgesvj scaled coupling above; "abs" is
+    the LAPACK-dgesvd-class ``max |g_ij| / dmax2`` — the statistic the
+    blocked-rotation bulk phase drives (its eigh-quality subproblem
+    solves converge the abs class fast but leave small-column couplings
+    at the eigh floor, so the rel statistic could never terminate the
+    bulk loop). The abs form needs no deflation mask — a null column's
+    couplings are tiny against dmax2 by construction — so masked and
+    unmasked coincide.
     """
     f32 = jnp.float32
     g = g.astype(f32)
     n2 = g.shape[-1]
     eps = jnp.finfo(f32).eps
     d2 = jnp.diagonal(g, axis1=-2, axis2=-1)
+    if criterion == "abs":
+        no_diag = (1.0 - jnp.eye(n2, dtype=f32))[None]
+        c = jnp.abs(g) * no_diag
+        if members is None:
+            stat = jnp.max(c) / jnp.maximum(dmax2.astype(f32),
+                                            jnp.finfo(f32).tiny)
+            return stat, stat
+        seg, nseg = members
+        stat = jax.ops.segment_max(jnp.max(c, axis=(1, 2)), seg,
+                                   num_segments=nseg)
+        stat = stat / jnp.maximum(dmax2.astype(f32), jnp.finfo(f32).tiny)
+        return stat, stat
     inv = 1.0 / jnp.maximum(d2, jnp.finfo(f32).tiny)
     r2 = (g * g) * inv[:, :, None] * inv[:, None, :]
     r2 = r2 * (1.0 - jnp.eye(n2, dtype=f32))[None]
@@ -392,6 +415,337 @@ def cross_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *, polish,
     return top, bot, vtop, vbot, g, stat
 
 
+def block_round(top, bot, vtop, vbot, dmax2, rtol, *, apply_x3=False,
+                interpret=False, batch=1, return_rotated=False):
+    """One blocked-rotation tournament round (the MXU-native lane,
+    `ops.block_rotate`): form the pairs' full 2b x 2b Gram panels, solve
+    each subproblem COMPLETELY on-chip with the rotations accumulated
+    into one orthogonal factor J (`block_rotate.accumulate`), and apply J
+    to the m x b panels — and the V panels — as ONE rank-2b matmul per
+    pair, batched along the pair axis. On compiled TPU backends the apply
+    AND the tournament exchange fuse into the existing
+    `pallas_apply.apply_exchange` kernel (J has exactly the cross
+    kernel's (k, 2b, 2b) factor shape), so the round is gram kernel +
+    batched eigh + one fused apply per stack — zero latency-bound
+    rotation steps.
+
+    Statistics are the ABS criterion (`panel_stats(criterion="abs")`,
+    segmented per member when ``batch > 1``): the eigh-quality subproblem
+    solve converges the abs class, and the rel endgame belongs to the
+    scalar-accurate kernel polish (`iterate`). The round-skip gate uses
+    the same abs statistic against ``rtol``.
+    """
+    b = top.shape[-1]
+    with_v = vtop is not None
+    with scope("gram"):
+        if not interpret and pg.supported(top.shape[1], b):
+            g = pg.gram_pairs(top, bot)
+        else:
+            x = jnp.concatenate([top, bot], axis=-1)
+            g = _einsum(x, x, "kmi,kmj->kij")
+    if batch > 1:
+        stat, skip = panel_stats(
+            g, dmax2, members=_members(batch, top.shape[0] // batch),
+            criterion="abs")
+        skip = _skip_stat(skip)
+    else:
+        stat, skip = panel_stats(g, dmax2, criterion="abs")
+    fused = (not interpret and pa.supported(top.shape[1], b)
+             and (not with_v or pa.supported(vtop.shape[1], b)))
+
+    def do(args):
+        top, bot, vtop, vbot = args
+        q = br.accumulate(g)
+        if fused:
+            with scope("apply_exchange"):
+                top, bot = pa.apply_exchange(top, bot, q, x3=apply_x3,
+                                             batch=batch)
+                if with_v:
+                    vtop, vbot = pa.apply_exchange(vtop, vbot, q,
+                                                   x3=apply_x3, batch=batch)
+            return top, bot, vtop, vbot
+        with scope("apply"):
+            top, bot, nvt, nvb = br.apply_factor(
+                top, bot, vtop if with_v else None,
+                vbot if with_v else None, q, x3=apply_x3)
+            if with_v:
+                vtop, vbot = nvt, nvb
+        with scope("exchange"):
+            top, bot = sched.rotate_blocks(top, bot, batch)
+            if with_v:
+                vtop, vbot = sched.rotate_blocks(vtop, vbot, batch)
+        return top, bot, vtop, vbot
+
+    def skip_branch(args):
+        top, bot, vtop, vbot = args
+        with scope("exchange"):
+            top, bot = sched.rotate_blocks(top, bot, batch)
+            if with_v:
+                vtop, vbot = sched.rotate_blocks(vtop, vbot, batch)
+        return top, bot, vtop, vbot
+
+    top, bot, vtop, vbot = jax.lax.cond(skip > rtol, do, skip_branch,
+                                        (top, bot, vtop, vbot))
+    if return_rotated:
+        return top, bot, vtop, vbot, stat, (skip > rtol).astype(jnp.int32)
+    return top, bot, vtop, vbot, stat
+
+
+def block_round_fused(top, bot, vtop, vbot, g, dmax2, rtol, *,
+                      apply_x3=False, interpret=False, batch=1,
+                      return_rotated=False):
+    """`block_round` with the Gram panel as loop-carried state (the exact
+    carry pattern of `cross_round_fused`): ``g`` is the CURRENT pairs'
+    full 2b x 2b panel — produced by the previous round's fused
+    apply+exchange+gram kernel, or the bootstrap `pg.gram_pairs` call —
+    and the returned panel belongs to the post-exchange pairs, so a
+    rotate round is batched eigh + ONE fused apply kernel per stack with
+    zero standalone gram reads of the m-height panels (the standalone
+    read would be a full extra HBM pass per round on the lane whose
+    whole point is attacking the 1.7% MFU). The skip branch pays a plain
+    exchange + gram kernel (late sweeps, where rounds are cheap)."""
+    with_v = vtop is not None
+    if batch > 1:
+        stat, skip = panel_stats(
+            g, dmax2, members=_members(batch, top.shape[0] // batch),
+            criterion="abs")
+        skip = _skip_stat(skip)
+    else:
+        stat, skip = panel_stats(g, dmax2, criterion="abs")
+
+    def do(args):
+        top, bot, vtop, vbot, _ = args
+        q = br.accumulate(g)
+        with scope("apply_exchange"):
+            top, bot, g2 = pa.apply_exchange(top, bot, q, x3=apply_x3,
+                                             with_gram=True,
+                                             interpret=interpret,
+                                             batch=batch)
+            if with_v:
+                vtop, vbot = pa.apply_exchange(vtop, vbot, q, x3=apply_x3,
+                                               interpret=interpret,
+                                               batch=batch)
+        return top, bot, vtop, vbot, g2
+
+    def skip_branch(args):
+        top, bot, vtop, vbot, _ = args
+        with scope("exchange"):
+            top, bot = sched.rotate_blocks(top, bot, batch)
+            if with_v:
+                vtop, vbot = sched.rotate_blocks(vtop, vbot, batch)
+        with scope("gram"):
+            g2 = pg.gram_pairs(top, bot, interpret=interpret)
+        return top, bot, vtop, vbot, g2
+
+    top, bot, vtop, vbot, g = jax.lax.cond(
+        skip > rtol, do, skip_branch, (top, bot, vtop, vbot, g))
+    if return_rotated:
+        return top, bot, vtop, vbot, g, stat, (skip > rtol).astype(jnp.int32)
+    return top, bot, vtop, vbot, g, stat
+
+
+def sweep_block(top, bot, vtop, vbot, dmax2, rtol, *, interpret,
+                apply_x3=False, telemetry=False, batch=1):
+    """One blocked-rotation sweep: ``2k-1`` tournament rounds of
+    `block_round` — NO separate self round, because each round's fully
+    solved 2b x 2b subproblem annihilates the within-block pairs too
+    (they are re-covered every round; cross-block pairs exactly once when
+    their blocks meet). Returns the max ABS coupling observed across the
+    sweep's fresh Gram panels (per-matrix ``(batch,)`` vector on the
+    batched lane), measured BEFORE each round's rotations — the bulk
+    phase's loop statistic. On compiled TPU backends with lane-sized
+    panels the rounds run gram-carried (`block_round_fused` — one
+    bootstrap panel, then every round is eigh + fused
+    apply/exchange/gram); elsewhere each round recomputes its panel
+    (`block_round`). Single-device only (the mesh keeps the kernel
+    lane)."""
+    k, m, b = top.shape
+    with_v = vtop is not None
+    k_per = k // batch
+    n_rounds = sched.num_rounds(2 * k_per)
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
+    # Same gate as `sweep`'s fused path: compiled backend, kernel-usable
+    # panels/rows for every stack (gram kernel needed for bootstrap and
+    # the skip branch).
+    fused = (not interpret and pa.supported(m, b) and pg.supported(m, b)
+             and (not with_v or pa.supported(vtop.shape[1], b)))
+
+    if fused:
+        with scope("gram"):
+            g0 = pg.gram_pairs(top, bot)
+
+        def body(carry, _):
+            top, bot, vtop, vbot, g, mx = carry[:6]
+            out = block_round_fused(
+                top, bot, vtop if with_v else None,
+                vbot if with_v else None, g, dmax2, rtol,
+                apply_x3=apply_x3, interpret=interpret, batch=batch,
+                return_rotated=telemetry)
+            top, bot, nvt, nvb, g, stat = out[:6]
+            if with_v:
+                vtop, vbot = nvt, nvb
+            new = (top, bot, vtop, vbot, g, jnp.maximum(mx, stat))
+            if telemetry:
+                new += (carry[6] + out[6],)
+            return new, None
+
+        mx0 = (jnp.zeros((batch,), jnp.float32) if batch > 1
+               else jnp.zeros((), jnp.float32))
+        init = (top, bot, vtop, vbot, g0, mx0)
+        if telemetry:
+            init += (jnp.int32(0),)
+        carry, _ = jax.lax.scan(body, init, None, length=n_rounds)
+        top, bot, vtop, vbot, _, off = carry[:6]
+        out = (top, bot, (vtop if with_v else None),
+               (vbot if with_v else None), off)
+        return out + (carry[6],) if telemetry else out
+
+    def body(carry, _):
+        top, bot, vtop, vbot, mx = carry[:5]
+        out = block_round(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            dmax2, rtol, apply_x3=apply_x3, interpret=interpret,
+            batch=batch, return_rotated=telemetry)
+        top, bot, nvt, nvb, stat = out[:5]
+        if with_v:
+            vtop, vbot = nvt, nvb
+        new = (top, bot, vtop, vbot, jnp.maximum(mx, stat))
+        if telemetry:
+            new += (carry[5] + out[5],)
+        return new, None
+
+    mx0 = (jnp.zeros((batch,), jnp.float32) if batch > 1
+           else jnp.zeros((), jnp.float32))
+    init = (top, bot, vtop, vbot, mx0)
+    if telemetry:
+        init += (jnp.int32(0),)
+    carry, _ = jax.lax.scan(body, init, None, length=n_rounds)
+    top, bot, vtop, vbot, off = carry[:5]
+    out = (top, bot, (vtop if with_v else None),
+           (vbot if with_v else None), off)
+    return out + (carry[5],) if telemetry else out
+
+
+def iterate_block(top, bot, vtop, vbot, *, abs_tol, max_sweeps, interpret,
+                  apply_x3=False, stall_detection=True, start_sweeps=0,
+                  telemetry=False, stage="block_bulk", nonfinite0=None,
+                  chaos_nan_sweep=None):
+    """`lax.while_loop` of `sweep_block`s until the ABS coupling drops
+    below ``abs_tol`` (the blocked-rotation BULK phase; the caller's
+    kernel polish finishes to the rel criterion). Stall constants are the
+    abs criterion's (`solver._should_continue`: gate ``4*abs_tol``,
+    shrink 0.75) — an input whose abs floor sits above ``abs_tol``
+    (extreme grading) exits on stall and hands the rest to the polish
+    instead of burning the sweep budget. Health word semantics follow
+    `iterate_phase` exactly (nonfinite rides the dmax2/off reductions;
+    ``chaos_nan_sweep`` is the fault-injection hook). Returns
+    (top, bot, vtop, vbot, off, sweeps, nonfinite)."""
+    from ..resilience import chaos as _chaos
+    with_v = vtop is not None
+    k = top.shape[0]
+    if vtop is None:
+        vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
+
+    def cond(st):
+        _, _, _, _, off, prev_off, sweeps, nonfinite = st
+        return should_continue(off, prev_off, sweeps, tol=abs_tol,
+                               max_sweeps=max_sweeps,
+                               stall_detection=stall_detection,
+                               stall_gate=4.0 * abs_tol, stall_shrink=0.75,
+                               nonfinite=nonfinite)
+
+    def body(st):
+        top, bot, vtop, vbot, prev_off, _, sweeps, nonfinite = st
+        if chaos_nan_sweep is not None:
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
+        dmax2 = _global_dmax2(top, bot)
+        out = sweep_block(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            dmax2, abs_tol, interpret=interpret, apply_x3=apply_x3,
+            telemetry=telemetry)
+        top, bot, nvt, nvb, off = out[:5]
+        nonfinite = nonfinite | ~jnp.isfinite(dmax2) | ~jnp.isfinite(off)
+        if telemetry:
+            metrics.emit("sweep",
+                         meta={"path": "block", "stage": stage},
+                         sweep=sweeps + 1, off_rel=off,
+                         rounds_rotated=out[5])
+        if not with_v:
+            nvt, nvb = st[2], st[3]
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1, nonfinite)
+
+    inf = jnp.float32(jnp.inf)
+    nf0 = (jnp.zeros((), jnp.bool_) if nonfinite0 is None
+           else jnp.asarray(nonfinite0, jnp.bool_))
+    state = (top, bot, vtop, vbot, inf, inf,
+             jnp.asarray(start_sweeps, jnp.int32), nf0)
+    top, bot, vtop, vbot, off, _, sweeps, nonfinite = jax.lax.while_loop(
+        cond, body, state)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off, sweeps, nonfinite)
+
+
+def iterate_block_batched(top, bot, vtop, vbot, *, batch, abs_tol,
+                          max_sweeps, interpret, apply_x3=False,
+                          stall_detection=True, chaos_nan_sweep=None):
+    """Batched blocked-rotation bulk loop (`solver.svd_batched`'s
+    block-rotation lane): `iterate_batched`'s per-member bookkeeping over
+    `sweep_block` sweeps against the ABS statistic. A member that reaches
+    ``abs_tol`` (or stalls at its abs floor, or goes non-finite) freezes
+    its statistics and rides the remaining bulk sweeps near-identity; the
+    caller continues every member through the kernel polish
+    (`iterate_batched` with the carried counters). Returns
+    (top, bot, vtop, vbot, off (batch,), sweeps scalar, msweeps (batch,),
+    nonfinite (batch,))."""
+    from ..resilience import chaos as _chaos
+    with_v = vtop is not None
+    kb = top.shape[0]
+    if vtop is None:
+        vtop = vbot = jnp.zeros((kb, 0, top.shape[2]), top.dtype)
+
+    def go_mask(off, prev_off, sweeps, nonfinite):
+        return should_continue(off, prev_off, sweeps, tol=abs_tol,
+                               max_sweeps=max_sweeps,
+                               stall_detection=stall_detection,
+                               stall_gate=4.0 * abs_tol, stall_shrink=0.75,
+                               nonfinite=nonfinite)
+
+    def cond(st):
+        _, _, _, _, off, prev_off, sweeps, _, nonfinite = st
+        return jnp.any(go_mask(off, prev_off, sweeps, nonfinite))
+
+    def body(st):
+        top, bot, vtop, vbot, off, prev_off, sweeps, msweeps, nonfinite = st
+        go = go_mask(off, prev_off, sweeps, nonfinite)
+        if chaos_nan_sweep is not None:
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
+        dmax2 = _global_dmax2(top, bot, batch=batch)
+        out = sweep_block(top, bot, vtop if with_v else None,
+                          vbot if with_v else None, dmax2, abs_tol,
+                          interpret=interpret, apply_x3=apply_x3,
+                          batch=batch)
+        top, bot, nvt, nvb, off_new = out[:5]
+        nf_new = ~jnp.isfinite(dmax2) | ~jnp.isfinite(off_new)
+        nonfinite = nonfinite | (go & nf_new)
+        prev_off = jnp.where(go, off, prev_off)
+        off = jnp.where(go, off_new, off)
+        msweeps = msweeps + go.astype(jnp.int32)
+        if not with_v:
+            nvt, nvb = st[2], st[3]
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1, msweeps,
+                nonfinite)
+
+    inf = jnp.full((batch,), jnp.inf, jnp.float32)
+    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0),
+             jnp.zeros((batch,), jnp.int32),
+             jnp.zeros((batch,), jnp.bool_))
+    (top, bot, vtop, vbot, off, _, sweeps, msweeps,
+     nonfinite) = jax.lax.while_loop(cond, body, state)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off, sweeps, msweeps, nonfinite)
+
+
 def sweep(top, bot, vtop, vbot, dmax2, rtol, *, interpret, polish, bf16_gram,
           axis_name=None, n_rounds=None, exchange=None, apply_x3=False,
           telemetry=False, batch=1):
@@ -654,6 +1008,7 @@ def iterate_phase(top, bot, vtop, vbot, *, stop_tol, rtol, max_sweeps,
 
 def iterate_batched(top, bot, vtop, vbot, *, batch, tol, max_sweeps,
                     interpret, polish, stall_detection=True,
+                    start_sweeps=0, msweeps0=None, nonfinite0=None,
                     chaos_nan_sweep=None):
     """Batched sweep loop (the `solver.svd_batched` lane): the stacks hold
     ``batch`` matrices back to back along the pair axis and ONE fused
@@ -672,6 +1027,11 @@ def iterate_batched(top, bot, vtop, vbot, *, batch, tol, max_sweeps,
     reported convergence. Returns
     (top, bot, vtop, vbot, off (batch,), sweeps (batch,),
     nonfinite (batch,)).
+
+    ``start_sweeps`` / ``msweeps0`` / ``nonfinite0`` seed the stack-level
+    counter, per-member sweep counts, and per-member health word from an
+    earlier phase (the blocked-rotation lane's `iterate_block_batched`
+    bulk), so ``max_sweeps`` stays a TOTAL budget across phases.
     """
     from ..resilience import chaos as _chaos
     with_v = vtop is not None
@@ -713,9 +1073,12 @@ def iterate_batched(top, bot, vtop, vbot, *, batch, tol, max_sweeps,
                 nonfinite)
 
     inf = jnp.full((batch,), jnp.inf, jnp.float32)
-    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0),
-             jnp.zeros((batch,), jnp.int32),
-             jnp.zeros((batch,), jnp.bool_))
+    msw0 = (jnp.zeros((batch,), jnp.int32) if msweeps0 is None
+            else jnp.asarray(msweeps0, jnp.int32))
+    nf0 = (jnp.zeros((batch,), jnp.bool_) if nonfinite0 is None
+           else jnp.asarray(nonfinite0, jnp.bool_))
+    state = (top, bot, vtop, vbot, inf, inf,
+             jnp.asarray(start_sweeps, jnp.int32), msw0, nf0)
     (top, bot, vtop, vbot, off, _, _, msweeps,
      nonfinite) = jax.lax.while_loop(cond, body, state)
     return (top, bot, (vtop if with_v else None),

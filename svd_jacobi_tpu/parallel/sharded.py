@@ -378,6 +378,15 @@ def _plan_entry(a, mesh: Mesh, config: SVDConfig, *, compute_u: bool = True,
     b, k = _single._plan(n, n_devices, config, m=a.shape[0], dtype=a.dtype)
     tol, gram_dtype_name, method, criterion = _single._resolve_options(
         a, config, compute_uv=compute_u)
+    if method == "block_rotation":
+        # The blocked-rotation lane is single-device (its bulk/polish
+        # phase loops are not threaded through the ring exchange, and its
+        # subproblem eigh would run per shard-local panel set): the mesh
+        # keeps the pallas kernel lane — the documented fallback, same
+        # accuracy class and the same tol/criterion resolution (both are
+        # _KERNEL_METHODS), so a table row pinning block_rotation can
+        # never break a sharded solve. Collective budgets are unchanged.
+        method = "pallas"
     if method == "pallas" and b % 2:
         # The self kernel halves blocks: b must be even (keep k a multiple
         # of the device count).
@@ -586,6 +595,19 @@ class SweepStepper(_single.SweepStepper):
         self.n_devices = mesh.size
         super().__init__(a, compute_u=compute_u, compute_v=compute_v,
                          full_matrices=full_matrices, config=config)
+        if self.method == "block_rotation":
+            # Mesh fallback, mirroring `sharded._plan_entry`: the
+            # blocked-rotation bulk is single-device, so the mesh steps
+            # the pallas kernel sweeps — with the SINGLE-stage pallas
+            # machinery (without this, the base class's bulk/polish
+            # stage machine would drive abs-criterion bookkeeping over
+            # rel-statistic sharded pallas sweeps: wrong stall
+            # constants, and a control stop in the phantom "bulk" stage
+            # would decode DEADLINE/CANCELLED past final tolerance).
+            # tol/criterion are already the kernel lanes' shared
+            # resolution — nothing else changes.
+            self.method = "pallas"
+            self._stage = "single"
         # Re-plan with the mesh's device count (the base class planned for
         # 1), mirroring `sharded.svd`'s geometry exactly (including the
         # even-b adjustment for the self kernel and the same m/dtype

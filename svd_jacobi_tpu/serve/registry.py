@@ -78,6 +78,17 @@ def jit_entries() -> Dict[str, object]:
         "solver._svd_padded": solver._svd_padded,
         "solver._svd_pallas": solver._svd_pallas,
         "solver._svd_pallas_donated": solver._svd_pallas_donated,
+        # Blocked-rotation lane (pair_solver="block_rotation"): fused
+        # entries + the host-stepped bulk-sweep twins (the polish stage
+        # reuses the pallas sweep/finish entries below).
+        "solver._svd_block_rotation": solver._svd_block_rotation,
+        "solver._svd_block_rotation_donated":
+            solver._svd_block_rotation_donated,
+        "solver._svd_block_rotation_batched":
+            solver._svd_block_rotation_batched,
+        "solver._sweep_step_block_jit": solver._sweep_step_block_jit,
+        "solver._sweep_step_block_batched_jit":
+            solver._sweep_step_block_batched_jit,
         "sharded._svd_sharded_jit": sharded._svd_sharded_jit,
         # Host-stepped serving entries (SweepStepper).
         "solver._precondition_qr_jit": solver._precondition_qr_jit,

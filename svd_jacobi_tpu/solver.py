@@ -150,6 +150,39 @@ def _plan(n: int, n_devices: int, config: SVDConfig, m: Optional[int] = None,
     return b, k
 
 
+# The device-kernel solver lanes: both run the blockified sweep machinery
+# of ops/rounds.py with f32 rotation math (f64 routes to qr-svd) and
+# terminate on the rel statistic. "pallas" generates rotations with the
+# latency-bound Pallas step kernels every round; "block_rotation" solves
+# each round's full 2b x 2b Gram subproblem on-chip (ops/block_rotate —
+# accumulate into one factor J, apply as one rank-2b matmul per pair) as
+# an abs-statistic bulk phase and polishes with the pallas kernels.
+_KERNEL_METHODS = ("pallas", "block_rotation")
+
+
+def _resolve_mixed_store(config: SVDConfig, n: int, m: int, dtype) -> str:
+    """The ONE validate-and-resolve of `SVDConfig.mixed_store` (shared by
+    the pallas/mixed-bulk planner, both block-rotation planners, and the
+    block-rotation steppers — the gate must read identically on every
+    dispatch surface or fused and served solves of one bucket diverge):
+    explicit values win, "auto" resolves through the tuning table."""
+    if config.mixed_store not in ("auto", "f32", "bf16", "bf16g"):
+        raise ValueError(f"unknown mixed_store mode: {config.mixed_store!r}")
+    return (config.mixed_store if config.mixed_store != "auto"
+            else _tuned(n, m, dtype).mixed_store)
+
+
+# Bulk-phase exit for the blocked-rotation lane, as a multiple of the abs
+# phase tolerance (so it scales with the input dtype's eps): 10x = ~1e-5
+# for f32. MEASURED, not derived (1024^2 CPU, uniform + gaussian inputs):
+# converging the eigh bulk all the way to 8*eps costs 2-3 extra bulk
+# sweeps AND lengthens the polish — each late-bulk eigh factor carries
+# backward error ~eps*sigma_max(panel)^2, which near the abs floor stops
+# resolving structure and starts re-perturbing what the polish must then
+# undo (14 total sweeps at 1x vs 11 at 10x; 4.40 s vs 2.71 s).
+_BLOCK_BULK_TOL_FACTOR = 10.0
+
+
 def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
     """Shared option resolution for the single-device and sharded entry
     points: tolerance, Gram dtype, pair-solver method, and convergence
@@ -185,10 +218,10 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
         #     compute_uv=False there is no U and the cheap gram-eigh/abs
         #     bulk path suffices.
         method = tuned.pair_solver
-        if a.dtype == jnp.float64 and method in ("pallas",):
+        if a.dtype == jnp.float64 and method in _KERNEL_METHODS:
             method = "qr-svd"
-        if method == "pallas" and not (min(m, n) >= 64
-                                       and config.criterion != "abs"):
+        if method in _KERNEL_METHODS and not (min(m, n) >= 64
+                                              and config.criterion != "abs"):
             method = "hybrid"
         if method == "gram-eigh" and compute_uv:
             # gram-eigh alone cannot deliver an orthogonal U (abs-class
@@ -200,10 +233,12 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
             method = "hybrid"
         if method == "hybrid" and not compute_uv:
             method = "gram-eigh"
-    if method == "pallas" and a.dtype == jnp.float64:
-        raise ValueError("pair_solver='pallas' computes rotations in float32; "
-                         "use 'qr-svd' (the auto choice) for float64 inputs")
-    if method not in ("pallas", "qr-svd", "gram-eigh", "hybrid"):
+    if method in _KERNEL_METHODS and a.dtype == jnp.float64:
+        raise ValueError(f"pair_solver={method!r} computes rotations in "
+                         "float32; use 'qr-svd' (the auto choice) for "
+                         "float64 inputs")
+    if method not in ("pallas", "block_rotation", "qr-svd", "gram-eigh",
+                      "hybrid"):
         raise ValueError(f"unknown pair solver method: {method!r}")
     criterion = config.criterion
     if criterion == "auto":
@@ -216,23 +251,27 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
         tcrit = tuned.criterion if tuned is not None else "follow"
         if tcrit == "rel" and method != "gram-eigh":
             criterion = "rel"
-        elif tcrit == "abs" and method != "pallas":
+        elif tcrit == "abs" and method not in _KERNEL_METHODS:
             criterion = "abs"
         else:
             criterion = "abs" if method == "gram-eigh" else "rel"
-    if method == "pallas":
+    if method in _KERNEL_METHODS:
         if criterion == "abs":
-            # The kernel path measures only the rel (dgesvj scaled-coupling)
-            # statistic; an abs-scale tolerance would be compared against
-            # the wrong quantity and could never be reached. An explicit
-            # abs request on the explicit kernel path is unsatisfiable —
-            # reject it loudly (this file's policy for precondition /
-            # mixed_bulk) instead of silently rewriting it to "rel".
+            # The kernel lanes TERMINATE on the rel (dgesvj scaled-
+            # coupling) statistic only — pallas measures nothing else, and
+            # block_rotation's abs statistic is an internal bulk-phase
+            # control, not the final convergence contract. An abs-scale
+            # tolerance would be compared against the wrong quantity and
+            # could never be reached; an explicit abs request on an
+            # explicit kernel lane is unsatisfiable — reject it loudly
+            # (this file's policy for precondition / mixed_bulk) instead
+            # of silently rewriting it to "rel".
             raise ValueError(
-                "criterion='abs' is not measurable on the Pallas kernel "
-                "path (pair_solver='pallas' measures only the dgesvj "
-                "scaled-coupling 'rel' statistic); use criterion='rel' or "
-                "an XLA pair solver ('gram-eigh'/'hybrid'/'qr-svd')")
+                f"criterion='abs' is not a termination criterion of the "
+                f"kernel lanes (pair_solver={method!r} terminates on the "
+                f"dgesvj scaled-coupling 'rel' statistic); use "
+                f"criterion='rel' or an XLA pair solver "
+                f"('gram-eigh'/'hybrid'/'qr-svd')")
         # (here criterion can only be "rel": "auto" resolved above, "abs"
         # just raised)
     if criterion not in ("rel", "abs"):
@@ -254,7 +293,7 @@ def _resolve_xla_options(a, config: SVDConfig, compute_uv: bool = True):
     matched pair."""
     import dataclasses as _dc
     tol, gram, method, criterion = _resolve_options(a, config, compute_uv)
-    if method == "pallas":
+    if method in _KERNEL_METHODS:
         tol, gram, method, criterion = _resolve_options(
             a, _dc.replace(config, pair_solver="hybrid"), compute_uv)
     return tol, gram, method, criterion
@@ -1000,6 +1039,196 @@ _svd_pallas_batched = partial(jax.jit,
     _svd_pallas_batched_impl)
 
 
+_BLOCK_ROTATION_STATIC = (
+    "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
+    "max_sweeps", "precondition", "polish", "apply_x3", "interpret",
+    "stall_detection", "refine", "telemetry", "chaos_nan_sweep")
+
+
+def _svd_block_rotation_impl(a, *, n, compute_u, compute_v, full_u, nblocks,
+                             n_pad, tol, max_sweeps, precondition, polish,
+                             apply_x3=False, interpret=False,
+                             stall_detection=True, refine=False,
+                             telemetry=False, chaos_nan_sweep=None):
+    """The MXU-native blocked-rotation solve (pair_solver=
+    "block_rotation"), m >= n — the ROADMAP "attack the 1.7% MFU" lane.
+
+    Two phases around the same preconditioning/postprocessing bookkeeping
+    as `_svd_pallas_impl`:
+
+      1. BULK (`rounds.iterate_block`): every tournament round solves its
+         block pair's FULL 2b x 2b Gram subproblem on-chip — the inner
+         Jacobi cycle runs as a batched eigendecomposition with the
+         rotations accumulated into one orthogonal factor J
+         (`ops.block_rotate.accumulate`) — and applies J to the m x b
+         panels (and V) as ONE rank-2b matmul per pair, batched along the
+         pair axis. The MXU sees stacked (m, 2b) x (2b, 2b) GEMMs instead
+         of the pallas lane's per-round chain of b latency-bound rotation
+         steps; ``apply_x3`` (the resolved mixed_store gate) runs those
+         GEMMs as bf16x3 split products. The phase drives the ABS
+         statistic — the class the eigh-quality subproblem solves
+         converge — down to `_abs_phase_tol`.
+      2. POLISH (`rounds.iterate` — the current kernel, kept as the
+         fallback lane): scalar-accurate Rutishauser sweeps restore the
+         dgesvj rel criterion (U orthogonality, small-sigma relative
+         accuracy), starting from near-converged state where the
+         round-skip taper bites.
+
+    Result accuracy is therefore the same class as the pallas lane (the
+    polish phase's arithmetic is identical); ``max_sweeps`` is a TOTAL
+    budget across both phases.
+    """
+    m = a.shape[0]
+    dtype = a.dtype
+    if precondition:
+        q1, _, order, work = _precondition_qr(a)
+        accumulate = compute_u       # rotations -> U
+        want_cols = compute_v        # normalized columns -> V
+    else:
+        q1 = order = None
+        work = a
+        accumulate = compute_v
+        want_cols = compute_u
+
+    top, bot = _blockify(work, n_pad, nblocks)
+    if accumulate:
+        vtop, vbot = _blockify(jnp.eye(n_pad, dtype=dtype), n_pad, nblocks)
+    else:
+        vtop = vbot = None
+
+    top, bot, vtop, vbot, bulk_off, bulk_sweeps, bulk_nf = \
+        rounds.iterate_block(
+            top, bot, vtop, vbot,
+            abs_tol=_BLOCK_BULK_TOL_FACTOR * _abs_phase_tol(dtype),
+            max_sweeps=max_sweeps, interpret=interpret, apply_x3=apply_x3,
+            stall_detection=stall_detection, telemetry=telemetry,
+            chaos_nan_sweep=chaos_nan_sweep)
+    if telemetry:
+        metrics.emit("stage", meta={"stage": "block_bulk"},
+                     sweeps=bulk_sweeps, off_rel=bulk_off)
+    top, bot, vtop, vbot, off_rel, sweeps, nonfinite = rounds.iterate(
+        top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
+        interpret=interpret, polish=polish, bulk_bf16=False,
+        stall_detection=stall_detection, start_sweeps=bulk_sweeps,
+        telemetry=telemetry, stage="polish", nonfinite0=bulk_nf,
+        chaos_nan_sweep=chaos_nan_sweep)
+    # Bulk budget-exhaustion: report the bulk statistic if the polish
+    # never ran (cf. the hybrid XLA path's identical carry handling; the
+    # scales differ — abs vs rel — exactly as they do there).
+    off_rel = jnp.where(sweeps > bulk_sweeps, off_rel, bulk_off)
+    status = _status_word(off_rel, sweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
+
+    a_work = _deblockify(top, bot)
+    v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
+    cols, s, rot = _postprocess(a_work, v_work, n, compute_u=want_cols,
+                                full_u=False, dtype=dtype)
+    if refine:
+        cols, s, rot = _refine_from_work(work, cols, s, rot)
+    if precondition:
+        u, v = _recombine_precondition(
+            cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=full_u, dtype=dtype, q1=q1, order=order)
+        return u, s, v, sweeps, off_rel, status
+    u = cols
+    if compute_u and full_u and m > n and u is not None:
+        u = _complete_orthonormal(u, n, dtype)
+    return u, s, rot, sweeps, off_rel, status
+
+
+_svd_block_rotation = partial(jax.jit,
+                              static_argnames=_BLOCK_ROTATION_STATIC)(
+    _svd_block_rotation_impl)
+# Input-donating twin, mirroring _svd_pallas_donated.
+_svd_block_rotation_donated = partial(
+    jax.jit, static_argnames=_BLOCK_ROTATION_STATIC,
+    donate_argnums=(0,))(_svd_block_rotation_impl)
+
+
+_BLOCK_ROTATION_BATCHED_STATIC = (
+    "n", "compute_u", "compute_v", "nblocks", "n_pad", "tol", "max_sweeps",
+    "precondition", "polish", "apply_x3", "interpret", "stall_detection",
+    "refine", "chaos_nan_sweep")
+
+
+def _svd_block_rotation_batched_impl(a, *, n, compute_u, compute_v, nblocks,
+                                     n_pad, tol, max_sweeps, precondition,
+                                     polish, apply_x3=False, interpret=False,
+                                     stall_detection=True, refine=False,
+                                     chaos_nan_sweep=None):
+    """Batched blocked-rotation solve: B same-shaped matrices stacked
+    along the pair axis (`_svd_pallas_batched_impl`'s layout) through the
+    bulk (`rounds.iterate_block_batched` — subproblem eigh batches over
+    B*k panels, stats segment per member) and the kernel polish
+    (`rounds.iterate_batched` continuing the per-member counters, so
+    max_sweeps stays a total budget). Per-member off/sweeps/status, one
+    NaN member decodes NONFINITE with OK neighbors."""
+    batch, m = a.shape[0], a.shape[1]
+    dtype = a.dtype
+    if precondition:
+        q1, _, order, work = jax.vmap(_precondition_qr)(a)
+        accumulate = compute_u
+        want_cols = compute_v
+    else:
+        q1 = order = None
+        work = a
+        accumulate = compute_v
+        want_cols = compute_u
+
+    top, bot = map(_stack_members,
+                   _blockify_batched(work, n_pad, nblocks))
+    if accumulate:
+        eye = jnp.broadcast_to(jnp.eye(n_pad, dtype=dtype),
+                               (batch, n_pad, n_pad))
+        vtop, vbot = map(_stack_members,
+                         _blockify_batched(eye, n_pad, nblocks))
+    else:
+        vtop = vbot = None
+
+    (top, bot, vtop, vbot, bulk_off, bulk_sweeps, bulk_msweeps,
+     bulk_nf) = rounds.iterate_block_batched(
+        top, bot, vtop, vbot, batch=batch,
+        abs_tol=_BLOCK_BULK_TOL_FACTOR * _abs_phase_tol(dtype),
+        max_sweeps=max_sweeps, interpret=interpret, apply_x3=apply_x3,
+        stall_detection=stall_detection, chaos_nan_sweep=chaos_nan_sweep)
+    top, bot, vtop, vbot, off, msweeps, nonfinite = rounds.iterate_batched(
+        top, bot, vtop, vbot, batch=batch, tol=tol, max_sweeps=max_sweeps,
+        interpret=interpret, polish=polish,
+        stall_detection=stall_detection, start_sweeps=bulk_sweeps,
+        msweeps0=bulk_msweeps, nonfinite0=bulk_nf,
+        chaos_nan_sweep=chaos_nan_sweep)
+    # Members whose polish never swept (total budget exhausted in bulk)
+    # report the bulk statistic, cf. the single-solve carry handling.
+    off = jnp.where(msweeps > bulk_msweeps, off, bulk_off)
+    status = _status_word(off, msweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
+
+    a_work = _deblockify_batched(top, bot, batch)
+    v_work = (_deblockify_batched(vtop, vbot, batch)[:, :n, :]
+              if accumulate else None)
+
+    def post_one(aw, vw, wk):
+        cols, s, rot = _postprocess(aw, vw, n, compute_u=want_cols,
+                                    full_u=False, dtype=dtype)
+        if refine:
+            cols, s, rot = _refine_from_work(wk, cols, s, rot)
+        return cols, s, rot
+
+    cols, s, rot = jax.vmap(post_one)(a_work, v_work, work)
+    if precondition:
+        u, v = jax.vmap(lambda c, r, qq, oo: _recombine_precondition(
+            c, r, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=False, dtype=dtype, q1=qq, order=oo))(cols, rot, q1,
+                                                         order)
+        return u, s, v, msweeps, off, status
+    return cols, s, rot, msweeps, off, status
+
+
+_svd_block_rotation_batched = partial(
+    jax.jit, static_argnames=_BLOCK_ROTATION_BATCHED_STATIC)(
+    _svd_block_rotation_batched_impl)
+
+
 def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
                 compute_v: bool = True, full_matrices: bool = False):
     """Resolve the fused jitted entry point a (input, config) pair
@@ -1019,6 +1248,47 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
         a, config, compute_uv=compute_u)
     if config.precondition not in ("auto", "on", "off", "double"):
         raise ValueError(f"unknown precondition mode: {config.precondition!r}")
+
+    if method == "block_rotation":
+        if b % 2:
+            # The polish phase's self kernel splits blocks in half.
+            b += 1
+            k = max(1, -(-n // (2 * b)))
+            n_pad = 2 * k * b
+        if config.precondition == "double":
+            raise ValueError(
+                "precondition='double' is a pallas-lane fused mode; the "
+                "block_rotation lane supports 'auto'/'on'/'off'")
+        if config.mixed_bulk or config.bulk_bf16:
+            raise ValueError(
+                "mixed_bulk/bulk_bf16 are pallas-lane bulk regimes; the "
+                "block_rotation lane runs its own eigh-accumulated bulk "
+                "(its panel matmuls honor mixed_store instead)")
+        precondition = (_tuned(n, m, a.dtype).precondition == "on"
+                        if config.precondition == "auto"
+                        else config.precondition == "on")
+        # The mixed-store gate composes with the blocked-rotation lane
+        # through its bulk-phase panel GEMMs: a bf16 storage verdict
+        # (table row or explicit) runs them as bf16x3 split products
+        # (~eps_bf16^2 error, absorbed by the abs-phase contract — the
+        # f32 polish re-converges from the applied state).
+        mixed_store = _resolve_mixed_store(config, n, m, a.dtype)
+        refine = (config.sigma_refine if config.sigma_refine is not None
+                  else (compute_u or compute_v))
+        solve = (_svd_block_rotation_donated if config.donate_input
+                 else _svd_block_rotation)
+        kwargs = dict(
+            n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
+            max_sweeps=int(config.max_sweeps),
+            precondition=bool(precondition),
+            polish=bool(config.kernel_polish),
+            apply_x3=mixed_store != "f32",
+            interpret=not pb.supported(),
+            stall_detection=bool(config.stall_detection),
+            refine=bool(refine), telemetry=bool(metrics.enabled()),
+            chaos_nan_sweep=_chaos.consume_nan_sweep())
+        return "block_rotation", solve, a, kwargs
 
     if method == "pallas":
         if b % 2:
@@ -1053,9 +1323,6 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
                 "bulk_bf16 (bf16 Gram panels inside the f32 loop) and "
                 "mixed_bulk (bf16x3 bulk sweeps + f32 polish) are mutually "
                 "exclusive bulk strategies")
-        if config.mixed_store not in ("auto", "f32", "bf16", "bf16g"):
-            raise ValueError(
-                f"unknown mixed_store mode: {config.mixed_store!r}")
         # auto resolves through the tuning table; the shipped verdict is
         # "f32" (PROFILE.md item 17, measured at 8192^2 on v5e: the
         # byte-halved regimes make the bulk monotonically faster, 4.19 ->
@@ -1064,8 +1331,7 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
         # mixed mode, 6.27 vs 6.47 vs 6.66 s). The bf16 regimes remain
         # selectable — per table row, for chips whose polish-phase cost
         # structure differs, or explicitly.
-        mixed_store = (config.mixed_store if config.mixed_store != "auto"
-                       else _tuned(n, m, a.dtype).mixed_store)
+        mixed_store = _resolve_mixed_store(config, n, m, a.dtype)
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
         solve = _svd_pallas_donated if config.donate_input else _svd_pallas
@@ -1123,7 +1389,7 @@ def _plan_entry_batched(a, config: SVDConfig, *, compute_u: bool = True,
         raise ValueError("donate_input is not supported on the batched "
                          "entry points (the stacked working set aliases "
                          "no single member's buffer)")
-    if method == "pallas":
+    if method in _KERNEL_METHODS:
         if b % 2:
             b += 1
             k = max(1, -(-n // (2 * b)))
@@ -1150,6 +1416,11 @@ def _plan_entry_batched(a, config: SVDConfig, *, compute_u: bool = True,
             stall_detection=bool(config.stall_detection),
             refine=bool(refine),
             chaos_nan_sweep=_chaos.consume_nan_sweep())
+        if method == "block_rotation":
+            kwargs["apply_x3"] = (
+                _resolve_mixed_store(config, n, m, a.dtype) != "f32")
+            return ("block_rotation_batched", _svd_block_rotation_batched,
+                    a, kwargs)
         return "pallas_batched", _svd_pallas_batched, a, kwargs
     if config.precondition in ("on", "double") or config.mixed_bulk:
         bad = ("mixed_bulk=True" if config.mixed_bulk
@@ -1706,10 +1977,24 @@ class _SweepControlMixin:
         return None
 
     def _phase(self):
-        """(method, criterion, tol) for the next sweep, per current stage."""
+        """(method, criterion, tol) for the next sweep, per current stage.
+
+        Two methods run as host-visible bulk+polish stages: "hybrid"
+        (gram-eigh/abs bulk, qr-svd/rel polish — the XLA lane) and
+        "block_rotation" (eigh-accumulated block rounds against the abs
+        statistic, pallas-kernel polish — the MXU lane). Both share the
+        abs-criterion stall/tolerance machinery for the bulk stage."""
         if self._stage == "bulk":
+            if self.method == "block_rotation":
+                # The block lane's measured bulk exit (see
+                # `_BLOCK_BULK_TOL_FACTOR`): past ~10x the abs floor the
+                # eigh factors' backward error re-perturbs structure.
+                return ("block_rotation", "abs",
+                        _BLOCK_BULK_TOL_FACTOR * self.abs_tol)
             return "gram-eigh", "abs", self.abs_tol
         if self._stage == "polish":
+            if self.method == "block_rotation":
+                return "pallas", self.criterion, self.tol
             return "qr-svd", self.criterion, self.tol
         return self.method, self.criterion, self.tol
 
@@ -1775,7 +2060,7 @@ class SweepStepper(_SweepControlMixin):
         b, k = _plan(n, 1, config, m=m, dtype=a.dtype)
         (self.tol, self.gram_dtype_name, self.method,
          self.criterion) = _resolve_options(a, config, compute_uv=compute_u)
-        self._kernel_path = (self.method == "pallas"
+        self._kernel_path = (self.method in _KERNEL_METHODS
                              and self._host_kernel_path())
         if self._kernel_path:
             # Host-stepped sweeps on the SAME compiled kernels as the
@@ -1800,6 +2085,13 @@ class SweepStepper(_SweepControlMixin):
                 else config.precondition == "on")
             self._accumulate = (compute_u if self._precondition
                                 else compute_v)
+            # The block lane's bulk GEMMs honor the resolved mixed-store
+            # gate exactly like the fused planner (the stepper IS the
+            # serving dispatch — fused and served solves of one bucket
+            # must run the same arithmetic).
+            self._apply_x3 = (
+                self.method == "block_rotation"
+                and _resolve_mixed_store(config, n, m, a.dtype) != "f32")
             self._pc = None          # lazy (q1, order, work) cache
         else:
             # XLA block solvers for the non-kernel methods (and for mesh
@@ -1810,9 +2102,11 @@ class SweepStepper(_SweepControlMixin):
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
         self.abs_tol = _abs_phase_tol(a.dtype)
         self._prev_off = float("inf")
-        # Hybrid runs as two host-visible stages: "bulk" (gram-eigh/abs)
-        # then "polish" (qr-svd/rel). Non-hybrid methods have one stage.
-        self._stage = "bulk" if self.method == "hybrid" else "single"
+        # Hybrid and block_rotation run as two host-visible stages:
+        # "bulk" (abs statistic) then "polish" (rel criterion) — see
+        # `_SweepControlMixin._phase`. Other methods have one stage.
+        self._stage = ("bulk" if self.method in ("hybrid", "block_rotation")
+                       else "single")
         self._just_switched = False
         self._input_digest = None
         # Why the host loop stopped ("tol" | "stall" | "max_sweeps" |
@@ -1956,6 +2250,18 @@ class SweepStepper(_SweepControlMixin):
     def _run_sweep(self, state: SweepState, method, criterion) -> SweepState:
         """One jitted sweep — the only piece mesh subclasses override."""
         if self._kernel_path:
+            if method == "block_rotation":
+                # The blocked-rotation bulk stage: fully-solved 2b x 2b
+                # subproblems applied as one GEMM per pair; the polish
+                # stage falls through to the pallas step below. The skip
+                # threshold is the stage tolerance `_phase` reports.
+                top, bot, vtop, vbot, off = _sweep_step_block_jit(
+                    state.top, state.bot, state.vtop, state.vbot,
+                    jnp.float32(_BLOCK_BULK_TOL_FACTOR * self.abs_tol),
+                    with_v=self._accumulate, apply_x3=self._apply_x3,
+                    interpret=not pb.supported())
+                return SweepState(top, bot, vtop, vbot, off,
+                                  state.sweeps + 1)
             top, bot, vtop, vbot, off = _sweep_step_pallas_jit(
                 state.top, state.bot, state.vtop, state.vbot,
                 jnp.float32(self.tol), with_v=self._accumulate,
@@ -2146,6 +2452,17 @@ class SweepStepper(_SweepControlMixin):
             else:
                 vtop_s = vbot_s = jax.ShapeDtypeStruct(
                     (k, 0, top_s.shape[2]), self.input_dtype)
+            if self.method == "block_rotation":
+                # The block lane's bulk stage compiles its own sweep
+                # entry; the polish stage's pallas entry follows below —
+                # two sweep programs per bucket, like the hybrid XLA
+                # lane's two phases.
+                entries.append((
+                    "solver._sweep_step_block_jit", _sweep_step_block_jit,
+                    (top_s, bot_s, vtop_s, vbot_s, f32s),
+                    dict(with_v=self._accumulate,
+                         apply_x3=self._apply_x3,
+                         interpret=not pb.supported())))
             entries.append((
                 "solver._sweep_step_pallas_jit", _sweep_step_pallas_jit,
                 (top_s, bot_s, vtop_s, vbot_s, f32s),
@@ -2320,6 +2637,27 @@ def _sweep_step_pallas_jit(top, bot, vtop, vbot, rtol, *, with_v, polish,
     return top, bot, vtop, vbot, off
 
 
+@partial(jax.jit, static_argnames=("with_v", "apply_x3", "interpret"))
+def _sweep_step_block_jit(top, bot, vtop, vbot, rtol, *, with_v, apply_x3,
+                          interpret):
+    """One blocked-rotation BULK sweep for the host-stepped API
+    (`SweepStepper` with pair_solver="block_rotation", stage "bulk"):
+    the same `ops.rounds.sweep_block` the fused solver loops, with the
+    per-sweep dmax2 deflation scale recomputed here. ``rtol`` is the
+    abs-statistic round-skip threshold (the stage's abs tolerance) and
+    ``apply_x3`` the resolved mixed-store gate — the stepper resolves it
+    exactly as the fused planner does, so fused and served solves of one
+    bucket run the same arithmetic; the polish stage runs
+    `_sweep_step_pallas_jit` unchanged."""
+    dmax2 = _global_dmax2(top, bot)
+    top, bot, nvt, nvb, off = rounds.sweep_block(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        dmax2, rtol, interpret=interpret, apply_x3=apply_x3)
+    if with_v:
+        vtop, vbot = nvt, nvb
+    return top, bot, vtop, vbot, off
+
+
 def _finish_pallas_one(top, bot, vtop, vbot, work, q1, order, *, n,
                        compute_u, compute_v, full_u, precondition, refine):
     """Kernel-path postprocessing + recombination (+ sigma refinement) for
@@ -2380,6 +2718,24 @@ def _sweep_step_pallas_batched_jit(top, bot, vtop, vbot, rtol, *, batch,
         top, bot, vtop if with_v else None, vbot if with_v else None,
         dmax2, rtol, interpret=interpret, polish=polish, bf16_gram=False,
         batch=batch)
+    if with_v:
+        vtop, vbot = nvt, nvb
+    return top, bot, vtop, vbot, off
+
+
+@partial(jax.jit, static_argnames=("batch", "with_v", "apply_x3",
+                                   "interpret"))
+def _sweep_step_block_batched_jit(top, bot, vtop, vbot, rtol, *, batch,
+                                  with_v, apply_x3, interpret):
+    """One blocked-rotation bulk sweep of a stacked (B*k, m, b) batch
+    (`BatchedSweepStepper` stage "bulk"): `rounds.sweep_block` with the
+    block-diagonal batched schedule; per-member (B,) dmax2/off vectors
+    on the ABS statistic. ``apply_x3``: the resolved mixed-store gate
+    (see `_sweep_step_block_jit`)."""
+    dmax2 = _global_dmax2(top, bot, batch=batch)
+    top, bot, nvt, nvb, off = rounds.sweep_block(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        dmax2, rtol, interpret=interpret, apply_x3=apply_x3, batch=batch)
     if with_v:
         vtop, vbot = nvt, nvb
     return top, bot, vtop, vbot, off
@@ -2516,7 +2872,7 @@ class BatchedSweepStepper(_SweepControlMixin):
         (self.tol, self.gram_dtype_name, self.method,
          self.criterion) = _resolve_options(a[0], config,
                                             compute_uv=compute_u)
-        self._kernel_path = self.method == "pallas"
+        self._kernel_path = self.method in _KERNEL_METHODS
         if self._kernel_path:
             if config.mixed_bulk or config.bulk_bf16:
                 raise ValueError("mixed_bulk/bulk_bf16 are fused-solver "
@@ -2535,6 +2891,11 @@ class BatchedSweepStepper(_SweepControlMixin):
                 else config.precondition == "on")
             self._accumulate = (compute_u if self._precondition
                                 else compute_v)
+            # Resolved mixed-store gate for the block lane's bulk GEMMs
+            # (cf. SweepStepper.__init__).
+            self._apply_x3 = (
+                self.method == "block_rotation"
+                and _resolve_mixed_store(config, n, m, a.dtype) != "f32")
             self._pc = None
         else:
             (self.tol, self.gram_dtype_name, self.method,
@@ -2542,7 +2903,8 @@ class BatchedSweepStepper(_SweepControlMixin):
                                                     compute_uv=compute_u)
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
         self.abs_tol = _abs_phase_tol(a.dtype)
-        self._stage = "bulk" if self.method == "hybrid" else "single"
+        self._stage = ("bulk" if self.method in ("hybrid", "block_rotation")
+                       else "single")
         self._just_switched = False
         # Per-member host bookkeeping: stop reason (None = live), frozen
         # sweep count and off-norm at the member's stopping boundary.
@@ -2610,7 +2972,13 @@ class BatchedSweepStepper(_SweepControlMixin):
             off = np.asarray(state.off_rel, np.float64)
             live = np.array([r is None for r in self._done])
             self._prev_off = np.where(live, off, self._prev_off)
-        if self._kernel_path:
+        if self._kernel_path and method == "block_rotation":
+            top, bot, vtop, vbot, off = _sweep_step_block_batched_jit(
+                state.top, state.bot, state.vtop, state.vbot,
+                jnp.float32(_BLOCK_BULK_TOL_FACTOR * self.abs_tol),
+                batch=self.batch, with_v=self._accumulate,
+                apply_x3=self._apply_x3, interpret=not pb.supported())
+        elif self._kernel_path:
             top, bot, vtop, vbot, off = _sweep_step_pallas_batched_jit(
                 state.top, state.bot, state.vtop, state.vbot,
                 jnp.float32(self.tol), batch=self.batch,
@@ -2841,6 +3209,17 @@ class BatchedSweepStepper(_SweepControlMixin):
             else:
                 vtop_s = vbot_s = jax.ShapeDtypeStruct(
                     (self.batch * k, 0, top_s.shape[2]), self.input_dtype)
+            if self.method == "block_rotation":
+                # Bulk-stage sweep entry of the block lane (the polish
+                # stage's pallas entry follows) — cf. the single
+                # stepper's aot_entries.
+                entries.append((
+                    "solver._sweep_step_block_batched_jit",
+                    _sweep_step_block_batched_jit,
+                    (top_s, bot_s, vtop_s, vbot_s, f32s),
+                    dict(batch=self.batch, with_v=self._accumulate,
+                         apply_x3=self._apply_x3,
+                         interpret=not pb.supported())))
             entries.append((
                 "solver._sweep_step_pallas_batched_jit",
                 _sweep_step_pallas_batched_jit,

@@ -88,7 +88,8 @@ def _build_config(base, knobs: Dict[str, object]):
               "criterion"):
         if k in knobs:
             updates[k] = knobs[k]
-    if updates.get("pair_solver", "auto") not in ("auto", "pallas"):
+    if updates.get("pair_solver", "auto") not in ("auto", "pallas",
+                                                  "block_rotation"):
         # Preconditioning is a Pallas-path mode; pinning "on" onto an
         # explicit XLA solver is a validation error, not a grid point.
         if updates.get("precondition", "auto") in ("on", "double"):
@@ -156,7 +157,8 @@ def _axes(n: int, dtype: str, baseline: Dict[str, object],
     if smoke:
         # The documented smoke grid: 2 knob axes, tiny value sets.
         axes = [("block_size", [b for b in (4, 8) if b <= max(1, n // 2)]),
-                ("pair_solver", (["pallas"] if pallas_routed else [])
+                ("pair_solver", (["pallas", "block_rotation"]
+                                 if pallas_routed else [])
                  + ["qr-svd"])]
         return [(k, [v for v in vs if v != baseline.get(k)])
                 for k, vs in axes]
@@ -166,9 +168,11 @@ def _axes(n: int, dtype: str, baseline: Dict[str, object],
     # gram-eigh is offered only where U orthogonality is not at stake —
     # it converges to the absolute class only (ops.blockwise), so a
     # measured table must never route compute_uv solves onto it.
+    # block_rotation shares the kernel lane's capability window (f32-only
+    # rotations, min(m, n) >= 64 to block usefully).
     solver_axis = (["qr-svd"] if f64
-                   else (["pallas", "hybrid", "qr-svd"] if n >= 64
-                         else ["hybrid", "qr-svd"]))
+                   else (["pallas", "block_rotation", "hybrid", "qr-svd"]
+                         if n >= 64 else ["hybrid", "qr-svd"]))
     axes = [
         ("block_size", block_axis),
         ("pair_solver", solver_axis),

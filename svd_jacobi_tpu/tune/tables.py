@@ -79,7 +79,8 @@ K_CLASSES = ("none", "small", "medium", "large")
 _MATCH_KEYS = ("n_class", "aspect", "dtype", "backend", "device_kind",
                "k_class")
 _VALID_MIXED_STORE = ("f32", "bf16", "bf16g")
-_VALID_PAIR_SOLVER = ("pallas", "qr-svd", "gram-eigh", "hybrid")
+_VALID_PAIR_SOLVER = ("pallas", "block_rotation", "qr-svd", "gram-eigh",
+                      "hybrid")
 # "double" (dgejsv's second QR) is deliberately NOT a table value: it is
 # a fused-single-solve-only mode the stepper/batched/mesh lanes cannot
 # run, so a row pinning it would make the fused and served solves of the

@@ -27,6 +27,14 @@ class ValidationReport(NamedTuple):
     # (including the reference's U = A Sigma^{-1},
     # lib/JacobiMethods.cu:1156-1173), so this is the meaningful metric.
     u_orth_live: Optional[jax.Array] = None
+    # The same live-column metric for V. The factor read off the rotated
+    # COLUMNS depends on the solver lane's bookkeeping: the XLA block
+    # solvers read U off columns (hence u_orth_live), the preconditioned
+    # kernel lanes read V off them — on numerically singular input the
+    # column-side factor's dead columns are noise whichever side it is,
+    # and abs-class bulk lanes (hybrid, gram-eigh, block_rotation) leave
+    # them unorthogonalized by construction.
+    v_orth_live: Optional[jax.Array] = None
 
     def as_dict(self):
         return {k: (None if v is None else float(v)) for k, v in self._asdict().items()}
@@ -115,4 +123,5 @@ def validate(a, result, s_ref=None) -> ValidationReport:
         v_orth=orthogonality_error(v) if v is not None else None,
         sigma_err=sigma_error(s, s_ref) if s_ref is not None else None,
         u_orth_live=live_orthogonality_error(u, s) if u is not None else None,
+        v_orth_live=live_orthogonality_error(v, s) if v is not None else None,
     )

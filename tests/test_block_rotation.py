@@ -1,0 +1,366 @@
+"""The MXU-native blocked-rotation lane (pair_solver="block_rotation").
+
+Covers the PR's acceptance surface: the accumulated subproblem factor J
+is orthogonal to lane tolerance, the lane's sigma/U/V match the existing
+pallas lane and the f64 oracle on gap/flat/decaying spectra, a NaN member
+still decodes NONFINITE through the batched lane, the serving steppers
+and the two-phase sigma/promote flow run the lane end to end, the new
+jits keep the once-per-bucket compile contract (RETRACE001), and the
+analysis ledger covers the lane (AOT001 bijection + seeded unbudgeted
+fixture, zero-collective HLO budget, tune axis/table validity).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig, solver
+from svd_jacobi_tpu.ops import block_rotate, rounds
+from svd_jacobi_tpu.resilience import chaos
+
+CFG = SVDConfig(pair_solver="block_rotation", block_size=16)
+
+
+def _spectrum_matrix(n, spec, seed=7, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    if spec == "gap":
+        sv = np.concatenate([np.ones(4) * 100.0, np.ones(n - 4)])
+    elif spec == "flat":
+        sv = np.ones(n)
+    else:  # decaying
+        sv = np.exp(-np.arange(n) / (n / 8))
+    qa, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    qb, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return jnp.asarray((qa * sv) @ qb.T, dtype)
+
+
+class TestAccumulate:
+    def test_factor_orthogonal_and_diagonalizing(self):
+        """J is orthogonal to the f32 Newton-Schulz floor and J^T G J is
+        diagonal to the subproblem solve's absolute class."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 48, 32)).astype(np.float32)
+        g = jnp.asarray(np.einsum("kmi,kmj->kij", x, x))
+        j = block_rotate.accumulate(g)
+        jtj = np.einsum("kij,kil->kjl", np.asarray(j), np.asarray(j))
+        eye = np.eye(32)[None]
+        assert np.max(np.abs(jtj - eye)) < 5e-6
+        rot = np.einsum("kij,kil,klm->kjm", np.asarray(j),
+                        np.asarray(g, np.float64), np.asarray(j))
+        off = rot - np.eye(32)[None] * np.diagonal(rot, axis1=1, axis2=2)[
+            :, None, :] * np.eye(32)[None]
+        off = rot * (1.0 - np.eye(32))[None]
+        scale = np.max(np.abs(rot))
+        assert np.max(np.abs(off)) / scale < 5e-5
+
+    def test_apply_factor_matches_concat_matmul(self):
+        rng = np.random.default_rng(1)
+        top = jnp.asarray(rng.standard_normal((2, 40, 8)), jnp.float32)
+        bot = jnp.asarray(rng.standard_normal((2, 40, 8)), jnp.float32)
+        x = rng.standard_normal((2, 24, 16)).astype(np.float32)
+        j = block_rotate.accumulate(
+            jnp.asarray(np.einsum("kmi,kmj->kij", x, x)))
+        nt, nb, _, _ = block_rotate.apply_factor(top, bot, None, None, j)
+        ref = np.einsum("kmi,kij->kmj",
+                        np.concatenate([top, bot], axis=-1), np.asarray(j))
+        got = np.concatenate([np.asarray(nt), np.asarray(nb)], axis=-1)
+        np.testing.assert_allclose(got, ref, rtol=0, atol=1e-5)
+
+    def test_abs_panel_stats_segmented(self):
+        """The abs-criterion stats segment per member: one member's huge
+        coupling never enters a neighbor's statistic."""
+        g = np.tile(np.eye(4, dtype=np.float32)[None], (4, 1, 1))
+        g[0, 0, 1] = g[0, 1, 0] = 3.0     # member 0's panels: 0, 1
+        g = jnp.asarray(g)
+        dmax2 = jnp.asarray([1.0, 1.0], jnp.float32)
+        stat, skip = rounds.panel_stats(
+            g, dmax2, members=rounds._members(2, 2), criterion="abs")
+        assert np.asarray(stat).shape == (2,)
+        assert float(stat[0]) == pytest.approx(3.0)
+        assert float(stat[1]) == pytest.approx(0.0)
+        np.testing.assert_array_equal(np.asarray(stat), np.asarray(skip))
+
+
+class TestLaneAccuracy:
+    @pytest.mark.parametrize("spec", ["gap", "flat", "decaying"])
+    def test_matches_pallas_and_oracle(self, spec):
+        """sigma/U/V of the block lane match the pallas lane and the f64
+        oracle on gap/flat/decaying spectra (f32 input, f64 oracle)."""
+        n = 96
+        a = _spectrum_matrix(n, spec)
+        r = sj.svd(a, config=CFG)
+        # STAGNATED = the stall detector found the criterion's roundoff
+        # floor above the requested tol — a legitimate terminal state on
+        # gap spectra (the accuracy asserts below are the contract).
+        assert r.status_enum().name in ("OK", "STAGNATED")
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        serr = np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0]
+        assert serr < 2e-6
+        u, s, v = (np.asarray(r.u, np.float64), np.asarray(r.s, np.float64),
+                   np.asarray(r.v, np.float64))
+        res = np.linalg.norm(np.asarray(a, np.float64) - (u * s) @ v.T)
+        assert res / np.linalg.norm(a) < 5e-6
+        assert np.max(np.abs(u.T @ u - np.eye(n))) < 5e-5
+        assert np.max(np.abs(v.T @ v - np.eye(n))) < 5e-5
+        rp = sj.svd(a, config=SVDConfig(pair_solver="pallas", block_size=16))
+        np.testing.assert_allclose(np.asarray(r.s), np.asarray(rp.s),
+                                   rtol=1e-5, atol=1e-5 * float(s_ref[0]))
+
+    def test_singular_input_contract(self):
+        """The reference's numerically singular triangular benchmark
+        input: sigma matches the f64 oracle, U (the rotation-product
+        side) and the LIVE columns of V are orthonormal. Dead-column V
+        directions are noise — the documented caveat the lane shares
+        with the abs-class XLA lanes (hybrid/gram-eigh show the same on
+        their column-read factor, U), measured by the validator's new
+        ``v_orth_live``."""
+        from svd_jacobi_tpu.utils import matgen, validation
+        a = matgen.random_upper_triangular(128, seed=3)
+        r = sj.svd(a, config=CFG)
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        rep = validation.validate(a, r, s_ref=s_ref).as_dict()
+        assert rep["sigma_err"] < 2e-6
+        assert rep["u_orth"] < 1e-3
+        assert rep["v_orth_live"] < 1e-3
+        assert rep["residual_rel"] < 1e-4
+
+    def test_wide_input_transposes(self):
+        rng = np.random.default_rng(5)
+        a = jnp.asarray(rng.standard_normal((64, 96)), jnp.float32)
+        r = sj.svd(a, config=CFG)
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / \
+            s_ref[0] < 2e-6
+        assert r.u.shape == (64, 64) and r.v.shape == (96, 64)
+
+    def test_batched_matches_oracle_and_isolates_nan_member(self):
+        """The batched lane: per-member sigmas match the oracle; a
+        chaos-poisoned member decodes NONFINITE with OK neighbors."""
+        rng = np.random.default_rng(9)
+        stack = jnp.stack([jnp.asarray(rng.standard_normal((64, 64)),
+                                       jnp.float32) for _ in range(3)])
+        cfg = SVDConfig(pair_solver="block_rotation", block_size=16)
+        r = solver.svd_batched(stack, config=cfg)
+        for i in range(3):
+            assert int(r.status[i]) == int(solver.SolveStatus.OK)
+            s_ref = np.linalg.svd(np.asarray(stack[i], np.float64),
+                                  compute_uv=False)
+            assert np.max(np.abs(np.asarray(r.s[i], np.float64) - s_ref)) \
+                / s_ref[0] < 2e-6
+        with chaos.nan_at_sweep(1):
+            rn = solver.svd_batched(stack, config=cfg)
+        assert int(rn.status[0]) == int(solver.SolveStatus.NONFINITE)
+        assert int(rn.status[1]) == int(solver.SolveStatus.OK)
+        assert int(rn.status[2]) == int(solver.SolveStatus.OK)
+
+    def test_chaos_nan_decodes_nonfinite_fused_and_stepped(self):
+        rng = np.random.default_rng(11)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        with chaos.nan_at_sweep(1):
+            r = sj.svd(a, config=CFG)
+        assert r.status_enum() is solver.SolveStatus.NONFINITE
+
+
+class TestSteppers:
+    def test_stepper_matches_fused(self):
+        rng = np.random.default_rng(13)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        rf = sj.svd(a, config=CFG)
+        st = solver.SweepStepper(a, config=CFG)
+        assert st._kernel_path and st.method == "block_rotation"
+        assert st.phase_info().stage == "bulk"
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        rs = st.finish(state)
+        assert rs.status_enum().name == "OK"
+        np.testing.assert_allclose(np.asarray(rs.s), np.asarray(rf.s),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_sigma_promote_flow(self):
+        """Two-phase serving inherits the lane: sigma_finish defers the
+        finish stage and finish_from_payload resumes it exactly."""
+        rng = np.random.default_rng(17)
+        a = jnp.asarray(rng.standard_normal((96, 64)), jnp.float32)
+        st = solver.SweepStepper(a, config=CFG)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        full = st.finish(state)
+        sig, payload = st.sigma_finish(state)
+        assert payload["promotable"]
+        np.testing.assert_allclose(np.asarray(sig.s), np.asarray(full.s),
+                                   rtol=1e-4, atol=1e-4)
+        promoted = solver.finish_from_payload(payload)
+        np.testing.assert_allclose(np.asarray(promoted.s),
+                                   np.asarray(full.s), rtol=0, atol=0)
+
+    def test_fused_round_matches_unfused(self):
+        """The gram-carried fused block round (eigh + one fused
+        apply/exchange/gram kernel, interpret mode here) equals the
+        unfused round + a fresh gram of the exchanged stacks."""
+        rng = np.random.default_rng(29)
+        k, m, b = 4, 96, 8
+        top = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+        bot = jnp.asarray(rng.standard_normal((k, m, b)), jnp.float32)
+        x = jnp.concatenate([top, bot], axis=-1)
+        g = jnp.einsum("kmi,kmj->kij", x, x,
+                       precision=jax.lax.Precision.HIGHEST)
+        dmax2 = rounds._global_dmax2(top, bot)
+        rtol = jnp.float32(1e-5)
+        ft, fb, _, _, fg, fstat = rounds.block_round_fused(
+            top, bot, None, None, g, dmax2, rtol, interpret=True)
+        ut, ub, _, _, ustat = rounds.block_round(
+            top, bot, None, None, dmax2, rtol, interpret=True)
+        np.testing.assert_allclose(np.asarray(ft), np.asarray(ut),
+                                   rtol=0, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(fb), np.asarray(ub),
+                                   rtol=0, atol=2e-5)
+        assert float(fstat) == pytest.approx(float(ustat))
+        xg = jnp.concatenate([ut, ub], axis=-1)
+        g_ref = jnp.einsum("kmi,kmj->kij", xg, xg,
+                           precision=jax.lax.Precision.HIGHEST)
+        np.testing.assert_allclose(np.asarray(fg), np.asarray(g_ref),
+                                   rtol=0, atol=5e-4)
+
+    def test_mesh_stepper_falls_back_to_pallas(self):
+        """The sharded stepper maps block_rotation to the pallas kernel
+        lane with SINGLE-stage machinery (the mesh never runs the block
+        bulk; without the fallback the bulk/polish stage machine would
+        drive abs bookkeeping over rel sharded sweeps)."""
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device (virtual CPU) mesh")
+        from svd_jacobi_tpu.parallel import sharded
+        rng = np.random.default_rng(31)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        st = sharded.SweepStepper(a, mesh=sharded.make_mesh(),
+                                  config=SVDConfig(
+                                      pair_solver="block_rotation"))
+        assert st.method == "pallas"
+        assert st.phase_info().stage == "single"
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        r = st.finish(state)
+        assert r.status_enum().name == "OK"
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / \
+            s_ref[0] < 2e-6
+
+    def test_aot_entries_cover_both_stages(self):
+        rng = np.random.default_rng(19)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        st = solver.SweepStepper(a, config=CFG)
+        names = [n for n, _, _, _ in st.aot_entries()]
+        assert "solver._sweep_step_block_jit" in names
+        assert "solver._sweep_step_pallas_jit" in names
+        stack = jnp.stack([a, a])
+        bst = solver.BatchedSweepStepper(stack, config=CFG)
+        bnames = [n for n, _, _, _ in bst.aot_entries()]
+        assert "solver._sweep_step_block_batched_jit" in bnames
+        assert "solver._sweep_step_pallas_batched_jit" in bnames
+
+
+class TestValidation:
+    """criterion="abs" + the block lane routes/raises consistently with
+    the pallas guard (the PR's bugfix satellite; cf.
+    test_regimes.test_abs_criterion_pallas_validation)."""
+
+    def test_abs_criterion_rejected_like_pallas(self):
+        rng = np.random.default_rng(21)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        with pytest.raises(ValueError, match="criterion='abs'"):
+            sj.svd(a, config=SVDConfig(pair_solver="block_rotation",
+                                       criterion="abs"))
+        with pytest.raises(ValueError, match="criterion='abs'"):
+            solver.SweepStepper(a, config=SVDConfig(
+                pair_solver="block_rotation", criterion="abs"))
+        # auto + abs routes to an abs-capable XLA solver instead: the
+        # table may propose either kernel lane, and the capability guard
+        # must coerce BOTH away from an unsatisfiable abs request.
+        assert solver._resolve_options(
+            a, SVDConfig(criterion="abs"), True)[2] == "hybrid"
+
+    def test_pallas_only_modes_rejected(self):
+        rng = np.random.default_rng(23)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        with pytest.raises(ValueError, match="mixed_bulk"):
+            sj.svd(a, config=SVDConfig(pair_solver="block_rotation",
+                                       mixed_bulk=True))
+        with pytest.raises(ValueError, match="double"):
+            sj.svd(a, config=SVDConfig(pair_solver="block_rotation",
+                                       precondition="double"))
+
+    def test_f64_rejected(self):
+        with pytest.raises(ValueError, match="float32"):
+            solver._resolve_options(
+                jnp.zeros((8, 8), jnp.float64),
+                SVDConfig(pair_solver="block_rotation"), True)
+
+
+class TestAnalysisLedger:
+    def test_retrace_once_per_problem(self):
+        """Once-per-bucket compiles for the new jits: two shapes, two
+        solves each — the repeats must be pure cache hits."""
+        from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+        rng = np.random.default_rng(27)
+        mats = {n: jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+                for n in (48, 64)}
+        cfg = SVDConfig(pair_solver="block_rotation", block_size=8,
+                        max_sweeps=8)
+        with RecompileGuard() as guard:
+            guard.expect("solver._svd_block_rotation", problems=2)
+            for n, a in mats.items():
+                jax.block_until_ready(sj.svd(a, config=cfg).s)
+                jax.block_until_ready(sj.svd(a, config=cfg).s)
+        assert guard.check() == []
+        traces = guard.new_traces()
+        assert traces["solver._svd_block_rotation"] == 2
+
+    def test_aot001_bijection_and_seeded_unbudgeted_entry(self):
+        """The registry/budget bijection covers the lane, and dropping a
+        block-rotation budget fires AOT001 naming the unbudgeted entry
+        (the seeded fixture)."""
+        from svd_jacobi_tpu import config as _config
+        from svd_jacobi_tpu.analysis import aot_checks
+        from svd_jacobi_tpu.serve import registry
+        assert "solver._svd_block_rotation" in registry.jit_entries()
+        assert aot_checks.check_budget_coverage() == []
+        budgets = {k: v for k, v in _config.RETRACE_BUDGETS.items()
+                   if k != "solver._svd_block_rotation"}
+        findings = aot_checks.check_budget_coverage(budgets=budgets)
+        assert [f.code for f in findings] == ["AOT001"]
+        assert findings[0].where == "solver._svd_block_rotation"
+
+    def test_zero_collective_hlo_budget(self):
+        """COLLECTIVE_BUDGET["pallas_block_rotation"]: the lowered fused
+        entry carries no collectives of any kind."""
+        from svd_jacobi_tpu.analysis import entries, hlo_checks
+        probes = {p.name: p
+                  for p in entries.single_device_probes(include_f64=False)}
+        assert "pallas_block_rotation" in probes
+        assert hlo_checks.check_collective_budget(
+            probes["pallas_block_rotation"]) == []
+
+    def test_tune_axis_and_table_validity(self):
+        """block_rotation is a valid table knob value and rides the
+        capability-filtered search axis exactly where the kernel lane
+        does (f32, n >= 64)."""
+        from svd_jacobi_tpu.tune import search, tables
+        t = tables.TuningTable.from_payload({
+            "schema_version": tables.SCHEMA_VERSION,
+            "table_id": "t", "rows": [
+                {"match": {"n_class": "medium"},
+                 "knobs": {"pair_solver": "block_rotation"}}],
+        }, verify_hash=False)
+        assert t.resolve(2048, dtype="float32", backend="cpu",
+                         device_kind="cpu").pair_solver == "block_rotation"
+        axes = dict(search._axes(512, "float32", {}, smoke=False))
+        assert "block_rotation" in axes["pair_solver"]
+        axes_f64 = dict(search._axes(512, "float64", {}, smoke=False))
+        assert "block_rotation" not in axes_f64["pair_solver"]
+        axes_tiny = dict(search._axes(32, "float32", {}, smoke=False))
+        assert "block_rotation" not in axes_tiny["pair_solver"]

@@ -275,6 +275,19 @@ def test_abs_criterion_pallas_validation():
     with pytest.raises(ValueError, match="criterion='abs'"):
         solver.SweepStepper(a, config=SVDConfig(pair_solver="pallas",
                                                 criterion="abs"))
+    # The blocked-rotation lane terminates on the same rel statistic (its
+    # abs statistic is an internal bulk control, not the convergence
+    # contract): an explicit abs request must raise the SAME way, on
+    # every dispatch surface — fused, stepper, batched.
+    with pytest.raises(ValueError, match="criterion='abs'"):
+        sj.svd(a, config=SVDConfig(pair_solver="block_rotation",
+                                   criterion="abs"))
+    with pytest.raises(ValueError, match="criterion='abs'"):
+        solver.SweepStepper(a, config=SVDConfig(
+            pair_solver="block_rotation", criterion="abs"))
+    with pytest.raises(ValueError, match="criterion='abs'"):
+        solver.svd_batched(a[None], config=SVDConfig(
+            pair_solver="block_rotation", criterion="abs"))
     # auto + abs: picks an abs-capable solver and converges.
     r = sj.svd(a, config=SVDConfig(criterion="abs"))
     assert r.status_enum().name == "OK"

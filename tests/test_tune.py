@@ -246,6 +246,24 @@ class TestShippedTableVerdicts:
         r = shipped.resolve(512, m=512, dtype="float64", **self.V5E)
         assert r.pair_solver == "qr-svd"
 
+    def test_cpu_medium_square_routes_block_rotation(self, shipped):
+        # r03 (PROFILE.md item 29): the blocked-rotation lane wins the
+        # CPU medium square class; TPU classes and the CPU small class
+        # keep the pallas kernel lane (fallback semantics).
+        cpu = {"backend": "cpu", "device_kind": "cpu"}
+        assert shipped.resolve(2048, m=2048, dtype="float32",
+                               **cpu).pair_solver == "block_rotation"
+        assert shipped.resolve(4096, m=4096, dtype="float32",
+                               **cpu).pair_solver == "block_rotation"
+        # Narrow verdict: tall aspect, the small class, and every TPU
+        # class stay on the measured pallas default.
+        assert shipped.resolve(2048, m=65536, dtype="float32",
+                               **cpu).pair_solver == "pallas"
+        assert shipped.resolve(512, m=512, dtype="float32",
+                               **cpu).pair_solver == "pallas"
+        assert shipped.resolve(2048, m=2048, dtype="float32",
+                               **self.V5E).pair_solver == "pallas"
+
     def test_solver_consumes_shipped_verdicts(self):
         """End-to-end: `_plan_entry` on a (spoofed-large) problem takes
         the table width. Exercised at the plan level (no 8192^2 solve on
