@@ -836,10 +836,203 @@ def _serve_coldstart(flags) -> None:
                          "its job")
 
 
+def _serve_federation(flags) -> None:
+    """--serve-federation: what does the replica router buy (PROFILE.md
+    item 30)? Three measurements over one seeded closed-loop mix:
+
+      rows 1-2: replicas=1 vs replicas=2 closed-loop throughput through
+        the SAME `serve.router.ReplicaRouter` front-end (+ a scaling
+        ratio row — on the 2-core CPU container the replicas share the
+        device, so this is an overhead statement, not a speed claim);
+      row 3 (availability): replicas=2 with the owner replica KILLED
+        mid-load — every request must still reach a terminal status,
+        the rescue count and the killed-window latency penalty are the
+        availability price of a replica death;
+      row 4: byte-identical resubmit end-to-end latency (the
+        consistent-hash ring must land it on the owner's result cache —
+        the admission fast-path behind the router).
+
+    Flags: --bucket=MxN:dtype (default 48x32:float32) --requests=N
+           --clients=C --deadline-s=D
+    """
+    import os
+    import tempfile
+    import threading
+
+    import jax
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from svd_jacobi_tpu.serve import as_bucket
+    bucket = as_bucket(flags.get("bucket", "48x32:float32"))
+    if bucket.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.resilience import chaos
+    from svd_jacobi_tpu.serve import (ReplicaRouter, RouterConfig,
+                                      ServeConfig)
+    from svd_jacobi_tpu.serve.cache import input_digest
+    from svd_jacobi_tpu.utils import matgen
+
+    requests = int(flags.get("requests", "32"))
+    clients = int(flags.get("clients", "8"))
+    deadline_s = float(flags.get("deadline-s", "600"))
+    mats = [np.asarray(matgen.random_dense(bucket.m - 4, bucket.n - 2,
+                                           seed=1000 + i,
+                                           dtype=jnp.dtype(bucket.dtype)))
+            for i in range(min(requests, 16))]
+
+    def build(n_replicas):
+        cfg = RouterConfig(
+            replicas=n_replicas,
+            serve=ServeConfig(
+                buckets=(bucket,), solver=SVDConfig(),
+                max_queue_depth=max(64, 2 * requests),
+                result_cache_bytes=64 << 20,
+                brownout_sigma_only_at=2.0, brownout_shed_at=2.0),
+            state_dir=tempfile.mkdtemp(prefix="svdj-fed-"),
+            supervise_interval_s=0.02, heartbeat_timeout_s=2.0,
+            probe_interval_s=0.25)
+        return ReplicaRouter(cfg).start()
+
+    def closed_loop(router, kill_at=None):
+        outcomes, lock, counter = [], threading.Lock(), [0]
+        killed = threading.Event()
+
+        def client(_cid):
+            while True:
+                with lock:
+                    i = counter[0]
+                    if i >= requests:
+                        return
+                    counter[0] += 1
+                if (kill_at is not None and i == kill_at
+                        and not killed.is_set()):
+                    killed.set()
+                    victim = router.ring.owner(bucket.name,
+                                               input_digest(mats[0]))
+                    router.replicas[victim].simulate_kill()
+                a = mats[i % len(mats)]
+                t0 = time.perf_counter()
+                try:
+                    res = router.submit(a, deadline_s=deadline_s).result(
+                        timeout=1800.0)
+                    ok = (res.error is None and res.status is not None
+                          and res.status.name == "OK")
+                    path = res.path
+                except Exception:
+                    ok, path = False, "raised"
+                dt = time.perf_counter() - t0
+                with lock:
+                    outcomes.append((dt, ok, path))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(max(1, clients))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=1800.0)
+        return outcomes, time.perf_counter() - t0
+
+    rows = {}
+    for n_replicas in (1, 2):
+        router = build(n_replicas)
+        try:
+            router.warmup(timeout=1800.0)
+            outcomes, wall = closed_loop(router)
+        finally:
+            router.stop(drain=True, timeout=60.0)
+        lat = sorted(d for d, _, _ in outcomes)
+        q = (lambda p: round(lat[min(len(lat) - 1,
+                                     int(p * len(lat)))] * 1e3, 2)
+             if lat else None)
+        row = {
+            "metric": f"serve_federation_{bucket.name}_r{n_replicas}",
+            "value": round(len(outcomes) / wall, 2),
+            "unit": "requests/s",
+            "replicas": n_replicas, "clients": clients,
+            "requests": len(outcomes),
+            "ok": sum(1 for _, ok, _ in outcomes if ok),
+            "p50_ms": q(0.50), "p99_ms": q(0.99),
+            "wall_s": round(wall, 3),
+            "device": str(jax.devices()[0]),
+        }
+        print(json.dumps(row))
+        rows[n_replicas] = row
+    if rows[1]["value"]:
+        print(json.dumps({
+            "metric": f"serve_federation_scaling_{bucket.name}",
+            "value": round(rows[2]["value"] / rows[1]["value"], 3),
+            "unit": "x vs 1 replica",
+            "ok": all(r["ok"] == r["requests"] for r in rows.values()),
+        }))
+
+    # Availability under replica death: kill the owner mid-load.
+    router = build(2)
+    try:
+        router.warmup(timeout=1800.0)
+        with chaos.slow_solve(0.05, shots=requests):
+            outcomes, wall = closed_loop(router, kill_at=requests // 3)
+        rescued = router.total_rescues
+        hz = router.healthz(probe_replicas=False)
+    finally:
+        router.stop(drain=True, timeout=60.0)
+    lat_ok = sorted(d for d, ok, _ in outcomes if ok)
+    q = (lambda p: round(lat_ok[min(len(lat_ok) - 1,
+                                    int(p * len(lat_ok)))] * 1e3, 2)
+         if lat_ok else None)
+    print(json.dumps({
+        "metric": f"serve_federation_kill_one_{bucket.name}",
+        "value": round(sum(1 for _, ok, _ in outcomes if ok)
+                       / max(1, len(outcomes)), 4),
+        "unit": "terminal-OK fraction under 1-of-2 replica death",
+        "requests": len(outcomes),
+        "ok": sum(1 for _, ok, _ in outcomes if ok),
+        "raised": sum(1 for _, _, p in outcomes if p == "raised"),
+        "rescued": rescued,
+        "p50_ms": q(0.50), "p99_ms": q(0.99),
+        "wall_s": round(wall, 3),
+        "replicas_active_after": hz["active"],
+    }))
+
+    # Resubmit-hits-owner latency: the cached fast path behind the ring.
+    router = build(2)
+    try:
+        router.warmup(timeout=1800.0)
+        a = mats[0]
+        router.submit(a, deadline_s=deadline_s).result(timeout=1800.0)
+        laps = []
+        for _ in range(16):
+            t0 = time.perf_counter()
+            res = router.submit(a, deadline_s=deadline_s).result(
+                timeout=60.0)
+            laps.append(time.perf_counter() - t0)
+            assert res.path == "cache", res.path
+    finally:
+        router.stop(drain=True, timeout=60.0)
+    laps.sort()
+    print(json.dumps({
+        "metric": f"serve_federation_resubmit_hit_{bucket.name}",
+        "value": round(laps[len(laps) // 2] * 1e3, 3),
+        "unit": "ms p50 end-to-end (byte-identical resubmit, cache hit "
+                "on the ring owner)",
+        "p99_ms": round(laps[-1] * 1e3, 3),
+        "laps": len(laps),
+    }))
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
                  for f in sys.argv[1:] if f.startswith("--"))
+    if "serve-federation" in flags:
+        _serve_federation(flags)
+        return
     if "serve-coldstart" in flags:
         _serve_coldstart(flags)
         return

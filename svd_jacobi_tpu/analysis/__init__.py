@@ -38,6 +38,13 @@ properties that decide whether those artifacts stay sane:
     table-resolved serving configs keep the once-per-bucket compile
     contract (reusing `recompile_guard` over a resolved-config serve
     sequence).
+  * `route_checks`  — the federated-router contract (ROUTE001):
+    consistent-hash routing is a pure function of (ring, bucket, input
+    digest) with the minimal-disruption property, byte-identical
+    resubmits map to the replica owning the cached result, and a
+    replica-death rescue keeps the once-per-bucket compile contract on
+    the receiving replica (a live two-replica kill-and-rescue drill
+    under `recompile_guard`).
   * `aot_checks`    — the entry-registry contract (AOT001):
     `config.RETRACE_BUDGETS` and the serving entry registry
     (`serve.registry.jit_entries`) enumerate EXACTLY the same entry

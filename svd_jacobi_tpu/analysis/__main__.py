@@ -21,7 +21,7 @@ import time
 from pathlib import Path
 
 PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot",
-              "obs")
+              "obs", "route")
 
 
 def _parse_args(argv):
@@ -88,6 +88,14 @@ def main(argv=None) -> int:
             # the registry's AOT plan dispatches is budgeted.
             from . import aot_checks
             findings, report = aot_checks.run_all()
+            return findings, report
+        if name == "route":
+            # The federated-router contract (ROUTE001): consistent-hash
+            # routing is deterministic given the ring + digest, and a
+            # replica-death rescue keeps the once-per-bucket compile
+            # contract on the receiving replica under RecompileGuard.
+            from . import route_checks
+            findings, report = route_checks.run_all()
             return findings, report
         if name == "obs":
             # The serving flight recorder's free-when-off contract

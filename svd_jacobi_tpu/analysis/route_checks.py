@@ -1,0 +1,268 @@
+"""ROUTE001 — the federated-router contract pass.
+
+Two load-bearing claims of `serve.router`, machine-checked against the
+real artifacts (the ring implementation and a live two-replica rescue):
+
+  1. ROUTING IS DETERMINISTIC: the consistent-hash ring is a pure
+     function of (replica set, vnodes, bucket, digest) — two
+     independently-built rings agree on every preference order, every
+     preference is a full permutation of the replica set (failover can
+     always walk somewhere), placement is SHA-256-positioned (identical
+     across processes and PYTHONHASHSEED), ownership spreads over the
+     replicas, and removing one replica remaps ONLY the keys it owned
+     (the consistent-hashing minimal-disruption property — a quarantine
+     must not reshuffle the healthy replicas' cache/compile locality).
+     Byte-identical resubmits (same `serve.cache.input_digest`) map to
+     the same owner, which is what keeps the result-cache admission
+     fast-path a sub-millisecond hit behind the router.
+  2. RESCUE KEEPS THE COMPILE CONTRACT: a replica-death rescue re-admits
+     the dead replica's journal debt onto the receiving replica, and
+     that dispatch must be a jit-cache HIT — the receiving replica
+     already compiled the bucket (shared persistent namespace + static
+     bucket shapes), so a rescue adds ZERO fresh traces. Proven live
+     under `RecompileGuard`: warm both replicas of a two-replica
+     in-process router on one bucket, kill the owner with a request
+     still queued, let the supervisor rescue it, and hold every
+     serving-path entry to a once-per-bucket budget across the WHOLE
+     sequence (warm + kill + rescue + re-serve).
+
+``run_all(seed_skew=True)`` is the seeded-violation fixture: it
+compares rings built with DIFFERENT vnode counts (a mis-deployed router
+fleet) and must fire rule 1 — demonstrated by tests/test_router.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from . import Finding
+
+CODE = "ROUTE001"
+
+_SAMPLE_BUCKETS = ("64x48:float32", "96x64:float32", "1024x1024:float32",
+                   "2048x256:float32:tall", "96x96:float32:topk8")
+
+
+def _sample_digests(n: int) -> List[str]:
+    return [hashlib.sha256(f"route001-sample-{i}".encode()).hexdigest()
+            for i in range(n)]
+
+
+def check_ring_determinism(replicas: Sequence[int] = (0, 1, 2),
+                           vnodes: int = 64, samples: int = 48,
+                           seed_skew: bool = False) -> List[Finding]:
+    """Rule 1 (see module docstring). ``seed_skew`` builds the second
+    ring with a different vnode count — the seeded violation the tests
+    prove this pass catches."""
+    from ..serve.router import HashRing
+    findings: List[Finding] = []
+    replicas = tuple(replicas)
+    ring_a = HashRing(replicas, vnodes=vnodes)
+    ring_b = HashRing(replicas,
+                      vnodes=(vnodes + 1) if seed_skew else vnodes)
+    digests = _sample_digests(samples)
+    owners: dict = {r: 0 for r in replicas}
+    mismatches = 0
+    for bi, bucket in enumerate(_SAMPLE_BUCKETS):
+        for digest in digests:
+            pa = ring_a.preference(bucket, digest)
+            pb = ring_b.preference(bucket, digest)
+            if pa != pb:
+                mismatches += 1
+            if sorted(pa) != sorted(replicas):
+                findings.append(Finding(
+                    code=CODE, where="serve.router.HashRing.preference",
+                    message=f"preference {pa} for ({bucket}, "
+                            f"{digest[:12]}) is not a permutation of the "
+                            f"replica set {replicas} — failover could "
+                            f"dead-end",
+                    suggestion="preference() must visit every replica "
+                               "exactly once in ring-walk order"))
+                break
+            owners[pa[0]] += 1
+        # Affinity fallback (no digest) must be deterministic too.
+        if ring_a.preference(bucket) != ring_b.preference(bucket):
+            mismatches += 1
+    if mismatches:
+        findings.append(Finding(
+            code=CODE, where="serve.router.HashRing",
+            message=f"two rings over the same replica set disagree on "
+                    f"{mismatches} of {len(_SAMPLE_BUCKETS) * samples} "
+                    f"sampled keys — routing is NOT a pure function of "
+                    f"(replica set, vnodes, bucket, digest)",
+            suggestion="ring construction must be deterministic "
+                       "(SHA-256 positions, no process state, identical "
+                       "vnode counts across the router fleet)"))
+    starved = [r for r, n in owners.items() if n == 0]
+    if starved and not findings:
+        findings.append(Finding(
+            code=CODE, where="serve.router.HashRing",
+            message=f"replicas {starved} own ZERO of "
+                    f"{len(_SAMPLE_BUCKETS) * samples} sampled keys — "
+                    f"the ring is not spreading ownership",
+            suggestion="raise ring_vnodes (placement variance shrinks "
+                       "as vnodes grow)"))
+    # Minimal-disruption: drop one replica; every key it did NOT own
+    # must keep its owner (quarantine must not reshuffle the healthy
+    # replicas' locality).
+    if len(replicas) > 1 and not seed_skew:
+        dropped = replicas[0]
+        reduced = HashRing([r for r in replicas if r != dropped],
+                           vnodes=vnodes)
+        moved = sum(
+            1 for bucket in _SAMPLE_BUCKETS for digest in digests
+            if ring_a.owner(bucket, digest) != dropped
+            and reduced.owner(bucket, digest) != ring_a.owner(bucket,
+                                                              digest))
+        if moved:
+            findings.append(Finding(
+                code=CODE, where="serve.router.HashRing",
+                message=f"removing replica {dropped} remapped {moved} "
+                        f"keys it never owned — consistent hashing's "
+                        f"minimal-disruption property is broken",
+                suggestion="only keys owned by the departed replica may "
+                           "move"))
+    return findings
+
+
+def check_resubmit_affinity() -> List[Finding]:
+    """Byte-identical resubmits compute the same digest and therefore
+    the same owner — the property that keeps the admission fast-path a
+    cache HIT behind the router (no numpy-copy or layout drift may leak
+    into the key)."""
+    import numpy as np
+
+    from ..serve.cache import input_digest
+    from ..serve.router import HashRing
+    findings: List[Finding] = []
+    ring = HashRing((0, 1, 2), vnodes=64)
+    rng1 = np.random.default_rng(7)
+    rng2 = np.random.default_rng(7)
+    a1 = rng1.standard_normal((48, 32)).astype(np.float32)
+    a2 = rng2.standard_normal((48, 32)).astype(np.float32)
+    d1, d2 = input_digest(a1), input_digest(np.asarray(a2, order="F"))
+    if d1 != d2:
+        findings.append(Finding(
+            code=CODE, where="serve.cache.input_digest",
+            message="byte-identical matrices (different memory layouts) "
+                    "digested differently — resubmits would miss their "
+                    "owner",
+            suggestion="input_digest must canonicalize layout "
+                       "(ascontiguousarray) before hashing"))
+    elif ring.owner("64x48:float32", d1) != ring.owner("64x48:float32",
+                                                       d2):
+        findings.append(Finding(
+            code=CODE, where="serve.router.HashRing",
+            message="equal digests routed to different owners",
+            suggestion="owner() must be a pure function of the key"))
+    return findings
+
+
+def run_rescue_case() -> tuple:
+    """Rule 2: the live two-replica rescue drill under `RecompileGuard`
+    (module docstring). Returns (findings, report)."""
+    import tempfile
+    import time
+
+    import jax.numpy as jnp
+
+    from ..config import SVDConfig
+    from ..resilience import chaos
+    from ..serve import ReplicaRouter, RouterConfig, ServeConfig
+    from ..utils import matgen
+    from .recompile_guard import RecompileGuard, _SERVE_ENTRIES
+
+    bucket = (64, 48, "float32")
+    cfg = RouterConfig(
+        replicas=2,
+        serve=ServeConfig(
+            buckets=(bucket,), solver=SVDConfig(pair_solver="pallas"),
+            max_queue_depth=16,
+            brownout_sigma_only_at=2.0, brownout_shed_at=2.0),
+        state_dir=tempfile.mkdtemp(prefix="route001-"),
+        supervise_interval_s=0.02,
+        heartbeat_timeout_s=1.0,
+        # No probe may run inside the guard window: a factor-free probe
+        # solve flips STATIC compute flags — a legitimate extra trace
+        # that would read as a false RETRACE001.
+        probe_interval_s=600.0)
+    findings: List[Finding] = []
+    report: dict = {}
+    with RecompileGuard() as guard:
+        for entry in _SERVE_ENTRIES:
+            # ONE bucket, shared in-process jit cache: every entry
+            # compiles once across BOTH replicas AND the rescue.
+            guard.expect(entry, problems=1)
+        router = ReplicaRouter(cfg).start()
+        try:
+            # Warm each replica: draw seeded matrices until both ring
+            # owners served one (deterministic — the ring is).
+            warmed = set()
+            seed = 0
+            while len(warmed) < 2 and seed < 64:
+                seed += 1
+                a = matgen.random_dense(60, 40, seed=seed,
+                                        dtype=jnp.float32)
+                t = router.submit(a, deadline_s=120.0)
+                res = t.result(timeout=600.0)
+                # The route record names the replica authoritatively.
+                routed = [rec for rec in router.records()
+                          if rec.get("event") == "route"
+                          and rec.get("request_id") == t.request_id]
+                warmed.add(routed[-1]["replica"])
+                if res.status is None or res.status.name != "OK":
+                    findings.append(Finding(
+                        code=CODE, where="route_checks.run_rescue_case",
+                        message=f"warm solve {t.request_id} not OK "
+                                f"({res.status}/{res.error})",
+                        suggestion="fix the serving path first"))
+            report["warmed_replicas"] = sorted(warmed)
+            # Kill the owner of one more matrix while its request is
+            # still queued behind a slowed solve; the supervisor must
+            # rescue it onto the surviving replica as a jit-cache HIT.
+            a_hold = matgen.random_dense(64, 48, seed=777,
+                                         dtype=jnp.float32)
+            a_kill = matgen.random_dense(62, 44, seed=778,
+                                         dtype=jnp.float32)
+            with chaos.slow_solve(0.25, shots=2):
+                t_hold = router.submit(a_hold, deadline_s=120.0)
+                t_kill = router.submit(a_kill, deadline_s=120.0)
+                routed = [rec for rec in router.records()
+                          if rec.get("event") == "route"]
+                victim_idx = routed[-1]["replica"]
+                time.sleep(0.05)
+                router.replicas[victim_idx].simulate_kill()
+                res_hold = t_hold.result(timeout=600.0)
+                res_kill = t_kill.result(timeout=600.0)
+            report["rescues"] = router.total_rescues
+            report["victim"] = victim_idx
+            for name, res in (("held", res_hold), ("killed", res_kill)):
+                ok = (res.error is None and res.status is not None
+                      and res.status.name == "OK")
+                report[f"{name}_status"] = (res.status.name
+                                            if res.status else res.error)
+                if not ok:
+                    findings.append(Finding(
+                        code=CODE, where="route_checks.run_rescue_case",
+                        message=f"{name} request did not survive the "
+                                f"replica death rescued-OK "
+                                f"(status={report[f'{name}_status']})",
+                        suggestion="the rescue must re-admit journal "
+                                   "debt on a healthy replica"))
+        finally:
+            router.stop(drain=True, timeout=60.0)
+        findings += guard.check()
+        report.update(guard.report())
+    return findings, report
+
+
+def run_all(seed_skew: bool = False) -> tuple:
+    """The whole ROUTE001 pass. Returns (findings, report)."""
+    findings = check_ring_determinism(seed_skew=seed_skew)
+    findings += check_resubmit_affinity()
+    report: dict = {"seed_skew": bool(seed_skew)}
+    rescue_findings, rescue_report = run_rescue_case()
+    findings += rescue_findings
+    report["rescue"] = rescue_report
+    return findings, report

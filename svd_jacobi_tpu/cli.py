@@ -234,6 +234,12 @@ def _parse_serve_args(argv):
                    help="bounded batching window: max wait for same-"
                         "bucket followers after the first pop (only with "
                         "--max-batch > 1)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="service REPLICAS behind a federated "
+                        "ReplicaRouter (each its own fault domain with "
+                        "its own journal; consistent-hash routing, "
+                        "replica-death journal rescue). 1 = a plain "
+                        "single service (default)")
     p.add_argument("--lanes", type=int, default=1,
                    help="solve lanes (fleet mode when > 1): one worker "
                         "per lane, per-lane fault domains with bucket-"
@@ -339,7 +345,35 @@ def serve_demo(argv) -> int:
                       lanes=max(1, args.lanes),
                       journal_path=args.journal,
                       compile_cache_dir=args.compile_cache)
-    svc = SVDService(cfg)
+    replicas = max(1, args.replicas)
+    if replicas > 1:
+        # Federated mode: N in-process service replicas behind the
+        # consistent-hash router, each with its OWN journal under the
+        # state dir (an explicit --journal names a single-replica path
+        # and would be a shared-journal hazard — the router derives
+        # per-replica paths instead).
+        import tempfile
+        if args.drill_resume:
+            raise SystemExit("--replicas > 1 is incompatible with the "
+                             "restart-drill resume phase (each replica "
+                             "recovers its own journal at boot)")
+        if args.journal:
+            raise SystemExit(
+                "--journal names ONE journal path, but every replica "
+                "needs its own (shared paths are refused by the "
+                "journal's exclusivity lock) — with --replicas > 1 the "
+                "router derives per-replica journals under "
+                "<report-dir>/router-state/replica-<i>/ instead")
+        from svd_jacobi_tpu.serve import ReplicaRouter, RouterConfig
+        state_dir = (Path(args.report_dir) / "router-state"
+                     if args.report_dir != "off"
+                     else Path(tempfile.mkdtemp(prefix="svdj-router-")))
+        svc = ReplicaRouter(RouterConfig(
+            replicas=replicas, serve=cfg,
+            state_dir=str(state_dir),
+            manifest_path=manifest_path))
+    else:
+        svc = SVDService(cfg)
 
     if args.drill_resume:
         # Restart-drill phase 2 (spawned by `_restart_drill`): recover
@@ -466,9 +500,16 @@ def serve_demo(argv) -> int:
     }
     if args.topk_mix:
         summary["topk_requests"] = sum(1 for p in plan if p[5] is not None)
+    if replicas > 1:
+        summary["replicas"] = replicas
+        summary["rescues"] = svc.total_rescues
     if warmup_s is not None:
         summary["warmup_s"] = warmup_s
-        cold = [r for r in svc.records() if r.get("kind") == "coldstart"]
+        all_records = list(svc.records())
+        if replicas > 1:
+            for rep in svc.replicas:
+                all_records += rep.service.records()
+        cold = [r for r in all_records if r.get("kind") == "coldstart"]
         if cold:
             summary["coldstart"] = {
                 "fresh_compiles": cold[-1]["fresh_compiles"],

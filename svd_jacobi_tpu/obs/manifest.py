@@ -101,9 +101,23 @@ _SERVE_SCHEMA: Dict[str, Any] = {
 }
 # Two-phase serving fields of the serve record — optional (pre-σ-first
 # streams lack them) but type-checked when present (`validate`).
+# ``digest`` is the oriented-input SHA-256 (the ResultCache / replica-
+# router resubmit key), exposed per-request when digesting is on.
 _SERVE_PHASE_FIELDS: Dict[str, Any] = {
     "phase": str,                       # "full" | "sigma" | "promote"
     "promoted_from": (str, type(None)),
+    "digest": (str, type(None)),
+}
+# Federation events ("router", written by serve.router): one record per
+# replica state transition / journal rescue / routing decision / probe /
+# healthz snapshot, so a federated deployment's whole replica-death ->
+# rescue -> recovery history reconstructs from the manifest stream —
+# the "fleet" kind's shape, one fault-domain ring up. ``replica`` is
+# None for router-wide events.
+_ROUTER_SCHEMA: Dict[str, Any] = {
+    "event": str,                 # replica_transition | rescue | route |
+                                  # probe | healthz
+    "replica": (int, type(None)),
 }
 # Autotuner search records ("tune", written by tune.search per searched
 # shape): the full measured grid — baseline knobs/time, every candidate
@@ -289,7 +303,8 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
                 rank_mode: str = "full",
                 k: Optional[int] = None,
                 phase: str = "full",
-                promoted_from: Optional[str] = None, **extra) -> dict:
+                promoted_from: Optional[str] = None,
+                digest: Optional[str] = None, **extra) -> dict:
     """Assemble a schema-valid per-request serving record
     (`serve.SVDService`). ``batch_id``/``batch_size``/``batch_tier``
     identify a COALESCED dispatch (micro-batched solve lane): every
@@ -328,6 +343,7 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
         "phase": str(phase),
         "promoted_from": (None if promoted_from is None
                           else str(promoted_from)),
+        "digest": None if digest is None else str(digest),
     }
     record.update(extra)
     validate(record)
@@ -439,6 +455,33 @@ def build_fleet(*, event: str, lane: Optional[int] = None, **extra) -> dict:
     return record
 
 
+def build_router(*, event: str, replica: Optional[int] = None,
+                 **extra) -> dict:
+    """Assemble a schema-valid federation event record (`serve.router`).
+
+    ``event`` enumerates the router happenings worth reconstructing:
+    ``replica_transition`` (``from_state``/``to_state``/``cause``
+    extras), ``rescue`` (``count``/``request_ids``/``targets`` — one
+    per journal-rescue of a dead replica's debt), ``route`` (one per
+    admitted request: ``request_id``/``bucket``/``digest``/``resubmit``
+    — the consistent-hash verdict, so routing determinism is auditable
+    from the stream), ``probe`` (``ok``/``request_id``), and
+    ``healthz`` (a federation snapshot dict). ``replica`` is the
+    subject replica's index, or None for router-wide events. ``extra``
+    rides along like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "router",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "event": str(event),
+        "replica": None if replica is None else int(replica),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def _check(cond: bool, errors: List[str], msg: str) -> None:
     if not cond:
         errors.append(msg)
@@ -500,6 +543,10 @@ def _validate_fleet(record: dict, errors: List[str]) -> None:
 
 def _validate_cache(record: dict, errors: List[str]) -> None:
     _check_fields(record, _CACHE_SCHEMA, "record", errors)
+
+
+def _validate_router(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _ROUTER_SCHEMA, "record", errors)
 
 
 def _validate_coldstart(record: dict, errors: List[str]) -> None:
@@ -773,6 +820,31 @@ def _summarize_fleet(record: dict) -> str:
     return line
 
 
+def _summarize_router(record: dict) -> str:
+    rep = record.get("replica")
+    line = (f"router {record.get('event', '?')} @ "
+            f"{record.get('timestamp', '?')}"
+            + (f"  replica={rep}" if rep is not None else ""))
+    if record.get("event") == "replica_transition":
+        line += (f"  {record.get('from_state', '?')} -> "
+                 f"{record.get('to_state', '?')} "
+                 f"({record.get('cause', '?')})")
+    elif record.get("event") == "rescue":
+        line += (f"  {record.get('count', '?')} request(s) "
+                 f"{record.get('request_ids', [])} -> "
+                 f"{record.get('targets', [])}")
+    elif record.get("event") == "route":
+        line += (f"  {record.get('request_id', '?')} "
+                 f"[{record.get('bucket', '?')}] "
+                 f"digest={str(record.get('digest') or '')[:12]}")
+        if record.get("resubmit"):
+            line += " resubmit"
+    elif record.get("event") == "probe":
+        line += (f"  {'ok' if record.get('ok') else 'FAILED'} "
+                 f"({record.get('request_id', '?')})")
+    return line
+
+
 def _summarize_cache(record: dict) -> str:
     line = (f"cache {record.get('store', '?')}/{record.get('event', '?')}"
             f" @ {record.get('timestamp', '?')}")
@@ -921,6 +993,7 @@ for _name, _builder, _validator, _summarizer in (
         ("serve", build_serve, _validate_serve, _summarize_serve),
         ("tune", build_tune, _validate_tune, _summarize_tune),
         ("fleet", build_fleet, _validate_fleet, _summarize_fleet),
+        ("router", build_router, _validate_router, _summarize_router),
         ("cache", build_cache, _validate_cache, _summarize_cache),
         ("coldstart", build_coldstart, _validate_coldstart,
          _summarize_coldstart),

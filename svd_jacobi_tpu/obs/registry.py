@@ -472,8 +472,8 @@ def render_slo(snap: dict) -> str:
 
 def registry_from_manifest(records: List[dict]) -> MetricsRegistry:
     """Rebuild the flight recorder's counter/histogram series from the
-    JSONL manifest records that already exist (serve / fleet / cache /
-    coldstart) — the ROADMAP's "Prometheus-style metrics export rendered
+    JSONL manifest records that already exist (serve / fleet / router /
+    cache / coldstart) — the ROADMAP's "Prometheus-style metrics export rendered
     from the manifest records" item, usable with zero live service (and
     zero jax): `python -m svd_jacobi_tpu.cli metrics reports/manifest.jsonl`.
     Gauges that only exist live (queue depth, breaker state) are not
@@ -527,6 +527,27 @@ def registry_from_manifest(records: List[dict]) -> MetricsRegistry:
                         ok=str(bool(rec.get("ok"))).lower(),
                         lane="" if lane is None else str(lane),
                         help="quarantined-lane recovery probes")
+        elif kind == "router":
+            event = str(rec.get("event", "?"))
+            rep = rec.get("replica")
+            rep_l = "" if rep is None else str(rep)
+            if event == "replica_transition":
+                reg.inc("svdj_replica_transitions_total", replica=rep_l,
+                        to_state=str(rec.get("to_state", "?")),
+                        help="replica state transitions")
+            elif event == "rescue":
+                reg.inc("svdj_replica_rescued_total",
+                        float(rec.get("count", 0) or 0), replica=rep_l,
+                        help="requests rescued off a dead replica")
+            elif event == "route":
+                reg.inc("svdj_router_routes_total", replica=rep_l,
+                        bucket=str(rec.get("bucket", "?")),
+                        help="requests routed to a replica")
+            elif event == "probe":
+                reg.inc("svdj_replica_probes_total",
+                        ok=str(bool(rec.get("ok"))).lower(),
+                        replica=rep_l,
+                        help="quarantined-replica probes")
         elif kind == "cache":
             reg.inc("svdj_cache_events_total",
                     store=str(rec.get("store", "?")),

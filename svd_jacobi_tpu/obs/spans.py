@@ -50,8 +50,12 @@ from typing import Dict, List, Optional
 # reconstructed timestamp. "sweep" repeats; "retain"/"promote" only
 # appear on the sigma flow; "cache_hit" replaces the dispatch chain on a
 # result-cache hit (and so must rank between admit and finalize).
-EVENT_ORDER = ("admit", "queued", "cache_hit", "dispatch", "sweep",
-               "finish", "retain", "finalize", "promote")
+# "route"/"rescue" are federation edges (serve.router): the ring verdict
+# precedes the replica's own admit, a journal rescue re-routes the
+# request mid-life onto another replica.
+EVENT_ORDER = ("route", "admit", "queued", "rescue", "cache_hit",
+               "dispatch", "sweep", "finish", "retain", "finalize",
+               "promote")
 
 
 class SpanRecorder:
@@ -200,6 +204,21 @@ def timeline_from_manifest(records: List[dict], request_id: str
             events.append({"name": "finalize", "t_wall": t_end,
                            "status": status,
                            "phase": rec.get("phase", "full")})
+        elif kind == "router":
+            # Federation edges: the ring verdict ("route" — which
+            # replica, was it a failover) and a journal rescue that
+            # re-homed this request after its replica died.
+            t = _parse_ts(rec.get("timestamp", "")) or 0.0
+            if (rec.get("event") == "route"
+                    and rec.get("request_id") == request_id):
+                events.append({"name": "route", "t_wall": t,
+                               "replica": rec.get("replica"),
+                               "failover": rec.get("failover")})
+            elif (rec.get("event") == "rescue"
+                    and request_id in (rec.get("request_ids") or ())):
+                events.append({"name": "rescue", "t_wall": t,
+                               "from_replica": rec.get("replica"),
+                               "cause": rec.get("cause")})
         elif kind == "cache" and rec.get("request_id") == request_id:
             t = _parse_ts(rec.get("timestamp", "")) or 0.0
             ev = rec.get("event")

@@ -49,6 +49,13 @@ detection/recovery machinery of this repo actually works:
         the lane, and once the shots are exhausted a recovery probe
         solves clean and returns it to ACTIVE.
 
+  * **replica injectors** (`kill_replica` / `wedge_replica`) — the lane
+    injectors one fault-domain ring up: federation faults targeted at
+    ONE replica of a `serve.router.ReplicaRouter`, driving the replica
+    supervisor's eviction -> journal-rescue -> probe-recovery ladder
+    (consumed per ROUTED submit, so two replicas sharing lane indices
+    in one test process cannot cross-consume).
+
   * `sigkill_at_dispatch(k)` — arm a REAL SIGKILL to this process at its
     k-th next served dispatch, delivered after the dispatch is journaled
     (`serve.journal`) — the process-loss fault the restart-survivability
@@ -87,6 +94,11 @@ _serve_faults: dict = {"slow": None, "stuck": None}
 # per kind — consumed only by dispatches of the TARGETED lane, so a
 # multi-lane test hits exactly the lane it armed for.
 _lane_faults: dict = {"kill": None, "wedge": None, "poison": None}
+# Replica-targeted federation faults (`serve.router`): one ring above
+# the lane injectors — the ROUTER consumes these per routed submit, so
+# a fault armed for replica 1 is invisible to replica 0 even though
+# both replicas' lanes share lane indices in one test process.
+_replica_faults: dict = {"kill": None, "wedge": None}
 
 
 class LaneKilled(BaseException):
@@ -202,29 +214,43 @@ def consume_stuck() -> Optional[float]:
 
 
 @contextlib.contextmanager
-def _lane_armed(kind: str, lane: int, value: float, shots: int):
-    """Shared arm/restore protocol of the lane-targeted fault slots."""
+def _indexed_armed(table: dict, index_key: str, kind: str, index: int,
+                   value: float, shots: int):
+    """THE arm/restore protocol of every index-targeted fault slot
+    (lane- and replica-scoped share it): save the previous slot, arm
+    {index, value, shots}, restore on exit."""
     with _lock:
-        prev = _lane_faults[kind]
-        _lane_faults[kind] = {"lane": int(lane), "value": float(value),
-                              "shots": int(shots)}
+        prev = table[kind]
+        table[kind] = {index_key: int(index), "value": float(value),
+                       "shots": int(shots)}
     try:
         yield
     finally:
         with _lock:
-            _lane_faults[kind] = prev
+            table[kind] = prev
 
 
-def _lane_consume(kind: str, lane: int) -> Optional[float]:
-    """One lane dispatch's view of a lane fault slot: the armed value
-    (decrementing the shot budget) when THIS lane is the target, else
-    None — a fault armed for lane 1 is invisible to lane 0."""
+def _indexed_consume(table: dict, index_key: str, kind: str,
+                     index: int) -> Optional[float]:
+    """One dispatch's view of an index-targeted fault slot: the armed
+    value (decrementing the shot budget) when THIS index is the target,
+    else None — a fault armed for lane/replica 1 is invisible to 0."""
     with _lock:
-        st = _lane_faults[kind]
-        if st is None or st["shots"] <= 0 or st["lane"] != int(lane):
+        st = table[kind]
+        if (st is None or st["shots"] <= 0
+                or st[index_key] != int(index)):
             return None
         st["shots"] -= 1
         return st["value"]
+
+
+def _lane_armed(kind: str, lane: int, value: float, shots: int):
+    """Lane-targeted fault slots (see `_indexed_armed`)."""
+    return _indexed_armed(_lane_faults, "lane", kind, lane, value, shots)
+
+
+def _lane_consume(kind: str, lane: int) -> Optional[float]:
+    return _indexed_consume(_lane_faults, "lane", kind, lane)
 
 
 def kill_lane(lane: int, shots: int = 1):
@@ -256,6 +282,57 @@ def wedge_lane(lane: int, wedge_s: float = 10.0, shots: int = 1):
 def consume_wedge(lane: int) -> Optional[float]:
     """The wedge bound in seconds for this lane's dispatch, or None."""
     return _lane_consume("wedge", lane)
+
+
+def _replica_armed(kind: str, replica: int, value: float, shots: int):
+    """Replica-targeted fault slots ride the SAME arm/restore/consume
+    protocol as the lane slots (`_indexed_armed` — one copy of the
+    lock/prev-save/shot-decrement dance), just against the replica
+    table."""
+    return _indexed_armed(_replica_faults, "replica", kind, replica,
+                          value, shots)
+
+
+def _replica_consume(kind: str, replica: int) -> Optional[float]:
+    return _indexed_consume(_replica_faults, "replica", kind, replica)
+
+
+def kill_replica(replica: int, shots: int = 1):
+    """Arm a replica death for the federated router (`serve.router`):
+    the targeted replica 'dies' right after its next ``shots`` routed
+    submits land (the request is already write-ahead journaled — the
+    exact durable state a process loss strands). For an in-process
+    replica handle this is the simulated-SIGKILL lane
+    (`SVDService._chaos_kill`: workers exit without serving, finalizing,
+    or rescuing; queued requests stay as journal debt); the REAL
+    process-loss twin is the subprocess drill's actual SIGKILL
+    (tests/_router_worker.py). Recovery is entirely the router
+    supervisor's job: dead-replica detection -> quarantine -> break the
+    dead journal's lock -> rescue its debt onto a healthy replica ->
+    probe the replica back to ACTIVE."""
+    return _replica_armed("kill", replica, 0.0, shots)
+
+
+def consume_replica_kill(replica: int) -> bool:
+    """True when this replica must simulate death after this submit."""
+    return _replica_consume("kill", replica) is not None
+
+
+def wedge_replica(replica: int, wedge_s: float = 10.0, shots: int = 1):
+    """Arm a replica wedge: the targeted replica's heartbeat FREEZES for
+    up to ``wedge_s`` seconds starting at its next routed submit —
+    indistinguishable from a hung process to the router supervisor,
+    which must evict it on two-tier heartbeat staleness and rescue its
+    journal debt. Bounded so an undetected wedge cannot hang a test; the
+    underlying replica keeps running, so a post-wedge probe succeeds and
+    the replica returns to ACTIVE (first-writer-wins absorbs anything it
+    finished meanwhile, exactly like a woken wedged lane)."""
+    return _replica_armed("wedge", replica, wedge_s, shots)
+
+
+def consume_replica_wedge(replica: int) -> Optional[float]:
+    """The wedge bound in seconds for this replica's submit, or None."""
+    return _replica_consume("wedge", replica)
 
 
 def poison_lane(lane: int, shots: int = 1):

@@ -31,6 +31,15 @@ The request-level robustness layer (PR 4) on top of the solve-level one
     request journal with exactly-once replay after SIGKILL
     (`SVDService.recover`), and zero-downtime `SVDService.reload`
     (background AOT warm, atomic swap) — README "Restart & cold start";
+  * federated serving (`router`): a `ReplicaRouter` fronting N service
+    REPLICAS — consistent-hash routing keyed by (bucket, input digest)
+    so byte-identical resubmits hit the replica owning the cached
+    result, per-replica journals guarded by O_EXCL lockfiles
+    (`JournalLockedError`), replica-death journal rescue at queue FRONT
+    on healthy replicas (``path="replica_rescue"``), outcome-caused
+    probe recovery, one shared persistent compile-cache namespace
+    (replica 2 warm-boots with zero fresh compiles), and ``"router"``
+    manifest records — README "Federated serving";
   * two-phase σ-first serving + content-addressed result cache
     (`cache`): ``submit(phase="sigma")`` returns σ at interactive
     latency with the solve's checkpointed stage retained under a byte
@@ -57,19 +66,25 @@ from __future__ import annotations
 
 from .breaker import BreakerState, Brownout, CircuitBreaker
 from .buckets import Bucket, BucketSet, as_bucket
-from .cache import PromotionError, PromotionStore, ResultCache
+from .cache import PromotionError, PromotionStore, ResultCache, input_digest
 from .fleet import Fleet, Lane, LaneState
-from .journal import Journal
+from .journal import Journal, JournalLockedError
 from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
 from .registry import (CompileCounter, EntryKey, EntryRegistry,
                        enable_persistent_cache, jit_entries)
+from .router import (HashRing, LocalReplica, ReplicaRouter, ReplicaState,
+                     RouterConfig, RouterTicket, SpoolReplica,
+                     run_spool_replica)
 from .service import ServeConfig, ServeResult, SVDService, Ticket
 
 __all__ = [
     "AdmissionError", "AdmissionQueue", "AdmissionReason", "Bucket",
     "BucketSet", "BreakerState", "Brownout", "CircuitBreaker",
-    "CompileCounter", "EntryKey", "EntryRegistry", "Fleet", "Journal",
-    "Lane", "LaneState", "PromotionError", "PromotionStore", "Request",
-    "ResultCache", "ServeConfig", "ServeResult", "SVDService", "Ticket",
-    "as_bucket", "enable_persistent_cache", "jit_entries",
+    "CompileCounter", "EntryKey", "EntryRegistry", "Fleet", "HashRing",
+    "Journal", "JournalLockedError", "Lane", "LaneState", "LocalReplica",
+    "PromotionError", "PromotionStore", "ReplicaRouter", "ReplicaState",
+    "Request", "ResultCache", "RouterConfig", "RouterTicket",
+    "ServeConfig", "ServeResult", "SpoolReplica", "SVDService", "Ticket",
+    "as_bucket", "enable_persistent_cache", "input_digest", "jit_entries",
+    "run_spool_replica",
 ]

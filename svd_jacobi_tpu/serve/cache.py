@@ -44,6 +44,21 @@ class PromotionError(RuntimeError):
     submit — possibly a result-cache hit."""
 
 
+def input_digest(a) -> str:
+    """SHA-256 of the ORIENTED input bytes — THE content identity every
+    don't-recompute surface keys by: the `ResultCache`, the journal
+    payload checksum, `Ticket.digest`, and the replica router's
+    consistent-hash ring (`serve.router`) all use this one definition,
+    so a byte-identical resubmit computes the same key everywhere
+    (device arrays pay one D2H copy; the cache trades that for whole
+    skipped solves)."""
+    import hashlib
+
+    import numpy as _np
+    return hashlib.sha256(
+        _np.ascontiguousarray(_np.asarray(a)).tobytes()).hexdigest()
+
+
 def _nbytes(x) -> int:
     return int(getattr(x, "nbytes", 0) or 0)
 

@@ -84,6 +84,23 @@ class LaneState(enum.Enum):
     QUARANTINED = "quarantined"
 
 
+def heartbeat_stale(now: float, heartbeat: float, *, busy: bool,
+                    holds_work: bool, idle_timeout_s: float,
+                    busy_timeout_s: float) -> bool:
+    """The two-tier heartbeat-staleness verdict, shared by the lane
+    supervisor (`Fleet._tick`) and the replica router's supervisor one
+    fault-domain up (`serve.router`): while ``busy`` (blocked inside a
+    device/compile step — a cold-cache jit compile legitimately stalls
+    for minutes on TPU) the longer ``busy_timeout_s`` governs; and
+    staleness only matters while the subject HOLDS work — there is
+    nothing to rescue off an idle one, and a loaded host can starve an
+    idle poll loop past the timeout without anything being wrong
+    (evicting it would just churn the fleet)."""
+    if not holds_work:
+        return False
+    return now - heartbeat > (busy_timeout_s if busy else idle_timeout_s)
+
+
 class Lane:
     """One solve lane: queue + breaker + worker thread + health state.
 
@@ -455,15 +472,12 @@ class Fleet:
                     cause = lane.unhealthy_flag
                 elif lane.thread is not None and not lane.thread.is_alive():
                     cause = "lane_dead"
-                elif (now - lane.heartbeat > (
-                        cfg.lane_step_timeout_s if lane.in_step
-                        else cfg.lane_heartbeat_timeout_s)
-                        and (lane.in_flight or lane.queue.depth() > 0)):
-                    # Staleness only matters when the lane HOLDS work:
-                    # there is nothing to rescue off an idle lane, and a
-                    # loaded host can starve an idle worker's poll loop
-                    # past the timeout without anything being wrong —
-                    # evicting it would just churn the fleet.
+                elif heartbeat_stale(
+                        now, lane.heartbeat, busy=lane.in_step,
+                        holds_work=bool(lane.in_flight
+                                        or lane.queue.depth() > 0),
+                        idle_timeout_s=cfg.lane_heartbeat_timeout_s,
+                        busy_timeout_s=cfg.lane_step_timeout_s):
                     cause = "heartbeat_stale"
                 elif lane.bad_streak >= cfg.lane_failure_threshold:
                     cause = "bad_outcomes"

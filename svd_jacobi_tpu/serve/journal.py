@@ -33,6 +33,23 @@ quarantined by the tolerant reader, never fatal.
 The journal is opt-in (``ServeConfig.journal_path``): journaling copies
 every input matrix to host and fsyncs per lifecycle event, a durability
 tax measured in the request path (PROFILE.md item 26).
+
+**Exclusivity** (the federated-serving guard): a journal path is one
+replica's write-ahead log, and two LIVE writers interleaving fsync'd
+records into one path would corrupt the exactly-once story silently.
+An EXCLUSIVE journal (``Journal(path, exclusive=True)`` — what
+`SVDService` opens) therefore takes an ``O_EXCL`` lockfile
+(``<path>.lock``, carrying pid + host boot id + a random token): a
+second live opener raises `JournalLockedError` loudly. A DEAD owner's
+stale lock (its pid is gone, or the host rebooted — the boot id
+differs) is broken automatically with a `RuntimeWarning`, so the PR 9
+restart lane (SIGKILL, then a fresh process recovers the same journal)
+keeps working unattended. A lock whose owner is still alive is only
+ever broken EXPLICITLY via `Journal.break_lock` — the replica router
+calls it after (and only after) its supervisor has declared the owning
+replica dead (`serve.router`). Non-exclusive handles (the default) are
+the read/scan/forensics surface; their appends are for tools and tests
+that own the path by construction.
 """
 
 from __future__ import annotations
@@ -42,13 +59,46 @@ import hashlib
 import itertools
 import json
 import os
+import secrets
 import time
+import warnings
 from pathlib import Path
 from typing import Dict, List, NamedTuple, Optional
 
 from ..obs.manifest import append_jsonl, read_jsonl_tolerant
 
 JOURNAL_VERSION = 1
+
+
+class JournalLockedError(RuntimeError):
+    """A second LIVE writer tried to open an exclusive journal: the
+    path's ``.lock`` file names an owner whose process is still alive on
+    this boot. Two live replicas must never interleave fsync'd writes
+    into one journal — give each replica its own ``journal_path``, or
+    (rescue only) break the lock explicitly AFTER the owner has been
+    declared dead (`Journal.break_lock`)."""
+
+
+def host_boot_id() -> str:
+    """This host's boot identity: a pid is only meaningful within one
+    boot (pids restart from scratch after a reboot, so a stale lock's
+    pid could name an unrelated live process)."""
+    try:
+        return Path("/proc/sys/kernel/random/boot_id").read_text().strip()
+    except OSError:
+        return "boot-unknown"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True      # exists, owned by someone else
+    except OSError:
+        return False
+    return True
 
 
 class JournalState(NamedTuple):
@@ -66,14 +116,18 @@ class JournalState(NamedTuple):
                 if rid not in self.finalized]
 
 
-def _encode_array(a, mode: str = "full") -> dict:
+def _encode_array(a, mode: str = "full",
+                  digest: Optional[str] = None) -> dict:
     """Journal payload for one input matrix. ``mode="full"`` carries the
     bytes (base64 — ~21 MB per 2048² float32 request, PROFILE.md item
     26's documented durability tax) so a crashed request is re-solvable;
     ``mode="digest"`` journals only the SHA-256 + shape/dtype — the tax
     drops to O(100 B), but the bytes are NOT recoverable and a crashed
     request replays as a loud ERROR instead of a re-solve
-    (`decode_array`)."""
+    (`decode_array`). ``digest`` may carry the ALREADY-computed SHA-256
+    of these bytes (`serve.cache.input_digest` — the admission path
+    hashes the oriented input once for the cache/ring key; hashing the
+    same megabytes again here would double the tax)."""
     import numpy as np
     if mode not in ("full", "digest"):
         raise ValueError(f"journal payload mode must be 'full' or "
@@ -83,7 +137,8 @@ def _encode_array(a, mode: str = "full") -> dict:
     payload = {
         "shape": [int(d) for d in a.shape],
         "dtype": str(a.dtype),
-        "data_sha256": hashlib.sha256(raw).hexdigest(),
+        "data_sha256": (digest if digest is not None
+                        else hashlib.sha256(raw).hexdigest()),
     }
     if mode == "full":
         payload["data_b64"] = base64.b64encode(raw).decode("ascii")
@@ -124,9 +179,18 @@ class Journal:
     lock, and `exclusive()` lets recovery make its scan-then-rewrite
     compaction atomic against appends."""
 
-    def __init__(self, path):
+    def __init__(self, path, *, exclusive: bool = False):
         import threading
         self.path = Path(path)
+        # Exclusivity (module docstring): an exclusive handle owns the
+        # path's O_EXCL lockfile for its lifetime — `SVDService` opens
+        # its journal this way, so two live replicas can never
+        # interleave writes into one path. The default (non-exclusive)
+        # handle is the scan/forensics surface.
+        self._lock_path = Path(str(self.path) + ".lock")
+        self._lock_token: Optional[str] = None
+        if exclusive:
+            self._acquire_lock()
         self._seq = itertools.count()
         # fsync-latency accounting (the durability tax, live): every
         # append is one fsync'd write; the flight recorder's
@@ -149,6 +213,101 @@ class Journal:
         read-modify-rewrite atomic against concurrent appends
         (`SVDService.recover`'s scan + compaction)."""
         return self._lock
+
+    # -- cross-process exclusivity (the O_EXCL lockfile) --------------------
+
+    @property
+    def locked(self) -> bool:
+        """True while this handle owns the path's exclusivity lock."""
+        return self._lock_token is not None
+
+    def _read_lock_owner(self) -> dict:
+        try:
+            return json.loads(self._lock_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            # Unreadable/torn lockfile: no liveness can be established —
+            # treat as a dead owner (breaking it is the safe direction:
+            # a LIVE owner rewrites nothing through the lockfile, it
+            # only holds it).
+            return {}
+
+    def _acquire_lock(self) -> None:
+        """Take the path's O_EXCL lockfile (pid + boot id + token).
+        Raises `JournalLockedError` when a LIVE owner holds it; breaks a
+        DEAD owner's stale lock (different boot, or its pid is gone)
+        with a `RuntimeWarning` — the unattended restart-after-SIGKILL
+        lane must not need an operator to rm a lockfile."""
+        payload = json.dumps({
+            "pid": os.getpid(), "boot_id": host_boot_id(),
+            "token": secrets.token_hex(8), "t_wall": time.time(),
+            "path": str(self.path)}, sort_keys=True)
+        self._lock_path.parent.mkdir(parents=True, exist_ok=True)
+        for attempt in range(2):
+            try:
+                fd = os.open(str(self._lock_path),
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+                try:
+                    os.write(fd, payload.encode())
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+                self._lock_token = json.loads(payload)["token"]
+                return
+            except FileExistsError:
+                owner = self._read_lock_owner()
+                pid = owner.get("pid")
+                alive = (owner.get("boot_id") == host_boot_id()
+                         and isinstance(pid, int) and _pid_alive(pid))
+                if alive:
+                    raise JournalLockedError(
+                        f"journal {self.path} is exclusively owned by a "
+                        f"LIVE process (pid {pid}, locked at "
+                        f"{owner.get('t_wall')}): two live replicas must "
+                        f"never share one journal path — give each its "
+                        f"own, or break the lock only after the owner is "
+                        f"declared dead (Journal.break_lock)")
+                if attempt == 0:
+                    warnings.warn(
+                        f"journal {self.path}: breaking stale lock of "
+                        f"dead owner (pid {pid}, boot "
+                        f"{str(owner.get('boot_id'))[:8]}...)",
+                        RuntimeWarning, stacklevel=3)
+                    try:
+                        self._lock_path.unlink()
+                    except OSError:
+                        pass
+        raise JournalLockedError(
+            f"journal {self.path}: could not acquire {self._lock_path} "
+            f"(another opener keeps re-creating it)")
+
+    def release(self) -> None:
+        """Drop this handle's exclusivity lock (idempotent). Only
+        removes the lockfile if it is still OURS — a router that broke
+        this handle's lock and re-locked the path must not have its
+        fresh lock deleted by the dead owner's eventual cleanup."""
+        token, self._lock_token = self._lock_token, None
+        if token is None:
+            return
+        if self._read_lock_owner().get("token") == token:
+            try:
+                self._lock_path.unlink()
+            except OSError:
+                pass
+
+    @classmethod
+    def break_lock(cls, path) -> bool:
+        """FORCE-remove a journal path's lockfile — the rescue path's
+        explicit override, legitimate only once the lock's owner has
+        been declared dead by a supervisor (the owner's pid may still be
+        alive when the 'replica' was an in-process handle, which is why
+        this cannot be the automatic dead-pid lane). Returns True when a
+        lockfile existed."""
+        lock = Path(str(Path(path)) + ".lock")
+        try:
+            lock.unlink()
+            return True
+        except OSError:
+            return False
 
     def io_stats(self) -> dict:
         """Cumulative append/fsync accounting (scrape-time view)."""
@@ -201,7 +360,8 @@ class Journal:
                            else float(req.deadline_s)),
             "top_k": None if req.top_k is None else int(req.top_k),
             "phase": str(getattr(req, "phase", "full")),
-            "input": _encode_array(req.a, payload_mode),
+            "input": _encode_array(req.a, payload_mode,
+                                   digest=getattr(req, "digest", None)),
         }
         with self._lock:
             return self._timed_append(rec)

@@ -42,6 +42,11 @@ class AdmissionReason(enum.Enum):
     # cannot promise an answer, so it rejects loudly instead of queueing
     # onto a lane nobody will pop.
     NO_LANE = "no_lane"
+    # Federated (router) mode only (`serve.router`): every replica of
+    # the federation is quarantined/dead — the router cannot promise an
+    # answer and says so at the door, one fault-domain ring above
+    # NO_LANE.
+    NO_REPLICA = "no_replica"
 
 
 class AdmissionError(RuntimeError):
@@ -93,6 +98,12 @@ class Request:
     # content-addressed result cache is enabled (None otherwise): the
     # finalize path stores a successful full result under it.
     digest: Optional[str] = None
+    # How this request reached the queue when NOT via plain admission:
+    # "replica_rescue" marks a request re-admitted from a dead replica's
+    # journal by the router's rescue (`SVDService.admit_journal_debt`) —
+    # its eventual serve record carries this as ``path`` so the rescue
+    # reconstructs from the stream. None for ordinary submits.
+    via: Optional[str] = None
 
 
 class AdmissionQueue:

@@ -1,0 +1,1727 @@
+"""Federated replica router — cross-process fault domains with rescue.
+
+The robustness ladder so far hardens the solve (PR 3), the request
+(PR 4), the lane (PR 6), and the process (PR 9) — but one `SVDService`
+is still ONE fault domain: the reference's root-rank MPI design (one
+process dies, the job is gone) reproduced at serving scale. This module
+is the next ring up: a front-end `ReplicaRouter` federating N service
+REPLICAS, giving them the exact supervision contract lanes already have
+— eviction on outcome-caused sickness, journal-based rescue of a dead
+replica's debt, outcome-caused probe recovery — one level up.
+
+**Routing** — a consistent-hash ring (`HashRing`, SHA-256 positioned, so
+placement is deterministic across processes and PYTHONHASHSEED) keyed by
+``(bucket, input digest)``: a byte-identical resubmit computes the same
+digest (`serve.cache.input_digest`, the `ResultCache` key ingredient)
+and therefore lands on the replica that owns the cached result — the
+admission fast-path stays a sub-millisecond hit even behind the router.
+Requests without a digestable identity fall back to bucket affinity
+(the ring keyed by bucket alone), quarantined replicas are failed over
+in deterministic ring order, and when no replica is healthy the router
+rejects loudly with `AdmissionReason.NO_REPLICA` — never a queue nobody
+will pop. Overload rejections (QUEUE_FULL / DEADLINE_BUDGET / SHED) on
+the owner also fail over: capacity elsewhere in the federation is the
+point of having one.
+
+**Replica fault domains** — every replica owns its OWN write-ahead
+journal path, guarded by the journal's O_EXCL lockfile
+(`serve.journal.JournalLockedError`): two live replicas can never
+interleave fsync'd records into one path, so a dead replica's journal
+is a complete, uncorrupted statement of its unfinalized debt. Replicas
+come in two shapes behind one handle interface: **in-process**
+(`LocalReplica` — an `SVDService` per replica, the test/default shape)
+and **spool subprocess** (`SpoolReplica` — a real OS process driven
+through an atomic-rename file spool, `run_spool_replica`; the chaos
+drill SIGKILLs one of these for real).
+
+**Supervision** — `ReplicaRouter`'s supervisor thread mirrors
+`fleet.Fleet._tick` one fault-domain up, with the SAME two-tier
+staleness verdict (`fleet.heartbeat_stale`): ``replica_dead`` (the
+process/workers are gone), ``heartbeat_stale`` (no heartbeat within the
+idle bound — or the longer step bound while busy in a device/compile
+call — while holding work), ``bad_outcomes`` (consecutive
+NONFINITE/ERROR results observed by the router), and
+``breaker_stuck_open`` (every lane breaker OPEN across consecutive
+healthz reads). Eviction **rescues**: the router breaks the dead
+journal's lock (legitimate exactly because the supervisor has declared
+the owner dead — `Journal.break_lock`'s contract), scans it under a
+fresh exclusive lock, and re-admits the unfinalized debt at queue FRONT
+on healthy replicas (ring-routed per record, remaining wall-clock
+deadline budget intact) via `SVDService.admit_journal_debt` — which
+write-ahead journals each rescued request on the RECEIVER before
+enqueueing it, so a second crash replays it again. Exactly-once is the
+existing composition: replay-skips-finalized + the receiver's
+write-ahead admit + `Ticket._finalize_once`; rescued serve records
+carry ``path="replica_rescue"``. Recovery is outcome-caused: a zero
+solve probed through the replica's NORMAL dispatch path (respawning a
+dead replica first) returns it to ACTIVE on success — no wall-clock
+amnesty.
+
+**Shared cold start** — every replica points at ONE persistent
+compile-cache namespace (`ServeConfig.compile_cache_dir`): PR 9's
+content-hash discipline (config + tuning-table + backend identity in
+the namespace hash) makes concurrent multi-process sharing safe by
+construction, so replica 2 warm-boots with ZERO fresh backend compiles
+after replica 1 warmed — proven by the chaos drill's warm-boot
+acceptance.
+
+**Observability** — every transition / rescue / route / probe appends a
+schema-versioned ``"router"`` manifest record (`obs.manifest
+.build_router`, registered through the KINDS registry) to the same
+stream as the per-request "serve" records; `ReplicaRouter.healthz()` is
+the federated view (per-replica states, heartbeat ages, ring ownership,
+rescue totals, per-replica /metrics listener addresses); with
+``RouterConfig.metrics`` the router keeps live `MetricsRegistry` gauges
+(``svdj_replica_state``, ``svdj_ring_owned_buckets``,
+``svdj_replica_rescued_total``, routes/probes counters) that
+`obs.registry.registry_from_manifest` reconstructs offline.
+
+The `ROUTE001` analysis pass (`analysis.route_checks`) pins the two
+load-bearing properties: routing is a pure function of (ring, bucket,
+digest, replica states); and a rescue keeps the once-per-bucket compile
+contract on the receiving replica under `RecompileGuard`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import enum
+import hashlib
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from .buckets import BucketSet
+from .journal import Journal, host_boot_id
+from .queue import AdmissionError, AdmissionReason
+from .service import ServeConfig, ServeResult, SVDService
+
+# Admission reasons that mean "this replica cannot take it right now,
+# but a sibling might" — the router fails these over along the ring.
+# Client-fault reasons (NO_BUCKET, NONFINITE_INPUT) re-raise untouched:
+# no replica can fix the request.
+_FAILOVER_REASONS = frozenset({
+    AdmissionReason.SHUTDOWN, AdmissionReason.QUEUE_FULL,
+    AdmissionReason.DEADLINE_BUDGET, AdmissionReason.BROWNOUT_SHED,
+    AdmissionReason.NO_LANE,
+})
+
+
+class ReplicaState(enum.Enum):
+    ACTIVE = "active"
+    QUARANTINED = "quarantined"
+
+
+class ReplicaUnavailable(RuntimeError):
+    """A replica handle refused a submit because its backing service /
+    process is gone (dead flag, no live workers). Router-internal: the
+    submit path treats it like a SHUTDOWN rejection and fails over."""
+
+
+# -- consistent-hash ring -----------------------------------------------------
+
+
+class HashRing:
+    """SHA-256-positioned consistent-hash ring over replica indices.
+
+    Every replica contributes ``vnodes`` virtual points (hash of
+    ``replica-<id>:vnode-<v>``); a request key (bucket name + input
+    digest — or bucket name alone for the affinity fallback) hashes to a
+    ring position, and `preference` walks clockwise from there returning
+    each replica ONCE in first-encounter order: index 0 is the owner,
+    the tail is the deterministic failover order. Pure function of the
+    replica set — no clocks, no process state, no `hash()` (SHA-256
+    makes placement identical across processes and PYTHONHASHSEED,
+    which is what lets a restarted router, the analysis pass, and an
+    offline reader all agree on who owned what)."""
+
+    def __init__(self, replica_ids, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.replica_ids = tuple(int(r) for r in replica_ids)
+        if len(set(self.replica_ids)) != len(self.replica_ids):
+            raise ValueError(f"duplicate replica ids: {self.replica_ids}")
+        self.vnodes = int(vnodes)
+        pts = []
+        for rid in self.replica_ids:
+            for v in range(self.vnodes):
+                pts.append((self._h(f"replica-{rid}:vnode-{v}"), rid))
+        pts.sort()
+        self._points = pts
+        self._hashes = [h for h, _ in pts]
+
+    @staticmethod
+    def _h(s: str) -> int:
+        return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8],
+                              "big")
+
+    @classmethod
+    def key(cls, bucket_name: str, digest: Optional[str] = None) -> int:
+        """Ring position of one request identity: ``(bucket, digest)``
+        for content-addressed placement (a byte-identical resubmit maps
+        here again), bucket alone for the affinity fallback."""
+        base = (str(bucket_name) if digest is None
+                else f"{bucket_name}:{digest}")
+        return cls._h(base)
+
+    def preference(self, bucket_name: str,
+                   digest: Optional[str] = None) -> Tuple[int, ...]:
+        """Replica ids in deterministic ring-walk order from the key
+        point (owner first, failovers after), each exactly once."""
+        if not self._points:
+            return ()
+        k = self.key(bucket_name, digest)
+        i = bisect.bisect_right(self._hashes, k)
+        seen: List[int] = []
+        for j in range(len(self._points)):
+            rid = self._points[(i + j) % len(self._points)][1]
+            if rid not in seen:
+                seen.append(rid)
+                if len(seen) == len(self.replica_ids):
+                    break
+        return tuple(seen)
+
+    def owner(self, bucket_name: str,
+              digest: Optional[str] = None) -> int:
+        return self.preference(bucket_name, digest)[0]
+
+    def ownership(self, bucket_names) -> Dict[str, int]:
+        """bucket name -> owning replica (the affinity fallback view;
+        healthz / the ring-ownership gauge render this)."""
+        return {str(b): self.owner(str(b)) for b in bucket_names}
+
+
+# -- spool codec (subprocess replicas) ---------------------------------------
+
+
+def _write_json_atomic(path: Path, obj: dict) -> None:
+    """tmp + rename: a reader (poller) either sees the whole file or no
+    file — never a torn JSON (the spool protocol's one invariant)."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(obj, sort_keys=True))
+    os.replace(tmp, path)
+
+
+def _read_json(path: Path) -> Optional[dict]:
+    try:
+        return json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _unlink_quiet(path: Path) -> None:
+    try:
+        path.unlink()
+    except OSError:
+        pass
+
+
+def _encode_result(res: ServeResult) -> dict:
+    """Outbox encoding of one terminal result (factors base64'd with the
+    journal's checksummed array codec)."""
+    from .journal import _encode_array
+    out = {
+        "id": res.request_id,
+        "status": None if res.status is None else res.status.name,
+        "error": res.error,
+        "sweeps": int(res.sweeps),
+        "bucket": res.bucket,
+        "queue_wait_s": float(res.queue_wait_s),
+        "solve_time_s": (None if res.solve_time_s is None
+                         else float(res.solve_time_s)),
+        "path": res.path,
+        "degraded": bool(res.degraded),
+    }
+    for name, val in (("u", res.u), ("s", res.s), ("v", res.v)):
+        out[name] = None if val is None else _encode_array(val)
+    return out
+
+
+def _decode_result(rec: dict) -> ServeResult:
+    from ..solver import SolveStatus
+    from .journal import decode_array
+    factors = {}
+    for name in ("u", "s", "v"):
+        enc = rec.get(name)
+        factors[name] = None if enc is None else decode_array(enc)
+    if rec.get("transposed"):
+        # The worker solved the ORIENTED array (the router transposed a
+        # wide input and swapped the flags at encode time); undo the
+        # orientation on the factors, exactly like `SVDService._slice`.
+        factors["u"], factors["v"] = factors["v"], factors["u"]
+    status = rec.get("status")
+    return ServeResult(
+        u=factors["u"], s=factors["s"], v=factors["v"],
+        status=(None if status in (None, "ERROR")
+                or status.startswith("REJECTED_")
+                else SolveStatus[status]),
+        error=rec.get("error"), sweeps=int(rec.get("sweeps", 0)),
+        bucket=rec.get("bucket"),
+        queue_wait_s=float(rec.get("queue_wait_s", 0.0)),
+        solve_time_s=rec.get("solve_time_s"),
+        path=str(rec.get("path", "base")),
+        degraded=bool(rec.get("degraded", False)),
+        request_id=str(rec.get("id", "?")))
+
+
+# -- sub-ticket adapters ------------------------------------------------------
+
+
+class _LocalSub:
+    """Uniform poll surface over an in-process `Ticket`."""
+
+    def __init__(self, ticket):
+        self.ticket = ticket
+        self.request_id = ticket.request_id
+
+    def done(self) -> bool:
+        return self.ticket.done()
+
+    def poll(self, slice_s: float) -> Optional[ServeResult]:
+        try:
+            return self.ticket.result(timeout=slice_s)
+        except TimeoutError:
+            return None
+
+    def cancel(self) -> None:
+        self.ticket.cancel()
+
+    def cleanup(self) -> None:
+        pass
+
+
+class _SpoolSub:
+    """Uniform poll surface over a spool replica's outbox file."""
+
+    def __init__(self, outbox_path: Path, request_id: str):
+        self.path = Path(outbox_path)
+        self.request_id = str(request_id)
+
+    def done(self) -> bool:
+        return self.path.exists()
+
+    def poll(self, slice_s: float) -> Optional[ServeResult]:
+        if not self.path.exists():
+            time.sleep(min(slice_s, 0.02))
+            if not self.path.exists():
+                return None
+        rec = _read_json(self.path)
+        if rec is None:
+            return None
+        return _decode_result(rec)
+
+    def cancel(self) -> None:
+        # Best-effort only: cross-process cancellation is not part of
+        # the spool protocol (the request's own deadline bounds it).
+        pass
+
+    def cleanup(self) -> None:
+        """Unlink the consumed outbox file: a result can carry megabytes
+        of base64 factors, and a long-running federation must not leak
+        one file per served request."""
+        try:
+            self.path.unlink()
+        except OSError:
+            pass
+
+
+class RouterTicket:
+    """Client handle on one federated request: blocks on `result`,
+    survives a mid-flight rescue (the router re-binds it to the rescued
+    request's new replica — the client never learns its replica died),
+    resolves EXACTLY once (first writer wins, mirroring
+    `Ticket._finalize_once` at the router level). ``digest`` is the
+    oriented-input SHA-256 the ring routed by — the resubmit key."""
+
+    def __init__(self, request_id: str, digest: Optional[str],
+                 bucket: Optional[str], router=None):
+        self.request_id = str(request_id)
+        self.digest = digest
+        self.bucket = bucket
+        self._router = router
+        self._done = threading.Event()
+        self._result: Optional[ServeResult] = None
+        self._lock = threading.Lock()
+        self._binding: Optional[tuple] = None   # (replica, sub)
+
+    def _bind(self, replica, sub) -> None:
+        with self._lock:
+            self._binding = (replica, sub)
+
+    def _resolve_once(self, result: ServeResult, replica=None) -> bool:
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._result = result
+            self._done.set()
+            binding = self._binding
+        if binding is not None:
+            binding[1].cleanup()    # e.g. unlink a consumed outbox file
+        if self._router is not None:
+            self._router._on_resolve(self, replica, result)
+        return True
+
+    def done(self) -> bool:
+        if self._done.is_set():
+            return True
+        with self._lock:
+            binding = self._binding
+        if binding is not None and binding[1].done():
+            res = binding[1].poll(0.0)
+            if res is not None:
+                self._resolve_once(res, binding[0])
+        return self._done.is_set()
+
+    def cancel(self) -> None:
+        with self._lock:
+            binding = self._binding
+        if binding is not None:
+            binding[1].cancel()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            if self._done.is_set():
+                return self._result
+            with self._lock:
+                binding = self._binding
+            slice_s = 0.05
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"request {self.request_id} not terminal after "
+                        f"{timeout}s")
+                slice_s = min(slice_s, remaining)
+            if binding is None:
+                self._done.wait(slice_s)
+                continue
+            res = binding[1].poll(slice_s)
+            if res is not None:
+                self._resolve_once(res, binding[0])
+
+
+# -- replica handles ----------------------------------------------------------
+
+
+class ReplicaHandle:
+    """The router's view of one replica: identity, health bookkeeping,
+    and the submit/debt surfaces. Concrete shapes: `LocalReplica`
+    (in-process `SVDService`) and `SpoolReplica` (a real subprocess
+    behind an atomic-rename file spool)."""
+
+    kind = "?"
+
+    def __init__(self, index: int, journal_path):
+        self.index = int(index)
+        self.journal_path = str(journal_path)
+        self.state = ReplicaState.ACTIVE
+        self.generation = 0
+        self.bad_streak = 0          # consecutive NONFINITE/ERROR results
+        self.open_streak = 0         # consecutive all-breakers-OPEN reads
+        self.rescued_off = 0
+        self.routes = 0
+        self.outstanding: set = set()     # rids currently bound here
+        self.last_probe = 0.0
+        self.last_respawn = 0.0
+        self.probe_sub = None
+        self.probe_rid: Optional[str] = None
+        self.transitions: List[tuple] = []
+        self._created = time.monotonic()
+
+    # -- interface ----------------------------------------------------------
+    def start(self) -> None: ...
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None: ...
+    def submit(self, a, **kw): ...
+    def admit_debt(self, records) -> Dict[str, Any]: ...
+    def alive(self) -> bool: ...
+    def heartbeat_age(self, now: float) -> float: ...
+    def busy(self) -> bool: ...
+    def holds_work(self) -> bool: ...
+    def healthz(self) -> Optional[dict]: ...
+    def respawn(self) -> None: ...
+    def fence(self) -> None: ...
+    def quiesce(self, timeout: float = 2.0) -> None: ...
+
+    def unconsumed_debt(self, exclude) -> List[dict]:
+        """Transport-level write-ahead records the replica accepted but
+        never journaled (only the spool transport has such a seam — an
+        in-process submit IS the journal append)."""
+        return []
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        hz = None
+        try:
+            hz = self.healthz()
+        except Exception:
+            pass
+        return {
+            "replica": self.index,
+            "kind": self.kind,
+            "state": self.state.value,
+            "alive": bool(self.alive()),
+            "heartbeat_age_s": self.heartbeat_age(now),
+            "busy": bool(self.busy()),
+            "holds_work": bool(self.holds_work()),
+            "bad_streak": self.bad_streak,
+            "open_streak": self.open_streak,
+            "routes": self.routes,
+            "rescued_off": self.rescued_off,
+            "outstanding": len(self.outstanding),
+            "journal": self.journal_path,
+            "http": None if not isinstance(hz, dict) else hz.get("http"),
+        }
+
+
+class LocalReplica(ReplicaHandle):
+    """One in-process `SVDService` as a replica fault domain. Death is
+    simulated (`chaos.kill_replica` -> `_chaos_kill`: workers exit
+    without serving or finalizing, queued requests stay as journal
+    debt, the journal lock stays held — everything a SIGKILL strands,
+    minus the ability to interrupt a solve already inside the device);
+    the REAL process-loss shape is `SpoolReplica` + the subprocess
+    drill. `respawn` builds a fresh service on the same per-replica
+    config (breaking the dead one's journal lock first, replaying
+    whatever debt the rescue left behind)."""
+
+    kind = "local"
+
+    def __init__(self, index: int, config: ServeConfig, *,
+                 respawn_warmup: bool = False):
+        if config.journal_path is None:
+            raise ValueError("a LocalReplica needs its own journal_path "
+                             "(the rescue contract reads it)")
+        super().__init__(index, config.journal_path)
+        self.config = config
+        self.respawn_warmup = bool(respawn_warmup)
+        self.dead = False
+        self._died_at = 0.0
+        self._frozen_at: Optional[float] = None    # wedge: frozen heartbeat
+        self._frozen_until = 0.0
+        self.service = SVDService(config)
+
+    def start(self) -> None:
+        self.service.start()
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        if not self.dead:
+            self.service.stop(drain=drain, timeout=timeout)
+
+    def submit(self, a, **kw):
+        if self.dead:
+            raise ReplicaUnavailable(
+                f"replica {self.index} is dead (simulated process loss)")
+        return _LocalSub(self.service.submit(a, **kw))
+
+    def admit_debt(self, records) -> Dict[str, Any]:
+        if self.dead:
+            raise ReplicaUnavailable(f"replica {self.index} is dead")
+        tickets = self.service.admit_journal_debt(records)
+        return {rid: _LocalSub(t) for rid, t in tickets.items()}
+
+    def freeze_heartbeat(self, wedge_s: float) -> None:
+        """`chaos.wedge_replica`: the router-visible heartbeat freezes
+        for ``wedge_s`` (the service underneath keeps running — the
+        woken-wedge first-writer-wins discipline applies)."""
+        now = time.monotonic()
+        self._frozen_at = now
+        self._frozen_until = now + float(wedge_s)
+
+    def _heartbeat(self) -> float:
+        now = time.monotonic()
+        if self._frozen_at is not None:
+            if now < self._frozen_until:
+                return self._frozen_at
+            self._frozen_at = None
+        if self.dead:
+            return self._died_at
+        return max(l.heartbeat for l in self.service.fleet.lanes)
+
+    def heartbeat_age(self, now: float) -> float:
+        return now - self._heartbeat()
+
+    def alive(self) -> bool:
+        if self.dead:
+            return False
+        return any(l.thread is not None and l.thread.is_alive()
+                   for l in self.service.fleet.lanes)
+
+    def busy(self) -> bool:
+        return (not self.dead
+                and any(l.in_step for l in self.service.fleet.lanes))
+
+    def holds_work(self) -> bool:
+        if self.outstanding:
+            return True
+        if self.dead:
+            return False
+        return any(l.in_flight or l.queue.depth() > 0
+                   for l in self.service.fleet.lanes)
+
+    def healthz(self) -> Optional[dict]:
+        return None if self.dead else self.service.healthz()
+
+    def simulate_kill(self) -> None:
+        """The in-process SIGKILL twin (consumed from
+        `chaos.kill_replica` by the router's submit path, or called
+        directly by tests)."""
+        if self.dead:
+            return
+        self.dead = True
+        self._died_at = time.monotonic()
+        self.service._chaos_kill()
+
+    def fence(self) -> None:
+        """STONITH before rescue: an alive-but-sick replica (stale
+        heartbeat, bad outcomes, stuck breaker) is hard-stopped so it
+        cannot keep serving requests whose debt the rescue is about to
+        re-home — without the fence, everything it still held would be
+        double-served and its journal rewritten under a live writer."""
+        self.simulate_kill()
+
+    def quiesce(self, timeout: float = 2.0) -> None:
+        """Bounded wait for the dead service's workers to reach their
+        exits, so the rescue's journal scan sees every finalize a
+        mid-solve worker still managed to append."""
+        deadline = time.monotonic() + timeout
+        for lane in self.service.fleet.lanes:
+            t = lane.thread
+            if t is not None:
+                t.join(max(0.0, deadline - time.monotonic()))
+
+    def respawn(self) -> None:
+        """Fresh service, same fault domain: break the dead service's
+        journal lock (a SIGKILL'd owner released nothing), replay the
+        journal's remaining debt, start. The shared compile-cache
+        namespace makes this warm — the PR 9 property the federation
+        inherits."""
+        Journal.break_lock(self.journal_path)
+        svc = SVDService(self.config)
+        svc.recover()
+        svc.start()
+        if self.respawn_warmup:
+            svc.warmup(timeout=600.0)
+        self.service = svc
+        self.dead = False
+        self._frozen_at = None
+        self.generation += 1
+
+
+class SpoolReplica(ReplicaHandle):
+    """A real-subprocess replica behind an atomic-rename file spool
+    (`run_spool_replica` is the process's serve loop):
+
+      * ``<spool>/inbox/<rid>.json``  — router -> replica: one submit
+        (journal-codec input payload + flags + wall-clock deadline), a
+        rescue debt batch, or a stop command;
+      * ``<spool>/outbox/<rid>.json`` — replica -> router: one terminal
+        result (status + factors, journal codec);
+      * ``<spool>/heartbeat.json``    — replica -> router: liveness (pid
+        + boot id + busy/holds_work + a trimmed healthz snapshot incl.
+        the REAL metrics listener port), rewritten every loop turn.
+
+    The router never shares memory with it — SIGKILL the process and
+    everything the drill needs (journal, lockfile, spool) is on disk.
+    ``respawn`` is delegated to the harness (a process supervisor in
+    production, the test in the drill) via the ``respawn_cmd``
+    callable."""
+
+    kind = "spool"
+
+    def __init__(self, index: int, spool_dir, journal_path, *,
+                 respawn_cmd=None):
+        super().__init__(index, journal_path)
+        self.spool = Path(spool_dir)
+        self.inbox = self.spool / "inbox"
+        self.outbox = self.spool / "outbox"
+        self.heartbeat_path = self.spool / "heartbeat.json"
+        self.inbox.mkdir(parents=True, exist_ok=True)
+        self.outbox.mkdir(parents=True, exist_ok=True)
+        self._respawn_cmd = respawn_cmd
+        self._hb_cache: dict = {}
+        self._hb_read = 0.0
+
+    def start(self) -> None:
+        pass    # the process is started by the harness
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        _write_json_atomic(self.inbox / "zz-stop.json", {"kind": "stop"})
+
+    def _hb(self) -> dict:
+        now = time.monotonic()
+        if now - self._hb_read > 0.02:
+            self._hb_cache = _read_json(self.heartbeat_path) or {}
+            self._hb_read = now
+        return self._hb_cache
+
+    def submit(self, a, *, compute_u=True, compute_v=True,
+               deadline_s=None, request_id=None, top_k=None,
+               phase="full", digest=None):
+        """Write one ADMIT-SHAPED submit record into the inbox: the
+        record carries the oriented payload plus the full journal-admit
+        field set, so an inbox file the replica never got to consume is
+        itself a complete write-ahead record the rescue can re-home
+        (`unconsumed_debt`) — the spool seam closes the durability hole
+        between 'the router handed it over' and 'the replica journaled
+        it'. Orientation happens HERE (flags swapped for wide inputs);
+        the worker submits the oriented array verbatim and the result
+        decode swaps the factors back (`_decode_result`)."""
+        import numpy as _np
+
+        from .journal import _encode_array
+        if not self.alive():
+            raise ReplicaUnavailable(
+                f"spool replica {self.index} has no live process")
+        rid = str(request_id)
+        a = _np.asarray(a)
+        transposed = a.ndim == 2 and a.shape[0] < a.shape[1]
+        oriented = a.T if transposed else a
+        if transposed:
+            compute_u, compute_v = compute_v, compute_u
+        m, n = (int(d) for d in oriented.shape)
+        rec = {
+            "kind": "submit", "id": rid, "t_wall": time.time(),
+            "attempt": 1,
+            "deadline_s": (None if deadline_s is None
+                           else float(deadline_s)),
+            "m": m, "n": n,
+            "orig_shape": [int(d) for d in a.shape],
+            "transposed": bool(transposed),
+            "bucket": None,
+            "compute_u": bool(compute_u), "compute_v": bool(compute_v),
+            "degraded": False, "brownout": "FULL",
+            "top_k": None if top_k is None else int(top_k),
+            "phase": str(phase),
+            "input": _encode_array(oriented, digest=digest),
+        }
+        _write_json_atomic(self.inbox / f"{rid}.json", rec)
+        return _SpoolSub(self.outbox / f"{rid}.json", rid)
+
+    def unconsumed_debt(self, exclude) -> List[dict]:
+        """The spool seam's durability tail, collected at rescue time:
+        submit records (and rescue batches) still sitting UNCONSUMED in
+        the dead replica's inbox. Each is admit-shaped by construction,
+        so the rescue re-homes them exactly like journal debt; consumed
+        files are removed (the replica is fenced — the rescuer owns its
+        spool). ``exclude`` holds ids the journal already accounts for
+        (admitted or finalized there — the journal wins: it is further
+        along the pipeline)."""
+        out: List[dict] = []
+        seen = set(exclude)
+        for f in sorted(self.inbox.glob("*.json")):
+            rec = _read_json(f)
+            if rec is None:
+                continue
+            kind = rec.get("kind")
+            recs = []
+            if kind == "submit":
+                recs = [rec]
+            elif kind == "debt":
+                recs = list(rec.get("records") or ())
+            else:
+                continue      # fences/stops are not debt
+            for r in recs:
+                rid = str(r.get("id"))
+                if rid in seen or rid.startswith("probe-"):
+                    continue
+                seen.add(rid)
+                out.append(r)
+            try:
+                f.unlink()
+            except OSError:
+                pass
+        return out
+
+    def admit_debt(self, records) -> Dict[str, Any]:
+        name = f"00-debt-{time.time_ns()}.json"
+        _write_json_atomic(self.inbox / name,
+                           {"kind": "debt", "records": list(records)})
+        return {rec["id"]: _SpoolSub(self.outbox / f"{rec['id']}.json",
+                                     rec["id"])
+                for rec in records}
+
+    def alive(self) -> bool:
+        hb = self._hb()
+        pid = hb.get("pid")
+        if not isinstance(pid, int):
+            # Not yet booted: alive-by-grace (the supervisor's staleness
+            # clock, seeded at handle creation, bounds the grace).
+            return True
+        if hb.get("boot_id") not in (None, host_boot_id()):
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            return True
+        return True
+
+    def heartbeat_age(self, now: float) -> float:
+        hb = self._hb()
+        t = hb.get("t_wall")
+        if not isinstance(t, (int, float)):
+            return now - self._created
+        # Wall-clock heartbeat (monotonic clocks do not cross process
+        # boundaries); ages compare against wall time.
+        return max(0.0, time.time() - float(t))
+
+    def busy(self) -> bool:
+        return bool(self._hb().get("busy"))
+
+    def holds_work(self) -> bool:
+        return bool(self.outstanding) or bool(self._hb().get("holds_work"))
+
+    def healthz(self) -> Optional[dict]:
+        return self._hb().get("healthz")
+
+    def respawn(self) -> None:
+        if self._respawn_cmd is None:
+            return    # the harness owns process lifecycle
+        self._respawn_cmd()
+        self._hb_cache, self._hb_read = {}, 0.0
+        self._created = time.monotonic()
+        self.generation += 1
+
+    def fence(self) -> None:
+        """STONITH before rescue: tell a possibly-still-alive replica
+        process to exit IMMEDIATELY without serving anything else (the
+        spool loop `os._exit`s on the fence command — SIGKILL semantics,
+        queued work stays as journal debt). A no-op for a process that
+        is already gone: the fence file just sits in the inbox, and a
+        RESPAWNED replica consumes-and-ignores any fence older than its
+        own boot."""
+        _write_json_atomic(self.inbox / "000-fence.json",
+                           {"kind": "fence", "t_wall": time.time()})
+
+    def quiesce(self, timeout: float = 2.0) -> None:
+        """Bounded wait for the fenced process to actually be gone
+        (pid-liveness via the heartbeat), so the journal scan cannot
+        race a final append."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and self.alive():
+            self._hb_read = 0.0      # force a fresh heartbeat read
+            time.sleep(0.05)
+
+
+# -- the router ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Federation-layer configuration (each replica's own knobs ride in
+    ``serve``; ``journal_path`` / ``metrics_port`` there are PER-REPLICA
+    and derived — give the template None / 0)."""
+
+    replicas: int = 2
+    serve: ServeConfig = ServeConfig()
+    # Root of the per-replica state: replica i's journal lives at
+    # ``<state_dir>/replica-<i>/journal.jsonl`` (its own fault domain's
+    # write-ahead log — the rescue contract reads exactly this path).
+    state_dir: Optional[str] = None
+    ring_vnodes: int = 64
+    # Two-tier replica staleness (the lane supervisor's verdict, one
+    # ring up — `fleet.heartbeat_stale`).
+    heartbeat_timeout_s: float = 2.0
+    step_timeout_s: float = 300.0
+    # Evict after this many consecutive NONFINITE/ERROR results the
+    # router observed from one replica.
+    failure_threshold: int = 3
+    # Evict after this many consecutive healthz reads with EVERY lane
+    # breaker OPEN (the replica's own ladder is not healing it).
+    open_threshold: int = 4
+    supervise_interval_s: float = 0.05
+    probe_interval_s: float = 0.25
+    probe_timeout_s: float = 60.0
+    # Minimum spacing between respawn attempts of one dead replica: a
+    # respawned process needs boot time (runtime import + cache-warm)
+    # before its heartbeat proves it alive, and re-respawning every
+    # probe interval meanwhile would spawn a storm of workers fighting
+    # over one journal lock.
+    respawn_grace_s: float = 45.0
+    # Warm a respawned local replica's registry before ACTIVE probing
+    # (cheap when the shared compile cache is hot; the drill proves 0
+    # fresh compiles).
+    respawn_warmup: bool = False
+    manifest_path: Optional[str] = None
+    max_records: int = 2048
+    metrics: bool = False
+
+
+class ReplicaRouter:
+    """Front-end federating N `SVDService` replicas (module docstring).
+
+    Build with in-process replicas (the default: ``RouterConfig.serve``
+    templated per replica under ``state_dir``) or hand in pre-built
+    handles (the subprocess drill passes `SpoolReplica`s)::
+
+        router = ReplicaRouter(RouterConfig(replicas=2,
+                                            state_dir=tmp)).start()
+        t = router.submit(a, deadline_s=5.0)
+        res = t.result(timeout=60.0)
+        router.stop()
+    """
+
+    def __init__(self, config: RouterConfig = RouterConfig(),
+                 replicas: Optional[List[ReplicaHandle]] = None):
+        if config.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got "
+                             f"{config.replicas}")
+        self.config = config
+        self.buckets = BucketSet(config.serve.buckets)
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._probe_seq = itertools.count()
+        self._records: list = []
+        self._stats: dict = {}
+        self._outstanding: Dict[str, RouterTicket] = {}
+        self._accepting = False
+        self.total_rescues = 0
+        if replicas is not None:
+            self.replicas = list(replicas)
+        else:
+            if config.state_dir is None:
+                raise ValueError("RouterConfig.state_dir is required for "
+                                 "router-built local replicas (their "
+                                 "per-replica journals live there)")
+            self.replicas = [
+                LocalReplica(i, self._replica_config(i),
+                             respawn_warmup=config.respawn_warmup)
+                for i in range(config.replicas)]
+        self.ring = HashRing([r.index for r in self.replicas],
+                             vnodes=config.ring_vnodes)
+        self._stop = threading.Event()
+        self._sup_thread: Optional[threading.Thread] = None
+        # Live federation gauges (None when off — free-when-off, the
+        # OBS002 discipline).
+        self.metrics = None
+        if config.metrics:
+            from ..obs.registry import MetricsRegistry
+            self.metrics = MetricsRegistry()
+            self.metrics.add_collector(self._collect_metrics)
+
+    def _replica_config(self, index: int) -> ServeConfig:
+        """Replica ``index``'s ServeConfig: the template with a
+        PER-REPLICA journal path (its own fault domain), digesting on
+        (the ring and resubmit keys need it), an ephemeral metrics port
+        when a fixed one was asked (N replicas on one host must not
+        collide — the real port is in healthz), and the SHARED
+        compile-cache namespace left exactly as the template says (the
+        whole point: one namespace, N replicas, PR 9's content hash
+        makes it safe)."""
+        cfg = self.config
+        rdir = Path(cfg.state_dir) / f"replica-{index}"
+        port = cfg.serve.metrics_port
+        return dataclasses.replace(
+            cfg.serve,
+            journal_path=str(rdir / "journal.jsonl"),
+            compute_digest=True,
+            manifest_path=cfg.manifest_path,
+            metrics_port=(0 if port is not None else None))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        for r in self.replicas:
+            r.start()
+        self._accepting = True
+        self._stop.clear()
+        self._sup_thread = threading.Thread(
+            target=self._supervise, name="svdj-router-supervisor",
+            daemon=True)
+        self._sup_thread.start()
+        return self
+
+    def warmup(self, timeout: float = 600.0) -> None:
+        """Warm every LOCAL replica's registry (spool replicas warm
+        themselves at boot). Sequential on purpose: replica 0 populates
+        the shared persistent cache, replicas 1..N-1 then warm from
+        cache hits — the shared-cold-start property, observable in each
+        replica's coldstart record."""
+        for r in self.replicas:
+            if isinstance(r, LocalReplica):
+                r.service.warmup(timeout=timeout)
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        self._accepting = False
+        self._stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout)
+        for r in self.replicas:
+            try:
+                r.stop(drain=drain, timeout=timeout)
+            except Exception as e:
+                print(f"svdj-router: replica {r.index} stop failed: {e}",
+                      file=sys.stderr)
+
+    def __enter__(self) -> "ReplicaRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=False, timeout=10.0)
+
+    # -- admission / routing ------------------------------------------------
+
+    def submit(self, a, *, compute_u: bool = True, compute_v: bool = True,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None,
+               top_k: Optional[int] = None,
+               phase: str = "full") -> RouterTicket:
+        """Admit one request into the federation: route by the
+        consistent-hash ring — ``(bucket, digest)`` so byte-identical
+        resubmits hit the replica owning the cached result — failing
+        over past quarantined/refusing replicas in deterministic ring
+        order, or raise `AdmissionError` (``NO_REPLICA`` when the whole
+        federation is down; client-fault reasons re-raised from the
+        replica untouched)."""
+        import numpy as _np
+
+        from ..resilience import chaos
+        from .cache import input_digest
+        if not self._accepting:
+            raise AdmissionError(AdmissionReason.SHUTDOWN,
+                                 "router is not accepting requests")
+        a = _np.asarray(a)
+        if a.ndim != 2:
+            raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+        transposed = a.shape[0] < a.shape[1]
+        oriented = a.T if transposed else a
+        m, n = (int(d) for d in oriented.shape)
+        tk = None if top_k is None else min(int(top_k), min(m, n))
+        bucket = self.buckets.route(m, n, str(oriented.dtype), top_k=tk)
+        if bucket is None:
+            raise AdmissionError(
+                AdmissionReason.NO_BUCKET,
+                f"shape {tuple(a.shape)} dtype {a.dtype} fits no declared "
+                f"bucket {[b.name for b in self.buckets]}")
+        digest = input_digest(oriented)
+        rid = request_id or f"fed-{next(self._seq):05d}"
+        pref = self.ring.preference(bucket.name, digest)
+        last: Optional[AdmissionError] = None
+        for idx in pref:
+            replica = self._replica(idx)
+            if replica is None or replica.state is not ReplicaState.ACTIVE:
+                continue
+            # Consume a fault shot only when THIS handle can act on it
+            # (the in-process simulations; a SpoolReplica's process is
+            # killed/wedged by the harness for real) — consuming first
+            # would silently swallow a shot aimed at a spool replica.
+            if isinstance(replica, LocalReplica):
+                wedge = chaos.consume_replica_wedge(idx)
+                if wedge is not None:
+                    replica.freeze_heartbeat(wedge)
+            try:
+                sub = replica.submit(
+                    a, compute_u=compute_u, compute_v=compute_v,
+                    deadline_s=deadline_s, request_id=rid, top_k=top_k,
+                    phase=phase, digest=digest)
+            except ReplicaUnavailable as e:
+                last = AdmissionError(AdmissionReason.SHUTDOWN, str(e))
+                continue
+            except AdmissionError as e:
+                if e.reason in _FAILOVER_REASONS:
+                    last = e
+                    continue
+                raise    # client fault: no replica can fix the request
+            ticket = RouterTicket(rid, digest, bucket.name, router=self)
+            ticket._bind(replica, sub)
+            with self._lock:
+                self._outstanding[rid] = ticket
+                replica.outstanding.add(rid)
+                replica.routes += 1
+            self._bump("routed", f"replica:{idx}")
+            if self.metrics is not None:
+                self.metrics.inc("svdj_router_routes_total",
+                                 replica=idx, bucket=bucket.name,
+                                 help="requests routed to a replica")
+            self._record(event="route", replica=idx, request_id=rid,
+                         bucket=bucket.name, digest=digest,
+                         owner=pref[0], failover=(idx != pref[0]))
+            # Armed replica death fires AFTER the submit landed (the
+            # request is write-ahead journaled on the replica): the
+            # durable state the rescue replays is exactly "this request
+            # was admitted when the replica died". Only a LocalReplica
+            # consumes the shot (see the wedge consumption above).
+            if (isinstance(replica, LocalReplica)
+                    and chaos.consume_replica_kill(idx)):
+                replica.simulate_kill()
+            return ticket
+        if last is not None:
+            raise last
+        raise AdmissionError(
+            AdmissionReason.NO_REPLICA,
+            f"all {len(self.replicas)} replicas are quarantined/dead")
+
+    def _replica(self, index: int) -> Optional[ReplicaHandle]:
+        for r in self.replicas:
+            if r.index == index:
+                return r
+        return None
+
+    def _on_resolve(self, ticket: RouterTicket, replica,
+                    result: ServeResult) -> None:
+        """Outcome bookkeeping at router level (mirrors
+        `Lane.note_outcome`): consecutive NONFINITE/ERROR results from
+        one replica are its bad-outcome eviction ladder."""
+        with self._lock:
+            self._outstanding.pop(ticket.request_id, None)
+            if replica is not None:
+                replica.outstanding.discard(ticket.request_id)
+                status = (result.status.name
+                          if result.status is not None else "ERROR")
+                if result.error is not None or status in ("NONFINITE",
+                                                          "ERROR"):
+                    replica.bad_streak += 1
+                else:
+                    replica.bad_streak = 0
+        name = ("ERROR" if result.error is not None
+                else result.status.name if result.status is not None
+                else "?")
+        self._bump(f"resolved:{name}")
+
+    # -- supervision --------------------------------------------------------
+
+    def _supervise(self) -> None:
+        interval = self.config.supervise_interval_s
+        while not self._stop.wait(interval):
+            try:
+                self._tick()
+            except Exception as e:    # the supervisor must outlive surprises
+                print(f"svdj-router: supervisor tick failed: {e}",
+                      file=sys.stderr)
+
+    def _tick(self, now: Optional[float] = None) -> None:
+        from .fleet import heartbeat_stale
+        cfg = self.config
+        now = time.monotonic() if now is None else now
+        for replica in self.replicas:
+            if replica.state is ReplicaState.ACTIVE:
+                cause = None
+                if not replica.alive():
+                    cause = "replica_dead"
+                elif heartbeat_stale(
+                        now, now - replica.heartbeat_age(now),
+                        busy=replica.busy(),
+                        holds_work=replica.holds_work(),
+                        idle_timeout_s=cfg.heartbeat_timeout_s,
+                        busy_timeout_s=cfg.step_timeout_s):
+                    cause = "heartbeat_stale"
+                elif replica.bad_streak >= cfg.failure_threshold:
+                    cause = "bad_outcomes"
+                else:
+                    cause = self._breaker_verdict(replica)
+                if cause is not None:
+                    self.evict(replica, cause)
+            elif self._accepting:
+                self._probe(replica, now)
+
+    def _breaker_verdict(self, replica: ReplicaHandle) -> Optional[str]:
+        """breaker_stuck_open, surfaced through healthz: every lane
+        breaker OPEN across `open_threshold` consecutive reads means the
+        replica's own escalation ladder is not healing it."""
+        try:
+            hz = replica.healthz()
+        except Exception:
+            return None
+        if not isinstance(hz, dict):
+            return None
+        lanes = (hz.get("fleet") or {}).get("lanes") or []
+        breakers = [l.get("breaker") for l in lanes]
+        if breakers and all(b == "open" for b in breakers):
+            replica.open_streak += 1
+        else:
+            replica.open_streak = 0
+        if replica.open_streak >= self.config.open_threshold:
+            return "breaker_stuck_open"
+        return None
+
+    def evict(self, replica: ReplicaHandle, cause: str) -> None:
+        """Quarantine a sick replica and rescue its journal debt.
+        Idempotent; mirrors `fleet.Fleet.evict` one fault-domain up."""
+        with self._lock:
+            if replica.state is not ReplicaState.ACTIVE:
+                return
+            replica.state = ReplicaState.QUARANTINED
+            replica.generation += 1
+            replica.bad_streak = 0
+            replica.open_streak = 0
+            # Probe clock starts AT eviction (never an instant probe in
+            # the same tick as the rescue).
+            replica.last_probe = time.monotonic()
+            replica.probe_sub = None
+        replica.transitions.append(("active", "quarantined", cause))
+        self._bump("evictions", f"evict_cause:{cause}")
+        if self.metrics is not None:
+            self.metrics.inc("svdj_replica_transitions_total",
+                             replica=replica.index,
+                             to_state="quarantined",
+                             help="replica state transitions")
+        self._record(event="replica_transition", replica=replica.index,
+                     from_state="active", to_state="quarantined",
+                     cause=cause)
+        try:
+            self._rescue(replica, cause)
+        except Exception as e:
+            # A failed rescue must be LOUD but must not kill the
+            # supervisor: the debt stays in the dead journal for the
+            # next attempt (probe-restore or operator).
+            self._bump("rescue_errors")
+            self._record(event="rescue", replica=replica.index,
+                         cause=cause, count=0, request_ids=[],
+                         targets=[], error=f"{type(e).__name__}: {e}")
+            print(f"svdj-router: rescue of replica {replica.index} "
+                  f"failed: {e}", file=sys.stderr)
+        self._record(event="healthz", replica=None,
+                     healthz=self.healthz(probe_replicas=False))
+
+    def _rescue(self, replica: ReplicaHandle, cause: str) -> None:
+        """Replica-death rescue (module docstring): break the dead
+        journal's lock — legitimate exactly HERE, after the supervisor
+        declared the owner dead — scan it exclusively, re-admit the
+        unfinalized debt ring-routed onto healthy replicas at queue
+        FRONT (`SVDService.admit_journal_debt`, write-ahead on the
+        receiver), re-bind the outstanding router tickets, and compact
+        the dead journal to empty. A record with no healthy target
+        resolves ERROR loudly, never silently."""
+        # FENCE first (STONITH): a replica evicted while its process is
+        # still alive — stale heartbeat, bad outcomes, stuck breaker —
+        # must stop serving BEFORE its journal is stolen, or everything
+        # it still holds is double-served under a rewritten journal.
+        # Already-dead replicas ignore the fence by construction.
+        replica.fence()
+        replica.quiesce(timeout=3.0)
+        Journal.break_lock(replica.journal_path)
+        j = Journal(replica.journal_path, exclusive=True)
+        moved: List[str] = []
+        targets_used: List[int] = []
+        try:
+            with j.exclusive():
+                state = j.scan()
+                debt = [rec for rec in state.unfinalized
+                        if not str(rec["id"]).startswith("probe-")]
+                # The transport seam's durability tail: admit-shaped
+                # records the dead replica ACCEPTED (atomic inbox
+                # rename) but never journaled are debt too — the
+                # journal wins on any id it already accounts for.
+                debt += replica.unconsumed_debt(
+                    set(state.admits) | set(state.finalized))
+                groups: Dict[int, List[dict]] = {}
+                orphans: List[dict] = []
+                for rec in debt:
+                    digest = (rec.get("input") or {}).get("data_sha256")
+                    target = None
+                    for idx in self.ring.preference(
+                            str(rec.get("bucket")), digest):
+                        cand = self._replica(idx)
+                        if (cand is not None and cand is not replica
+                                and cand.state is ReplicaState.ACTIVE
+                                and cand.alive()):
+                            target = cand
+                            break
+                    if target is None:
+                        orphans.append(rec)
+                    else:
+                        groups.setdefault(target.index, []).append(rec)
+                for idx, recs in groups.items():
+                    target = self._replica(idx)
+                    subs = target.admit_debt(recs)
+                    targets_used.append(idx)
+                    for rec in recs:
+                        rid = rec["id"]
+                        moved.append(rid)
+                        with self._lock:
+                            rt = self._outstanding.get(rid)
+                            replica.outstanding.discard(rid)
+                            if rt is not None and rid in subs:
+                                target.outstanding.add(rid)
+                        if rt is not None and rid in subs:
+                            rt._bind(target, subs[rid])
+                for rec in orphans:
+                    # No healthy replica left: loud terminal, exactly
+                    # like the fleet's no-healthy-lane rescue.
+                    rt = self._outstanding.get(rec["id"])
+                    if rt is not None:
+                        rt._resolve_once(ServeResult(
+                            u=None, s=None, v=None, status=None,
+                            error=(f"replica {replica.index} evicted "
+                                   f"({cause}) and no healthy replica "
+                                   f"to rescue onto"),
+                            sweeps=0, bucket=rec.get("bucket"),
+                            queue_wait_s=0.0, solve_time_s=None,
+                            path="replica_rescue", degraded=False,
+                            request_id=rec["id"]), replica)
+                # Every debt record is accounted (re-admitted write-ahead
+                # on a receiver, or terminally resolved): compact the
+                # dead journal so a restart of this replica replays
+                # nothing twice. FINALIZE TOMBSTONES are kept for the
+                # requests the dead replica already served — the
+                # federation's exactly-once accounting stays auditable
+                # across the rescue (a late-waking duplicate finalize is
+                # detectable against them), and a respawn's recover()
+                # reads them as zero debt. ORPHANS (no healthy target)
+                # get ERROR tombstones: their loud terminal must leave a
+                # durable trace too, not just an in-memory ticket
+                # resolution — never a silent drop, even on disk.
+                from .journal import JOURNAL_VERSION
+                tombstones = [
+                    (rid, status)
+                    for rid, status in sorted(state.finalized.items())
+                ] + [(rec["id"], "ERROR") for rec in orphans]
+                j.rewrite([
+                    {"journal_version": JOURNAL_VERSION,
+                     "kind": "finalize", "seq": i, "id": rid,
+                     "t_wall": time.time(), "status": status,
+                     "rescue_compacted": True}
+                    for i, (rid, status) in enumerate(tombstones)])
+        finally:
+            j.release()
+        replica.rescued_off += len(moved)
+        with self._lock:
+            self.total_rescues += len(moved)
+        self._bump(*(["rescued"] * len(moved)))
+        if self.metrics is not None and moved:
+            self.metrics.inc("svdj_replica_rescued_total", len(moved),
+                             replica=replica.index,
+                             help="requests rescued off a dead replica")
+        self._record(event="rescue", replica=replica.index, cause=cause,
+                     count=len(moved), request_ids=moved,
+                     targets=sorted(set(targets_used)),
+                     orphaned=len(debt) - len(moved), torn=state.torn)
+
+    # -- recovery -----------------------------------------------------------
+
+    def _probe(self, replica: ReplicaHandle, now: float) -> None:
+        """Outcome-caused replica recovery: a zeros solve of the
+        smallest bucket through the replica's NORMAL dispatch path
+        (respawning a dead replica first); OK -> ACTIVE."""
+        import numpy as _np
+        sub = replica.probe_sub
+        if sub is not None:
+            if not sub.done():
+                if not replica.alive():
+                    replica.probe_sub = None
+                    self._record(event="probe", replica=replica.index,
+                                 ok=False, request_id=replica.probe_rid,
+                                 error="probe replica died")
+                return
+            res = sub.poll(0.0)
+            sub.cleanup()          # a probe result file must not leak
+            replica.probe_sub = None
+            if res is None:
+                return
+            from ..solver import SolveStatus
+            ok = res.error is None and res.status is SolveStatus.OK
+            self._bump(f"probe:{'ok' if ok else 'fail'}")
+            if self.metrics is not None:
+                self.metrics.inc("svdj_replica_probes_total",
+                                 ok=str(bool(ok)).lower(),
+                                 replica=replica.index,
+                                 help="quarantined-replica probes")
+            self._record(event="probe", replica=replica.index,
+                         ok=bool(ok), request_id=replica.probe_rid,
+                         error=res.error)
+            if ok:
+                self.restore(replica, "probe success")
+            return
+        if now - replica.last_probe < self.config.probe_interval_s:
+            return
+        replica.last_probe = now
+        if not replica.alive():
+            if now - replica.last_respawn < self.config.respawn_grace_s:
+                return    # a respawn is still booting; give it time
+            replica.last_respawn = now
+            try:
+                replica.respawn()
+            except Exception as e:
+                self._record(event="probe", replica=replica.index,
+                             ok=False, request_id=None,
+                             error=f"respawn failed: "
+                                   f"{type(e).__name__}: {e}")
+                return
+        b = min(self.buckets, key=lambda b: b.cost)
+        rid = f"probe-fed{replica.index}-{next(self._probe_seq)}"
+        try:
+            sub = replica.submit(
+                _np.zeros((b.m, b.n), _np.dtype(b.dtype)),
+                compute_u=False, compute_v=False,
+                deadline_s=self.config.probe_timeout_s,
+                request_id=rid,
+                top_k=(b.k if b.kind == "topk" else None))
+        except (ReplicaUnavailable, AdmissionError) as e:
+            self._record(event="probe", replica=replica.index, ok=False,
+                         request_id=rid, error=str(e))
+            return
+        replica.probe_sub = sub
+        replica.probe_rid = rid
+
+    def restore(self, replica: ReplicaHandle, cause: str) -> None:
+        with self._lock:
+            if replica.state is not ReplicaState.QUARANTINED:
+                return
+            replica.state = ReplicaState.ACTIVE
+            replica.bad_streak = 0
+            replica.open_streak = 0
+        replica.transitions.append(("quarantined", "active", cause))
+        self._bump("restores")
+        if self.metrics is not None:
+            self.metrics.inc("svdj_replica_transitions_total",
+                             replica=replica.index, to_state="active",
+                             help="replica state transitions")
+        self._record(event="replica_transition", replica=replica.index,
+                     from_state="quarantined", to_state="active",
+                     cause=cause)
+
+    # -- views --------------------------------------------------------------
+
+    def ready(self) -> bool:
+        return bool(self._accepting
+                    and any(r.state is ReplicaState.ACTIVE and r.alive()
+                            for r in self.replicas))
+
+    def healthz(self, probe_replicas: bool = True) -> dict:
+        """The federated view: per-replica snapshots (states, heartbeat
+        ages, streaks, outstanding counts, metrics listener addresses),
+        ring ownership of every declared bucket, rescue totals."""
+        now = time.monotonic()
+        reps = [r.snapshot(now) for r in self.replicas]
+        out = {
+            "ok": any(r["alive"] for r in reps),
+            "ready": self.ready(),
+            "replicas": reps,
+            "active": sum(1 for r in reps if r["state"] == "active"),
+            "quarantined": sum(1 for r in reps
+                               if r["state"] == "quarantined"),
+            "rescues": self.total_rescues,
+            "ring": self.ring.ownership(b.name for b in self.buckets),
+            "stats": self.stats(),
+        }
+        if probe_replicas:
+            out["replica_healthz"] = {
+                r.index: self._safe_healthz(r) for r in self.replicas}
+        return out
+
+    @staticmethod
+    def _safe_healthz(replica: ReplicaHandle) -> Optional[dict]:
+        try:
+            return replica.healthz()
+        except Exception:
+            return None
+
+    def metrics_targets(self) -> List[Tuple[str, int]]:
+        """The REAL (host, port) of every replica's live /metrics
+        listener (ephemeral ports resolved through healthz) — what a
+        Prometheus scraper should be pointed at."""
+        out = []
+        for r in self.replicas:
+            hz = self._safe_healthz(r)
+            http = (hz or {}).get("http")
+            if isinstance(http, dict) and http.get("port"):
+                out.append((str(http.get("host", "127.0.0.1")),
+                            int(http["port"])))
+        return out
+
+    def metrics_text(self) -> str:
+        if self.metrics is None:
+            return ("# svdj router metrics disabled "
+                    "(RouterConfig.metrics=False)\n")
+        return self.metrics.render()
+
+    def _collect_metrics(self, reg) -> None:
+        owned: Dict[int, int] = {}
+        for b in self.buckets:
+            owned[self.ring.owner(b.name)] = \
+                owned.get(self.ring.owner(b.name), 0) + 1
+        for r in self.replicas:
+            ri = str(r.index)
+            reg.set("svdj_replica_state",
+                    1.0 if r.state is ReplicaState.ACTIVE else 0.0,
+                    replica=ri, help="1 = ACTIVE, 0 = QUARANTINED")
+            reg.set("svdj_ring_owned_buckets",
+                    float(owned.get(r.index, 0)), replica=ri,
+                    help="declared buckets whose ring owner this is")
+            reg.set("svdj_replica_outstanding",
+                    float(len(r.outstanding)), replica=ri,
+                    help="router tickets currently bound to the replica")
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._records)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _bump(self, *keys: str) -> None:
+        with self._lock:
+            for k in keys:
+                self._stats[k] = self._stats.get(k, 0) + 1
+
+    def _record(self, *, event: str, replica: Optional[int] = None,
+                **extra) -> None:
+        from .. import obs
+        record = obs.manifest.build_router(event=event, replica=replica,
+                                           **extra)
+        with self._lock:
+            if self.config.max_records > 0:
+                self._records.append(record)
+                del self._records[:-self.config.max_records]
+        if self.config.manifest_path is not None:
+            try:
+                from .. import obs as _obs
+                _obs.manifest.append(self.config.manifest_path, record)
+            except Exception as e:
+                self._bump("manifest_errors")
+                print(f"svdj-router: manifest append failed: {e}",
+                      file=sys.stderr)
+
+
+# -- spool replica serve loop (the subprocess side) ---------------------------
+
+
+def run_spool_replica(spool_dir, config: ServeConfig, *,
+                      poll_s: float = 0.02, warmup: bool = False,
+                      max_runtime_s: Optional[float] = None) -> int:
+    """The serve loop of one spool-replica PROCESS (`SpoolReplica`'s
+    counterpart; `tests/_router_worker.py` and `cli serve-demo
+    --replicas` spawn this): build the service, replay the journal (a
+    restarted replica recovers its own remaining debt), warm from the
+    shared compile cache, then poll the inbox — submits, rescue debt
+    batches, stop — writing one atomic outbox file per terminal result
+    and rewriting the heartbeat every turn. Returns the process exit
+    code (0 on a clean stop)."""
+    spool = Path(spool_dir)
+    inbox, outbox = spool / "inbox", spool / "outbox"
+    inbox.mkdir(parents=True, exist_ok=True)
+    outbox.mkdir(parents=True, exist_ok=True)
+    hb_path = spool / "heartbeat.json"
+    boot_wall = time.time()     # fences older than this target a past life
+
+    from .journal import decode_array
+    svc = SVDService(config)
+    outstanding: Dict[str, Any] = {}
+    # Per-request orientation of the PLAIN submit lane: the router
+    # pre-oriented the payload, so the outbox record must tell the
+    # decoder to swap the factors back (journal-debt results are
+    # de-oriented by the service itself and never swap).
+    transpose_out: Dict[str, bool] = {}
+    # Ids the journal already accounts for (admitted or finalized in a
+    # previous life): an inbox file that survived the crash window
+    # between journal append and unlink must NOT be double-admitted.
+    journal_seen: set = set()
+    finalized_prev: Dict[str, str] = {}
+    if (config.journal_path is not None
+            and Path(config.journal_path).exists()):
+        st0 = Journal(config.journal_path).scan(quarantine=False)
+        journal_seen = set(st0.admits) | set(st0.finalized)
+        finalized_prev = dict(st0.finalized)
+        outstanding.update(svc.recover())
+    svc.start()
+    coldstart = None
+    if warmup:
+        svc.warmup(timeout=600.0)
+        cold = [r for r in svc.records() if r.get("kind") == "coldstart"]
+        if cold:
+            coldstart = {
+                "fresh_compiles": cold[-1]["fresh_compiles"],
+                "cache_hits": cold[-1]["cache_hits"],
+                "backend_compiles": cold[-1]["backend_compiles"],
+                "total_s": cold[-1]["total_s"]}
+
+    def write_heartbeat() -> None:
+        lanes = svc.fleet.lanes
+        _write_json_atomic(hb_path, {
+            "t_wall": time.time(),
+            "pid": os.getpid(),
+            "boot_id": host_boot_id(),
+            "busy": any(l.in_step for l in lanes),
+            "holds_work": bool(outstanding) or any(
+                l.in_flight or l.queue.depth() > 0 for l in lanes),
+            "coldstart": coldstart,
+            "healthz": _trim_healthz(svc),
+        })
+
+    # The heartbeat is the PROCESS's liveness signal, so it must not
+    # depend on the inbox loop's scheduling: on a loaded host the GIL
+    # can starve the loop past the router's idle staleness bound while
+    # the solve threads are making perfectly good progress — a dedicated
+    # writer thread keeps the signal honest (a SIGKILL stops it all the
+    # same, which is the event it exists to expose).
+    hb_stop = threading.Event()
+
+    def _hb_loop() -> None:
+        while not hb_stop.wait(0.2):
+            try:
+                write_heartbeat()
+            except Exception:
+                pass
+
+    write_heartbeat()
+    threading.Thread(target=_hb_loop, name="svdj-spool-heartbeat",
+                     daemon=True).start()
+
+    t_end = (None if max_runtime_s is None
+             else time.monotonic() + max_runtime_s)
+    stop_rc: Optional[int] = None
+    try:
+        while stop_rc is None:
+            if t_end is not None and time.monotonic() > t_end:
+                stop_rc = 4    # runtime fuse: a forgotten worker exits
+                break
+            for f in sorted(inbox.glob("*.json")):
+                rec = _read_json(f)
+                if rec is None:
+                    continue    # mid-rename glimpse; next turn
+                kind = rec.get("kind")
+                if kind == "stop":
+                    _unlink_quiet(f)
+                    stop_rc = 0
+                    break
+                if kind == "fence":
+                    # Router fencing (STONITH before journal rescue):
+                    # exit IMMEDIATELY, serving nothing else — queued
+                    # work must stay as journal debt for the rescuer.
+                    # A fence older than this process's boot targeted a
+                    # previous life (the respawn must not re-die on it).
+                    if float(rec.get("t_wall", 0.0)) >= boot_wall:
+                        os._exit(5)
+                    _unlink_quiet(f)
+                    continue
+                if kind == "debt":
+                    try:
+                        outstanding.update(
+                            svc.admit_journal_debt(rec["records"]))
+                    except Exception as e:
+                        # A malformed rescue batch must not kill the
+                        # replica loop; the router's own debt accounting
+                        # (the receiver journals write-ahead) bounds the
+                        # damage to the bad batch.
+                        print(f"svdj-spool: debt admit failed: "
+                              f"{type(e).__name__}: {e}", file=sys.stderr)
+                    _unlink_quiet(f)
+                    continue
+                rid = str(rec.get("id"))
+                if rid in journal_seen:
+                    # The crash window between a previous life's journal
+                    # append and the inbox unlink: the journal already
+                    # owns this id (its debt was replayed at boot, its
+                    # finalize settled it) — double-admitting it here
+                    # would break exactly-once. A finalized-but-lost
+                    # result is reported LOUDLY, never silently.
+                    if (rid in finalized_prev
+                            and not (outbox / f"{rid}.json").exists()
+                            and rid not in outstanding):
+                        _write_json_atomic(outbox / f"{rid}.json", {
+                            "id": rid, "status": None,
+                            "error": (f"request finalized "
+                                      f"{finalized_prev[rid]} before a "
+                                      f"crash; the result did not "
+                                      f"survive the restart (journal "
+                                      f"exactly-once forbids a silent "
+                                      f"re-solve)"),
+                            "sweeps": 0, "bucket": None,
+                            "queue_wait_s": 0.0, "solve_time_s": None,
+                            "path": "recovery", "degraded": False,
+                            "u": None, "s": None, "v": None})
+                    _unlink_quiet(f)
+                    continue
+                try:
+                    a = decode_array(rec["input"])     # ORIENTED payload
+                    deadline_s = rec.get("deadline_s")
+                    if deadline_s is not None:
+                        # Wall-clock deadline budget across the process
+                        # boundary: decay from the router's submit time.
+                        deadline_s = (float(rec["t_wall"])
+                                      + float(deadline_s) - time.time())
+                    t = svc.submit(a, request_id=rid,
+                                   compute_u=bool(rec.get("compute_u",
+                                                          True)),
+                                   compute_v=bool(rec.get("compute_v",
+                                                          True)),
+                                   deadline_s=deadline_s,
+                                   top_k=rec.get("top_k"),
+                                   phase=str(rec.get("phase", "full")),
+                                   # The payload checksum IS the oriented
+                                   # digest — no third hash of the same
+                                   # bytes on the replica.
+                                   digest=(rec.get("input") or {}).get(
+                                       "data_sha256"))
+                    outstanding[rid] = t
+                    transpose_out[rid] = bool(rec.get("transposed",
+                                                      False))
+                except AdmissionError as e:
+                    _write_json_atomic(outbox / f"{rid}.json", {
+                        "id": rid,
+                        "status": f"REJECTED_{e.reason.name}",
+                        "error": e.detail, "sweeps": 0, "bucket": None,
+                        "queue_wait_s": 0.0, "solve_time_s": None,
+                        "path": "rejected", "degraded": False,
+                        "u": None, "s": None, "v": None})
+                except Exception as e:
+                    _write_json_atomic(outbox / f"{rid}.json", {
+                        "id": rid, "status": None,
+                        "error": f"{type(e).__name__}: {e}", "sweeps": 0,
+                        "bucket": None, "queue_wait_s": 0.0,
+                        "solve_time_s": None, "path": "rejected",
+                        "degraded": False, "u": None, "s": None,
+                        "v": None})
+                # Unlink AFTER the request is journaled (inside submit)
+                # or terminally answered: a crash mid-processing leaves
+                # the inbox file as the write-ahead record the rescue
+                # replays (`SpoolReplica.unconsumed_debt`); the
+                # journal_seen dedupe absorbs the double-accounting
+                # window on restart.
+                _unlink_quiet(f)
+            for rid in [r for r, t in outstanding.items() if t.done()]:
+                res = outstanding.pop(rid).result(0)
+                enc = _encode_result(res)
+                enc["transposed"] = transpose_out.pop(rid, False)
+                _write_json_atomic(outbox / f"{rid}.json", enc)
+            time.sleep(poll_s)
+    finally:
+        hb_stop.set()
+        try:
+            svc.stop(drain=True, timeout=60.0)
+            for rid in list(outstanding):
+                t = outstanding.pop(rid)
+                if t.done():
+                    enc = _encode_result(t.result(0))
+                    enc["transposed"] = transpose_out.pop(rid, False)
+                    _write_json_atomic(outbox / f"{rid}.json", enc)
+        except Exception:
+            pass
+    return int(stop_rc or 0)
+
+
+def _trim_healthz(svc: SVDService) -> dict:
+    """The heartbeat's healthz excerpt: JSON-safe, small, and carrying
+    exactly what the router supervisor reads (breaker states per lane,
+    readiness, the REAL metrics listener address)."""
+    hz = svc.healthz()
+    fleet = hz.get("fleet") or {}
+    return {
+        "ok": bool(hz.get("ok")),
+        "ready": bool(hz.get("ready")),
+        "breaker": hz.get("breaker"),
+        "queue_depth": int(hz.get("queue_depth", 0)),
+        "in_flight": hz.get("in_flight"),
+        "http": hz.get("http"),
+        "fleet": {"lanes": [
+            {"lane": l.get("lane"), "breaker": l.get("breaker"),
+             "state": l.get("state")}
+            for l in (fleet.get("lanes") or [])]},
+    }

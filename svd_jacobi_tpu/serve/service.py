@@ -101,6 +101,12 @@ class Ticket:
     def __init__(self, request_id: str, service=None, phase: str = "full"):
         self.request_id = request_id
         self.phase = phase
+        # SHA-256 of the oriented input bytes — the ResultCache /
+        # replica-router resubmit key, set at admission when digesting
+        # is on (``result_cache_bytes > 0`` or
+        # ``ServeConfig.compute_digest``); None otherwise. Clients key
+        # byte-identical resubmits off this instead of re-hashing.
+        self.digest: Optional[str] = None
         self._service = service
         self._done = threading.Event()
         self._result: Optional[ServeResult] = None
@@ -299,6 +305,13 @@ class ServeConfig:
     # dispatch and no queue slot. 0 disables (no digesting — the exact
     # pre-cache submit path).
     result_cache_bytes: int = 0
+    # Digest every admitted input even with the result cache OFF: the
+    # oriented-input SHA-256 (the ResultCache key ingredient) is then
+    # exposed on `Ticket.digest` and in the per-request serve records,
+    # so clients and the replica router (`serve.router`) can key
+    # byte-identical resubmits without re-hashing. Implied by
+    # ``result_cache_bytes > 0``.
+    compute_digest: bool = False
     # --- serving flight recorder (obs.registry / obs.spans) --------------
     # Live metrics registry + per-request span timelines + SLO
     # accounting. OFF by default and FREE when off: no registry object
@@ -360,6 +373,11 @@ class SVDService:
         self._lock = threading.Lock()
         self._accepting = False
         self._drain = True
+        # chaos.kill_replica's in-process SIGKILL simulation: once set,
+        # workers exit at their next pop WITHOUT serving or finalizing —
+        # queued requests stay stranded as journal debt, exactly what a
+        # process loss leaves behind (`_chaos_kill`).
+        self._killed = False
         self._seq = itertools.count()
         self._batch_seq = itertools.count()
         # The lane set (a trivial one-lane fleet when lanes == 1) owns
@@ -394,9 +412,14 @@ class SVDService:
         # at first use, cleared by `reload`'s swap (a reloaded solver
         # config must never serve a stale cached result).
         self._bucket_cfg_hash: dict = {}
-        # Durable request journal (write-ahead; see `recover`).
+        # Durable request journal (write-ahead; see `recover`). Opened
+        # EXCLUSIVE: the service is this path's one live writer, and a
+        # second live service on the same path fails loudly with
+        # `JournalLockedError` (two replicas interleaving fsync'd
+        # records into one journal would silently corrupt the
+        # exactly-once story — serve.journal module docstring).
         from .journal import Journal
-        self.journal = (Journal(config.journal_path)
+        self.journal = (Journal(config.journal_path, exclusive=True)
                         if config.journal_path is not None else None)
         # request_id -> Ticket of journal-recovered requests (`recover`).
         self.recovered: dict = {}
@@ -536,9 +559,42 @@ class SVDService:
         # Belt-and-braces: anything still queued anywhere (a crashed or
         # quarantined lane's leftovers the supervisor no longer rescues)
         # is finalized, never stranded.
-        if all(not t.is_alive() for t in threads):
+        workers_gone = all(not t.is_alive() for t in threads)
+        if workers_gone:
             self._cancel_queued()
         self.stop_http()
+        if self.journal is not None and workers_gone:
+            # The stopped service is single-use: drop the journal path's
+            # exclusivity lock so a successor (restart, or the replica
+            # router's rescue) can claim it without breaking anything.
+            # ONLY once every worker thread is dead — a worker that
+            # outlived the join timeout is still a live writer, and
+            # releasing under it would let a successor interleave with
+            # its final appends (the exact corruption the lock exists
+            # to prevent; the stale-lock auto-break covers the eventual
+            # cleanup if this process then dies holding it).
+            self.journal.release()
+
+    def _chaos_kill(self) -> None:
+        """In-process SIGKILL simulation (`chaos.kill_replica` /
+        `serve.router`): stop accepting, close every lane queue (wakes
+        blocked pops), bump every lane generation, and flag the service
+        killed so workers exit at their next loop turn WITHOUT serving,
+        finalizing, or rescuing anything — queued requests stay exactly
+        where a process loss would leave them: as unfinalized write-ahead
+        journal debt. A dispatch already inside a solve completes and
+        finalizes normally (a thread cannot be interrupted mid-solve
+        in-process; the journal-scan rescue skips it as finalized). The
+        journal lock is NOT released — a SIGKILL'd process releases
+        nothing, which is what `Journal.break_lock` exists for."""
+        with self._lock:
+            self._accepting = False
+            self._killed = True
+            for lane in self.fleet.lanes:
+                lane.generation += 1
+        self.fleet.stop_supervisor(timeout=1.0)
+        for lane in self.fleet.lanes:
+            lane.queue.close()
 
     def _cancel_queued(self) -> None:
         for lane in self.fleet.lanes:
@@ -700,7 +756,6 @@ class SVDService:
         `start()`)."""
         if self.journal is None:
             raise ValueError("recover() requires ServeConfig.journal_path")
-        from .journal import decode_array
         tickets: dict = {}
         queued: list = []     # (lane, req, admit_record) in admit order
         terminal: list = []   # (ticket, rec, status, error) — applied last
@@ -728,48 +783,14 @@ class SVDService:
                 self._seq = itertools.count(max(auto) + 1)
             debt = state.unfinalized
             for rec in debt:
-                rid = rec["id"]
-                ticket = Ticket(rid, self, str(rec.get("phase", "full")))
-                tickets[rid] = ticket
-                deadline_s = rec.get("deadline_s")
-                try:
-                    a = decode_array(rec["input"])
-                except Exception as e:
-                    terminal.append((ticket, rec, "ERROR",
-                                     f"journal payload: {e}"))
+                ticket, req, status, error = self._debt_request(
+                    rec, now_wall, now_mono)
+                tickets[rec["id"]] = ticket
+                if req is None:
+                    terminal.append((ticket, rec, status, error))
                     continue
-                if deadline_s is not None:
-                    remaining = rec["t_wall"] + float(deadline_s) - now_wall
-                    if remaining <= 0:
-                        # The promise expired with the dead process —
-                        # honor the budget, loudly, without a sweep.
-                        terminal.append((ticket, rec, "DEADLINE", None))
-                        continue
-                bucket = self.buckets.route(rec["m"], rec["n"],
-                                            str(a.dtype),
-                                            top_k=rec.get("top_k"))
-                if bucket is None:
-                    terminal.append((
-                        ticket, rec, "ERROR",
-                        f"journaled bucket {rec.get('bucket')} no "
-                        f"longer routable in this configuration"))
-                    continue
-                req = Request(
-                    id=rid, a=a, m=int(rec["m"]), n=int(rec["n"]),
-                    orig_shape=tuple(rec["orig_shape"]),
-                    transposed=bool(rec["transposed"]), bucket=bucket,
-                    compute_u=bool(rec["compute_u"]),
-                    compute_v=bool(rec["compute_v"]),
-                    degraded=bool(rec.get("degraded", False)),
-                    brownout=str(rec.get("brownout", "FULL")),
-                    deadline=(None if deadline_s is None
-                              else now_mono + remaining),
-                    deadline_s=deadline_s, submitted=now_mono,
-                    cancel=ticket._cancel, ticket=ticket,
-                    top_k=rec.get("top_k"), rank_mode=bucket.kind,
-                    phase=str(rec.get("phase", "full")))
                 try:
-                    lane = self.fleet.route(bucket)
+                    lane = self.fleet.route(req.bucket)
                 except AdmissionError as e:
                     terminal.append((ticket, rec, "ERROR", e.detail))
                     continue
@@ -811,31 +832,142 @@ class SVDService:
                            torn=state.torn)
         return tickets
 
+    def _debt_request(self, rec: dict, now_wall: float,
+                      now_mono: float) -> tuple:
+        """Rebuild one journaled admit record into a live `Request`
+        (ticket attached, remaining wall-clock deadline budget intact),
+        or a terminal verdict when it cannot be re-admitted. Returns
+        ``(ticket, req, status_name, error)`` — ``req`` is None iff the
+        record terminalizes instead (expired deadline -> DEADLINE,
+        corrupt payload / unroutable bucket -> ERROR). Shared by
+        `recover` (this process's own journal) and `admit_journal_debt`
+        (another replica's journal, handed over by the router's
+        rescue)."""
+        from .journal import decode_array
+        rid = rec["id"]
+        ticket = Ticket(rid, self, str(rec.get("phase", "full")))
+        deadline_s = rec.get("deadline_s")
+        try:
+            a = decode_array(rec["input"])
+        except Exception as e:
+            return ticket, None, "ERROR", f"journal payload: {e}"
+        remaining = None
+        if deadline_s is not None:
+            remaining = rec["t_wall"] + float(deadline_s) - now_wall
+            if remaining <= 0:
+                # The promise expired with the dead process — honor the
+                # budget, loudly, without a sweep.
+                return ticket, None, "DEADLINE", None
+        bucket = self.buckets.route(rec["m"], rec["n"], str(a.dtype),
+                                    top_k=rec.get("top_k"))
+        if bucket is None:
+            return ticket, None, "ERROR", (
+                f"journaled bucket {rec.get('bucket')} no longer "
+                f"routable in this configuration")
+        # The journal payload's SHA-256 IS the oriented-input digest the
+        # result cache / router key by (same bytes, same definition) —
+        # carry it so a rescued clean solve still lands in the receiving
+        # replica's result cache and serve records.
+        digest = (rec.get("input") or {}).get("data_sha256")
+        ticket.digest = digest
+        req = Request(
+            id=rid, a=a, m=int(rec["m"]), n=int(rec["n"]),
+            orig_shape=tuple(rec["orig_shape"]),
+            transposed=bool(rec["transposed"]), bucket=bucket,
+            compute_u=bool(rec["compute_u"]),
+            compute_v=bool(rec["compute_v"]),
+            degraded=bool(rec.get("degraded", False)),
+            brownout=str(rec.get("brownout", "FULL")),
+            deadline=(None if remaining is None else now_mono + remaining),
+            deadline_s=deadline_s, submitted=now_mono,
+            cancel=ticket._cancel, ticket=ticket,
+            top_k=rec.get("top_k"), rank_mode=bucket.kind,
+            phase=str(rec.get("phase", "full")), digest=digest)
+        return ticket, req, None, None
+
+    def admit_journal_debt(self, records, *,
+                           via: str = "replica_rescue") -> dict:
+        """Re-admit ANOTHER replica's journaled-but-unfinalized requests
+        onto THIS service — the replica router's rescue lane
+        (`serve.router`), mirroring the lane supervisor's rescue one
+        fault domain up. Each record is write-ahead journaled HERE
+        (attempt-bumped, ORIGINAL admit wall time preserved so deadline
+        budgets keep decaying from the client's real submit) before
+        being requeued at the FRONT of its bucket's lane queue — the
+        rescued request already waited its turn on the replica that
+        died. Expired deadlines finalize DEADLINE, corrupt payloads /
+        unroutable buckets ERROR — loud, with ``via`` as the serve-record
+        path — and exactly-once is the existing composition: the caller
+        scans the dead journal under its (broken-then-reacquired) lock
+        and skips finalized ids, this journal's write-ahead admit makes
+        a second rescue replayable, and `Ticket._finalize_once` wins
+        in-process races. Returns ``{request_id: Ticket}``."""
+        tickets: dict = {}
+        queued: list = []
+        now_wall, now_mono = time.time(), time.monotonic()
+        for rec in records:
+            rid = rec["id"]
+            if rid in tickets:
+                continue
+            ticket, req, status, error = self._debt_request(
+                rec, now_wall, now_mono)
+            tickets[rid] = ticket
+            if req is None:
+                self._recover_terminal(ticket, rec, status, error=error,
+                                       path=via)
+                continue
+            req.via = via
+            try:
+                lane = self.fleet.route(req.bucket)
+            except AdmissionError as e:
+                self._recover_terminal(ticket, rec, "ERROR",
+                                       error=e.detail, path=via)
+                continue
+            if self.journal is not None:
+                # Write-ahead on the RECEIVING replica: once this append
+                # returns, the rescued request survives a second crash
+                # here too (original admit time kept, attempt bumped).
+                self._observe_journal_append(self.journal.append_admit(
+                    req, attempt=int(rec.get("attempt", 1)) + 1,
+                    admitted_wall=rec["t_wall"],
+                    payload_mode=self.config.journal_payload))
+            queued.append((lane, req, rec))
+        # Reverse admit order so the oldest rescued request ends up at
+        # the very front — recovered FIFO, like `recover`.
+        for lane, req, rec in reversed(queued):
+            if not lane.queue.requeue(req):
+                self._recover_terminal(req.ticket, rec, "CANCELLED",
+                                       path=via)
+        self._bump(*([f"rescued_in"] * len(queued)))
+        return tickets
+
     def _recover_terminal(self, ticket: Ticket, rec: dict,
                           status_name: str,
-                          error: Optional[str] = None) -> bool:
+                          error: Optional[str] = None,
+                          path: str = "recovery") -> bool:
         """Terminalize a journal-recovered request WITHOUT re-admitting
         it (expired deadline, corrupt payload, unroutable bucket) —
-        loud: a serve record with path="recovery", a journal finalize,
-        never a silent drop."""
+        loud: a serve record with path="recovery" (or the router
+        rescue's "replica_rescue"), a journal finalize, never a silent
+        drop."""
         from ..solver import SolveStatus
         result = ServeResult(
             u=None, s=None, v=None,
             status=(None if error is not None
                     else SolveStatus[status_name]),
             error=error, sweeps=0, bucket=rec.get("bucket"),
-            queue_wait_s=0.0, solve_time_s=None, path="recovery",
+            queue_wait_s=0.0, solve_time_s=None, path=path,
             degraded=bool(rec.get("degraded", False)), request_id=rec["id"])
         if not ticket._finalize_once(result):
             return False
         self._journal_finalize(rec["id"], status_name)
-        self._bump("served", f"status:{status_name}", "path:recovery")
+        self._bump("served", f"status:{status_name}", f"path:{path}")
         self._record(
             request_id=rec["id"],
             orig_shape=tuple(rec.get("orig_shape", (0, 0))),
             dtype=str(rec.get("input", {}).get("dtype", "?")),
             bucket=rec.get("bucket"), queue_wait_s=0.0, solve_time_s=None,
-            status=status_name, path="recovery",
+            status=status_name, path=path,
             breaker=self.breaker.state().value,
             brownout=str(rec.get("brownout", "FULL")), degraded=False,
             deadline_s=rec.get("deadline_s"), error=error,
@@ -999,6 +1131,13 @@ class SVDService:
             "fleet": self.fleet.healthz(),
             "result_cache": self.result_cache.snapshot(),
             "promotions": self.promotions.snapshot(),
+            # The ACTUAL bound (host, port) of the metrics listener —
+            # with ``metrics_port=0`` (ephemeral: the only collision-free
+            # choice for several replicas on one host) this is where a
+            # scraper/router must look, since the configured port says 0.
+            "http": (None if self._http_addr is None
+                     else {"host": self._http_addr[0],
+                           "port": self._http_addr[1]}),
         }
         if self.slo is not None:
             # SLO accounting rides the liveness probe: per-bucket
@@ -1014,7 +1153,12 @@ class SVDService:
 
     def stats(self) -> dict:
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+        if self._http_addr is not None:
+            # The live listener's REAL port (metrics_port=0 binds an
+            # ephemeral one); counters only otherwise.
+            out["http_port"] = self._http_addr[1]
+        return out
 
     # -- serving flight recorder (obs.registry / obs.spans) -----------------
 
@@ -1211,7 +1355,8 @@ class SVDService:
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
                top_k: Optional[int] = None,
-               phase: str = "full") -> Ticket:
+               phase: str = "full",
+               digest: Optional[str] = None) -> Ticket:
         """Admit one request: returns a `Ticket` or raises
         `AdmissionError` (reason: SHUTDOWN | NO_BUCKET | BROWNOUT_SHED |
         QUEUE_FULL | DEADLINE_BUDGET). ``deadline_s`` is relative to now;
@@ -1335,10 +1480,19 @@ class SVDService:
             # (deliberately) bypasses the SHED rung below: serving it
             # adds no load. Only full-phase requests consult the cache;
             # the promotion store is the sigma phase's own reuse lane.
-            digest = None
-            if self.result_cache.max_bytes > 0:
+            # ``digest`` may arrive precomputed (the replica router
+            # hashes the oriented bytes to key its ring; re-hashing the
+            # same megabytes here would double the admission tax —
+            # PROFILE item 30's hot path). Trusted like any caller
+            # input: a wrong digest mis-keys the cache exactly as a
+            # caller hashing the wrong bytes would.
+            if not (self.result_cache.max_bytes > 0
+                    or self.config.compute_digest):
+                digest = None
+            elif digest is None:
                 digest = self._input_digest(a)
-                if phase == "full":
+            if digest is not None:
+                if phase == "full" and self.result_cache.max_bytes > 0:
                     hit = self._cache_lookup(
                         rid, digest, bucket, m=m, n=n,
                         orig_shape=orig_shape,
@@ -1354,6 +1508,7 @@ class SVDService:
                     f"{self.queue.max_depth} at shed threshold")
             now = time.monotonic()
             ticket = Ticket(rid, self, phase)
+            ticket.digest = digest
             req = Request(
                 id=rid, a=a, m=m, n=n, orig_shape=orig_shape,
                 transposed=transposed, bucket=bucket,
@@ -1434,14 +1589,11 @@ class SVDService:
 
     @staticmethod
     def _input_digest(a) -> str:
-        """SHA-256 of the ORIENTED input bytes (host pull for device
-        arrays — the cache trades one D2H copy per submit for whole
-        skipped solves on every byte-identical resubmit)."""
-        import hashlib
-
-        import numpy as _np
-        return hashlib.sha256(
-            _np.ascontiguousarray(_np.asarray(a)).tobytes()).hexdigest()
+        """SHA-256 of the ORIENTED input bytes (`serve.cache.input_digest`
+        — ONE definition shared with the journal payload checksum and
+        the replica router's ring key)."""
+        from .cache import input_digest
+        return input_digest(a)
 
     def _cfg_hash_for(self, bucket) -> str:
         """Content hash of the bucket's declaration-time resolved solver
@@ -1516,6 +1668,7 @@ class SVDService:
         if entry is None:
             return None
         ticket = Ticket(rid, self, "full")
+        ticket.digest = digest
         result = ServeResult(
             u=entry["u"], s=entry["s"], v=entry["v"],
             status=SolveStatus(int(entry["status"])), error=None,
@@ -1540,7 +1693,7 @@ class SVDService:
                      path="cache", breaker=self.breaker.state().value,
                      brownout=brown.name, degraded=False,
                      deadline_s=deadline_s, sweeps=int(entry["sweeps"]),
-                     rank_mode=bucket.kind, k=top_k)
+                     rank_mode=bucket.kind, k=top_k, digest=digest)
         return ticket
 
     def _maybe_cache_result(self, req: Request, result: ServeResult,
@@ -1597,6 +1750,10 @@ class SVDService:
         single = self.fleet.size == 1
         poll = None if single else self._FLEET_POLL_S
         while True:
+            if self._killed:
+                # chaos.kill_replica: simulated process loss — exit
+                # without serving, finalizing, or rescuing anything.
+                return
             if lane.generation != gen:
                 return     # evicted: a respawned worker owns this lane now
             lane.beat()
@@ -1615,6 +1772,13 @@ class SVDService:
                     stolen = req is not None
                 if req is None:
                     continue
+            if self._killed:
+                # Simulated process loss AFTER the pop: the request is
+                # dropped un-finalized (its write-ahead admit record IS
+                # the durable debt a rescuer replays) — finalizing or
+                # rescuing here would be work a SIGKILL'd process could
+                # never have done.
+                return
             if lane.generation != gen:
                 # Evicted between pop and dispatch: this worker may not
                 # serve anymore — hand the request to the rescue path.
@@ -2011,32 +2175,35 @@ class SVDService:
 
         from ..resilience import chaos
         from ..solver import BatchedSweepStepper
-        if all(isinstance(r.a, np.ndarray) for r in live):
-            # Host-admitted members: build the padded tier stack in one
-            # host buffer and pay ONE device transfer for the whole batch.
-            buf = np.zeros((tier, bucket.m, bucket.n),
-                           np.dtype(bucket.dtype))
-            for j, r in enumerate(live):
-                buf[j, :r.a.shape[0], :r.a.shape[1]] = r.a
-            a = jnp.asarray(buf)
-        else:
-            stack = [self.buckets.pad(r.a, bucket) for r in live]
-            if tier > len(stack):
-                pad = jnp.zeros((bucket.m, bucket.n),
-                                jnp.dtype(bucket.dtype))
-                stack += [pad] * (tier - len(stack))
-            a = jnp.stack(stack)
-        a = self._place(a, lane)
-        if chaos.consume_poison(lane.index):
-            a = a.at[0, 0, 0].set(jnp.nan)
-        stall = chaos.consume_stuck()
-        if stall is not None:
-            self._stall(live[0], stall, lane)
-        slow = chaos.consume_slow()
-        scfg = self._solver_for(bucket)
-        ccu, ccv = self._core_flags(bucket, cu, cv)
-        lane.in_step = True     # device/compile stalls are legitimate here
+        # in_step from the first device op (cf. `_solve_base`): the
+        # stack pad/placement compiles on a cold lane too.
+        lane.in_step = True
         try:
+            if all(isinstance(r.a, np.ndarray) for r in live):
+                # Host-admitted members: build the padded tier stack in
+                # one host buffer and pay ONE device transfer for the
+                # whole batch.
+                buf = np.zeros((tier, bucket.m, bucket.n),
+                               np.dtype(bucket.dtype))
+                for j, r in enumerate(live):
+                    buf[j, :r.a.shape[0], :r.a.shape[1]] = r.a
+                a = jnp.asarray(buf)
+            else:
+                stack = [self.buckets.pad(r.a, bucket) for r in live]
+                if tier > len(stack):
+                    pad = jnp.zeros((bucket.m, bucket.n),
+                                    jnp.dtype(bucket.dtype))
+                    stack += [pad] * (tier - len(stack))
+                a = jnp.stack(stack)
+            a = self._place(a, lane)
+            if chaos.consume_poison(lane.index):
+                a = a.at[0, 0, 0].set(jnp.nan)
+            stall = chaos.consume_stuck()
+            if stall is not None:
+                self._stall(live[0], stall, lane)
+            slow = chaos.consume_slow()
+            scfg = self._solver_for(bucket)
+            ccu, ccv = self._core_flags(bucket, cu, cv)
             core_in, lift = self._pre_core(bucket, a, scfg, batched=True)
             st = BatchedSweepStepper(core_in, compute_u=ccu, compute_v=ccv,
                                      config=scfg)
@@ -2221,20 +2388,25 @@ class SVDService:
 
         from ..resilience import chaos
         from ..solver import SweepStepper
-        a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
-        if chaos.consume_poison(lane.index):
-            # NaN-poison the working set so the solve surfaces NONFINITE
-            # through the production health word (chaos.poison_lane) —
-            # on the tall/topk families through the sketch-stage flag.
-            a_pad = a_pad.at[0, 0].set(jnp.nan)
-        stall = chaos.consume_stuck()
-        if stall is not None:
-            self._stall(req, stall, lane)
-        slow = chaos.consume_slow()
-        scfg = self._solver_for(req.bucket)
-        ccu, ccv = self._core_flags(req.bucket, cu, cv)
-        lane.in_step = True     # device/compile stalls are legitimate here
+        # in_step from the very first device op: the bucket PAD is a jit
+        # too, and on a cold replica its compile can outlast the idle
+        # heartbeat bound — a compiling lane must be judged by the step
+        # bound, not evicted as wedged (the supervisor's two-tier rule).
+        lane.in_step = True
         try:
+            a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
+            if chaos.consume_poison(lane.index):
+                # NaN-poison the working set so the solve surfaces
+                # NONFINITE through the production health word
+                # (chaos.poison_lane) — on the tall/topk families
+                # through the sketch-stage flag.
+                a_pad = a_pad.at[0, 0].set(jnp.nan)
+            stall = chaos.consume_stuck()
+            if stall is not None:
+                self._stall(req, stall, lane)
+            slow = chaos.consume_slow()
+            scfg = self._solver_for(req.bucket)
+            ccu, ccv = self._core_flags(req.bucket, cu, cv)
             # The pre-stage runs under in_step too: its first dispatch
             # per (bucket, lane) is a legitimate compile stall.
             core_in, lift = self._pre_core(req.bucket, a_pad, scfg,
@@ -2299,15 +2471,15 @@ class SVDService:
         import jax.numpy as jnp
 
         from ..resilience import chaos, resilient_svd
-        a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
-        if chaos.consume_poison(lane.index):
-            a_pad = jnp.asarray(a_pad).at[0, 0].set(jnp.nan)
         on_overrun = None
         if self.fleet.size > 1:
             on_overrun = (lambda info:
                           self.fleet.flag_unhealthy(lane, "ladder_overrun"))
         lane.in_step = True     # the fused ladder blocks for whole solves
         try:
+            a_pad = self._place(self.buckets.pad(req.a, req.bucket), lane)
+            if chaos.consume_poison(lane.index):
+                a_pad = jnp.asarray(a_pad).at[0, 0].set(jnp.nan)
             return resilient_svd(a_pad, compute_u=cu, compute_v=cv,
                                  config=self._solver_for(req.bucket),
                                  manifest_path=self.config.manifest_path,
@@ -2589,17 +2761,24 @@ class SVDService:
                      else []),
                    *([f"rank_mode:{req.rank_mode}"]
                      if req.rank_mode != "full" else []))
+        # A router-rescued request's record path carries its provenance
+        # ("replica_rescue") instead of the generic "base" — the ladder
+        # and control paths stay visible as themselves.
+        record_path = (req.via if (req.via is not None and path == "base")
+                       else path)
         self._record(
             request_id=req.id, orig_shape=req.orig_shape,
             dtype=req.bucket.dtype, bucket=req.bucket.name,
             queue_wait_s=queue_wait, solve_time_s=solve_time,
-            status=status_name, path=path, breaker=breaker_state.value,
+            status=status_name, path=record_path,
+            breaker=breaker_state.value,
             brownout=req.brownout,
             degraded=req.degraded, deadline_s=req.deadline_s,
             sweeps=result.sweeps, error=result.error,
             batch_id=batch_id, batch_size=batch_size,
             batch_tier=batch_tier, lane=lane,
-            rank_mode=req.rank_mode, k=req.top_k, phase=req.phase)
+            rank_mode=req.rank_mode, k=req.top_k, phase=req.phase,
+            digest=req.digest)
         return True
 
     def _finalize_rescue(self, req: Request, status_name: str,
@@ -2675,7 +2854,8 @@ class SVDService:
                 rank_mode: str = "full",
                 k: Optional[int] = None,
                 phase: str = "full",
-                promoted_from: Optional[str] = None) -> None:
+                promoted_from: Optional[str] = None,
+                digest: Optional[str] = None) -> None:
         from .. import obs
         record = obs.manifest.build_serve(
             request_id=request_id, m=orig_shape[0], n=orig_shape[1],
@@ -2689,7 +2869,8 @@ class SVDService:
             batch_size=batch_size, batch_tier=batch_tier,
             lane=(None if lane is None else int(lane)),
             rank_mode=str(rank_mode), k=(None if k is None else int(k)),
-            phase=str(phase), promoted_from=promoted_from)
+            phase=str(phase), promoted_from=promoted_from,
+            digest=(None if digest is None else str(digest)))
         self._store(record)
 
     def _record_cache(self, store: str, event: str, *,

@@ -7,10 +7,21 @@ path runs in CI with no TPU attached.
 """
 
 import os
+from pathlib import Path
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+# Subprocess drills (tests/_*_worker.py) run the package from a bare
+# `python tests/_x_worker.py` child; script-mode sys.path holds the
+# SCRIPT's directory, not the repo root, so without an installed package
+# the child dies on `import svd_jacobi_tpu` before the drill starts.
+# Export the repo root once so every spawned child inherits it.
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+os.environ["PYTHONPATH"] = (
+    _REPO_ROOT + os.pathsep + os.environ["PYTHONPATH"]
+    if os.environ.get("PYTHONPATH") else _REPO_ROOT)
 
 import jax
 

@@ -306,6 +306,10 @@ class TestRecover:
             j.append_admit(_mk_request(writer, f"rq-{i}", seed=i,
                                        deadline_s=600.0))
         j.append_finalize("rq-0", "OK")       # already served pre-crash
+        # The writer plays a CRASHED process: a real crash leaves a
+        # dead-pid lockfile the successor auto-breaks; in-process the
+        # pid stays live, so stand in for the death by releasing.
+        writer.journal.release()
         svc = SVDService(_cfg(journal_path=str(jpath)))
         tickets = svc.recover()
         assert sorted(tickets) == ["rq-1", "rq-2"]
@@ -333,6 +337,7 @@ class TestRecover:
         # The original admit was 60 wall-seconds ago: the 5 s budget is
         # long spent — recovery must honor it, not resurrect it.
         writer.journal.append_admit(req, admitted_wall=time.time() - 60.0)
+        writer.journal.release()   # stand in for the dead owner
         svc = SVDService(_cfg(journal_path=str(jpath)))
         tickets = svc.recover()
         res = tickets["rq-exp"].result(timeout=5.0)
@@ -350,6 +355,7 @@ class TestRecover:
         records, _ = manifest.read_jsonl_tolerant(jpath)
         records[0]["input"]["data_sha256"] = "0" * 64
         jpath.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        writer.journal.release()   # stand in for the dead owner
         svc = SVDService(_cfg(journal_path=str(jpath)))
         tickets = svc.recover()
         res = tickets["rq-bad"].result(timeout=5.0)
@@ -365,6 +371,7 @@ class TestRecover:
             writer.journal.append_admit(
                 _mk_request(writer, f"r{i:05d}", seed=i, deadline_s=600.0))
         writer.journal.append_finalize("r00001", "OK")
+        writer.journal.release()   # stand in for the dead owner
         svc = SVDService(_cfg(journal_path=str(jpath)))
         tickets = svc.recover()
         assert sorted(tickets) == ["r00000", "r00002"]
@@ -582,6 +589,7 @@ class TestJournalPayloadModes:
         writer.journal.append_admit(_mk_request(writer, "jp-1",
                                                 deadline_s=600.0),
                                     payload_mode="digest")
+        writer.journal.release()   # stand in for the dead owner
         svc = SVDService(_cfg(journal_path=str(jpath)))
         tickets = svc.recover()
         res = tickets["jp-1"].result(timeout=5.0)

@@ -1,0 +1,694 @@
+"""Federated replica router lane (serve.router): consistent-hash
+routing, journal exclusivity, replica-death rescue, probe recovery, and
+the ROUTE001 contract — plus the real-SIGKILL subprocess drill
+(tests/_router_worker.py) in the chaos+slow lane."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from svd_jacobi_tpu import SVDConfig  # noqa: E402
+from svd_jacobi_tpu.obs import manifest  # noqa: E402
+from svd_jacobi_tpu.resilience import chaos  # noqa: E402
+from svd_jacobi_tpu.serve import (AdmissionError, AdmissionReason,  # noqa: E402
+                                  HashRing, Journal, JournalLockedError,
+                                  ReplicaRouter, ReplicaState, RouterConfig,
+                                  ServeConfig, SpoolReplica, SVDService,
+                                  input_digest)
+from svd_jacobi_tpu.utils import matgen  # noqa: E402
+
+pytestmark = pytest.mark.router
+
+BUCKETS = ((32, 32, "float64"), (48, 32, "float64"))
+SOLVER = SVDConfig(block_size=4)
+
+
+def _serve_cfg(**over):
+    base = dict(buckets=BUCKETS, solver=SOLVER, max_queue_depth=32,
+                brownout_sigma_only_at=2.0, brownout_shed_at=2.0,
+                result_cache_bytes=16 << 20)
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _router_cfg(tmp_path, **over):
+    base = dict(replicas=2, serve=_serve_cfg(),
+                state_dir=str(tmp_path / "router-state"),
+                supervise_interval_s=0.02, heartbeat_timeout_s=0.6,
+                probe_interval_s=0.05, probe_timeout_s=120.0)
+    base.update(over)
+    return RouterConfig(**base)
+
+
+def _mat(m, n, seed):
+    return np.asarray(matgen.random_dense(m, n, seed=seed,
+                                          dtype=jnp.float64))
+
+
+def _sref(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+def _routed_replica(router, request_id):
+    recs = [r for r in router.records() if r.get("event") == "route"
+            and r.get("request_id") == request_id]
+    assert recs, f"no route record for {request_id}"
+    return recs[-1]["replica"]
+
+
+def _wait(pred, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring.
+
+
+class TestHashRing:
+    def test_deterministic_and_complete(self):
+        r1 = HashRing((0, 1, 2), vnodes=64)
+        r2 = HashRing((0, 1, 2), vnodes=64)
+        for i in range(32):
+            d = input_digest(np.full((4, 3), i, np.float32))
+            assert r1.preference("32x32:float64", d) == \
+                r2.preference("32x32:float64", d)
+            assert sorted(r1.preference("32x32:float64", d)) == [0, 1, 2]
+        assert r1.preference("48x32:float64") == \
+            r2.preference("48x32:float64")
+
+    def test_resubmit_lands_on_owner(self):
+        ring = HashRing((0, 1), vnodes=64)
+        a = _mat(30, 24, seed=5)
+        b = np.asarray(a, order="F")      # same bytes, different layout
+        assert input_digest(a) == input_digest(b)
+        assert ring.owner("32x32:float64", input_digest(a)) == \
+            ring.owner("32x32:float64", input_digest(b))
+
+    def test_minimal_disruption_on_departure(self):
+        full = HashRing((0, 1, 2), vnodes=64)
+        reduced = HashRing((1, 2), vnodes=64)
+        for i in range(64):
+            d = input_digest(np.full((2, 2), i, np.float32))
+            if full.owner("b", d) != 0:
+                assert reduced.owner("b", d) == full.owner("b", d)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="vnodes"):
+            HashRing((0, 1), vnodes=0)
+        with pytest.raises(ValueError, match="duplicate"):
+            HashRing((0, 0))
+
+
+# ---------------------------------------------------------------------------
+# Journal exclusivity (satellite).
+
+
+class TestJournalLock:
+    def test_second_live_opener_fails_loudly(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        j1 = Journal(p, exclusive=True)
+        with pytest.raises(JournalLockedError, match="LIVE"):
+            Journal(p, exclusive=True)
+        j1.release()
+        Journal(p, exclusive=True).release()   # relockable after release
+
+    def test_dead_owner_lock_breaks_automatically(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        # A lockfile whose owner pid is gone (a SIGKILL'd process): the
+        # successor must break it unattended, loudly.
+        (tmp_path / "j.jsonl.lock").write_text(json.dumps(
+            {"pid": 2 ** 22 + 1234567, "boot_id": "some-other-boot",
+             "token": "dead", "t_wall": 0.0}))
+        with pytest.warns(RuntimeWarning, match="stale lock"):
+            j = Journal(p, exclusive=True)
+        assert j.locked
+        j.release()
+
+    def test_break_lock_overrides_live_owner(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        j1 = Journal(p, exclusive=True)
+        assert Journal.break_lock(p) is True
+        j2 = Journal(p, exclusive=True)      # the rescuer's fresh lock
+        # The dead owner's eventual cleanup must NOT delete the
+        # rescuer's lock (token mismatch).
+        j1.release()
+        assert (tmp_path / "j.jsonl.lock").exists()
+        j2.release()
+        assert not (tmp_path / "j.jsonl.lock").exists()
+
+    def test_nonexclusive_scan_coexists_with_live_owner(self, tmp_path):
+        p = tmp_path / "j.jsonl"
+        j1 = Journal(p, exclusive=True)
+        assert Journal(p).scan().unfinalized == []   # read surface
+        j1.release()
+
+    def test_two_live_services_one_path_refused(self, tmp_path):
+        jpath = str(tmp_path / "j.jsonl")
+        svc = SVDService(_serve_cfg(journal_path=jpath))
+        with pytest.raises(JournalLockedError):
+            SVDService(_serve_cfg(journal_path=jpath))
+        svc.start()
+        svc.stop(timeout=30.0)
+        # stop() released the lock: a successor service can claim it.
+        SVDService(_serve_cfg(journal_path=jpath)).journal.release()
+
+    @pytest.mark.chaos
+    def test_cross_process_live_owner_refused(self, tmp_path):
+        """The subprocess half of the satellite: a lock held by a LIVE
+        sibling process refuses this process's opener."""
+        p = tmp_path / "j.jsonl"
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys, time\n"
+             "from svd_jacobi_tpu.serve.journal import Journal\n"
+             f"j = Journal({str(p)!r}, exclusive=True)\n"
+             "print('locked', flush=True)\n"
+             "time.sleep(60)\n"],
+            stdout=subprocess.PIPE, text=True,
+            cwd=str(Path(__file__).resolve().parent.parent))
+        try:
+            assert child.stdout.readline().strip() == "locked"
+            with pytest.raises(JournalLockedError, match="LIVE"):
+                Journal(p, exclusive=True)
+        finally:
+            child.kill()
+            child.wait(timeout=30)
+        # The owner is dead now: the opener auto-breaks its stale lock.
+        with pytest.warns(RuntimeWarning, match="stale lock"):
+            Journal(p, exclusive=True).release()
+
+
+# ---------------------------------------------------------------------------
+# Ticket digest exposure (satellite).
+
+
+class TestTicketDigest:
+    def test_digest_on_ticket_and_record(self):
+        with SVDService(_serve_cfg(result_cache_bytes=0,
+                                   compute_digest=True)) as svc:
+            a = _mat(30, 24, seed=3)
+            t = svc.submit(a, request_id="dg-0")
+            res = t.result(timeout=300.0)
+            assert res.status is not None and res.status.name == "OK"
+            assert t.digest == input_digest(a)
+            rec = [r for r in svc.records() if r.get("kind") == "serve"
+                   and r["request"]["id"] == "dg-0"][0]
+            assert rec["digest"] == t.digest
+            manifest.validate(rec)       # schema round-trip
+
+    def test_digest_off_by_default(self):
+        with SVDService(_serve_cfg(result_cache_bytes=0)) as svc:
+            t = svc.submit(_mat(30, 24, seed=3))
+            t.result(timeout=300.0)
+            assert t.digest is None
+
+    def test_cache_hit_ticket_carries_digest(self):
+        with SVDService(_serve_cfg()) as svc:
+            a = _mat(30, 24, seed=4)
+            svc.submit(a).result(timeout=300.0)
+            t2 = svc.submit(a)
+            res2 = t2.result(timeout=30.0)
+            assert res2.path == "cache"
+            assert t2.digest == input_digest(a)
+
+    def test_build_serve_digest_round_trip(self):
+        rec = manifest.build_serve(
+            request_id="x", m=4, n=3, dtype="float32", bucket="b",
+            queue_wait_s=0.0, solve_time_s=0.1, status="OK", path="base",
+            breaker="closed", brownout="FULL", digest="ab" * 32)
+        manifest.validate(rec)
+        assert rec["digest"] == "ab" * 32
+        with pytest.raises(ValueError):
+            manifest.validate({**rec, "digest": 7})
+
+
+# ---------------------------------------------------------------------------
+# Metrics listener ephemeral port (satellite).
+
+
+@pytest.mark.obs
+class TestEphemeralMetricsPort:
+    def test_two_replicas_one_host_distinct_ports(self):
+        cfgs = [_serve_cfg(metrics=True, metrics_port=0)
+                for _ in range(2)]
+        svcs = [SVDService(c).start() for c in cfgs]
+        try:
+            ports = []
+            for svc in svcs:
+                hz = svc.healthz()
+                assert hz["http"] is not None and hz["http"]["port"] > 0
+                assert svc.stats()["http_port"] == hz["http"]["port"]
+                ports.append(hz["http"]["port"])
+            assert ports[0] != ports[1]
+        finally:
+            for svc in svcs:
+                svc.stop(timeout=30.0)
+
+    def test_router_aggregates_metrics_targets(self, tmp_path):
+        cfg = _router_cfg(tmp_path,
+                          serve=_serve_cfg(metrics=True, metrics_port=0))
+        with ReplicaRouter(cfg) as router:
+            targets = router.metrics_targets()
+            assert len(targets) == 2
+            assert len({p for _, p in targets}) == 2
+
+
+# ---------------------------------------------------------------------------
+# Federated serving.
+
+
+class TestRouterServing:
+    def test_routes_and_matches_oracle(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            mats = [_mat(30, 24, seed=i) for i in range(4)]
+            tickets = [router.submit(m, deadline_s=300.0) for m in mats]
+            for m, t in zip(mats, tickets):
+                res = t.result(timeout=300.0)
+                assert res.status is not None and res.status.name == "OK"
+                assert np.abs(np.asarray(res.s) - _sref(m)).max() < 1e-10
+                assert t.digest == input_digest(m)
+            # Deterministic: the route records agree with the ring.
+            for m, t in zip(mats, tickets):
+                assert _routed_replica(router, t.request_id) == \
+                    router.ring.owner("32x32:float64", input_digest(m))
+
+    def test_resubmit_hits_owner_cache(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            a = _mat(30, 24, seed=9)
+            t1 = router.submit(a, deadline_s=300.0)
+            assert t1.result(timeout=300.0).status.name == "OK"
+            t2 = router.submit(a, deadline_s=300.0)
+            res2 = t2.result(timeout=60.0)
+            assert res2.path == "cache"     # zero dispatch on the owner
+            assert _routed_replica(router, t2.request_id) == \
+                _routed_replica(router, t1.request_id)
+
+    def test_failover_past_quarantined_replica(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            a = _mat(30, 24, seed=11)
+            owner = router.ring.owner("32x32:float64", input_digest(a))
+            router.replicas[owner].state = ReplicaState.QUARANTINED
+            t = router.submit(a, deadline_s=300.0)
+            assert t.result(timeout=300.0).status.name == "OK"
+            served = _routed_replica(router, t.request_id)
+            assert served != owner
+            rec = [r for r in router.records()
+                   if r.get("event") == "route"
+                   and r.get("request_id") == t.request_id][-1]
+            assert rec["failover"] is True and rec["owner"] == owner
+            router.replicas[owner].state = ReplicaState.ACTIVE
+
+    def test_no_replica_is_loud(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            for r in router.replicas:
+                r.state = ReplicaState.QUARANTINED
+            with pytest.raises(AdmissionError) as ei:
+                router.submit(_mat(30, 24, seed=1))
+            assert ei.value.reason is AdmissionReason.NO_REPLICA
+            for r in router.replicas:
+                r.state = ReplicaState.ACTIVE
+
+    def test_client_fault_not_failed_over(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            with pytest.raises(AdmissionError) as ei:
+                router.submit(np.ones((500, 400)))
+            assert ei.value.reason is AdmissionReason.NO_BUCKET
+
+    def test_healthz_federated_view(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            hz = router.healthz()
+            assert hz["active"] == 2 and hz["quarantined"] == 0
+            assert set(hz["ring"]) == {"32x32:float64", "48x32:float64"}
+            assert all(s["journal"] for s in hz["replicas"])
+            assert router.ready()
+
+    def test_per_replica_journals_are_distinct_and_locked(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            paths = {r.journal_path for r in router.replicas}
+            assert len(paths) == 2
+            for p in paths:
+                with pytest.raises(JournalLockedError):
+                    Journal(p, exclusive=True)
+
+
+# ---------------------------------------------------------------------------
+# Replica chaos: kill -> evict -> journal rescue -> probe recovery.
+
+
+@pytest.mark.chaos
+class TestReplicaChaos:
+    def test_kill_replica_rescues_and_recovers(self, tmp_path):
+        with ReplicaRouter(_router_cfg(tmp_path)) as router:
+            mats = [_mat(30, 24, seed=20 + i) for i in range(5)]
+            victim_idx = router.ring.owner("32x32:float64",
+                                           input_digest(mats[0]))
+            # The probe RESPAWNS the victim with a fresh service whose
+            # in-memory records start empty (a real process restart
+            # loses them too; the manifest file is the durable stream)
+            # — hold the pre-kill service to audit its records.
+            victim_service = router.replicas[victim_idx].service
+            with chaos.slow_solve(0.3, shots=64):
+                with chaos.kill_replica(victim_idx):
+                    tickets = [router.submit(m, deadline_s=600.0)
+                               for m in mats]
+                results = [t.result(timeout=600.0) for t in tickets]
+            for m, res in zip(mats, results):
+                assert res.error is None and res.status.name == "OK"
+                assert np.abs(np.asarray(res.s) - _sref(m)).max() < 1e-10
+            # The rescue reconstructs from the router stream.
+            events = router.records()
+            trans = [r for r in events
+                     if r.get("event") == "replica_transition"]
+            assert any(r["to_state"] == "quarantined"
+                       and r["replica"] == victim_idx for r in trans)
+            rescues = [r for r in events if r.get("event") == "rescue"
+                       and r.get("replica") == victim_idx]
+            assert rescues and rescues[0]["count"] >= 1
+            # Rescued requests carry path="replica_rescue" in the
+            # RECEIVING replica's serve records.
+            survivor = router.replicas[1 - victim_idx]
+            rescued_paths = [r for r in survivor.service.records()
+                             if r.get("kind") == "serve"
+                             and r.get("path") == "replica_rescue"]
+            assert len(rescued_paths) >= 1
+            # Exactly-once: every submitted id terminal exactly once
+            # across BOTH replicas' serve streams.
+            ids = [t.request_id for t in tickets]
+
+            def terminal_map():
+                # The ticket unblocks BEFORE the worker appends its
+                # serve record (finalize-then-record) — give the last
+                # append a moment to land before auditing the stream.
+                out = {}
+                for recs in (victim_service.records(),
+                             survivor.service.records()):
+                    for r in recs:
+                        if (r.get("kind") == "serve"
+                                and r["request"]["id"] in ids):
+                            out[r["request"]["id"]] = \
+                                out.get(r["request"]["id"], 0) + 1
+                return out
+            assert _wait(lambda: set(terminal_map()) == set(ids),
+                         timeout=10.0)
+            terminal = terminal_map()
+            assert all(terminal.get(i, 0) == 1 for i in ids), terminal
+            # Offline timeline: the federation edges (ring verdict +
+            # rescue) join the rescued request's causal story.
+            from svd_jacobi_tpu.obs.spans import timeline_from_manifest
+            rescued_rid = rescues[0]["request_ids"][0]
+            stream = (router.records() + victim_service.records()
+                      + survivor.service.records())
+            names = [e["name"]
+                     for e in timeline_from_manifest(stream, rescued_rid)]
+            assert "route" in names and "rescue" in names
+            assert "finalize" in names
+            # Outcome-caused recovery: probe returns the victim ACTIVE.
+            assert _wait(lambda: router.replicas[victim_idx].state
+                         is ReplicaState.ACTIVE, timeout=60.0)
+            assert any(r["to_state"] == "active" and
+                       r["replica"] == victim_idx for r in
+                       [x for x in router.records()
+                        if x.get("event") == "replica_transition"])
+            # The recovered replica serves again (through the ring).
+            t = router.submit(mats[0], deadline_s=300.0)
+            assert t.result(timeout=300.0).status.name == "OK"
+
+    def test_wedge_replica_evicts_then_recovers(self, tmp_path):
+        cfg = _router_cfg(tmp_path, heartbeat_timeout_s=0.4,
+                          step_timeout_s=0.4)
+        with ReplicaRouter(cfg) as router:
+            a = _mat(30, 24, seed=40)
+            victim_idx = router.ring.owner("32x32:float64",
+                                           input_digest(a))
+            with chaos.slow_solve(0.25, shots=16):
+                with chaos.wedge_replica(victim_idx, wedge_s=1.5):
+                    t1 = router.submit(a, deadline_s=600.0)
+                    t2 = router.submit(_mat(28, 20, seed=41),
+                                       deadline_s=600.0)
+                    res = [t1.result(timeout=600.0),
+                           t2.result(timeout=600.0)]
+            assert all(r.error is None and r.status.name == "OK"
+                       for r in res)
+            assert any(r.get("event") == "replica_transition"
+                       and r.get("cause") == "heartbeat_stale"
+                       for r in router.records())
+            assert _wait(lambda: router.replicas[victim_idx].state
+                         is ReplicaState.ACTIVE, timeout=60.0)
+
+    def test_registry_reconstruction_matches_live(self, tmp_path):
+        from svd_jacobi_tpu.obs.registry import registry_from_manifest
+        cfg = _router_cfg(tmp_path, metrics=True)
+        with ReplicaRouter(cfg) as router:
+            a = _mat(30, 24, seed=50)
+            victim_idx = router.ring.owner("32x32:float64",
+                                           input_digest(a))
+            with chaos.slow_solve(0.3, shots=32):
+                with chaos.kill_replica(victim_idx):
+                    tickets = [router.submit(_mat(30, 24, seed=50 + i),
+                                             deadline_s=600.0)
+                               for i in range(3)]
+                [t.result(timeout=600.0) for t in tickets]
+            text = router.metrics_text()
+            assert "svdj_replica_state" in text
+            assert "svdj_ring_owned_buckets" in text
+            offline = registry_from_manifest(router.records())
+            live_rescued = router.metrics.value(
+                "svdj_replica_rescued_total", replica=str(victim_idx))
+            off_rescued = offline.value("svdj_replica_rescued_total",
+                                        replica=str(victim_idx))
+            assert live_rescued == off_rescued and live_rescued >= 1
+
+
+# ---------------------------------------------------------------------------
+# ROUTE001 pass (ring rules; the live rescue rule runs in the analysis
+# suite itself).
+
+
+class TestRouteAnalysisPass:
+    def test_ring_rules_clean(self):
+        from svd_jacobi_tpu.analysis import route_checks
+        assert route_checks.check_ring_determinism() == []
+        assert route_checks.check_resubmit_affinity() == []
+
+    def test_seeded_skew_fires(self):
+        from svd_jacobi_tpu.analysis import route_checks
+        findings = route_checks.check_ring_determinism(seed_skew=True)
+        assert findings and all(f.code == "ROUTE001" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# The real-SIGKILL subprocess drill (chaos + slow: two worker boots +
+# a kill + a respawn are tens of seconds on the CPU container).
+
+
+def _spawn_worker(tmp_path, idx, cache, warmup=True, slow_s=0.0):
+    spool = tmp_path / f"spool-{idx}"
+    journal = tmp_path / f"journal-{idx}.jsonl"
+    argv = [sys.executable,
+            str(Path(__file__).resolve().parent / "_router_worker.py"),
+            "serve", "--spool", str(spool), "--journal", str(journal),
+            "--cache", str(cache), "--replica", str(idx),
+            # The runtime fuse is an ORPHAN backstop only — it must
+            # comfortably outlive the whole drill, or it reads as a
+            # mysterious mid-drill replica death.
+            "--max-runtime-s", "900"]
+    if warmup:
+        argv.append("--warmup")
+    if slow_s > 0:
+        argv += ["--slow-s", str(slow_s)]
+    log = open(tmp_path / f"worker-{idx}.log", "a")
+    proc = subprocess.Popen(argv, stdout=log, stderr=log)
+    return proc, spool, journal
+
+
+def _wait_heartbeat(spool, timeout=180.0):
+    hb = spool / "heartbeat.json"
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if hb.exists():
+            try:
+                return json.loads(hb.read_text())
+            except json.JSONDecodeError:
+                pass
+        time.sleep(0.1)
+    raise TimeoutError(f"no heartbeat in {spool}")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestSpoolSigkillDrill:
+    def test_sigkill_one_of_two_loaded_replicas(self, tmp_path):
+        cache = tmp_path / "shared-cache"
+        procs = {}
+        try:
+            # Replica 0 boots FIRST and populates the shared persistent
+            # compile-cache namespace; replica 1 then warm-boots from it.
+            p0, spool0, journal0 = _spawn_worker(tmp_path, 0, cache,
+                                                 slow_s=0.10)
+            hb0 = _wait_heartbeat(spool0)
+            procs[0] = p0
+            p1, spool1, journal1 = _spawn_worker(tmp_path, 1, cache,
+                                                 slow_s=0.10)
+            hb1 = _wait_heartbeat(spool1)
+            procs[1] = p1
+            # Shared cold start: the SECOND boot's warmup reads the
+            # namespace replica 0 populated — zero fresh compiles.
+            assert hb0["coldstart"] is not None
+            assert hb1["coldstart"] is not None
+            assert hb1["coldstart"]["fresh_compiles"] == 0, hb1
+            assert hb1["coldstart"]["cache_hits"] > 0
+
+            replicas = [
+                SpoolReplica(0, spool0, journal0),
+                SpoolReplica(1, spool1, journal1),
+            ]
+            cfg = RouterConfig(
+                replicas=2,
+                serve=ServeConfig(
+                    buckets=((48, 32, "float32"),),
+                    solver=SVDConfig(pair_solver="pallas"),
+                    max_queue_depth=64,
+                    brownout_sigma_only_at=2.0, brownout_shed_at=2.0),
+                state_dir=str(tmp_path),
+                supervise_interval_s=0.05,
+                heartbeat_timeout_s=2.0,
+                probe_interval_s=0.5, probe_timeout_s=180.0)
+            router = ReplicaRouter(cfg, replicas=replicas).start()
+            try:
+                rng = np.random.default_rng(0)
+                mats = [rng.standard_normal((40, 30)).astype(np.float32)
+                        for _ in range(8)]
+                tickets = [router.submit(m, deadline_s=600.0,
+                                         request_id=f"drill-{i:02d}")
+                           for i, m in enumerate(mats)]
+                # Wait until the victim holds journaled-but-UNFINALIZED
+                # debt (the slow solves keep its queue loaded), then
+                # REAL SIGKILL — no cleanup, no final fsync beyond what
+                # write-ahead already guaranteed.
+                victim = max((0, 1),
+                             key=lambda i: len(replicas[i].outstanding))
+                vjournal = tmp_path / f"journal-{victim}.jsonl"
+                assert _wait(
+                    lambda: bool(Journal(vjournal).scan(
+                        quarantine=False).unfinalized),
+                    timeout=120.0)
+                os.kill(procs[victim].pid, signal.SIGKILL)
+                procs[victim].wait(timeout=30)
+
+                # Zero lost requests: every ticket terminal, OK == oracle.
+                def _results_or_diagnose(timeout=300.0):
+                    deadline = time.monotonic() + timeout
+                    out = []
+                    for t in tickets:
+                        try:
+                            out.append(t.result(timeout=max(
+                                5.0, deadline - time.monotonic())))
+                        except TimeoutError:
+                            # Postmortem before pytest kills the
+                            # workers: thread stacks into the worker
+                            # logs, router records to stdout.
+                            for p in procs.values():
+                                if p.poll() is None:
+                                    os.kill(p.pid, signal.SIGUSR1)
+                            time.sleep(1.0)
+                            print("UNRESOLVED:", t.request_id)
+                            for rec in router.records():
+                                print({k: rec.get(k) for k in (
+                                    "event", "replica", "cause", "count",
+                                    "request_ids", "targets", "error",
+                                    "ok", "request_id")})
+                            print("STATS:", router.stats())
+                            for i in (0, 1):
+                                log = tmp_path / f"worker-{i}.log"
+                                if log.exists():
+                                    print(f"--- worker {i} log tail:")
+                                    print(log.read_text()[-3000:])
+                            raise
+                    return out
+                results = _results_or_diagnose()
+                for m, res in zip(mats, results):
+                    assert res.error is None, res
+                    assert res.status.name == "OK"
+                    sref = np.linalg.svd(np.asarray(m, np.float64),
+                                         compute_uv=False)
+                    assert np.abs(np.asarray(res.s, np.float64)
+                                  - sref).max() < 5e-4
+                # Router stayed serviceable and rescued the debt.
+                assert router.total_rescues >= 1
+                events = router.records()
+                assert any(r.get("event") == "rescue"
+                           and r.get("count", 0) >= 1 for r in events)
+                # Exactly-once, journal-verified, BEFORE the victim is
+                # respawned (its recover() compacts the journal again):
+                # the victim journal holds finalize tombstones for what
+                # it served pre-kill (the rescue's compaction keeps
+                # them), the survivor its own admits + finalizes incl.
+                # the rescued debt — each drill id finalizes at most
+                # once per journal, exactly once across the federation.
+                ids = {t.request_id for t in tickets}
+                finalized_all = {}
+                for jp in (tmp_path / "journal-0.jsonl",
+                           tmp_path / "journal-1.jsonl"):
+                    recs, _ = manifest.read_jsonl_tolerant(
+                        jp, quarantine=False)
+                    per = {}
+                    for r in recs:
+                        if (r.get("kind") == "finalize"
+                                and r.get("id") in ids):
+                            per[r["id"]] = per.get(r["id"], 0) + 1
+                    assert all(c == 1 for c in per.values()), per
+                    for rid in per:
+                        finalized_all[rid] = finalized_all.get(rid, 0) + 1
+                assert set(finalized_all) == ids
+                assert all(c == 1 for c in finalized_all.values()), \
+                    finalized_all
+
+                # Respawn = a process supervisor restarting the
+                # replica; wired only NOW so the drill controls the
+                # audit-vs-respawn ordering.
+                def respawn():
+                    p, _, _ = _spawn_worker(tmp_path, victim, cache,
+                                            warmup=True)
+                    procs[victim] = p
+                replicas[victim]._respawn_cmd = respawn
+                # Dead replica recovers to ACTIVE via the probe.
+                assert _wait(lambda: replicas[victim].state
+                             is ReplicaState.ACTIVE, timeout=240.0)
+                # The respawned boot (the NEW pid's heartbeat, not the
+                # dead process's stale file) warm-started from the
+                # shared cache: zero fresh backend compiles.
+                def respawned_hb():
+                    try:
+                        hb = json.loads(
+                            (tmp_path / f"spool-{victim}"
+                             / "heartbeat.json").read_text())
+                    except (OSError, json.JSONDecodeError):
+                        return None
+                    return hb if hb.get("pid") == procs[victim].pid \
+                        else None
+                assert _wait(lambda: respawned_hb() is not None,
+                             timeout=120.0)
+                hb_re = respawned_hb()
+                assert hb_re["coldstart"]["fresh_compiles"] == 0
+            finally:
+                router.stop(drain=True, timeout=60.0)
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
