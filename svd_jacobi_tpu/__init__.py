@@ -7,7 +7,7 @@ on the MXU, sharded over TPU meshes with ICI collectives, with a
 LAPACK-gesvd-style API, bench/validation harness, and checkpointing.
 """
 
-from . import obs, resilience, serve, tune
+from . import grad, obs, resilience, serve, tune
 from .config import SVDConfig
 from .solver import (SolveStatus, SVDResult, svd, svd_batched, svd_tall,
                      svd_topk)
@@ -15,5 +15,5 @@ from .solver import (SolveStatus, SVDResult, svd, svd_batched, svd_tall,
 __version__ = "0.1.0"
 
 __all__ = ["svd", "svd_batched", "svd_tall", "svd_topk", "SVDConfig",
-           "SVDResult", "SolveStatus", "obs", "resilience", "serve", "tune",
-           "__version__"]
+           "SVDResult", "SolveStatus", "grad", "obs", "resilience", "serve",
+           "tune", "__version__"]

@@ -45,6 +45,12 @@ properties that decide whether those artifacts stay sane:
     replica-death rescue keeps the once-per-bucket compile contract on
     the receiving replica (a live two-replica kill-and-rescue drill
     under `recompile_guard`).
+  * `grad_checks`   — the differentiable-solver contract (GRAD001):
+    `jax.grad` traces through `solver.svd`/`svd_topk`/`svd_tall` contain
+    the package's own sweep machinery (no silent fallback to
+    `jnp.linalg.svd`'s rule at the full input shape), no host callbacks
+    in the forward/backward trace, and every jitted gradient entry
+    (`grad.rules.jit_entries`) carries a retrace budget.
   * `aot_checks`    — the entry-registry contract (AOT001):
     `config.RETRACE_BUDGETS` and the serving entry registry
     (`serve.registry.jit_entries`) enumerate EXACTLY the same entry

@@ -59,8 +59,9 @@ RULES = {
 }
 
 # Modules whose code runs under jit tracing (GRAFT001/002 scope); paths
-# relative to the package root.
-TRACED_MODULES = ("solver.py", "ops/", "parallel/")
+# relative to the package root. grad/ is traced code too: the rule
+# bodies run inside jvp/vjp traces of user training steps.
+TRACED_MODULES = ("solver.py", "ops/", "parallel/", "grad/")
 
 # jnp/lax attribute calls that return host metadata, not traced arrays.
 _METADATA_FNS = frozenset({

@@ -1,0 +1,192 @@
+"""GRAD001: the differentiable-solver contract.
+
+The gradient path is the easiest place for this package's whole value
+proposition to silently leak away: one refactor that drops the custom
+rule and `jax.grad(loss)` either dies in the sweep while_loop or — worse
+— somebody "fixes" it by swapping in `jnp.linalg.svd`, and every
+training loop quietly stops using the kernels this repo exists for.
+This pass checks the REAL grad traces (``jax.make_jaxpr(jax.grad(...))``
+over representative losses through `solver.svd` / `svd_topk`) the way
+the other passes check the forward artifacts:
+
+  * the trace must contain OUR solver's sweep machinery — the fused
+    ``while`` loop the Jacobi solve runs (a rule-less fallback trace has
+    none);
+  * the trace must contain NO ``svd`` primitive applied at the probe's
+    full input shape — the signature of `jnp.linalg.svd`'s rule running
+    the whole problem. (The qr-svd pair solver's legitimate small-block
+    `svd` calls are (2b, 2b)-shaped and batched; probe shapes are chosen
+    so the two can never collide.);
+  * the whole forward+backward trace must be free of host-callback
+    primitives (`jaxpr_checks.HOST_CALLBACK_PRIMS`) — a callback in the
+    backward pass would serialize every training step on the host link;
+  * every jitted gradient entry (`grad.rules.jit_entries`) must carry a
+    `config.RETRACE_BUDGETS` budget — an unbudgeted grad jit is an
+    unguarded compile surface on the training hot path (AOT001's
+    registry equality covers the reverse direction).
+
+Seeded failing fixtures are parameter injection
+(tests/fixtures/grad_fixtures.py + tests/test_grad.py): a loss built
+directly on `jnp.linalg.svd` (the silent-fallback trace) makes the trace
+checks fire, and a budgets dict missing a grad key makes the budget
+check fire.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from . import Finding
+from .. import config as _config
+from .jaxpr_checks import HOST_CALLBACK_PRIMS, iter_eqns
+
+
+def _grad_jaxpr(loss_fn: Callable, shape, dtype):
+    """The closed jaxpr of ``jax.grad(loss_fn)`` at a zeros probe input
+    (tracing is shape/dtype-driven; no solve executes)."""
+    import jax
+    import jax.numpy as jnp
+    a = jnp.zeros(shape, jnp.dtype(dtype))
+    return jax.make_jaxpr(jax.grad(loss_fn))(a)
+
+
+def check_grad_trace(loss_fn: Optional[Callable] = None,
+                     shape=(96, 64), dtype="float32",
+                     where: str = "svd.grad[96x64,f32]",
+                     expect_while: bool = True) -> List[Finding]:
+    """The three trace contracts over one grad probe. ``loss_fn``
+    substitutes the seeded silent-fallback fixture; the default is
+    grad-of-nuclear-norm through `solver.svd`."""
+    if loss_fn is None:
+        import jax.numpy as jnp
+        from .. import solver
+
+        def loss_fn(a):
+            return jnp.sum(solver.svd(a).s)
+
+    closed = _grad_jaxpr(loss_fn, shape, dtype)
+    findings: List[Finding] = []
+    full_shapes = {tuple(shape), tuple(shape)[::-1]}
+    saw_while = False
+    fallback_hits = 0
+    callback_prims = set()
+    for eqn, _ in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        # The sweep machinery's signature is the `while` primitive (every
+        # solve lane's convergence loop; lax.fori_loop would lower to
+        # while/scan, never to a primitive of its own).
+        if name == "while":
+            saw_while = True
+        if name in HOST_CALLBACK_PRIMS:
+            callback_prims.add(name)
+        if name == "svd" and eqn.invars:
+            opshape = tuple(eqn.invars[0].aval.shape)
+            if len(opshape) >= 2 and opshape[-2:] in full_shapes:
+                fallback_hits += 1
+    if fallback_hits:
+        findings.append(Finding(
+            code="GRAD001", where=where,
+            message=(f"the grad trace contains {fallback_hits} full-"
+                     f"input-shape `svd` primitive(s) ({shape}) — the "
+                     f"signature of a silent fallback to "
+                     f"jnp.linalg.svd's AD rule (the whole problem "
+                     f"solved off our kernel lanes)"),
+            suggestion=("route the solve through solver.svd's custom "
+                        "VJP/JVP rules (grad_rule != 'off'), not "
+                        "jnp.linalg.svd")))
+    if expect_while and not saw_while:
+        findings.append(Finding(
+            code="GRAD001", where=where,
+            message=("the grad trace contains no `while` loop — our "
+                     "solver's sweep machinery is absent from the "
+                     "forward pass of the differentiated program"),
+            suggestion=("the primal of the custom rule must run the "
+                        "package's own solve entry points")))
+    if callback_prims:
+        findings.append(Finding(
+            code="GRAD001", where=where,
+            message=(f"host callback primitive(s) "
+                     f"{sorted(callback_prims)} in the grad trace — a "
+                     f"callback in the forward/backward pass serializes "
+                     f"every training step on the host link"),
+            suggestion=("keep the rule bodies callback-free (telemetry "
+                        "must stay statically off in differentiated "
+                        "programs)")))
+    return findings
+
+
+def check_budget_coverage(budgets: Optional[Dict[str, int]] = None
+                          ) -> List[Finding]:
+    """Every grad jit entry must be budgeted (GRAD001 otherwise);
+    ``budgets`` substitutes the seeded unbudgeted-grad-jit fixture."""
+    from ..grad import rules as _rules
+    budgets = dict(_config.RETRACE_BUDGETS if budgets is None else budgets)
+    findings = []
+    for name in sorted(_rules.jit_entries()):
+        if name not in budgets:
+            findings.append(Finding(
+                code="GRAD001", where=name,
+                message=(f"grad jit entry {name!r} carries no "
+                         f"config.RETRACE_BUDGETS budget — an unguarded "
+                         f"compile surface on the training hot path"),
+                suggestion="declare a RETRACE_BUDGETS entry for it"))
+    return findings
+
+
+def _default_probes():
+    """(where, loss builder, shape, dtype) per covered lane. Shapes keep
+    the pair-solver's legitimate small-block `svd` calls (2b, 2b) well
+    away from the full probe shape, so the fallback detector cannot
+    false-positive on the qr-svd/hybrid lanes."""
+    import jax.numpy as jnp
+    from .. import solver
+    from ..config import SVDConfig
+
+    def nuclear(config=None, **kw):
+        def loss(a):
+            return jnp.sum(solver.svd(a, config=config, **kw).s)
+        return loss
+
+    def topk_loss(a):
+        return jnp.sum(solver.svd_topk(a, 8).s)
+
+    def tall_loss(a):
+        return jnp.sum(solver.svd_tall(a).s)
+
+    probes = [
+        # The f32 kernel lane (the default route for this shape class).
+        ("svd.nuclear[96x64,f32]", nuclear(), (96, 64), "float32"),
+        # sigma-only: the no-F-matrix rule over the factor-computing twin.
+        ("svd.sigma_only[96x64,f32]",
+         nuclear(compute_u=False, compute_v=False), (96, 64), "float32"),
+        # The explicit custom_vjp mode (reverse rule + chaos guard).
+        ("svd.vjp_rule[96x64,f32]",
+         nuclear(config=SVDConfig(grad_rule="vjp")), (96, 64), "float32"),
+        # Truncated lane: the thin-SVD rule over the sketch pipeline.
+        ("svd_topk.nuclear[96x64,k8,f32]", topk_loss, (96, 64), "float32"),
+        # Tall lane: the economy rule over the TSQR pipeline.
+        ("svd_tall.nuclear[160x16,f32]", tall_loss, (160, 16), "float32"),
+    ]
+    import jax
+    if jax.config.jax_enable_x64:
+        # The f64 qr-svd lane — its small-block svd calls are the case
+        # the full-shape fallback detector must NOT flag.
+        probes.append(("svd.nuclear[48x32,f64]", nuclear(),
+                       (48, 32), "float64"))
+    return probes
+
+
+def run_all() -> tuple:
+    """The CLI's ``grad`` pass: every probe's trace contracts plus the
+    budget coverage. Returns ``(findings, report)``."""
+    findings: List[Finding] = []
+    probed = []
+    for where, loss, shape, dtype in _default_probes():
+        findings += check_grad_trace(loss, shape=shape, dtype=dtype,
+                                     where=where)
+        probed.append(where)
+    findings += check_budget_coverage()
+    from ..grad import rules as _rules
+    report = {"probes": probed,
+              "grad_entries": sorted(_rules.jit_entries())}
+    return findings, report

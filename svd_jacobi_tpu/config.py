@@ -154,6 +154,31 @@ class SVDConfig:
     # heuristic chunk — it is a config-free shared helper (its jit
     # signature is fixed), so this knob does not reach it.
     tsqr_chunk: Optional[int] = None
+    # --- differentiable-solver knobs (svd_jacobi_tpu.grad) ---
+    # Which AD rule attaches to svd/svd_topk/svd_tall:
+    #   "auto"/"jvp" — one transposable jax.custom_jvp rule (the
+    #                  F-matrix tangent is linear in the input tangent,
+    #                  so JAX derives reverse mode by transposition):
+    #                  both jax.jvp AND jax.grad work;
+    #   "vjp"        — the explicit jax.custom_vjp pair (grad/rules.py
+    #                  _svd_vjp), whose backward pass additionally zeroes
+    #                  NON-FINITE cotangents (the grad-under-chaos guard
+    #                  — nonlinear in the cotangent, which only a
+    #                  custom_vjp may be). Reverse mode only: jax.jvp
+    #                  raises JAX's standard custom_vjp error;
+    #   "off"        — no rule (the historical opaque while_loop
+    #                  failure; escape hatch for trace-sensitive
+    #                  debugging).
+    # Host-level routing only: never part of any jit key.
+    grad_rule: str = "auto"  # "auto" | "jvp" | "vjp" | "off"
+    # Degenerate-sigma classification band of the gradient safeguards:
+    # a pair whose sigma^2 gap is <= rtol * sigma_max^2 is CLUSTERED and
+    # its F-matrix term is masked to 0 (grad/fmatrix.py — finite
+    # gradients on tied/clustered spectra; exact for cluster-invariant
+    # losses). None = the per-dtype tuning-table row (f32 needs a wider
+    # band than f64 — its sigma^2 gaps carry ~eps_f32 * sigma_max^2 of
+    # solve noise), falling back to 8 * eps of the accumulation dtype.
+    grad_degenerate_rtol: Optional[float] = None
 
     def pick_block_size(self, n: int, m: Optional[int] = None,
                         dtype=None) -> int:
@@ -350,6 +375,20 @@ RETRACE_BUDGETS = {
     # sigma-then-promote request streams).
     "solver._sigma_from_state_jit": 1,
     "solver._sigma_from_state_batched_jit": 1,
+    # Differentiable-solver entries (svd_jacobi_tpu.grad.rules): the
+    # jitted gradient math the custom VJP/JVP rules dispatch — the
+    # F-matrix tangent/cotangent and the sigma-only fast path. The
+    # degenerate-band rtol rides as a TRACED operand (never a static
+    # arg), so the problem key is the factor shapes alone: one compile
+    # per differentiated problem shape, never per knob value or per
+    # training step (a per-step leak into any of these keys would put a
+    # compile on every optimizer iteration). Enumerated by
+    # serve.registry.jit_entries via grad.rules.jit_entries, and proven
+    # budgeted by the GRAD001 analysis pass.
+    "grad._svd_jvp_jit": 1,
+    "grad._svd_vjp_jit": 1,
+    "grad._sigma_jvp_jit": 1,
+    "grad._sigma_vjp_jit": 1,
 }
 
 # Batch-size tiers of the serving layer's coalesced dispatch
@@ -418,4 +457,11 @@ HOT_SCOPES = {
     # region that replaces the latency-bound per-step rotation chain
     # during the bulk phase.
     "block_solve": ("ops/block_rotate.py", "accumulate"),
+    # Differentiable-solver hot regions (svd_jacobi_tpu.grad): the
+    # safeguarded F-matrix construction and the full/sigma-only
+    # cotangent recombinations — the backward-pass cost a training-loop
+    # profile must be able to attribute.
+    "grad_fmatrix": ("grad/fmatrix.py", "fmatrix"),
+    "grad_cotangent": ("grad/rules.py", "_svd_vjp"),
+    "grad_sigma": ("grad/rules.py", "_sigma_vjp"),
 }

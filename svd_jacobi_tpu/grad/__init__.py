@@ -1,0 +1,44 @@
+"""Differentiable solver subsystem — custom VJP/JVP rules for the fast
+Jacobi SVD, so `solver.svd`, `solver.svd_topk`, and `solver.svd_tall`
+sit inside training loops (`jax.grad` / `jax.jvp` / `jax.vjp`) instead
+of dying in JAX's reverse-mode-through-`while_loop` error or silently
+falling back to `jnp.linalg.svd`'s rule.
+
+Layout:
+
+  * `fmatrix` — the safeguarded F-matrix terms ``1/(sigma_i^2 -
+    sigma_j^2)``: degenerate/clustered pairs are MASKED the way the
+    sweep loop's deflation classifier masks sub-floor couplings (gap
+    measured against the global sigma_max^2 scale), never Inf/NaN. The
+    band is the ``SVDConfig.grad_degenerate_rtol`` knob, resolved
+    through the same per-dtype tuning-table rows as every other knob.
+  * `rules` — the rule machinery: the transposable `jax.custom_jvp`
+    rule (the "auto" mode — one rule, both AD directions), the explicit
+    `jax.custom_vjp` pair with the non-finite-cotangent chaos guard,
+    the F-matrix-free sigma-only fast path, the thin-SVD null-space
+    corrections for rectangular/truncated factors, and the
+    `NonDifferentiableError` loud-failure wrapper for uncovered paths.
+
+The rules attach inside the solver entry points (`solver.svd` et al.
+route every solve through them unless ``grad_rule="off"``); this package
+holds no entry points of its own. Contract checks live in
+`analysis.grad_checks` (GRAD001) and the jitted gradient math is
+enumerated in `config.RETRACE_BUDGETS` / `serve.registry.jit_entries`
+like every other compile surface.
+"""
+
+from .fmatrix import degenerate_band, degenerate_mask, fmatrix, sigma_recip
+from .rules import (NonDifferentiableError, differentiable, jit_entries,
+                    resolve_rule_mode, uncovered)
+
+__all__ = [
+    "NonDifferentiableError",
+    "degenerate_band",
+    "degenerate_mask",
+    "differentiable",
+    "fmatrix",
+    "jit_entries",
+    "resolve_rule_mode",
+    "sigma_recip",
+    "uncovered",
+]

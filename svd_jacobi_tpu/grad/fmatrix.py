@@ -1,0 +1,96 @@
+"""Safeguarded F-matrix terms of the SVD gradient.
+
+The standard SVD differentiation formulas (Townsend, *Differentiating the
+Singular Value Decomposition*, 2016; Ionescu et al., ICCV 2015) couple
+singular-vector perturbations through
+
+    F_ij = 1 / (sigma_j^2 - sigma_i^2)        (i != j, zero diagonal)
+
+which is singular exactly where one-sided Jacobi's own deflation
+machinery already knows the spectrum is degenerate: pairs whose
+sigma^2 gap sits at or below the roundoff band of the GLOBAL scale
+sigma_max^2 (the same normalization `ops.rounds.panel_stats` deflates
+its coupling statistic against — a gap measured relative to anything
+smaller is noise). A naive 1/(s_i^2 - s_j^2) there produces Inf/NaN that
+poisons the whole gradient; dividing by a "regularized" gap instead
+produces a finite but enormous garbage rotation.
+
+This module takes the deflation classifier's answer: CLUSTERED PAIRS ARE
+MASKED (F_ij = 0), never inverted. The masked gradient is exact for every
+loss that is invariant under rotations within a degenerate subspace —
+the only class of loss whose gradient is mathematically well-defined
+there (individual singular vectors of a tied sigma are arbitrary within
+the cluster, so no rule could do better). The band is the
+``grad_degenerate_rtol`` knob: explicit on `SVDConfig`, else the
+per-dtype tuning-table row (f32 needs a wider band than f64 — its
+sigma^2 differences carry ~eps_f32 * sigma_max^2 of solve noise), else
+``8 * eps`` of the accumulation dtype.
+
+Everything here is traced library code (jit/vmap-safe, no host reads) and
+is exercised through `grad.rules`' jitted entry points.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..obs.scopes import scope
+
+
+def _acc(x):
+    """The accumulation dtype of the gradient math — the same
+    promote_types(input, float32) boundary every other solve stage
+    declares (`config.MIXED_PRECISION_BOUNDARIES`)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+
+def degenerate_band(s, rtol):
+    """The absolute sigma^2-gap band below which a pair is classified
+    degenerate/clustered: ``rtol * sigma_max^2`` (the global deflation
+    scale — one matrix-wide normalization, exactly like the dmax2 scale
+    the sweep-loop deflation mask uses, so a cluster of small sigmas in
+    a large-sigma matrix is classified by the matrix's scale, not its
+    own). Safe for the all-zero matrix (band floors at ``tiny``)."""
+    s = _acc(s)
+    s2max = jnp.max(s * s)
+    return rtol * jnp.maximum(s2max, jnp.finfo(s.dtype).tiny)
+
+
+def degenerate_mask(s, rtol):
+    """Boolean (r, r) mask: True where the pair (i, j) is SAFE to invert
+    (its sigma^2 gap clears the band). The diagonal is always False (a
+    sigma's gap to itself is zero)."""
+    s = _acc(s)
+    s2 = s * s
+    diff = s2[None, :] - s2[:, None]
+    return jnp.abs(diff) > degenerate_band(s, rtol)
+
+
+def fmatrix(s, rtol):
+    """The safeguarded F matrix: ``F_ij = 1/(s_j^2 - s_i^2)`` where the
+    pair's gap clears the degenerate band, 0 elsewhere (diagonal
+    included). Never Inf/NaN, for any input spectrum — including exact
+    ties, padded zero sigmas, and the all-zero matrix."""
+    with scope("grad_fmatrix"):
+        s = _acc(s)
+        s2 = s * s
+        diff = s2[None, :] - s2[:, None]
+        # ONE classifier: the mask here and the exported degenerate_mask
+        # (what the tests pin) are the same function — they cannot drift.
+        ok = degenerate_mask(s, rtol)
+        # Masked denominator: the unsafe entries divide 1 (then zeroed),
+        # so no Inf is ever materialized for jnp.where to launder.
+        return jnp.where(ok, 1.0 / jnp.where(ok, diff, 1.0),
+                         jnp.zeros((), s.dtype))
+
+
+def sigma_recip(s, rtol):
+    """Safe ``1/sigma`` for the thin-SVD null-space projection terms:
+    sigmas whose SQUARE sits inside the degenerate band (i.e. the sigma
+    is not separated from zero any better than a clustered pair is from
+    its neighbor — the same classification, applied to the pair
+    (sigma_i, 0)) contribute 0 instead of an exploding reciprocal."""
+    s = _acc(s)
+    ok = s * s > degenerate_band(s, rtol)
+    return jnp.where(ok, 1.0 / jnp.where(ok, s, 1.0),
+                     jnp.zeros((), s.dtype))

@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import _compat
 from ..config import SVDConfig
+from ..grad import rules as _grad
 from ..obs import metrics
 from ..ops import blockwise
 from ..resilience import chaos as _chaos
@@ -356,7 +357,20 @@ def svd(
         mesh = make_mesh()
     kwargs = _plan_entry(a, mesh, config, compute_u=compute_u,
                          compute_v=compute_v, full_matrices=full_matrices)
-    u, s, v, sweeps, off_rel, status = _svd_sharded_jit(a, **kwargs)
+    run = lambda x: _svd_sharded_jit(x, **kwargs)
+    if _grad.resolve_rule_mode(config) != "off":
+        # No gradient rule on the mesh entry yet (a rule would need the
+        # recombination/refine stages threaded per shard — the ROADMAP
+        # remainder of the differentiable-solver item); fail loudly with
+        # the supported spelling instead of the while_loop error.
+        run = _grad.uncovered(
+            run,
+            "parallel.sharded.svd has no gradient rule yet; "
+            "differentiate the single-device solver.svd (it carries "
+            "custom VJP/JVP rules) and shard the surrounding "
+            "computation, or run the mesh solve outside the "
+            "differentiated region")
+    u, s, v, sweeps, off_rel, status = run(a)
     return _single.SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
                              status=status)
 

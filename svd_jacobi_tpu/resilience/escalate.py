@@ -142,14 +142,28 @@ def resilient_svd(
     """
     import threading
 
+    import jax
     import jax.numpy as jnp
 
     from .. import obs
     from ..config import SVDConfig
+    from ..grad.rules import NonDifferentiableError
     from ..solver import SolveStatus
     from ..utils._exec import host_scalar
     from . import guard
 
+    if isinstance(a, jax.core.Tracer):
+        # The escalation ladder is a HOST loop: it reads each attempt's
+        # health word between solves and decides the next rung from it —
+        # structure no trace can capture, and gradients through "the
+        # config that happened to converge" would be ill-defined anyway.
+        # Fail with the supported spelling instead of a deep tracer leak.
+        raise NonDifferentiableError(
+            "resilient_svd cannot run under jax transforms (jit/grad/"
+            "vmap): its escalation ladder reads solve health on the host "
+            "between attempts. Differentiate solver.svd / svd_topk / "
+            "svd_tall directly — they carry custom VJP/JVP rules — and "
+            "keep resilient_svd for the host-side serving path.")
     if config is None:
         config = SVDConfig()
     a = jnp.asarray(a)

@@ -72,6 +72,7 @@ def jit_entries() -> Dict[str, object]:
     coverage against the budgets, so this enumeration IS the declared
     compile surface of the package."""
     from .. import solver
+    from ..grad import rules as _grad_rules
     from ..parallel import sharded
     return {
         # Fused one-shot entries (svd() / the escalation ladder).
@@ -128,6 +129,11 @@ def jit_entries() -> Dict[str, object]:
         "solver._sigma_from_state_jit": solver._sigma_from_state_jit,
         "solver._sigma_from_state_batched_jit":
             solver._sigma_from_state_batched_jit,
+        # Differentiable-solver entries (grad.rules): the jitted gradient
+        # math the custom VJP/JVP rules dispatch — enumerated here so the
+        # AOT001 two-way ledger covers the training-loop compile surface
+        # like every serving entry (the GRAD001 pass double-checks).
+        **_grad_rules.jit_entries(),
     }
 
 

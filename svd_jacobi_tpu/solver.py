@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import SVDConfig
+from .grad import rules as _grad
 from .obs import metrics
 from .obs.scopes import scope
 from .ops import blockwise, rounds
@@ -297,6 +298,26 @@ def _resolve_xla_options(a, config: SVDConfig, compute_uv: bool = True):
         tol, gram, method, criterion = _resolve_options(
             a, _dc.replace(config, pair_solver="hybrid"), compute_uv)
     return tol, gram, method, criterion
+
+
+def _resolve_grad_rtol(config: SVDConfig, n: int, m: int, dtype) -> float:
+    """The degenerate-sigma classification band of the gradient
+    safeguards (`grad.fmatrix`): explicit `SVDConfig.grad_degenerate_rtol`
+    wins, else the per-dtype tuning-table row (the same `tune.tables`
+    lookup as every other knob; the shipped table pins f32 ~8*eps_f32 and
+    f64 ~8*eps_f64 — f32's band is ~1e9x wider, matching its solve
+    noise), else 8*eps of the accumulation dtype."""
+    if config.grad_degenerate_rtol is not None:
+        rtol = float(config.grad_degenerate_rtol)
+        if not rtol > 0:
+            raise ValueError(f"grad_degenerate_rtol must be > 0, got "
+                             f"{config.grad_degenerate_rtol!r}")
+        return rtol
+    tuned = _tuned(n, m, dtype).grad_degenerate_rtol
+    if tuned is not None:
+        return float(tuned)
+    acc = jnp.promote_types(dtype, jnp.float32)
+    return 8.0 * float(jnp.finfo(acc).eps)
 
 
 def _should_continue(off_rel, prev_off, sweeps, *, tol, max_sweeps,
@@ -1490,7 +1511,18 @@ def svd_batched(
                          off_rel=r.off_rel, status=r.status)
     _, solve, a_in, kwargs = _plan_entry_batched(
         a, config, compute_u=compute_u, compute_v=compute_v)
-    u, s, v, sweeps, off_rel, status = solve(a_in, **kwargs)
+    run = lambda x: solve(x, **kwargs)
+    if _grad.resolve_rule_mode(config) != "off":
+        # No gradient rule on the stacked fused lane (its block-diagonal
+        # tournament shares one latency chain across members — a
+        # per-member F-matrix rule does not map onto it); fail loudly
+        # with the supported spelling instead of the while_loop error.
+        run = _grad.uncovered(
+            run,
+            "svd_batched has no gradient rule (the coalesced fused "
+            "lane); differentiate jax.vmap(solver.svd) over the stack "
+            "instead — vmap composes with svd's custom VJP/JVP rules")
+    u, s, v, sweeps, off_rel, status = run(a_in)
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
                      status=status)
 
@@ -1521,6 +1553,16 @@ def svd(
 
     Returns:
       SVDResult(u, s, v, sweeps, off_rel) with s descending.
+
+    Differentiable: the solve carries a custom VJP/JVP rule
+    (`svd_jacobi_tpu.grad`, routed by ``config.grad_rule``), so
+    ``jax.grad``/``jax.jvp`` through this function use the safeguarded
+    F-matrix SVD gradient on OUR kernels instead of failing in the sweep
+    while_loop or falling back to `jnp.linalg.svd`. ``full_matrices=True``
+    with m > n raises `grad.NonDifferentiableError` under differentiation
+    (the orthonormal completion has no gradient rule); sigma-only solves
+    differentiate through the factor-computing twin with the cheap
+    no-F-matrix sigma gradient. See README "Differentiable solves".
     """
     if config is None:
         config = SVDConfig()
@@ -1537,22 +1579,52 @@ def svd(
         return SVDResult(u=r.v, s=r.s, v=r.u, sweeps=r.sweeps,
                          off_rel=r.off_rel, status=r.status)
 
-    entry, solve, a_in, kwargs = _plan_entry(
-        a, config, compute_u=compute_u, compute_v=compute_v,
-        full_matrices=full_matrices)
-    u, s, v, sweeps, off_rel, status = solve(a_in, **kwargs)
-    if entry == "padded":
-        refine = (config.sigma_refine if config.sigma_refine is not None
-                  else (u is not None or v is not None))
-        if refine and (u is not None or v is not None):
-            # Parity with the Pallas path and the mesh solver: the XLA
-            # block solvers run on A directly, so the working matrix IS a
-            # (on a warm start, the pre-rotated A @ v0 — whose sigmas are
-            # A's own, v0 being orthonormal).
-            u, s, v = _refine_xla_jit(a, u, s, v, n=n,
-                                      with_u=u is not None,
-                                      with_v=v is not None,
-                                      full_u=bool(full_matrices))
+    def _make_runner(cu, cv):
+        # The differentiable unit: plan + fused solve + (padded-entry)
+        # refine, as one pure a -> (u, s, v, sweeps, off_rel, status)
+        # function of the tall-oriented input. The grad rules wrap this
+        # whole pipeline so the refinement's direct reads of ``a`` stay
+        # INSIDE the custom-rule boundary (a half-wrapped pipeline would
+        # mix rule gradients with AD-through-refine double counting).
+        def _run(x):
+            entry, solve, a_in, kwargs = _plan_entry(
+                x, config, compute_u=cu, compute_v=cv,
+                full_matrices=full_matrices)
+            u, s, v, sweeps, off_rel, status = solve(a_in, **kwargs)
+            if entry == "padded":
+                refine = (config.sigma_refine
+                          if config.sigma_refine is not None
+                          else (u is not None or v is not None))
+                if refine and (u is not None or v is not None):
+                    # Parity with the Pallas path and the mesh solver:
+                    # the XLA block solvers run on A directly, so the
+                    # working matrix IS x (on a warm start, the
+                    # pre-rotated A @ v0 — whose sigmas are A's own, v0
+                    # being orthonormal).
+                    u, s, v = _refine_xla_jit(x, u, s, v, n=n,
+                                              with_u=u is not None,
+                                              with_v=v is not None,
+                                              full_u=bool(full_matrices))
+            return u, s, v, sweeps, off_rel, status
+        return _run
+
+    mode = _grad.resolve_rule_mode(config)
+    if mode == "off":
+        run = _make_runner(compute_u, compute_v)
+    elif full_matrices and m > n:
+        run = _grad.uncovered(
+            _make_runner(compute_u, compute_v),
+            "svd(full_matrices=True) has no gradient rule: the (m, m) "
+            "orthonormal completion of U is not a function of A alone "
+            "(its trailing columns are an arbitrary null-space basis). "
+            "Differentiate the economy solve (full_matrices=False) "
+            "instead — it carries the full custom VJP/JVP rule.")
+    else:
+        rtol = _resolve_grad_rtol(config, n, m, a.dtype)
+        run = _grad.differentiable(
+            _make_runner, compute_u=compute_u, compute_v=compute_v,
+            mode=mode, rtol=rtol)
+    u, s, v, sweeps, off_rel, status = run(a)
     if v0 is not None and v is not None:
         v = _compose_v0_jit(v0, v)
     return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
@@ -1826,20 +1898,45 @@ def svd_topk(
             u=None if r.u is None else r.u[:, :k], s=r.s[:k],
             v=None if r.v is None else r.v[:, :k],
             sweeps=r.sweeps, off_rel=r.off_rel, status=r.status)
-    q, bt, nf = _sketch_project_jit(a, l=l, power_iters=power_iters,
-                                    chunk=chunk, seed=0)
-    # Core on B^T (n, l): its U is A's right factor W, its V the small
-    # rotation Z that lifts to A's left factor through Q.
-    inner = svd(bt, compute_u=compute_v, compute_v=compute_u, config=config)
-    u = v = None
-    if compute_u and inner.v is not None:
-        u = _lift_q_jit(q, inner.v[:, :k])
-    if compute_v and inner.u is not None:
-        v = inner.u[:, :k]
-    status = (None if inner.status is None
-              else _combine_sketch_status(nf, inner.status))
-    return SVDResult(u=u, s=inner.s[:k], v=v, sweeps=inner.sweeps,
-                     off_rel=inner.off_rel, status=status)
+    mode = _grad.resolve_rule_mode(config)
+    # The sketch pipeline below carries its OWN truncated-SVD rule (the
+    # whole range-finder + projected solve + lift is one differentiable
+    # unit), so the inner core solve runs rule-off — nesting svd's rule
+    # inside this one would be dead weight in the trace.
+    import dataclasses as _dc
+    inner_cfg = (config if mode == "off"
+                 else _dc.replace(config, grad_rule="off"))
+
+    def _make_runner(cu, cv):
+        def _run(x):
+            q, bt, nf = _sketch_project_jit(x, l=l,
+                                            power_iters=power_iters,
+                                            chunk=chunk, seed=0)
+            # Core on B^T (n, l): its U is A's right factor W, its V the
+            # small rotation Z that lifts to A's left factor through Q.
+            inner = svd(bt, compute_u=cv, compute_v=cu, config=inner_cfg)
+            uu = _lift_q_jit(q, inner.v[:, :k]) if cu else None
+            vv = inner.u[:, :k] if cv else None
+            status = (None if inner.status is None
+                      else _combine_sketch_status(nf, inner.status))
+            return (uu, inner.s[:k], vv, inner.sweeps, inner.off_rel,
+                    status)
+        return _run
+
+    if mode == "off":
+        run = _make_runner(compute_u, compute_v)
+    else:
+        # Truncated thin-SVD rule: the (m, k)/(n, k) factors take BOTH
+        # null-space correction terms (gradient of the idealized top-k
+        # factorization — the range-finder tail term is treated as
+        # converged, like the forward lane's own accuracy contract).
+        rtol = _resolve_grad_rtol(config, n, m, a.dtype)
+        run = _grad.differentiable(
+            _make_runner, compute_u=compute_u, compute_v=compute_v,
+            mode=mode, rtol=rtol)
+    u, s, v, sweeps, off_rel, status = run(a)
+    return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
+                     status=status)
 
 
 def svd_tall(
@@ -1880,16 +1977,36 @@ def svd_tall(
         return svd(a, compute_u=compute_u, compute_v=compute_v,
                    full_matrices=full_matrices, config=config)
     _, _, chunk = _resolve_sketch(config, n, m, a.dtype)
-    q, r_tri, nf = _tsqr_jit(a, chunk=chunk)
-    inner = svd(r_tri, compute_u=compute_u, compute_v=compute_v,
-                config=config)
-    u = inner.u
-    if compute_u and inner.u is not None:
-        u = _lift_q_jit(q, inner.u)
-    status = (None if inner.status is None
-              else _combine_sketch_status(nf, inner.status))
-    return SVDResult(u=u, s=inner.s, v=inner.v, sweeps=inner.sweeps,
-                     off_rel=inner.off_rel, status=status)
+    mode = _grad.resolve_rule_mode(config)
+    import dataclasses as _dc
+    inner_cfg = (config if mode == "off"
+                 else _dc.replace(config, grad_rule="off"))
+
+    def _make_runner(cu, cv):
+        def _run(x):
+            q, r_tri, nf = _tsqr_jit(x, chunk=chunk)
+            inner = svd(r_tri, compute_u=cu, compute_v=cv,
+                        config=inner_cfg)
+            uu = _lift_q_jit(q, inner.u) if cu else None
+            status = (None if inner.status is None
+                      else _combine_sketch_status(nf, inner.status))
+            return (uu, inner.s, inner.v if cv else None, inner.sweeps,
+                    inner.off_rel, status)
+        return _run
+
+    if mode == "off":
+        run = _make_runner(compute_u, compute_v)
+    else:
+        # The TSQR lane computes the EXACT economy factorization, so it
+        # takes the same economy rule as svd() (V is square — only the
+        # left null-space correction applies, by shape).
+        rtol = _resolve_grad_rtol(config, n, m, a.dtype)
+        run = _grad.differentiable(
+            _make_runner, compute_u=compute_u, compute_v=compute_v,
+            mode=mode, rtol=rtol)
+    u, s, v, sweeps, off_rel, status = run(a)
+    return SVDResult(u=u, s=s, v=v, sweeps=sweeps, off_rel=off_rel,
+                     status=status)
 
 
 # ---------------------------------------------------------------------------

@@ -49,10 +49,13 @@ SCHEMA_VERSION = 1
 # The tunable knobs a table row may pin. Everything else in SVDConfig is
 # either semantic (tolerances, job options) or validated elsewhere.
 # oversample / power_iters / tsqr_chunk are the SKETCH knobs of the
-# top-k / tall lanes (solver.svd_topk / svd_tall / ops.sketch).
+# top-k / tall lanes (solver.svd_topk / svd_tall / ops.sketch);
+# grad_degenerate_rtol is the differentiable-solver safeguard band
+# (svd_jacobi_tpu.grad — per-dtype rows: f32 needs a wider cluster band
+# than f64, null = 8*eps of the accumulation dtype).
 KNOBS = ("block_size", "mixed_store", "pair_solver", "precondition",
          "criterion", "batch_tiers", "oversample", "power_iters",
-         "tsqr_chunk")
+         "tsqr_chunk", "grad_degenerate_rtol")
 
 # The sketch-knob subset, used by the TUNE001 coverage rule: a declared
 # top-k serve bucket must get these from a MEASURED (non-generic) row.
@@ -190,6 +193,10 @@ GENERIC_KNOBS: Dict[str, object] = {
     "oversample": 8,
     "power_iters": 1,
     "tsqr_chunk": None,
+    # Differentiable-solver degenerate band (None = 8*eps of the
+    # accumulation dtype at solve time — the dtype-derived floor; the
+    # shipped table pins per-dtype rows on top).
+    "grad_degenerate_rtol": None,
 }
 
 
@@ -218,6 +225,7 @@ class Resolved(NamedTuple):
     oversample: int
     power_iters: int
     tsqr_chunk: Optional[int]
+    grad_degenerate_rtol: Optional[float]
     generic_only: bool
     sketch_generic_only: bool
     source: str
@@ -277,6 +285,11 @@ def _validate_row(row: dict, where: str, errors: List[str]) -> None:
     if tc is not None and (not isinstance(tc, int) or tc < 1):
         errors.append(f"{where}.knobs.tsqr_chunk: expected null or int >= 1, "
                       f"got {tc!r}")
+    gr = knobs.get("grad_degenerate_rtol", None)
+    if gr is not None and (not isinstance(gr, (int, float))
+                           or isinstance(gr, bool) or not gr > 0):
+        errors.append(f"{where}.knobs.grad_degenerate_rtol: expected null "
+                      f"or a number > 0, got {gr!r}")
     tiers = knobs.get("batch_tiers")
     if tiers is not None and (
             not isinstance(tiers, (list, tuple)) or not tiers
@@ -417,6 +430,7 @@ class TuningTable:
                 break
         bs = knobs["block_size"]
         tc = knobs["tsqr_chunk"]
+        gr = knobs["grad_degenerate_rtol"]
         return Resolved(
             block_size=int(bs) if bs is not None
             else heuristic_block_size(int(n)),
@@ -428,6 +442,7 @@ class TuningTable:
             oversample=int(knobs["oversample"]),
             power_iters=int(knobs["power_iters"]),
             tsqr_chunk=None if tc is None else int(tc),
+            grad_degenerate_rtol=None if gr is None else float(gr),
             generic_only=generic_only,
             sketch_generic_only=sketch_generic_only,
             source=f"{self.table_id}:{','.join(contributors) or 'builtin'}",
@@ -607,4 +622,11 @@ def resolve_config(config, m: int, n: int, dtype,
         updates["power_iters"] = int(r.power_iters)
     if config.tsqr_chunk is None and r.tsqr_chunk is not None:
         updates["tsqr_chunk"] = int(r.tsqr_chunk)
+    # The differentiable-solver safeguard band (read only by the grad
+    # rules; valid everywhere): pinned like the sketch knobs so a
+    # bucket-resolved config differentiates identically to solve-time
+    # auto resolution.
+    if (getattr(config, "grad_degenerate_rtol", None) is None
+            and r.grad_degenerate_rtol is not None):
+        updates["grad_degenerate_rtol"] = float(r.grad_degenerate_rtol)
     return _dc.replace(config, **updates) if updates else config

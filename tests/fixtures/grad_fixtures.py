@@ -1,0 +1,28 @@
+"""Seeded failing fixtures for the GRAD001 analysis pass.
+
+A checker that cannot fail its fixture proves nothing (the same
+discipline as tests/fixtures/graft_violations): these are the two
+violation shapes GRAD001 exists to catch, injected into the pass's
+parameter seams by tests/test_grad.py.
+"""
+
+import jax.numpy as jnp
+
+
+def silent_fallback_loss(a):
+    """What a silent fallback looks like: the loss differentiates
+    `jnp.linalg.svd` at the FULL input shape — its `svd` primitive (and
+    AD rule) run the whole problem, and the package's sweep while_loop
+    never appears in the trace. Both GRAD001 trace contracts must fire
+    on this."""
+    return jnp.sum(jnp.linalg.svd(a, full_matrices=False,
+                                  compute_uv=False))
+
+
+def unbudgeted_grad_budgets():
+    """A RETRACE_BUDGETS ledger with one grad jit entry dropped — the
+    unguarded-compile-surface fixture for GRAD001's budget check."""
+    from svd_jacobi_tpu.config import RETRACE_BUDGETS
+    budgets = dict(RETRACE_BUDGETS)
+    budgets.pop("grad._svd_vjp_jit")
+    return budgets

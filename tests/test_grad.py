@@ -1,0 +1,458 @@
+"""Differentiable-solver lane: the custom VJP/JVP rules of
+`svd_jacobi_tpu.grad` attached to `solver.svd` / `svd_topk` / `svd_tall`.
+
+Covers the contracts README "Differentiable solves" documents:
+VJP/JVP against f64 central finite differences and against
+`jnp.linalg.svd`'s own rule on gap/flat/clustered spectra, the
+degenerate-sigma no-NaN guarantee (masked F-matrix), the sigma-only
+fast-path equivalence, jit/vmap/scan composition, grad-under-chaos
+(NaN cotangent -> finite gradient), the loud uncovered-path errors, the
+`grad_degenerate_rtol` knob resolution, and the GRAD001 analysis pass
+with its seeded failing fixtures.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svd_jacobi_tpu import solver
+from svd_jacobi_tpu.config import SVDConfig
+from svd_jacobi_tpu.grad import (NonDifferentiableError, degenerate_mask,
+                                 fmatrix, sigma_recip)
+
+pytestmark = pytest.mark.grad
+
+VJP_CFG = SVDConfig(grad_rule="vjp")
+
+
+def _make_matrix(m, n, sigmas, seed=0, dtype=jnp.float32):
+    """U diag(sigmas) V^T with random orthonormal factors (f64 build,
+    cast at the end) — a matrix whose spectrum the test controls."""
+    rng = np.random.default_rng(seed)
+    qu, _ = np.linalg.qr(rng.standard_normal((m, min(m, n))))
+    qv, _ = np.linalg.qr(rng.standard_normal((n, min(m, n))))
+    s = np.zeros(min(m, n))
+    s[:len(sigmas)] = sigmas
+    return jnp.asarray(qu @ np.diag(s) @ qv.T, dtype)
+
+
+def _gap_matrix(m=48, n=32, seed=0, dtype=jnp.float32):
+    sig = 2.0 ** (-np.arange(min(m, n), dtype=np.float64) / 4.0)
+    return _make_matrix(m, n, sig, seed=seed, dtype=dtype)
+
+
+def _fd_directional(np_loss, a, d, h=1e-4):
+    """f64 central finite difference of a host-side loss along d."""
+    a64 = np.asarray(a, np.float64)
+    d64 = np.asarray(d, np.float64)
+    return (np_loss(a64 + h * d64) - np_loss(a64 - h * d64)) / (2 * h)
+
+
+def _np_nuclear(x):
+    return float(np.linalg.svd(x, compute_uv=False).sum())
+
+
+def _directions(shape, k=3, seed=7):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(k):
+        d = rng.standard_normal(shape)
+        out.append(jnp.asarray(d / np.linalg.norm(d), jnp.float32))
+    return out
+
+
+def _nuclear(config=None, **kw):
+    def loss(a):
+        return jnp.sum(solver.svd(a, config=config, **kw).s)
+    return loss
+
+
+class TestEconomyRule:
+    def test_nuclear_grad_matches_fd(self):
+        a = _gap_matrix()
+        g = jax.grad(_nuclear())(a)
+        assert np.isfinite(np.asarray(g)).all()
+        for d in _directions(a.shape):
+            got = float(jnp.vdot(g, d))
+            want = _fd_directional(_np_nuclear, a, d)
+            assert got == pytest.approx(want, rel=2e-3, abs=1e-4)
+
+    def test_jvp_matches_fd(self):
+        a = _gap_matrix(seed=1)
+        for d in _directions(a.shape, k=2):
+            _, tang = jax.jvp(_nuclear(), (a,), (d,))
+            want = _fd_directional(_np_nuclear, a, d)
+            assert float(tang) == pytest.approx(want, rel=2e-3, abs=1e-4)
+
+    def test_nuclear_grad_matches_jnp_rule(self):
+        a = _gap_matrix(seed=2)
+        ours = jax.grad(_nuclear())(a)
+        ref = jax.grad(
+            lambda x: jnp.sum(jnp.linalg.svd(x, compute_uv=False)))(a)
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_subspace_loss_grad_f64_matches_fd(self):
+        # A loss through the VECTORS (top-2 left projector): exercises
+        # the F-matrix terms, which the nuclear norm never touches. The
+        # f64 qr-svd lane gives the tight comparison.
+        a = _gap_matrix(32, 24, seed=3, dtype=jnp.float64)
+        rng = np.random.default_rng(11)
+        c = jnp.asarray(rng.standard_normal((32, 32)), jnp.float64)
+
+        def loss(x):
+            u = solver.svd(x).u[:, :2]
+            return jnp.sum((u @ u.T) * c)
+
+        def np_loss(x):
+            u = np.linalg.svd(x)[0][:, :2]
+            return float(np.sum((u @ u.T) * np.asarray(c)))
+
+        g = jax.grad(loss)(a)
+        for d in _directions(a.shape, k=2):
+            got = float(jnp.vdot(g, d.astype(jnp.float64)))
+            want = _fd_directional(np_loss, a, d, h=1e-6)
+            assert got == pytest.approx(want, rel=1e-5, abs=1e-8)
+
+    def test_vjp_mode_matches_jvp_mode(self):
+        # The explicit custom_vjp cotangent formula IS the transpose of
+        # the custom_jvp rule: same factors in, (near-)identical
+        # gradients out — through a vector-touching loss so the F-matrix
+        # and null-space terms are both exercised.
+        a = _gap_matrix(seed=4)
+        rng = np.random.default_rng(5)
+        c = jnp.asarray(rng.standard_normal(a.shape), jnp.float32)
+
+        def loss(cfg):
+            def f(x):
+                r = solver.svd(x, config=cfg)
+                return jnp.sum(r.u * c) + jnp.sum(r.s ** 2)
+            return f
+
+        g_jvp = jax.grad(loss(None))(a)
+        g_vjp = jax.grad(loss(VJP_CFG))(a)
+        # Same factors, same masked terms; the only daylight is f32
+        # rounding between the two operation orders.
+        scale = float(jnp.abs(g_jvp).max())
+        np.testing.assert_allclose(np.asarray(g_jvp) / scale,
+                                   np.asarray(g_vjp) / scale,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_wide_input_grad(self):
+        # m < n transposes internally; the rule rides the recursion.
+        a = _gap_matrix(32, 48, seed=6)
+        g = jax.grad(_nuclear())(a)
+        assert g.shape == a.shape
+        r = solver.svd(a)
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.asarray(r.u @ r.v.T),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestDegenerateSigma:
+    def test_repeated_sigma_no_nan(self):
+        # Exact ties and near-zero sigmas: every F-matrix denominator is
+        # degenerate somewhere — the masked rule must stay finite in
+        # both modes and both AD directions.
+        a = _make_matrix(40, 24, [3.0, 3.0, 2.0, 2.0, 1.0] + [1e-9] * 19,
+                         seed=8)
+        rng = np.random.default_rng(9)
+        c = jnp.asarray(rng.standard_normal((40, 24)), jnp.float32)
+
+        def loss(cfg):
+            def f(x):
+                r = solver.svd(x, config=cfg)
+                return jnp.sum(r.u * c) + jnp.sum(r.s)
+            return f
+
+        for cfg in (None, VJP_CFG):
+            g = jax.grad(loss(cfg))(a)
+            assert np.isfinite(np.asarray(g)).all(), cfg
+        _, tang = jax.jvp(loss(None), (a,), (jnp.ones_like(a),))
+        assert np.isfinite(float(tang))
+
+    def test_clustered_nuclear_grad_still_matches_fd(self):
+        # A clustered spectrum masks the intra-cluster F terms, but the
+        # nuclear norm is cluster-invariant — its gradient (U V^T) stays
+        # exact through the mask.
+        sig = np.concatenate([np.full(4, 1.0 + 1e-8), np.full(4, 0.5),
+                              2.0 ** (-np.arange(16) / 2.0 - 2)])
+        a = _make_matrix(48, 24, sig, seed=10)
+        g = jax.grad(_nuclear())(a)
+        assert np.isfinite(np.asarray(g)).all()
+        for d in _directions(a.shape, k=2):
+            got = float(jnp.vdot(g, d))
+            want = _fd_directional(_np_nuclear, a, d)
+            assert got == pytest.approx(want, rel=2e-3, abs=1e-4)
+
+    def test_zero_matrix_finite(self):
+        a = jnp.zeros((24, 16), jnp.float32)
+        for cfg in (None, VJP_CFG):
+            g = jax.grad(_nuclear(cfg))(a)
+            assert np.isfinite(np.asarray(g)).all()
+
+    def test_fmatrix_helpers_finite_and_masked(self):
+        s = jnp.asarray([2.0, 2.0, 1.0, 0.0], jnp.float32)
+        f = fmatrix(s, 1e-6)
+        assert np.isfinite(np.asarray(f)).all()
+        m = np.asarray(degenerate_mask(s, 1e-6))
+        assert not m[0, 1] and not m[1, 0]      # the tie is masked
+        assert m[0, 2] and m[2, 3]              # clear gaps are not
+        assert not np.asarray(m.diagonal()).any()
+        r = np.asarray(sigma_recip(s, 1e-6))
+        assert np.isfinite(r).all() and r[3] == 0.0
+
+
+class TestSigmaOnly:
+    def test_sigma_only_equals_full_gradient(self):
+        a = _gap_matrix(seed=12)
+        g_full = jax.grad(_nuclear())(a)
+        g_sig = jax.grad(_nuclear(compute_u=False, compute_v=False))(a)
+        np.testing.assert_allclose(np.asarray(g_sig), np.asarray(g_full),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sigma_only_vjp_mode(self):
+        a = _gap_matrix(seed=13)
+        g = jax.grad(_nuclear(VJP_CFG, compute_u=False,
+                              compute_v=False))(a)
+        r = solver.svd(a)
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.asarray(r.u @ r.v.T),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_one_factor_requested(self):
+        a = _gap_matrix(seed=14)
+        g = jax.grad(lambda x: jnp.sum(
+            solver.svd(x, compute_v=False).s))(a)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestComposition:
+    def test_jit_grad(self):
+        a = _gap_matrix(seed=15)
+        eager = jax.grad(_nuclear())(a)
+        jitted = jax.jit(jax.grad(_nuclear()))(a)
+        np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_vmap_grad(self):
+        stack = jnp.stack([_gap_matrix(32, 24, seed=s) for s in (1, 2, 3)])
+        gb = jax.vmap(jax.grad(_nuclear()))(stack)
+        assert gb.shape == stack.shape
+        g0 = jax.grad(_nuclear())(stack[0])
+        np.testing.assert_allclose(np.asarray(gb[0]), np.asarray(g0),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_scan_grad(self):
+        a = _gap_matrix(32, 24, seed=16)
+
+        def loss(x):
+            def body(c, _):
+                return c * 0.5, _nuclear()(x * c)
+            _, ys = jax.lax.scan(body, jnp.float32(1.0), None, length=2)
+            return jnp.sum(ys)
+
+        g = jax.grad(loss)(a)
+        # sum_i c_i * ||a||_* gradient = (1 + 0.5) * U V^T
+        r = solver.svd(a)
+        np.testing.assert_allclose(np.asarray(g),
+                                   1.5 * np.asarray(r.u @ r.v.T),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_warmstart_grad_matches_cold(self):
+        a = _gap_matrix(seed=17)
+        prior = solver.svd(a)
+        a2 = a + 1e-3 * jnp.outer(jnp.ones(a.shape[0]),
+                                  jnp.ones(a.shape[1])) / a.shape[0]
+        g_cold = jax.grad(_nuclear())(a2)
+        g_warm = jax.grad(lambda x: jnp.sum(
+            solver.svd(x, v0=prior.v).s))(a2)
+        np.testing.assert_allclose(np.asarray(g_warm), np.asarray(g_cold),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestLaneRules:
+    def test_topk_grad_matches_truncated_full(self):
+        a = _gap_matrix(64, 48, seed=18)
+        k = 6
+        g_topk = jax.grad(lambda x: jnp.sum(solver.svd_topk(x, k).s))(a)
+        g_full = jax.grad(lambda x: jnp.sum(solver.svd(x).s[:k]))(a)
+        assert np.isfinite(np.asarray(g_topk)).all()
+        np.testing.assert_allclose(np.asarray(g_topk), np.asarray(g_full),
+                                   rtol=5e-3, atol=1e-3)
+
+    def test_topk_sigma_only_and_vjp_mode(self):
+        a = _gap_matrix(64, 48, seed=19)
+        g1 = jax.grad(lambda x: jnp.sum(
+            solver.svd_topk(x, 6, compute_u=False,
+                            compute_v=False).s))(a)
+        g2 = jax.grad(lambda x: jnp.sum(
+            solver.svd_topk(x, 6, config=VJP_CFG).s))(a)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_tall_grad_matches_fd(self):
+        sig = 2.0 ** (-np.arange(12, dtype=np.float64) / 3.0)
+        a = _make_matrix(160, 12, sig, seed=20)
+        g = jax.grad(lambda x: jnp.sum(solver.svd_tall(x).s))(a)
+        assert np.isfinite(np.asarray(g)).all()
+        for d in _directions(a.shape, k=2):
+            got = float(jnp.vdot(g, d))
+            want = _fd_directional(_np_nuclear, a, d)
+            assert got == pytest.approx(want, rel=2e-3, abs=1e-4)
+
+
+class TestChaosGuard:
+    def test_nan_cotangent_finite_vjp_mode(self):
+        # grad-under-chaos: a fully-poisoned sigma cotangent is zeroed
+        # by the custom_vjp chaos guard — the pullback stays finite
+        # (exactly zero: the loud sentinel), and the forward solve's
+        # health word is untouched (OK).
+        a = _gap_matrix(seed=21)
+        f = lambda x: solver.svd(x, config=VJP_CFG)
+        r, pullback = jax.vjp(lambda x: f(x).s, a)
+        (abar,) = pullback(jnp.full_like(r, jnp.nan))
+        assert np.isfinite(np.asarray(abar)).all()
+        assert float(jnp.abs(abar).max()) == 0.0
+        assert f(a).status_enum() == solver.SolveStatus.OK
+
+    def test_partial_nan_cotangent_keeps_finite_entries(self):
+        a = _gap_matrix(seed=22)
+        s, pullback = jax.vjp(
+            lambda x: solver.svd(x, config=VJP_CFG).s, a)
+        ct = jnp.zeros_like(s).at[0].set(jnp.nan).at[1].set(1.0)
+        (abar,) = pullback(ct)
+        assert np.isfinite(np.asarray(abar)).all()
+        # The finite entry's contribution survives: u_1 v_1^T.
+        r = solver.svd(a)
+        want = np.outer(np.asarray(r.u)[:, 1], np.asarray(r.v)[:, 1])
+        np.testing.assert_allclose(np.asarray(abar), want,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestUncoveredPaths:
+    def test_full_matrices_raises_clearly(self):
+        a = _gap_matrix(seed=23)
+        with pytest.raises(NonDifferentiableError,
+                           match="full_matrices=False"):
+            jax.grad(lambda x: jnp.sum(
+                solver.svd(x, full_matrices=True).s))(a)
+        # The plain forward call is unchanged.
+        assert solver.svd(a, full_matrices=True).u.shape == (48, 48)
+
+    def test_square_full_matrices_still_differentiable(self):
+        # m == n: the economy U IS the full U — no completion, rule on.
+        a = _gap_matrix(24, 24, seed=24)
+        g = jax.grad(lambda x: jnp.sum(
+            solver.svd(x, full_matrices=True).s))(a)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_batched_raises_naming_vmap(self):
+        stack = jnp.stack([_gap_matrix(24, 16, seed=s) for s in (1, 2)])
+        with pytest.raises(NonDifferentiableError, match="vmap"):
+            jax.grad(lambda x: jnp.sum(solver.svd_batched(x).s))(stack)
+        assert solver.svd_batched(stack).s.shape == (2, 16)
+
+    def test_sharded_raises_naming_alternative(self):
+        from svd_jacobi_tpu.parallel import sharded
+        a = _gap_matrix(64, 48, seed=28)
+        with pytest.raises(NonDifferentiableError, match="solver.svd"):
+            jax.grad(lambda x: jnp.sum(sharded.svd(x).s))(a)
+        assert sharded.svd(a).s.shape == (48,)
+
+    def test_resilient_svd_raises_naming_alternative(self):
+        from svd_jacobi_tpu.resilience import resilient_svd
+        a = _gap_matrix(seed=25)
+        with pytest.raises(NonDifferentiableError, match="solver.svd"):
+            jax.grad(lambda x: jnp.sum(resilient_svd(x).s))(a)
+
+    def test_jvp_through_vjp_mode_raises_jax_error(self):
+        a = _gap_matrix(seed=26)
+        with pytest.raises(TypeError, match="custom_vjp"):
+            jax.jvp(_nuclear(VJP_CFG), (a,), (jnp.ones_like(a),))
+
+    def test_unknown_grad_rule_rejected(self):
+        a = _gap_matrix(seed=27)
+        with pytest.raises(ValueError, match="grad_rule"):
+            solver.svd(a, config=SVDConfig(grad_rule="bogus"))
+
+
+class TestKnobResolution:
+    def test_table_rows_resolve_per_dtype(self):
+        # The shipped per-dtype rows: f32's cluster band is ~1e9x wider
+        # than f64's (matching each dtype's sigma^2 solve noise).
+        f32 = solver._resolve_grad_rtol(SVDConfig(), 1024, 1024,
+                                        jnp.float32)
+        f64 = solver._resolve_grad_rtol(SVDConfig(), 1024, 1024,
+                                        jnp.float64)
+        assert f32 == pytest.approx(1e-6)
+        assert f64 == pytest.approx(2e-15)
+        assert f32 > 1e6 * f64
+
+    def test_explicit_knob_wins_and_validates(self):
+        cfg = SVDConfig(grad_degenerate_rtol=3e-4)
+        assert solver._resolve_grad_rtol(cfg, 64, 64,
+                                         jnp.float32) == pytest.approx(3e-4)
+        with pytest.raises(ValueError, match="grad_degenerate_rtol"):
+            solver._resolve_grad_rtol(
+                SVDConfig(grad_degenerate_rtol=-1.0), 64, 64, jnp.float32)
+
+    def test_dtype_floor_fallback(self):
+        # With tables bypassed, the band falls back to 8*eps of the
+        # accumulation dtype.
+        from svd_jacobi_tpu.tune import tables
+        tables.set_active_table("off")
+        try:
+            got = solver._resolve_grad_rtol(SVDConfig(), 64, 64,
+                                            jnp.float32)
+            assert got == pytest.approx(
+                8 * float(jnp.finfo(jnp.float32).eps))
+        finally:
+            tables.set_active_table(None)
+
+    def test_resolve_config_pins_grad_band(self):
+        from svd_jacobi_tpu.tune import tables
+        cfg = tables.resolve_config(SVDConfig(), 96, 64, "float32",
+                                    backend="cpu", device_kind="x")
+        assert cfg.grad_degenerate_rtol == pytest.approx(1e-6)
+        pinned = dataclasses.replace(SVDConfig(),
+                                     grad_degenerate_rtol=7e-5)
+        cfg2 = tables.resolve_config(pinned, 96, 64, "float32",
+                                     backend="cpu", device_kind="x")
+        assert cfg2.grad_degenerate_rtol == pytest.approx(7e-5)
+
+
+class TestGrad001:
+    def test_all_probes_clean(self):
+        from svd_jacobi_tpu.analysis import grad_checks
+        findings, report = grad_checks.run_all()
+        assert findings == []
+        assert any("svd.nuclear" in p for p in report["probes"])
+        assert "grad._svd_vjp_jit" in report["grad_entries"]
+
+    def test_silent_fallback_fixture_fires(self):
+        from fixtures.grad_fixtures import silent_fallback_loss
+        from svd_jacobi_tpu.analysis import grad_checks
+        findings = grad_checks.check_grad_trace(
+            silent_fallback_loss, shape=(96, 64), dtype="float32",
+            where="fixture.silent_fallback")
+        codes = [f.message for f in findings]
+        assert any("silent fallback" in m for m in codes)
+        assert any("sweep machinery" in m for m in codes)
+
+    def test_unbudgeted_grad_jit_fixture_fires(self):
+        from fixtures.grad_fixtures import unbudgeted_grad_budgets
+        from svd_jacobi_tpu.analysis import grad_checks
+        findings = grad_checks.check_budget_coverage(
+            unbudgeted_grad_budgets())
+        assert len(findings) == 1
+        assert "grad._svd_vjp_jit" in findings[0].where
+
+    def test_registry_budget_ledger_two_way(self):
+        # The grad jits ride the same AOT001 two-way ledger as every
+        # serving entry.
+        from svd_jacobi_tpu.analysis import aot_checks
+        assert aot_checks.check_budget_coverage() == []
