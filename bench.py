@@ -99,8 +99,15 @@ Usage:
                                   the current kernel)
 
 Every solve row carries ``mfu``: measured GFLOP/s over the device's
-f32-effective peak (bench._PEAK_FLOPS, keyed by device kind; CPU rows
-use a documented rough estimate and say so via "peak_est").
+f32-effective peak (obs.costmodel.PEAK_FLOPS, keyed by device kind; CPU
+rows use a documented rough estimate and say so via "peak_est"), plus
+``peak_flops_source``/``hbm_bw_source`` provenance ("table" vs the
+estimate fallback) for every derived metric.
+
+``--check-against=BENCH_rNN.json`` gates the fresh headline row against
+the BENCH_*.json history beside that file: `obs.perf.check_rows` fits a
+per-metric noise band from repeated rows and the bench exits rc 4 on a
+regression beyond it — append and gate in one run.
 """
 
 from __future__ import annotations
@@ -111,35 +118,29 @@ import time
 
 import numpy as np
 
-# f32-effective peak FLOP/s by device kind (keys normalized like
-# tune.tables.normalize_device_kind), for the per-row MFU field — the
-# headline metric of the ROADMAP "attack the 1.7% MFU" item. TPU entries
-# are the chip's bf16 MXU peak / 6: the solver's f32-HIGHEST matmuls run
-# as bf16x6 passes, so that is the peak this workload could reach. The
-# "cpu" entry is a DOCUMENTED ROUGH ESTIMATE for the 2-core bench
-# container (2 cores x ~8 f32 FLOP/cycle FMA+AVX x ~3 GHz ~= 48 GFLOP/s):
-# CPU MFU rows are comparable across rounds, not absolute truth. Unknown
-# device kinds fall back to the CPU estimate with a "peak_est" note in
-# the row so an uncalibrated MFU can never pass silently as a measured
-# one.
-_PEAK_FLOPS = {
-    "tpu-v5-lite": 197e12 / 6,
-    "tpu-v5e": 197e12 / 6,
-    "tpu-v5p": 459e12 / 6,
-    "tpu-v4": 275e12 / 6,
-    "tpu-v6-lite": 918e12 / 6,
-    "tpu-v6e": 918e12 / 6,
-    "cpu": 48e9,
-}
-
+# f32-effective peak FLOP/s by device kind: the authoritative table now
+# lives in `svd_jacobi_tpu.obs.costmodel.PEAK_FLOPS`, right beside its
+# HBM-bandwidth sibling (`costmodel.HBM_BW`) so the MFU denominator and
+# the roofline denominators can never disagree. TPU entries are the
+# chip's bf16 MXU peak / 6 (the solver's f32-HIGHEST matmuls run as
+# bf16x6 passes); the "cpu" entry is a DOCUMENTED ROUGH ESTIMATE for
+# the 2-core bench container (2 cores x ~8 f32 FLOP/cycle FMA+AVX x
+# ~3 GHz ~= 48 GFLOP/s). Unknown device kinds fall back to the CPU
+# estimate; the estimated bit lands in the row as
+# `peak_flops_source="peak_est"` / `hbm_bw_source="bw_est"` so an
+# uncalibrated MFU or roofline number can never pass silently as a
+# measured one.
 
 def _peak_flops(device_kind: str):
     """(peak_flops, estimated?) for one device kind."""
-    from svd_jacobi_tpu.tune.tables import normalize_device_kind
-    kind = normalize_device_kind(device_kind)
-    if kind in _PEAK_FLOPS:
-        return _PEAK_FLOPS[kind], kind == "cpu"
-    return _PEAK_FLOPS["cpu"], True
+    from svd_jacobi_tpu.obs.costmodel import peak_flops
+    return peak_flops(device_kind)
+
+
+def _hbm_bw(device_kind: str):
+    """(bytes/s, estimated?) for one device kind."""
+    from svd_jacobi_tpu.obs.costmodel import hbm_bandwidth
+    return hbm_bandwidth(device_kind)
 
 
 def _mfu(gflops: float, device_kind: str):
@@ -1538,13 +1539,18 @@ def main() -> None:
         "sweeps": int(r.sweeps) if np.ndim(r.sweeps) == 0 else int(
             np.max(np.asarray(r.sweeps))),
         "mfu": mfu,
+        # Provenance of every derived (per-peak / per-bandwidth) metric
+        # in this row: "table" = tabulated device constant,
+        # "peak_est"/"bw_est" = the documented fallback estimate.
+        "peak_flops_source": "peak_est" if mfu_est else "table",
+        "hbm_bw_source": "bw_est" if _hbm_bw(device_kind)[1] else "table",
         "device": str(jax.devices()[0]),
         **extras,
     }
     if mfu_est:
         row["peak_est"] = ("documented CPU-class estimate "
-                           "(bench._PEAK_FLOPS) — MFU comparable across "
-                           "rounds, not absolute")
+                           "(obs.costmodel.PEAK_FLOPS) — MFU comparable "
+                           "across rounds, not absolute")
     if retried is not None:
         row["retried"] = retried
     print(json.dumps(row))
@@ -1646,6 +1652,26 @@ def main() -> None:
             argv=sys.argv[1:])
         obs.manifest.append(manifest_path, record)
         print(f"manifest: {manifest_path}", file=sys.stderr)
+
+    if "check-against" in flags:
+        # Append-and-gate in one run: the headline row just produced is
+        # checked against the BENCH_*.json history beside the named
+        # round, under the fitted per-metric noise band. rc 4 is the
+        # regression exit (distinct from solve/backend failures).
+        import glob as _glob
+        from svd_jacobi_tpu.obs.perf import check_rows
+        against = flags["check-against"]
+        hist = []
+        for p in sorted(_glob.glob(os.path.join(
+                os.path.dirname(os.path.abspath(against)) or ".",
+                "BENCH_*.json"))):
+            with open(p) as fh:
+                data = json.load(fh)
+            hist += data if isinstance(data, list) else [data]
+        ok, lines = check_rows({"parsed": row}, hist)
+        print("\n".join(lines), file=sys.stderr)
+        if not ok:
+            sys.exit(4)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,11 @@ it:
     # p50/p99, deadline-miss/shed counts, error-budget burn)
     python scripts/telemetry_summary.py reports/manifest.jsonl --slo
 
+    # roofline observatory records (a `cli --profile` run or
+    # `python -m svd_jacobi_tpu.perf report --emit` appends them):
+    # per-scope ms / GFLOP/s / %-of-roof with device-constant provenance
+    python scripts/telemetry_summary.py reports/manifest.jsonl --kind perf
+
     # diff two records (by index into one file, or across two files);
     # negative indices count from the end, like Python
     python scripts/telemetry_summary.py reports/manifest.jsonl --diff -2 -1

@@ -21,7 +21,7 @@ import time
 from pathlib import Path
 
 PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot",
-              "obs", "route", "grad")
+              "obs", "route", "grad", "perf")
 
 
 def _parse_args(argv):
@@ -104,6 +104,14 @@ def main(argv=None) -> int:
             # budget.
             from . import obs_checks
             findings, report = obs_checks.run_all()
+            return findings, report
+        if name == "perf":
+            # The roofline observatory contract (PERF001): the analytic
+            # cost model agrees with compiled.cost_analysis() on every
+            # registry entry, the SCOPE_PHASES join covers HOT_SCOPES
+            # exactly, and the perf-off hot path stays byte-identical.
+            from . import perf_checks
+            findings, report = perf_checks.run_all()
             return findings, report
         if name == "grad":
             # The differentiable-solver contract (GRAD001): grad traces

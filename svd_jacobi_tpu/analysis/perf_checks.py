@@ -1,0 +1,196 @@
+"""PERF001 — the analytic cost model agrees with XLA's own accounting.
+
+The roofline observatory (obs.costmodel / obs.attribution / obs.perf)
+divides measured per-scope durations by ANALYTIC FLOP counts. An
+analytic model nobody checks drifts silently — a refactor moves work
+between phases, a new entry lands unmodeled, and every roofline
+percentage quietly becomes fiction. This pass pins the model to a
+ground truth XLA computes for free:
+
+  1. **Model agreement** — for every registry entry probe
+     (`analysis.entries.single_device_probes`), the model's
+     ``convention="xla"`` FLOP count (`obs.costmodel.entry_flops`) must
+     agree with ``probe.lower().compile().cost_analysis()["flops"]``
+     within ``MODEL_TOL_FACTOR`` either way. The xla convention mirrors
+     `cost_analysis` semantics (while/scan bodies counted once,
+     LAPACK-style custom calls ~zero, matmuls 2mnk), so the residual
+     ratio is structure error — exactly what drift looks like. The
+     seeded fixture (``drift_factor`` ~9x, a lost n^3 term) MUST fire:
+     tests prove the detector can fail, not just that it passes.
+  2. **Scope-phase join coverage** — `config.SCOPE_PHASES` (the
+     attribution join table) keys must equal `config.HOT_SCOPES` keys
+     EXACTLY, and every mapped phase must be a canonical
+     `obs.costmodel.PHASES` name: a new profiler scope cannot land
+     unattributable, and a typo'd phase cannot silently drop its model.
+  3. **Perf-off HLO byte-identity** — the OBS002 discipline extended to
+     the observatory: importing obs.perf, exercising a
+     `ConvergenceRecorder`, and resolving roofline device constants is
+     host-side only and must not perturb any entry's lowering.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding
+
+# Agreement tolerance, either direction (model/xla in
+# [1/2.5, 2.5]). Measured headroom on the current probe census:
+# ratios 0.70-1.35 on the f32 entries, 1.79 on the f64 qr-svd lane
+# (cost_analysis gives its LAPACK custom calls ~zero flops while the
+# model keeps the loop-visible matmuls). A lost or doubled n^3 term
+# moves the ratio well past 2.5; dtype/shape bookkeeping errors scale
+# worse.
+MODEL_TOL_FACTOR = 2.5
+
+
+def _probe_model_flops(probe, *, drift_factor: float = 1.0) -> float:
+    """The model's xla-convention FLOPs for one entry probe, parameters
+    read off the probe itself (shape, dtype, sketch kwargs)."""
+    from ..obs import costmodel
+
+    a = probe.args[0]
+    batch = 1
+    shape = tuple(a.shape)
+    if probe.name == "pallas_batched":
+        batch, m, n = shape
+    else:
+        m, n = shape
+    kw = dict(block_size=costmodel.default_block_size(n),
+              dtype=str(a.dtype), batch=batch, convention="xla")
+    if probe.name == "sketch_project":
+        kw["sketch_width"] = int(probe.kwargs.get("l", 0))
+        kw["power_iters"] = int(probe.kwargs.get("power_iters", 0))
+        kw["chunk"] = probe.kwargs.get("chunk")
+    elif probe.name == "tsqr_tall":
+        kw["chunk"] = probe.kwargs.get("chunk")
+    return costmodel.entry_flops(probe.name, m, n, **kw) * drift_factor
+
+
+def _xla_flops(probe) -> float:
+    ca = probe.lower().compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0))
+
+
+def check_model_agreement(*, drift_factor: float = 1.0) -> tuple:
+    """PERF001 check 1 (see module docstring). ``drift_factor``
+    multiplies the model — the seeded drifted-model fixture. Returns
+    (findings, report rows)."""
+    from . import entries
+
+    findings: List[Finding] = []
+    rows = []
+    for probe in entries.single_device_probes():
+        model = _probe_model_flops(probe, drift_factor=drift_factor)
+        xla = _xla_flops(probe)
+        ratio = model / xla if xla > 0 else float("inf")
+        rows.append({"entry": probe.name, "model_flops": model,
+                     "xla_flops": xla, "ratio": round(ratio, 3)})
+        if not (1.0 / MODEL_TOL_FACTOR <= ratio <= MODEL_TOL_FACTOR):
+            findings.append(Finding(
+                code="PERF001", where=probe.name,
+                message=(f"analytic model disagrees with XLA "
+                         f"cost_analysis: model {model:.3e} vs xla "
+                         f"{xla:.3e} FLOPs (ratio {ratio:.2f}, "
+                         f"tolerance {MODEL_TOL_FACTOR}x either way)"),
+                suggestion=("re-derive obs.costmodel.entry_flops for "
+                            "this entry against its HLO dot census — "
+                            "a phase's term was lost, doubled, or the "
+                            "entry's composition changed")))
+    return findings, rows
+
+
+def check_scope_phase_join() -> List[Finding]:
+    """PERF001 check 2: SCOPE_PHASES covers HOT_SCOPES exactly and maps
+    into the canonical phase vocabulary."""
+    from .. import config
+    from ..obs import costmodel
+
+    findings: List[Finding] = []
+    scopes = set(config.HOT_SCOPES)
+    mapped = set(config.SCOPE_PHASES)
+    for missing in sorted(scopes - mapped):
+        findings.append(Finding(
+            code="PERF001", where=f"config.SCOPE_PHASES[{missing!r}]",
+            message=(f"HOT_SCOPES scope {missing!r} has no phase "
+                     f"mapping — its trace time would attribute to "
+                     f"'other' with no roofline"),
+            suggestion="add the scope to config.SCOPE_PHASES"))
+    for stale in sorted(mapped - scopes):
+        findings.append(Finding(
+            code="PERF001", where=f"config.SCOPE_PHASES[{stale!r}]",
+            message=(f"SCOPE_PHASES maps {stale!r}, which is not a "
+                     f"HOT_SCOPES scope — stale join entry"),
+            suggestion="remove it or add the scope to HOT_SCOPES"))
+    for scope, phase in sorted(config.SCOPE_PHASES.items()):
+        if phase not in costmodel.PHASES:
+            findings.append(Finding(
+                code="PERF001",
+                where=f"config.SCOPE_PHASES[{scope!r}]",
+                message=(f"maps to unknown phase {phase!r} (canonical: "
+                         f"{list(costmodel.PHASES)})"),
+                suggestion="use a costmodel.PHASES name"))
+    return findings
+
+
+def check_perf_off_hlo() -> List[Finding]:
+    """PERF001 check 3: the observatory is host-side only — importing
+    and exercising it must leave a probe's perf-off lowering
+    byte-identical (the OBS002 discipline)."""
+    from . import entries
+
+    probes = entries.single_device_probes(include_f64=False)
+    by_name = {p.name: p for p in probes}
+    picked = [by_name[n] for n in ("pallas", "padded_hybrid")
+              if n in by_name] or probes[:2]
+
+    findings: List[Finding] = []
+    for probe in picked:
+        off = probe.with_kwargs(
+            **({probe.telemetry_key: False} if probe.telemetry_key
+               else {}))
+        baseline = off.lower().as_text()
+        # Exercise the whole observatory surface between lowerings.
+        from ..obs import costmodel
+        from ..obs.perf import ConvergenceRecorder, device_block
+        rec = ConvergenceRecorder(spectrum="perf001")
+        rec.record(0.5, "bulk")
+        rec.record(1e-7, "polish")
+        rec.record_rounds(3, 4)
+        rec.block(tol=1e-6)
+        device_block("cpu")
+        costmodel.solve_costs(48, 32, block_size=4)
+        after = off.lower().as_text()
+        if after != baseline:
+            findings.append(Finding(
+                code="PERF001", where=probe.name,
+                message=("perf-off lowering changed after exercising "
+                         "the perf observatory — it leaked into the "
+                         "trace"),
+                suggestion=("costmodel/attribution/perf must stay "
+                            "host-side: nothing there may run under a "
+                            "jax trace")))
+    return findings
+
+
+def run_all() -> tuple:
+    """The PERF001 pass body (analysis.__main__ 'perf'). Returns
+    (findings, report)."""
+    findings, rows = check_model_agreement()
+    findings += check_scope_phase_join()
+    findings += check_perf_off_hlo()
+    # Seeded drifted-model fixture: a model off by ~9x (one lost n^3
+    # term's magnitude) MUST trip the detector.
+    drift_findings, _ = check_model_agreement(drift_factor=9.0)
+    if not drift_findings:
+        findings.append(Finding(
+            code="PERF001", where="drift_fixture",
+            message=("seeded 9x model drift produced zero findings — "
+                     "the agreement detector itself is broken (real "
+                     "drift would pass unnoticed)"),
+            suggestion="check check_model_agreement's ratio math"))
+    report = {"model": rows, "tolerance_factor": MODEL_TOL_FACTOR,
+              "drift_fixture_fired": bool(drift_findings)}
+    return findings, report

@@ -999,6 +999,37 @@ def main(argv=None) -> int:
         path = obs.manifest.append(
             Path(args.report_dir) / "manifest.jsonl", record)
         log(f"manifest: {path}")
+
+    # --profile runs additionally emit the roofline observatory's "perf"
+    # record: the capture just serialized is joined with the analytic
+    # cost model through the SAME obs.perf.build_report code path the
+    # offline `python -m svd_jacobi_tpu.perf report` uses, so the table
+    # printed here and the one rebuilt later from the manifest + trace
+    # are equal by construction. Best-effort: a capture without device
+    # events (profiler unavailable) must not fail the solve run.
+    if args.profile and (ctx is None or ctx.is_coordinator):
+        from svd_jacobi_tpu.obs import perf as obs_perf
+        try:
+            workload = {
+                "m": m, "n": n, "dtype": args.dtype,
+                "block_size": config.block_size,
+                "pair_solver": config.pair_solver,
+                "sweeps": float(r.sweeps),
+                "compute_u": args.jobu != "none",
+                "compute_v": args.jobv != "none",
+                "top_k": int(args.top_k) if args.top_k else None,
+                "oversample": config.oversample,
+                "power_iters": config.power_iters,
+            }
+            device = obs_perf.device_block(devices[0].device_kind)
+            perf_record = obs_perf.build_report(
+                args.profile, workload, device, source="cli")
+            perf_path = obs.manifest.append(
+                Path(args.report_dir) / "manifest.jsonl", perf_record)
+            log(obs_perf.render_report(perf_record))
+            log(f"perf manifest: {perf_path}")
+        except Exception as e:
+            log(f"perf attribution skipped: {e}")
     print(json.dumps(solve))
     # Exit code carries solve health (the reference exits 0 no matter
     # what): non-zero when the warm-up self-test missed its tolerance or

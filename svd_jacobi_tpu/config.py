@@ -465,3 +465,33 @@ HOT_SCOPES = {
     "grad_cotangent": ("grad/rules.py", "_svd_vjp"),
     "grad_sigma": ("grad/rules.py", "_sigma_vjp"),
 }
+
+# Roofline attribution join: every HOT_SCOPES profiler scope maps onto
+# one canonical phase of `obs.costmodel.PHASES`, so a trace's per-scope
+# durations can be divided by that phase's analytic FLOP/HBM-byte cost
+# (obs.attribution.attribute). Total coverage — keys here must equal
+# HOT_SCOPES' exactly — is enforced by the PERF001 analysis pass: a new
+# hot scope without a phase assignment would silently fall into the
+# model-less "other" bucket of every perf report.
+SCOPE_PHASES = {
+    "gram": "sweep.gram",
+    "rotations": "sweep.rotations",
+    "pair_solve": "sweep.rotations",
+    "block_solve": "sweep.rotations",
+    "apply": "sweep.apply",
+    "apply_exchange": "sweep.apply",
+    "exchange": "sweep.exchange",
+    "precondition_qr": "precondition",
+    "tsqr": "precondition",
+    "sketch": "sketch",
+    "reconstitute": "finish",
+    "ns_orthogonalize": "finish",
+    "postprocess": "finish",
+    "sigma_refine": "finish",
+    "recombine": "finish",
+    "lift": "finish",
+    "health": "health",
+    "grad_fmatrix": "grad",
+    "grad_cotangent": "grad",
+    "grad_sigma": "grad",
+}

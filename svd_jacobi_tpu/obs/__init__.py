@@ -35,15 +35,33 @@ stdlib-only at import, loadable without jax):
     `timeline_from_manifest` offline) and the `XprofWindow` hook that
     captures a `jax.profiler` trace of exactly one request's
     dispatch..finish window.
+
+The roofline observatory (`python -m svd_jacobi_tpu.perf`) closes the
+loop from scopes to numbers, all stdlib-only on the read side:
+
+  * `obs.costmodel` — analytic FLOP/HBM-byte model per phase and per
+    registry entry (two conventions: true arithmetic for rooflines,
+    XLA `cost_analysis` accounting for the PERF001 agreement check),
+    plus the device peak-FLOP/HBM-bandwidth tables with provenance.
+  * `obs.attribution` — stdlib parser for `jax.profiler` `.xplane.pb`
+    captures: joins device-plane events to `svdj/` named scopes through
+    the embedded HLO metadata and folds durations per `HOT_SCOPES` key.
+  * `obs.perf` — the `report`/`model`/`check` CLI, `ConvergenceRecorder`
+    (per-sweep off_rel series at zero extra readback), and the bench
+    noise-band regression gate.
 """
 
-from . import manifest, metrics, registry, scopes, spans
+from . import attribution, costmodel, manifest, metrics, perf, registry
+from . import scopes, spans
 from .metrics import capture, emit, enabled
+from .perf import ConvergenceRecorder
 from .registry import MetricsRegistry, SLOTracker
 from .scopes import scope
 from .spans import SpanRecorder
 from .trace import trace
 
-__all__ = ["manifest", "metrics", "registry", "scopes", "spans",
+__all__ = ["attribution", "costmodel", "manifest", "metrics", "perf",
+           "registry", "scopes", "spans",
            "capture", "emit", "enabled", "scope", "trace",
-           "MetricsRegistry", "SLOTracker", "SpanRecorder"]
+           "ConvergenceRecorder", "MetricsRegistry", "SLOTracker",
+           "SpanRecorder"]

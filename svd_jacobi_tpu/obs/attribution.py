@@ -1,0 +1,420 @@
+"""Per-scope device-time attribution from `jax.profiler` traces.
+
+Reads the `.xplane.pb` (XSpace protobuf) a `--profile` run or a PR 11
+`XprofWindow` capture leaves under ``<log_dir>/plugins/profile/<stamp>/``
+and recovers how much device time each `config.HOT_SCOPES` named scope
+consumed — WITHOUT tensorflow, tensorboard-plugin-profile, or even jax on
+the read side. The whole module is stdlib-only by contract (like
+`obs.manifest` / `obs.registry`): the offline `python -m
+svd_jacobi_tpu.perf report` path must work from a checked-in trace on a
+bare-python machine.
+
+How the join works (verified against jax 0.4.x CPU and TPU captures):
+
+  * An XSpace holds planes; device planes ("/host:CPU", "/device:TPU:N")
+    carry one XEvent per executed HLO op, named by INSTRUCTION name
+    ("broadcast_multiply_fusion.9") — the `svdj/<scope>` annotation is
+    NOT on the event.
+  * The "/host:metadata" plane's XEventMetadata entries carry each
+    compiled module's serialized HloProto in an XStat. Each instruction's
+    `metadata.op_name` there holds the full named_scope path
+    ("jit(_svd_pallas_impl)/.../svdj/rotations/...").
+  * So: parse the HloProtos into (module, instruction) -> op_name, then
+    walk the device-plane events, join by instruction name (events that
+    are not HLO ops — python frames, ThunkExecutor wrappers — simply
+    don't join and are reported as host/unattributed time), and fold
+    durations by the innermost `svdj/` path component.
+
+Only the protobuf wire format is implemented (varints + length-delimited
+fields — ~40 lines); field numbers follow tensorflow's xplane.proto and
+openxla's hlo.proto and are pinned in `_F`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+SCOPE_PREFIX = "svdj/"     # mirrors obs.scopes.PREFIX (stdlib copy)
+
+
+# --------------------------------------------------------------------------
+# Protobuf wire format.
+# --------------------------------------------------------------------------
+
+def _varint(b: bytes, i: int) -> Tuple[int, int]:
+    r = s = 0
+    while True:
+        x = b[i]
+        i += 1
+        r |= (x & 0x7F) << s
+        if not x & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(b: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    Length-delimited values come back as bytes; varints as int. Groups
+    (wire types 3/4) are long-dead — a message using them is malformed
+    for our purposes and raises."""
+    i, n = 0, len(b)
+    while i < n:
+        tag, i = _varint(b, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(b, i)
+        elif wt == 1:
+            v = b[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _varint(b, i)
+            v = b[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = b[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield fn, wt, v
+
+
+def _first(b: bytes, field: int) -> Optional[object]:
+    for fn, _, v in _fields(b):
+        if fn == field:
+            return v
+    return None
+
+
+class _F:
+    """Pinned field numbers (xplane.proto / hlo.proto)."""
+
+    # XSpace
+    SPACE_PLANES = 1
+    # XPlane
+    PLANE_NAME = 2
+    PLANE_LINES = 3
+    PLANE_EVENT_METADATA = 4        # map<int64, XEventMetadata>
+    PLANE_STAT_METADATA = 5         # map<int64, XStatMetadata>
+    # map entries
+    MAP_KEY = 1
+    MAP_VALUE = 2
+    # XLine
+    LINE_EVENTS = 4
+    # XEvent
+    EVENT_METADATA_ID = 1
+    EVENT_DURATION_PS = 3
+    EVENT_STATS = 4
+    # XEventMetadata
+    EMETA_NAME = 2
+    EMETA_STATS = 5
+    # XStatMetadata
+    SMETA_NAME = 2
+    # XStat
+    STAT_METADATA_ID = 1
+    STAT_UINT64 = 3
+    STAT_INT64 = 4
+    STAT_STR = 5
+    STAT_BYTES = 6
+    STAT_REF = 7
+    # HloProto / HloModuleProto / HloComputationProto /
+    # HloInstructionProto / OpMetadata
+    HLO_MODULE = 1
+    MODULE_NAME = 1
+    MODULE_COMPUTATIONS = 3
+    COMP_INSTRUCTIONS = 2
+    INSTR_NAME = 1
+    INSTR_METADATA = 7
+    OPMETA_OP_NAME = 2
+
+
+# --------------------------------------------------------------------------
+# XSpace reading.
+# --------------------------------------------------------------------------
+
+def read_trace_bytes(path: str) -> bytes:
+    """Raw XSpace bytes from a file path; transparently gunzips (fixture
+    traces are checked in compressed)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+    return data
+
+
+def find_trace(path: str) -> str:
+    """Resolve ``path`` to one ``.xplane.pb[.gz]`` file: accepts the file
+    itself, a profile log_dir (the `trace`/`XprofWindow` argument — the
+    newest capture under ``plugins/profile/*/`` wins), or any directory
+    containing captures."""
+    if os.path.isfile(path):
+        return path
+    hits: List[str] = []
+    for root, _, names in os.walk(path):
+        for name in names:
+            if name.endswith((".xplane.pb", ".xplane.pb.gz")):
+                hits.append(os.path.join(root, name))
+    if not hits:
+        raise FileNotFoundError(
+            f"no .xplane.pb under {path!r} — pass a jax.profiler log_dir "
+            f"(the directory given to --profile / obs.trace) or the "
+            f"xplane.pb file itself")
+    return max(hits, key=os.path.getmtime)
+
+
+def _plane_name(plane: bytes) -> str:
+    v = _first(plane, _F.PLANE_NAME)
+    return v.decode("utf-8", "replace") if isinstance(v, bytes) else ""
+
+
+def _op_name_map(data: bytes) -> Dict[str, str]:
+    """(instruction name) -> op_name scope path, merged over every
+    HloProto found in any plane's event metadata. Instruction names are
+    unique within a module and modules share the map; a cross-module
+    collision (same instruction name, different op_name) keeps the first
+    seen — harmless for scope attribution since colliding names are
+    near-identical boilerplate (params, copies) with no svdj scope."""
+    ops: Dict[str, str] = {}
+    for fn, _, plane in _fields(data):
+        if fn != _F.SPACE_PLANES:
+            continue
+        for f2, _, entry in _fields(plane):
+            if f2 != _F.PLANE_EVENT_METADATA:
+                continue
+            emeta = _first(entry, _F.MAP_VALUE)
+            if not isinstance(emeta, bytes):
+                continue
+            for f3, _, stat in _fields(emeta):
+                if f3 != _F.EMETA_STATS:
+                    continue
+                blob = _first(stat, _F.STAT_BYTES)
+                if not isinstance(blob, bytes) or len(blob) < 8:
+                    continue
+                try:
+                    _collect_hlo_ops(blob, ops)
+                except (ValueError, IndexError):
+                    continue          # stat bytes that are not an HloProto
+    return ops
+
+
+def _collect_hlo_ops(hlo_proto: bytes, out: Dict[str, str]) -> None:
+    module = _first(hlo_proto, _F.HLO_MODULE)
+    if not isinstance(module, bytes):
+        return
+    for fn, _, comp in _fields(module):
+        if fn != _F.MODULE_COMPUTATIONS:
+            continue
+        for f2, _, instr in _fields(comp):
+            if f2 != _F.COMP_INSTRUCTIONS:
+                continue
+            name = op_name = None
+            for f3, _, v in _fields(instr):
+                if f3 == _F.INSTR_NAME and isinstance(v, bytes):
+                    name = v.decode("utf-8", "replace")
+                elif f3 == _F.INSTR_METADATA and isinstance(v, bytes):
+                    o = _first(v, _F.OPMETA_OP_NAME)
+                    if isinstance(o, bytes):
+                        op_name = o.decode("utf-8", "replace")
+            if name and name not in out:
+                out[name] = op_name or ""
+
+
+def _device_events(data: bytes) -> Iterator[Tuple[str, str, int]]:
+    """Yield (plane_name, event_name, duration_ps) for every event on
+    every plane that has lines (device planes and the host op line)."""
+    for fn, _, plane in _fields(data):
+        if fn != _F.SPACE_PLANES:
+            continue
+        pname = _plane_name(plane)
+        emeta: Dict[int, str] = {}
+        lines: List[bytes] = []
+        for f2, _, v in _fields(plane):
+            if f2 == _F.PLANE_EVENT_METADATA:
+                key = _first(v, _F.MAP_KEY)
+                meta = _first(v, _F.MAP_VALUE)
+                if isinstance(meta, bytes):
+                    nm = _first(meta, _F.EMETA_NAME)
+                    if isinstance(nm, bytes):
+                        emeta[int(key or 0)] = nm.decode("utf-8", "replace")
+            elif f2 == _F.PLANE_LINES:
+                lines.append(v)
+        for line in lines:
+            for f3, _, ev in _fields(line):
+                if f3 != _F.LINE_EVENTS:
+                    continue
+                mid = dur = 0
+                for f4, _, v in _fields(ev):
+                    if f4 == _F.EVENT_METADATA_ID:
+                        mid = int(v)
+                    elif f4 == _F.EVENT_DURATION_PS:
+                        dur = int(v)
+                name = emeta.get(mid)
+                if name:
+                    yield pname, name, dur
+
+
+# --------------------------------------------------------------------------
+# Scope attribution.
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ScopeTime:
+    """Accumulated device time of one profiler scope."""
+
+    scope: str
+    seconds: float = 0.0
+    events: int = 0
+
+
+@dataclasses.dataclass
+class Attribution:
+    """Per-scope device time recovered from one trace, plus the honesty
+    buckets: ``unscoped_s`` is HLO-op time carrying no svdj scope
+    (preconditioner custom calls, input copies, glue), ``unattributed_s``
+    is event time that joined no instruction at all (python frames,
+    executor wrappers) — reported, never silently folded in."""
+
+    scopes: Dict[str, ScopeTime]
+    unscoped_s: float
+    unattributed_s: float
+    trace_path: str = ""
+
+    @property
+    def scoped_s(self) -> float:
+        return sum(s.seconds for s in self.scopes.values())
+
+    @property
+    def device_s(self) -> float:
+        """Total HLO-op time (scoped + unscoped)."""
+        return self.scoped_s + self.unscoped_s
+
+
+def innermost_scope(op_name: str,
+                    prefix: str = SCOPE_PREFIX) -> Optional[str]:
+    """The innermost `svdj/<scope>` component of an op_name path, or
+    None. Nested scopes attribute to the most specific annotation."""
+    idx = op_name.rfind(prefix)
+    if idx < 0:
+        return None
+    rest = op_name[idx + len(prefix):]
+    return rest.split("/", 1)[0] or None
+
+
+def scope_durations(trace: str, *,
+                    prefix: str = SCOPE_PREFIX) -> Attribution:
+    """Fold a capture's device time by profiler scope.
+
+    ``trace``: a log_dir or an ``.xplane.pb[.gz]`` path (`find_trace`
+    resolution). Durations SUM across threads/cores executing ops in
+    parallel — this is device-time attribution (how the FLOP budget was
+    spent), not wall-clock decomposition; shares are what matter.
+    """
+    path = find_trace(trace)
+    data = read_trace_bytes(path)
+    ops = _op_name_map(data)
+    scopes: Dict[str, ScopeTime] = {}
+    unscoped = unattributed = 0
+    for _, name, dur_ps in _device_events(data):
+        op_name = ops.get(name)
+        if op_name is None:
+            unattributed += dur_ps
+            continue
+        scope = innermost_scope(op_name, prefix)
+        if scope is None:
+            unscoped += dur_ps
+            continue
+        st = scopes.setdefault(scope, ScopeTime(scope))
+        st.seconds += dur_ps * 1e-12
+        st.events += 1
+    return Attribution(scopes, unscoped * 1e-12, unattributed * 1e-12,
+                       trace_path=path)
+
+
+# --------------------------------------------------------------------------
+# Joining measured time with the cost model.
+# --------------------------------------------------------------------------
+
+def attribute(attr: Attribution, phase_costs: Dict[str, object], *,
+              scope_phases: Dict[str, str], peak_flops: float,
+              hbm_bw: float, estimated: bool = False) -> List[dict]:
+    """Join per-scope durations with per-phase analytic costs into the
+    roofline rows of the "perf" manifest kind.
+
+    A phase's modeled FLOPs/bytes are split across its scopes
+    proportionally to measured time (e.g. `apply` and `apply_exchange`
+    both land in "sweep.apply"). Scopes whose phase has no model (grad,
+    health) — and phases modeled at zero flops (exchange) — still get a
+    row with measured seconds and achieved GB/s, with roofline fields
+    None. Rows are sorted by descending seconds.
+    """
+    try:
+        from . import costmodel
+    except ImportError:
+        # Loaded standalone by file path (scripts/telemetry_summary.py
+        # style) — costmodel.py is loaded beside us under its bare name.
+        import costmodel  # type: ignore
+
+    by_phase: Dict[str, List[ScopeTime]] = {}
+    for st in attr.scopes.values():
+        phase = scope_phases.get(st.scope, "other")
+        by_phase.setdefault(phase, []).append(st)
+
+    rows: List[dict] = []
+    for phase, members in by_phase.items():
+        phase_s = sum(st.seconds for st in members)
+        cost = phase_costs.get(phase)
+        for st in members:
+            share = st.seconds / phase_s if phase_s > 0 else 0.0
+            row = {
+                "scope": st.scope, "phase": phase,
+                "seconds": st.seconds, "events": st.events,
+                "share_of_phase": share,
+                "flops": None, "hbm_bytes": None, "intensity": None,
+                "gflops": None, "gbytes_per_s": None,
+                "attainable_gflops": None, "frac_of_roof": None,
+                "bound": None,
+            }
+            if cost is not None and st.seconds > 0:
+                sliced = costmodel.PhaseCost(
+                    phase, cost.flops * share, cost.hbm_bytes * share)
+                roof = costmodel.roofline(
+                    phase, st.seconds, sliced, peak_flops=peak_flops,
+                    hbm_bw=hbm_bw, estimated=estimated)
+                row.update(
+                    flops=sliced.flops, hbm_bytes=sliced.hbm_bytes,
+                    intensity=roof.intensity,
+                    gflops=roof.achieved_flops / 1e9,
+                    gbytes_per_s=roof.achieved_bytes / 1e9,
+                    attainable_gflops=roof.attainable / 1e9,
+                    frac_of_roof=roof.frac_of_roof, bound=roof.bound)
+            rows.append(row)
+    rows.sort(key=lambda r: -r["seconds"])
+    return rows
+
+
+def render_table(rows: List[dict], *, unscoped_s: float = 0.0,
+                 unattributed_s: float = 0.0,
+                 title: str = "per-scope roofline") -> str:
+    """Fixed-width table of attribution rows (the `perf report` body and
+    the "perf" manifest summarizer's long form)."""
+    head = (f"{'scope':<16} {'phase':<16} {'ms':>9} {'GFLOP/s':>9} "
+            f"{'GB/s':>8} {'AI':>7} {'%roof':>6} {'bound':<9}")
+    out = [title, head, "-" * len(head)]
+    for r in rows:
+        def fmt(v, spec):
+            return format(v, spec) if v is not None else "-"
+        out.append(
+            f"{r['scope']:<16} {r['phase']:<16} "
+            f"{r['seconds'] * 1e3:>9.3f} {fmt(r['gflops'], '>9.2f')} "
+            f"{fmt(r['gbytes_per_s'], '>8.2f')} "
+            f"{fmt(r['intensity'], '>7.2f')} "
+            f"{fmt(None if r['frac_of_roof'] is None else 100 * r['frac_of_roof'], '>6.1f')} "
+            f"{r['bound'] or '-':<9}")
+    scoped = sum(r["seconds"] for r in rows)
+    out.append("-" * len(head))
+    out.append(f"scoped {scoped * 1e3:.3f} ms | unscoped HLO "
+               f"{unscoped_s * 1e3:.3f} ms | unattributed (host) "
+               f"{unattributed_s * 1e3:.3f} ms")
+    return "\n".join(out)

@@ -1,0 +1,551 @@
+"""Analytic FLOP / HBM-byte cost model for the solver's phases.
+
+The roofline layer's ground truth (Williams et al., "Roofline: an
+insightful visual performance model", CACM 2009): every phase of a solve
+— preconditioning QR, the sweep rounds (Gram panels, rotation solves,
+stack applies, tournament exchanges), the block-rotation bulk's eigh
+subproblem + rank-2b GEMMs, the TSQR/sketch stages of the tall/top-k
+lanes, and the finish/lift epilogue — gets an analytic FLOP count and an
+HBM traffic estimate parameterized by (m, n, b, dtype, mixed_store).
+Dividing a measured per-scope duration (obs.attribution) by these yields
+achieved GFLOP/s and GB/s; comparing the phase's arithmetic intensity
+against the device ridge point (peak_flops / hbm_bandwidth) classifies it
+compute- or bandwidth-bound and gives the %-of-roofline headroom number
+every kernel PR must report.
+
+Two counting conventions, selected per call:
+
+  * ``convention="algorithm"`` — the true arithmetic of the method,
+    factorization terms included (QR at 2mn^2 - 2n^3/3, eigh at ~9n^3),
+    loop bodies multiplied by their actual trip counts. This is the
+    roofline numerator: what the hardware really executed.
+  * ``convention="xla"`` — XLA's `compiled.cost_analysis()` accounting,
+    which the PERF001 analysis pass validates this model against:
+    LAPACK-style custom calls (geqrf/orgqr, syevd, gesdd) are counted as
+    ~ZERO flops (measured: qr(48x32) = 2078 "flops" — boundary
+    elementwise only — vs 76k algorithmic), and `while`/`scan` bodies
+    are counted ONCE regardless of trip count (measured: a 5-trip
+    fori_loop of a 64^3 matmul = 524290 vs 524288 for one). Matmuls
+    count exactly 2mnk in every dtype.
+
+Stdlib-only BY CONTRACT (like obs/manifest.py, obs/registry.py): the
+offline `python -m svd_jacobi_tpu.perf report` path must render a
+roofline table from a checked-in trace on a machine with no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# Device tables.
+# --------------------------------------------------------------------------
+
+def normalize_device_kind(kind: str) -> str:
+    """Mirror of `tune.tables.normalize_device_kind` (stdlib-only copy —
+    this module must import without jax): lowercase, spaces/underscores
+    to dashes, so "TPU v5 lite" matches the table keys."""
+    return str(kind).strip().lower().replace(" ", "-").replace("_", "-")
+
+
+# HBM bandwidth in bytes/s, keyed like bench's `_PEAK_FLOPS` (bench.py
+# imports THIS table so the two stay next to each other on the read side).
+# Sources: published per-chip HBM specs — v4 1228 GB/s, v5e 819 GB/s,
+# v5p 2765 GB/s, v6e (Trillium) 1638 GB/s. The "cpu" row is a deliberately
+# round order-of-magnitude stand-in for the dev machines the CPU backend
+# runs on; `hbm_bandwidth` flags it (and any unknown kind) as estimated so
+# bench rows can carry `hbm_bw_source` provenance and a roofline number can
+# never silently rest on the fallback.
+HBM_BW: Dict[str, float] = {
+    "tpu-v4": 1.2288e12,
+    "tpu-v5-lite": 8.19e11,
+    "tpu-v5e": 8.19e11,
+    "tpu-v5p": 2.765e12,
+    "tpu-v6-lite": 1.638e12,
+    "tpu-v6e": 1.638e12,
+    "cpu": 5.0e10,
+}
+
+_CPU_FALLBACK_BW = 5.0e10
+
+
+def hbm_bandwidth(device_kind: str) -> Tuple[float, bool]:
+    """(bytes/s, estimated?) for a device kind. ``estimated`` is True for
+    the cpu stand-in and for kinds missing from the table — the same
+    two-state provenance contract as bench's `_peak_flops`."""
+    kind = normalize_device_kind(device_kind)
+    if kind in HBM_BW:
+        return HBM_BW[kind], kind == "cpu"
+    return _CPU_FALLBACK_BW, True
+
+
+# f32-effective peak FLOP/s by device kind — the authoritative copy of
+# what was bench.py's `_PEAK_FLOPS` (bench aliases this table so MFU and
+# roofline rows can never disagree on the denominator). TPU entries are
+# the chip's bf16 MXU peak / 6: the solver's f32-HIGHEST matmuls run as
+# bf16x6 passes. The "cpu" entry is a DOCUMENTED ROUGH ESTIMATE for the
+# 2-core bench container (2 cores x ~8 f32 FLOP/cycle x ~3 GHz).
+PEAK_FLOPS: Dict[str, float] = {
+    "tpu-v5-lite": 197e12 / 6,
+    "tpu-v5e": 197e12 / 6,
+    "tpu-v5p": 459e12 / 6,
+    "tpu-v4": 275e12 / 6,
+    "tpu-v6-lite": 918e12 / 6,
+    "tpu-v6e": 918e12 / 6,
+    "cpu": 48e9,
+}
+
+
+def peak_flops(device_kind: str) -> Tuple[float, bool]:
+    """(FLOP/s, estimated?) for a device kind — estimated for the cpu
+    stand-in and for kinds that fall back to it."""
+    kind = normalize_device_kind(device_kind)
+    if kind in PEAK_FLOPS:
+        return PEAK_FLOPS[kind], kind == "cpu"
+    return PEAK_FLOPS["cpu"], True
+
+
+_DTYPE_BYTES = {
+    "float64": 8, "f64": 8, "float32": 4, "f32": 4,
+    "bfloat16": 2, "bf16": 2, "float16": 2, "f16": 2,
+}
+
+
+def dtype_bytes(dtype: str) -> int:
+    try:
+        return _DTYPE_BYTES[str(dtype).lower()]
+    except KeyError:
+        raise ValueError(f"unknown dtype {dtype!r} (expected one of "
+                         f"{sorted(set(_DTYPE_BYTES))})") from None
+
+
+def default_block_size(n: int) -> int:
+    """The untuned block-width default (n/8, clamped to [4, 128]) — a
+    stdlib mirror of `SVDConfig.pick_block_size`'s generic ladder for
+    offline use. Live callers pass the true resolved width instead."""
+    return max(4, min(128, n // 8))
+
+
+# --------------------------------------------------------------------------
+# Phase vocabulary and the HOT_SCOPES join.
+# --------------------------------------------------------------------------
+
+# Canonical phase names, in pipeline order. `config.SCOPE_PHASES` maps
+# every `config.HOT_SCOPES` profiler scope onto one of these (checked by
+# PERF001), so a trace's per-scope durations can be joined with the model.
+PHASES = (
+    "precondition",       # QR / chunked-TSQR preconditioning of tall inputs
+    "sweep.gram",         # pair Gram panels X^T X (MXU)
+    "sweep.rotations",    # 2b x 2b rotation solves (kernel / eigh / qr-svd)
+    "sweep.apply",        # rank-2b rotation applies to the U/V stacks (MXU)
+    "sweep.exchange",     # tournament block exchange (pure data movement)
+    "sketch",             # randomized range-finder projection (top-k lane)
+    "finish",             # reconstitute / sigma / NS-polish / lift epilogue
+    "grad",               # differentiable-solver backward hot regions
+    "health",             # in-graph health word (budgeted ~zero)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseCost:
+    """Analytic cost of one phase over a whole solve (or one loop trip,
+    under the "xla" convention). ``flops`` may be 0.0 for pure-movement
+    phases (exchange) — arithmetic intensity is then 0 and the phase is
+    bandwidth-bound by construction."""
+
+    phase: str
+    flops: float
+    hbm_bytes: float
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity in FLOP/byte (0 when no traffic is
+        modeled — degenerate, treated as compute-bound)."""
+        return self.flops / self.hbm_bytes if self.hbm_bytes > 0 else 0.0
+
+
+# Calibration constants for terms without a closed-form flop count.
+# EIGH_FLOPS_PER_N3: tridiagonalization + implicit-QL work of a dense
+# symmetric eigensolve, the standard ~9n^3 (Golub & Van Loan §8.3).
+EIGH_FLOPS_PER_N3 = 9.0
+# KERNEL_ROT_FLOPS_PER_N3: the pallas scalar-Jacobi rotation solve on a
+# 2b x 2b subproblem — ~(2b-1) inner rounds x b column pairs x ~24b flops
+# per pair (dots, Rutishauser angle, two rank-1 updates) ≈ 6 (2b)^3.
+KERNEL_ROT_FLOPS_PER_N3 = 6.0
+# Newton-Schulz polish of a near-orthogonal q: two n^2-by-n matmuls.
+_NS_FLOPS_PER_N3 = 4.0
+# The mixed-store entry's inter-loop bf16->f32 reconstitution + polish
+# chain, in units of n^3 (~5 matmuls of the work triangle; calibrated
+# against the probe entry's HLO dot census — see PERF001).
+_MIXED_RECONSTITUTE_N3 = 10.0
+
+
+def _pad_geometry(n: int, b: int) -> Tuple[int, int, int]:
+    """(n_pad, pairs, rounds_per_sweep) of the blocked tournament:
+    columns pad to 2b * k, giving 2k block columns swept in one self
+    round plus 2k-1 cross rounds."""
+    width = 2 * b
+    k = max(1, math.ceil(n / width))
+    return k * width, k, 2 * k  # 1 self + (2k - 1) cross rounds
+
+
+def sweep_costs(m: int, n: int, *, block_size: Optional[int] = None,
+                dtype: str = "float32", pair_solver: str = "pallas",
+                accumulate_v: bool = True, sweeps: float = 1.0,
+                gram_dtype: Optional[str] = None,
+                convention: str = "algorithm") -> Dict[str, PhaseCost]:
+    """Costs of ``sweeps`` full sweeps on an m x n working matrix.
+
+    ``pair_solver`` picks the rotation-solve term: "pallas" (scalar
+    kernel), "gram-eigh"/"hybrid" (batched eigh + NS polish),
+    "block_rotation" (eigh-accumulated factors applied as rank-2b GEMMs).
+    ``gram_dtype`` models the mixed_store regime (bf16 Gram panels while
+    applies stay in the store dtype). Under ``convention="xla"`` the trip
+    count collapses to one round (scan/while bodies counted once) and
+    custom-call eigh terms drop to zero.
+    """
+    b = block_size or default_block_size(n)
+    ds = dtype_bytes(dtype)
+    gs = dtype_bytes(gram_dtype or dtype)
+    n_pad, k, rounds = _pad_geometry(n, b)
+    xla = convention == "xla"
+    trips = 1.0 if xla else float(sweeps) * rounds
+    w = 2 * b                                     # pair width
+
+    # Gram: k pairs of (m x 2b) panels -> (2b x 2b) Gram blocks.
+    gram_flops = trips * 8.0 * m * b * b * k
+    gram_bytes = trips * (m * n_pad * gs + k * w * w * gs)
+
+    # Rotation solves on the k subproblems.
+    if pair_solver in ("gram-eigh", "hybrid", "block_rotation"):
+        eigh_term = 0.0 if xla else EIGH_FLOPS_PER_N3 * w ** 3
+        rot_flops = trips * k * (eigh_term + _NS_FLOPS_PER_N3 * w ** 3)
+    elif pair_solver == "qr-svd":
+        # QR + small SVD per pair: LAPACK custom calls, ~zero under the
+        # XLA accounting; the scalar Givens cleanup sweep that follows is
+        # elementwise (no dots) and rides the same bucket.
+        rot_flops = 0.0 if xla else trips * k * (
+            qr_flops(w, w, form_q=True) + EIGH_FLOPS_PER_N3 * w ** 3)
+    else:                                         # pallas scalar kernel
+        rot_flops = trips * k * KERNEL_ROT_FLOPS_PER_N3 * w ** 3
+    rot_bytes = trips * k * 3.0 * w * w * ds
+
+    # Applies: rank-2b GEMMs onto the X stack (m rows) and, when V is
+    # accumulated, onto the V stack (n_pad rows). The block_rotation
+    # bulk's one-GEMM-per-pair apply has the same count — that lane's
+    # win is arithmetic intensity, not fewer flops.
+    apply_rows = m + (n_pad if accumulate_v else 0)
+    apply_flops = trips * 8.0 * apply_rows * b * b * k
+    apply_bytes = trips * 2.0 * apply_rows * n_pad * ds
+
+    # Tournament exchange: pure permutation traffic of both stacks.
+    exch_bytes = trips * 2.0 * apply_rows * n_pad * ds
+
+    return {
+        "sweep.gram": PhaseCost("sweep.gram", gram_flops, gram_bytes),
+        "sweep.rotations": PhaseCost("sweep.rotations", rot_flops, rot_bytes),
+        "sweep.apply": PhaseCost("sweep.apply", apply_flops, apply_bytes),
+        "sweep.exchange": PhaseCost("sweep.exchange", 0.0, exch_bytes),
+    }
+
+
+def qr_flops(m: int, n: int, *, form_q: bool = False) -> float:
+    """Householder QR of m x n (m >= n): 2mn^2 - 2n^3/3, doubled when the
+    thin Q is explicitly formed (orgqr has the same count)."""
+    base = 2.0 * m * n * n - 2.0 * n ** 3 / 3.0
+    return base * (2.0 if form_q else 1.0)
+
+
+def precondition_costs(m: int, n: int, *, dtype: str = "float32",
+                       form_q: bool = True, tall_chunks: int = 1,
+                       convention: str = "algorithm") -> PhaseCost:
+    """QR (or chunked-TSQR) preconditioning of the m x n input. The TSQR
+    tree's extra stacked-R factorizations add ~2n^3/3 per chunk level —
+    second order next to 2mn^2 for the m >= 8n shapes the tall lane
+    admits. Under "xla" the geqrf/orgqr custom calls count ~zero and a
+    chunked tree's scan is counted once."""
+    ds = dtype_bytes(dtype)
+    if convention == "xla":
+        flops = 0.0
+        m_eff = m / max(1, tall_chunks)     # one scan trip of the tree
+        bytes_ = 2.0 * m_eff * n * ds
+    else:
+        flops = qr_flops(m, n, form_q=form_q) + (
+            (tall_chunks - 1) * 2.0 * n ** 3 / 3.0)
+        bytes_ = (2.0 + (1.0 if form_q else 0.0)) * m * n * ds
+    return PhaseCost("precondition", flops, bytes_)
+
+
+def tsqr_fixup_flops(m: int, n: int, chunk: int) -> float:
+    """Counted matmul work of the recursive blocked TSQR: each level's
+    per-chunk reduced QR is a (zero-counted) custom call, but stitching
+    Q <- Q_chunk @ Q_next IS a dot — 2 * (c * chunk) * n^2 per level.
+    The chunk blocks are a Python loop (reshape + batched QR), NOT a
+    scan, so every level counts under both conventions."""
+    total, rows = 0.0, float(m)
+    while rows > max(chunk, 2 * n):
+        c = math.ceil(rows / chunk)
+        total += 2.0 * c * chunk * n * n
+        rows = c * n
+    return total
+
+
+def sketch_costs(m: int, n: int, sketch_width: int, *,
+                 power_iters: int = 0, dtype: str = "float32",
+                 chunk: Optional[int] = None,
+                 convention: str = "algorithm") -> PhaseCost:
+    """Randomized range-finder of the top-k lane: Y = A @ Omega (2mnl),
+    each power iteration A(A^T Q(Y)) (4mnl), the projection B = Q^T A
+    (2mnl), and one TSQR orthonormalization of the m x l panel per
+    range-finder pass (its stitch matmuls, ~2ml^2 per pass — the QR
+    itself is custom-call-zero under "xla" but second order under
+    "algorithm" too at l << n). The chunked tree is unrolled Python, so
+    both conventions count every chunk."""
+    ds = dtype_bytes(dtype)
+    l = sketch_width
+    flops = 2.0 * m * n * l * (2.0 + 2.0 * power_iters)
+    flops += (1.0 + power_iters) * tsqr_fixup_flops(m, l, chunk or m)
+    if convention != "xla":
+        flops += (1.0 + power_iters) * qr_flops(m, l, form_q=True)
+    bytes_ = (2.0 + 2.0 * power_iters) * m * n * ds + 2.0 * m * l * ds
+    return PhaseCost("sketch", flops, bytes_)
+
+
+def finish_costs(m: int, n: int, *, dtype: str = "float32",
+                 compute_u: bool = True, compute_v: bool = True,
+                 preconditioned: bool = False, refine: bool = False,
+                 lift: bool = False, work_rows: Optional[int] = None,
+                 convention: str = "algorithm") -> PhaseCost:
+    """Epilogue: sigma column norms (2*wr*n), U reconstitution from the
+    rotated work stack against the accumulated V (2*wr*n^2), the
+    optional Newton-Schulz + sigma-refinement chain (algorithm
+    convention only — on the probe entries those land as elementwise
+    ops, not dots), the Q1 recombination of a preconditioned solve
+    (2mn^2), and the tall/top-k lane's Q-basis lift (2mn^2).
+    ``work_rows`` is the row count of the swept stacks: n for the
+    QR-preconditioned kernel lanes (the sweep ran on the triangle), m
+    for the padded XLA lanes."""
+    ds = dtype_bytes(dtype)
+    wr = work_rows if work_rows is not None else (n if preconditioned
+                                                  else m)
+    flops = 2.0 * wr * n                      # sigma norms
+    bytes_ = 2.0 * wr * n * ds
+    if compute_u or compute_v:
+        flops += 2.0 * wr * n * n             # reconstitute
+        bytes_ += 2.0 * wr * n * ds
+        if refine and convention != "xla":
+            flops += _NS_FLOPS_PER_N3 * n ** 3 + 2.0 * wr * n * n
+            bytes_ += 2.0 * wr * n * ds
+    if preconditioned and compute_u:
+        flops += 2.0 * m * n * n              # Q1 @ U_r recombine
+        bytes_ += 2.0 * m * n * ds
+    if lift and compute_u:
+        flops += 2.0 * m * n * n              # Q @ U_small
+        bytes_ += 2.0 * m * n * ds
+    return PhaseCost("finish", flops, bytes_)
+
+
+def solve_costs(m: int, n: int, *, block_size: Optional[int] = None,
+                dtype: str = "float32", pair_solver: str = "pallas",
+                sweeps: float = 8.0, bulk_sweeps: float = 0.0,
+                compute_u: bool = True, compute_v: bool = True,
+                mixed_store: bool = False, top_k: Optional[int] = None,
+                oversample: int = 8, power_iters: int = 0,
+                convention: str = "algorithm") -> Dict[str, PhaseCost]:
+    """Full-solve cost by phase, the attribution join table.
+
+    The sweep phases run on the n x n preconditioned work triangle (the
+    kernel lanes QR-precondition every input; a square input's QR is the
+    identity-cost case m == n). ``bulk_sweeps`` of the total ``sweeps``
+    run in the bulk regime (block_rotation or mixed bf16 Gram), the rest
+    in the polish kernel. ``top_k`` switches the sweep work onto the
+    (k + oversample)-wide sketch projection of the top-k lane.
+    """
+    accumulate_v = compute_u or compute_v
+    out: Dict[str, PhaseCost] = {}
+    tall = (top_k is None) and m >= 8 * n
+
+    if top_k is not None:
+        l = min(n, top_k + oversample)
+        out["sketch"] = sketch_costs(m, n, l, power_iters=power_iters,
+                                     dtype=dtype, convention=convention)
+        sweep_m, sweep_n = l, l
+        out["precondition"] = precondition_costs(
+            m, l, dtype=dtype, form_q=True, convention=convention)
+    else:
+        sweep_m, sweep_n = n, n
+        out["precondition"] = precondition_costs(
+            m, n, dtype=dtype, form_q=compute_u,
+            tall_chunks=max(1, m // (8 * n)) if tall else 1,
+            convention=convention)
+
+    def _acc(phases: Dict[str, PhaseCost]) -> None:
+        for name, c in phases.items():
+            prev = out.get(name)
+            out[name] = PhaseCost(
+                name, c.flops + (prev.flops if prev else 0.0),
+                c.hbm_bytes + (prev.hbm_bytes if prev else 0.0))
+
+    polish_sweeps = max(0.0, sweeps - bulk_sweeps)
+    if bulk_sweeps > 0:
+        bulk_solver = ("block_rotation" if pair_solver == "block_rotation"
+                       else pair_solver)
+        _acc(sweep_costs(sweep_m, sweep_n, block_size=block_size,
+                         dtype=dtype, pair_solver=bulk_solver,
+                         accumulate_v=accumulate_v, sweeps=bulk_sweeps,
+                         gram_dtype="bfloat16" if mixed_store else None,
+                         convention=convention))
+    if polish_sweeps > 0 or bulk_sweeps == 0:
+        _acc(sweep_costs(sweep_m, sweep_n, block_size=block_size,
+                         dtype=dtype,
+                         pair_solver="pallas" if pair_solver in
+                         ("pallas", "block_rotation") else pair_solver,
+                         accumulate_v=accumulate_v,
+                         sweeps=max(polish_sweeps, 1.0),
+                         convention=convention))
+
+    out["finish"] = finish_costs(
+        m if top_k is None else m, sweep_n if top_k is None else l,
+        dtype=dtype, compute_u=compute_u, compute_v=compute_v,
+        preconditioned=True, refine=compute_u or compute_v,
+        lift=tall or top_k is not None, convention=convention)
+    return out
+
+
+def total_cost(phases: Dict[str, PhaseCost]) -> PhaseCost:
+    return PhaseCost("total", sum(c.flops for c in phases.values()),
+                     sum(c.hbm_bytes for c in phases.values()))
+
+
+# --------------------------------------------------------------------------
+# Per-registry-entry composition (the PERF001 contract surface).
+# --------------------------------------------------------------------------
+
+def entry_flops(kind: str, m: int, n: int, *, block_size: int,
+                dtype: str = "float32", batch: int = 1,
+                sketch_width: int = 0, power_iters: int = 0,
+                chunk: Optional[int] = None,
+                convention: str = "xla") -> float:
+    """Model FLOPs of one fused registry entry, by probe kind.
+
+    ``kind`` matches `analysis.entries` probe names ("pallas",
+    "pallas_mixed", "padded_hybrid", ...). The default "xla" convention
+    is what PERF001 compares against `compiled.cost_analysis()`:
+    while/scan bodies once, custom calls ~zero. A second program stage
+    (mixed bulk + polish, hybrid bulk + polish, block bulk + kernel
+    polish) contributes its own counted-once loop body.
+    """
+    kw = dict(block_size=block_size, dtype=dtype, convention=convention)
+
+    def stage(pair_solver, *, gram_dtype=None, mm=n, accumulate_v=True):
+        return sum(c.flops for c in sweep_costs(
+            mm, n, pair_solver=pair_solver, gram_dtype=gram_dtype,
+            accumulate_v=accumulate_v, **kw).values())
+
+    def fin(**over):
+        fkw = dict(m=m, n=n, dtype=dtype, preconditioned=True,
+                   convention=convention)
+        fkw.update(over)
+        return finish_costs(**fkw).flops
+
+    pre = precondition_costs(m, n, dtype=dtype, form_q=True,
+                             convention=convention).flops
+
+    if kind in ("pallas", "pallas_donated"):
+        per = pre + stage("pallas") + fin()
+    elif kind == "pallas_mixed":
+        # Two sweep loops in one program: bf16 bulk + f32 polish. The
+        # bulk loop's applies land on BOTH the bf16 shadow stacks and
+        # the f32 masters (the mixed_store contract: angles from bf16
+        # Gram panels, applies at store precision) — one extra apply
+        # term — and the bf16->f32 reconstitution + NS/refine chain
+        # between the loops is ~5 n^3-class matmuls (measured on the
+        # probe HLO: 6 n^3 dots vs the plain entry's 1).
+        per = (pre + stage("pallas", gram_dtype="bfloat16")
+               + stage("pallas")
+               + sweep_costs(n, n, pair_solver="pallas",
+                             **kw)["sweep.apply"].flops
+               + _MIXED_RECONSTITUTE_N3 * float(n) ** 3 + fin())
+    elif kind == "pallas_batched":
+        per = pre + stage("pallas") + fin()
+    elif kind == "pallas_block_rotation":
+        per = pre + stage("block_rotation") + stage("pallas") + fin()
+    elif kind == "padded_hybrid":
+        # Padded XLA lane: no QR precondition — sweeps run on the full
+        # m-row stacks; bulk gram-eigh loop + polish qr-svd loop.
+        per = (stage("gram-eigh", mm=m) + stage("qr-svd", mm=m)
+               + fin(preconditioned=False))
+    elif kind in ("padded_novec", "padded_f64_qr"):
+        solver = "gram-eigh" if kind == "padded_novec" else "qr-svd"
+        vec = kind != "padded_novec"
+        per = (stage(solver, mm=m, accumulate_v=vec)
+               + fin(preconditioned=False, compute_u=vec, compute_v=vec))
+    elif kind == "sketch_project":
+        per = sketch_costs(m, n, sketch_width, power_iters=power_iters,
+                           dtype=dtype, chunk=chunk,
+                           convention=convention).flops
+    elif kind == "tsqr_tall":
+        per = (precondition_costs(m, n, dtype=dtype, form_q=True,
+                                  convention=convention).flops
+               + tsqr_fixup_flops(m, n, chunk or m))
+    else:
+        raise ValueError(f"unknown entry kind {kind!r}")
+    return per * batch
+
+
+# --------------------------------------------------------------------------
+# Roofline.
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Roofline:
+    """One phase's position under the device roofline. ``attainable`` is
+    min(peak, AI * bw) in FLOP/s; ``frac_of_roof`` the achieved fraction
+    of that ceiling; ``bound`` which ceiling binds ("compute" |
+    "bandwidth"); ``estimated`` whether either device constant came from
+    a fallback estimate rather than the table."""
+
+    phase: str
+    seconds: float
+    flops: float
+    hbm_bytes: float
+    intensity: float
+    achieved_flops: float          # FLOP/s
+    achieved_bytes: float          # byte/s
+    attainable: float
+    frac_of_roof: float
+    bound: str
+    estimated: bool
+
+    def as_record(self) -> Dict[str, object]:
+        d = dataclasses.asdict(self)
+        d["gflops"] = self.achieved_flops / 1e9
+        d["gbytes"] = self.achieved_bytes / 1e9
+        return d
+
+
+def roofline(phase: str, seconds: float, cost: PhaseCost, *,
+             peak_flops: float, hbm_bw: float,
+             estimated: bool = False) -> Roofline:
+    """Place one measured phase duration under the roofline built from
+    ``peak_flops`` (FLOP/s) and ``hbm_bw`` (byte/s)."""
+    if seconds <= 0:
+        raise ValueError(f"non-positive duration for {phase}: {seconds}")
+    ai = cost.intensity
+    ridge = peak_flops / hbm_bw
+    if cost.flops <= 0:
+        # Pure-movement phase: the ceiling is bandwidth itself.
+        achieved_b = cost.hbm_bytes / seconds
+        return Roofline(phase, seconds, 0.0, cost.hbm_bytes, 0.0, 0.0,
+                        achieved_b, hbm_bw,
+                        min(1.0, achieved_b / hbm_bw) if hbm_bw else 0.0,
+                        "bandwidth", estimated)
+    attainable = min(peak_flops, ai * hbm_bw) if ai > 0 else peak_flops
+    achieved = cost.flops / seconds
+    return Roofline(
+        phase, seconds, cost.flops, cost.hbm_bytes, ai, achieved,
+        cost.hbm_bytes / seconds, attainable,
+        achieved / attainable if attainable else 0.0,
+        "compute" if ai >= ridge else "bandwidth", estimated)

@@ -176,6 +176,29 @@ _CACHE_SCHEMA: Dict[str, Any] = {
     "digest": (str, type(None)),  # SHA-256 input digest (result store)
     "bytes": (int, type(None)),   # entry size (store/retain/evict)
 }
+# Roofline attribution reports ("perf", written by `python -m
+# svd_jacobi_tpu.perf`, `cli --profile`, and the serve capture path): one
+# record per measured window — per-scope device time joined with the
+# analytic cost model (obs.costmodel) into achieved GFLOP/s, GB/s,
+# %-of-roofline and a compute/bandwidth bound classification — plus the
+# per-sweep convergence telemetry of the window when the host-stepped
+# loop recorded one. The SAME row shape is produced live (after a
+# --profile solve) and offline (perf report over a checked-in trace), so
+# the offline-equals-live contract is testable record-for-record.
+# ``device`` carries the roofline constants WITH their provenance
+# (peak_flops_source/hbm_bw_source: "table" | "peak_est" | "bw_est") so
+# a % -of-roof number can never silently rest on an estimate.
+_PERF_SCHEMA: Dict[str, Any] = {
+    "source": str,                # "trace" | "live" | "convergence"
+    "workload": dict,             # {"m", "n", "dtype", model params...}
+    "device": dict,               # peak_flops/hbm_bw + *_source provenance
+    "scopes": list,               # attribution rows (_PERF_SCOPE_FIELDS)
+    "unscoped_s": _NUM,           # HLO time with no svdj scope
+    "unattributed_s": _NUM,       # non-HLO (host/python) trace time
+    "convergence": (dict, type(None)),  # per-sweep series, or None
+}
+_PERF_SCOPE_FIELDS = {"scope": str, "phase": str, "seconds": _NUM,
+                      "events": int}
 # Back-compat name: the solve-record schema as one flat dict.
 SCHEMA: Dict[str, Any] = {**_BASE_SCHEMA, **_SOLVE_SCHEMA}
 
@@ -184,6 +207,15 @@ _SOLVE_REQUIRED = {"time_s": _NUM, "sweeps": int, "off_norm": _NUM}
 _EVENT_REQUIRED = {"event": str}
 _PASS_FIELDS = {"name": str, "ok": bool, "findings": list, "time_s": _NUM}
 _ATTEMPT_FIELDS = {"rung": str, "status": str, "time_s": _NUM}
+
+
+def offline_environment() -> dict:
+    """Environment block for READ-SIDE record builders (perf report over
+    a checked-in trace on a machine without jax): schema-valid, loudly
+    marked offline rather than pretending a runtime was attached."""
+    return {"jax": "offline", "jaxlib": "offline", "backend": "offline",
+            "device_kind": "offline", "device_count": 0,
+            "process_count": 0}
 
 
 def environment() -> dict:
@@ -482,6 +514,46 @@ def build_router(*, event: str, replica: Optional[int] = None,
     return record
 
 
+def build_perf(*, source: str, workload: dict, device: dict,
+               scopes: List[dict], unscoped_s: float = 0.0,
+               unattributed_s: float = 0.0,
+               convergence: Optional[dict] = None, **extra) -> dict:
+    """Assemble a schema-valid roofline attribution record ("perf").
+
+    ``source``: "trace" (offline, from an .xplane.pb), "live" (emitted
+    right after a profiled solve), or "convergence" (telemetry-only — no
+    trace, scopes empty). ``workload``: the cost-model parameters the
+    rows were computed under ({"m", "n", "dtype", "block_size",
+    "sweeps", ...}). ``device``: roofline constants with provenance
+    ({"device_kind", "peak_flops", "peak_flops_source", "hbm_bw",
+    "hbm_bw_source"}). ``scopes``: `obs.attribution.attribute` rows.
+    ``convergence``: per-sweep series ({"off_rel": [...], "stages":
+    [...], "sweeps_to_tol", "rotations_skipped_frac", "spectrum"}).
+    Builds without jax installed (read-side contract): the environment
+    block degrades to `offline_environment`.
+    """
+    try:
+        env = environment()
+    except ImportError:
+        env = offline_environment()
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "perf",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": env,
+        "source": str(source),
+        "workload": dict(workload),
+        "device": dict(device),
+        "scopes": [dict(s) for s in scopes],
+        "unscoped_s": float(unscoped_s),
+        "unattributed_s": float(unattributed_s),
+        "convergence": None if convergence is None else dict(convergence),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def _check(cond: bool, errors: List[str], msg: str) -> None:
     if not cond:
         errors.append(msg)
@@ -547,6 +619,13 @@ def _validate_cache(record: dict, errors: List[str]) -> None:
 
 def _validate_router(record: dict, errors: List[str]) -> None:
     _check_fields(record, _ROUTER_SCHEMA, "record", errors)
+
+
+def _validate_perf(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _PERF_SCHEMA, "record", errors)
+    for i, s in enumerate(record.get("scopes") or []):
+        _check_fields(s, _PERF_SCOPE_FIELDS, f"record.scopes[{i}]",
+                      errors)
 
 
 def _validate_coldstart(record: dict, errors: List[str]) -> None:
@@ -895,6 +974,45 @@ def _summarize_serve(record: dict) -> str:
     return line
 
 
+def _summarize_perf(record: dict) -> str:
+    wl = record.get("workload", {})
+    dev = record.get("device", {})
+    lines = [
+        f"perf [{record.get('source', '?')}] @ "
+        f"{record.get('timestamp', '?')}  "
+        f"{wl.get('m')}x{wl.get('n')} {wl.get('dtype')}  "
+        f"device={dev.get('device_kind', '?')} "
+        f"(peak={dev.get('peak_flops_source', '?')}, "
+        f"bw={dev.get('hbm_bw_source', '?')})",
+    ]
+    scopes = sorted(record.get("scopes") or [],
+                    key=lambda s: -(s.get("seconds") or 0.0))
+    for s in scopes:
+        line = (f"  {s.get('scope', '?'):<16} "
+                f"{(s.get('seconds') or 0.0) * 1e3:9.2f} ms  "
+                f"[{s.get('phase', '?')}]")
+        if s.get("gflops") is not None:
+            line += f"  {s['gflops']:9.2f} GFLOP/s"
+        if s.get("frac_of_roof") is not None:
+            line += (f"  {s['frac_of_roof'] * 100.0:5.1f}% of roof "
+                     f"({s.get('bound', '?')}-bound)")
+        lines.append(line)
+    lines.append(f"  unscoped {record.get('unscoped_s', 0.0) * 1e3:.2f} ms"
+                 f"  unattributed "
+                 f"{record.get('unattributed_s', 0.0) * 1e3:.2f} ms")
+    conv = record.get("convergence")
+    if conv:
+        curve = conv.get("off_rel") or []
+        tail = (f" off_rel {curve[0]:.2e} -> {curve[-1]:.2e}"
+                if curve else "")
+        skipped = conv.get("rotations_skipped_frac")
+        lines.append(f"  convergence: {len(curve)} sweep(s)"
+                     f" [{conv.get('spectrum', '?')}]" + tail
+                     + (f"  skipped={skipped:.1%}"
+                        if isinstance(skipped, float) else ""))
+    return "\n".join(lines)
+
+
 def _summarize_solve(record: dict) -> str:
     dim = record.get("dimension", {})
     env = record.get("environment", {})
@@ -997,6 +1115,7 @@ for _name, _builder, _validator, _summarizer in (
         ("cache", build_cache, _validate_cache, _summarize_cache),
         ("coldstart", build_coldstart, _validate_coldstart,
          _summarize_coldstart),
+        ("perf", build_perf, _validate_perf, _summarize_perf),
 ):
     register_kind(_name, builder=_builder, validator=_validator,
                   summarizer=_summarizer)

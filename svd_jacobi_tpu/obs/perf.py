@@ -1,0 +1,540 @@
+"""Perf observatory: roofline reports, the analytic model table, and
+bench regression gating — the `python -m svd_jacobi_tpu.perf` entry.
+
+Three subcommands:
+
+  * ``report`` — join a `jax.profiler` capture (an ``.xplane.pb[.gz]``
+    file or the log_dir holding one — PR 11 `XprofWindow` output and
+    plain ``--profile`` traces both qualify) with the analytic cost
+    model (obs.costmodel) into a per-scope roofline table, and
+    optionally append the schema-versioned "perf" manifest record.
+    Workload parameters come from a manifest record (a prior "perf"
+    record, or any cli/bench solve record's dimension/dtype block) with
+    CLI flags overriding.
+  * ``model`` — print the analytic phase table (FLOPs, HBM bytes,
+    arithmetic intensity, roofline ceiling) for a workload with no
+    trace at all: the planning view.
+  * ``check`` — load the BENCH_*.json history, fit a per-metric noise
+    band from repeated rows, and exit non-zero when the candidate row
+    regresses beyond it. `bench.py --check-against` runs the same gate
+    in-process so a bench run can append and gate in one go.
+
+Stdlib-only BY CONTRACT (the `registry_from_manifest` discipline): the
+offline read side — `perf report` on a checked-in CPU trace + manifest,
+`perf check` on the BENCH history — must run on a machine with no jax.
+`scripts/telemetry_summary.py` loads this file by path, beside
+costmodel.py / attribution.py / manifest.py under their bare names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+try:
+    from . import attribution, costmodel, manifest
+except ImportError:                                   # file-path load
+    import attribution  # type: ignore
+    import costmodel  # type: ignore
+    import manifest  # type: ignore
+
+
+def load_scope_phases() -> Dict[str, str]:
+    """`config.SCOPE_PHASES` through whichever door is open: the package
+    (live), a sibling bare module (telemetry_summary's loader), or a
+    direct file-path load of config.py (stdlib at module level) relative
+    to this file — the fully offline case."""
+    try:
+        from ..config import SCOPE_PHASES
+        return dict(SCOPE_PHASES)
+    except ImportError:
+        pass
+    try:
+        from config import SCOPE_PHASES  # type: ignore
+        return dict(SCOPE_PHASES)
+    except ImportError:
+        pass
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "config.py")
+    spec = importlib.util.spec_from_file_location("_svdj_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    assert spec.loader is not None
+    # Registered BEFORE exec: config.py defines dataclasses, and the
+    # dataclass machinery resolves field types through
+    # sys.modules[cls.__module__].
+    sys.modules.setdefault("_svdj_config", mod)
+    spec.loader.exec_module(mod)
+    return dict(mod.SCOPE_PHASES)
+
+
+# --------------------------------------------------------------------------
+# Workload / device blocks.
+# --------------------------------------------------------------------------
+
+def device_block(device_kind: str, *, peak_flops: Optional[float] = None,
+                 hbm_bw: Optional[float] = None) -> dict:
+    """The "perf" record's device block: roofline constants WITH
+    provenance ("table" for a tabulated kind, "peak_est"/"bw_est" for
+    the fallback estimate) so a roofline percentage can never silently
+    rest on the CPU stand-in."""
+    if peak_flops is None:
+        peak, peak_est = costmodel.peak_flops(device_kind)
+    else:
+        peak, peak_est = float(peak_flops), False
+    if hbm_bw is None:
+        bw, bw_est = costmodel.hbm_bandwidth(device_kind)
+    else:
+        bw, bw_est = float(hbm_bw), False
+    return {
+        "device_kind": costmodel.normalize_device_kind(device_kind),
+        "peak_flops": peak,
+        "peak_flops_source": "peak_est" if peak_est else "table",
+        "hbm_bw": bw,
+        "hbm_bw_source": "bw_est" if bw_est else "table",
+    }
+
+
+def workload_from_record(record: dict) -> Optional[dict]:
+    """Extract cost-model parameters from a manifest record: a "perf"
+    record carries them verbatim; a cli/bench solve record yields them
+    from its dimension/dtype/solve blocks. None if the record has
+    neither shape."""
+    if record.get("kind") == "perf" and isinstance(record.get("workload"),
+                                                   dict):
+        return dict(record["workload"])
+    dim = record.get("dimension")
+    if not isinstance(dim, dict) or "n" not in dim:
+        return None
+    solve = record.get("solve") or {}
+    wl = {
+        "m": int(dim.get("m", dim["n"])),
+        "n": int(dim["n"]),
+        "dtype": str(record.get("dtype") or "float32"),
+    }
+    if isinstance(solve.get("sweeps"), (int, float)):
+        wl["sweeps"] = float(solve["sweeps"])
+    return wl
+
+
+def last_workload(manifest_path: str) -> Tuple[Optional[dict],
+                                               Optional[str]]:
+    """(workload, device_kind) from the LAST usable record of a manifest
+    JSONL — latest wins, like `registry_from_manifest`."""
+    wl = kind = None
+    for rec in manifest.load(manifest_path):
+        got = workload_from_record(rec)
+        if got is not None:
+            wl = got
+            if rec.get("kind") == "perf":
+                kind = (rec.get("device") or {}).get("device_kind")
+            else:
+                kind = (rec.get("environment") or {}).get("device_kind")
+    return wl, kind
+
+
+def phase_costs_for(workload: dict, *,
+                    convention: str = "algorithm") -> Dict[str, object]:
+    """The attribution join table for one workload dict (keys: m, n,
+    and optionally dtype/block_size/pair_solver/sweeps/bulk_sweeps/
+    compute_u/compute_v/mixed_store/top_k/oversample/power_iters)."""
+    m, n = int(workload["m"]), int(workload["n"])
+    kw = dict(convention=convention)
+    for key in ("dtype", "pair_solver", "mixed_store", "oversample",
+                "power_iters"):
+        if workload.get(key) is not None:
+            kw[key] = workload[key]
+    for key in ("sweeps", "bulk_sweeps"):
+        if workload.get(key) is not None:
+            kw[key] = float(workload[key])
+    for key in ("compute_u", "compute_v"):
+        if workload.get(key) is not None:
+            kw[key] = bool(workload[key])
+    if workload.get("top_k") is not None:
+        kw["top_k"] = int(workload["top_k"])
+    kw["block_size"] = int(workload.get("block_size")
+                           or costmodel.default_block_size(n))
+    return costmodel.solve_costs(m, n, **kw)
+
+
+def build_report(trace: str, workload: dict, device: dict, *,
+                 convergence: Optional[dict] = None,
+                 source: str = "trace") -> dict:
+    """Parse a trace, join it with the cost model, and return the
+    validated "perf" manifest record — the ONE code path behind both
+    the live (``cli.py --profile``) and offline (``perf report``)
+    tables, so offline-equals-live is true by construction."""
+    attr = attribution.scope_durations(trace)
+    rows = attribution.attribute(
+        attr, phase_costs_for(workload),
+        scope_phases=load_scope_phases(),
+        peak_flops=device["peak_flops"], hbm_bw=device["hbm_bw"],
+        estimated=(device.get("peak_flops_source") != "table"
+                   or device.get("hbm_bw_source") != "table"))
+    return manifest.build_perf(
+        source=source, workload=dict(workload), device=dict(device),
+        scopes=rows, unscoped_s=attr.unscoped_s,
+        unattributed_s=attr.unattributed_s, convergence=convergence,
+        trace=os.path.basename(attr.trace_path))
+
+
+def render_report(record: dict) -> str:
+    wl, dev = record.get("workload", {}), record.get("device", {})
+    title = (f"per-scope roofline — {wl.get('m')}x{wl.get('n')} "
+             f"{wl.get('dtype', 'float32')} on "
+             f"{dev.get('device_kind', '?')} "
+             f"(peak {dev.get('peak_flops', 0) / 1e9:.0f} GFLOP/s "
+             f"[{dev.get('peak_flops_source', '?')}], bw "
+             f"{dev.get('hbm_bw', 0) / 1e9:.0f} GB/s "
+             f"[{dev.get('hbm_bw_source', '?')}])")
+    out = attribution.render_table(
+        record.get("scopes") or [],
+        unscoped_s=record.get("unscoped_s", 0.0),
+        unattributed_s=record.get("unattributed_s", 0.0), title=title)
+    conv = record.get("convergence")
+    if conv:
+        curve = conv.get("off_rel") or []
+        line = f"convergence [{conv.get('spectrum', '?')}]: "
+        line += f"{len(curve)} sweep(s)"
+        if curve:
+            line += f", off_rel {curve[0]:.3e} -> {curve[-1]:.3e}"
+        if conv.get("sweeps_to_tol") is not None:
+            line += f", sweeps_to_tol={conv['sweeps_to_tol']}"
+        if conv.get("rotations_skipped_frac") is not None:
+            line += (f", rotations skipped "
+                     f"{conv['rotations_skipped_frac']:.1%}")
+        out += "\n" + line
+    return out
+
+
+# --------------------------------------------------------------------------
+# Per-sweep convergence telemetry (tentpole part 3).
+# --------------------------------------------------------------------------
+
+class ConvergenceRecorder:
+    """Per-sweep convergence series with ZERO extra device readback: it
+    is fed the `off_rel` scalar the host-stepped sweep loop ALREADY
+    pulls for its stopping decision (`SweepStepper.should_continue`),
+    plus the rotations-skipped counts the fused path already emits as
+    telemetry events. ``spectrum`` labels the series so "sweeps-to-tol
+    per spectrum class" is a tracked series across runs."""
+
+    def __init__(self, spectrum: str = "default") -> None:
+        self.spectrum = spectrum
+        self.off_rel: List[float] = []
+        self.stages: List[str] = []
+        self.rounds_rotated = 0
+        self.rounds_total = 0
+
+    def record(self, off_rel: float, stage: str = "bulk") -> None:
+        self.off_rel.append(float(off_rel))
+        self.stages.append(str(stage))
+
+    def record_rounds(self, rotated: int, total: int) -> None:
+        self.rounds_rotated += int(rotated)
+        self.rounds_total += int(total)
+
+    def sweeps_to_tol(self, tol: float) -> Optional[int]:
+        """1-based index of the first sweep at or under ``tol`` (None:
+        the curve never got there)."""
+        for i, v in enumerate(self.off_rel):
+            if v <= tol:
+                return i + 1
+        return None
+
+    def block(self, *, tol: Optional[float] = None) -> Optional[dict]:
+        """The "perf" record's convergence block (None if no sweeps were
+        recorded — a fast path that never entered the host loop)."""
+        if not self.off_rel:
+            return None
+        skipped = None
+        if self.rounds_total > 0:
+            skipped = 1.0 - self.rounds_rotated / self.rounds_total
+        return {
+            "spectrum": self.spectrum,
+            "off_rel": list(self.off_rel),
+            "stages": list(self.stages),
+            "sweeps": len(self.off_rel),
+            "tol": tol,
+            "sweeps_to_tol": (self.sweeps_to_tol(tol)
+                              if tol is not None else None),
+            "rotations_skipped_frac": skipped,
+        }
+
+
+# --------------------------------------------------------------------------
+# Bench regression gating (`perf check`).
+# --------------------------------------------------------------------------
+
+# A consecutive pair of history values this close counts as a REPEAT of
+# the same configuration (noise), not an improvement step; the band is
+# fit from repeats only, so a real 7x jump (r02 -> r03) never inflates it.
+_REPEAT_REL = 0.20
+# Band = max(_BAND_WIDEN x median repeat gap, _BAND_FLOOR), falling back
+# to _BAND_DEFAULT when the history holds no repeated pair yet.
+_BAND_WIDEN = 3.0
+_BAND_FLOOR = 0.02
+_BAND_DEFAULT = 0.05
+
+
+def fit_noise_band(values: List[float], *,
+                   repeat_rel: float = _REPEAT_REL) -> float:
+    """Relative regression band for one metric, fit from its history:
+    the median relative gap among consecutive repeated measurements,
+    widened x3 and floored at 2% (default 5% when the history has no
+    repeats to learn from)."""
+    gaps = []
+    for a, b in zip(values, values[1:]):
+        if a > 0 and b > 0:
+            rel = abs(b - a) / max(a, b)
+            if rel <= repeat_rel:
+                gaps.append(rel)
+    if not gaps:
+        return _BAND_DEFAULT
+    return max(_BAND_WIDEN * statistics.median(gaps), _BAND_FLOOR)
+
+
+def _bench_rows(path: str) -> List[dict]:
+    """BENCH_*.json holds one round dict today; tolerate a list of them
+    (a future consolidated history file) by flattening."""
+    with open(path) as f:
+        data = json.load(f)
+    return data if isinstance(data, list) else [data]
+
+
+def _metric_value(row: dict) -> Tuple[Optional[str], Optional[float]]:
+    parsed = row.get("parsed") or {}
+    metric = parsed.get("metric")
+    value = parsed.get("value")
+    return (metric, float(value) if isinstance(value, (int, float))
+            else None)
+
+
+def _lower_is_better(metric: str) -> bool:
+    return metric.endswith(("_time_s", "_seconds", "_s", "_err",
+                            "_error", "_sweeps"))
+
+
+def check_rows(candidate: dict, history: List[dict]) -> Tuple[bool,
+                                                              List[str]]:
+    """Gate one candidate bench row against its history. Returns
+    (ok, report lines). Fails when the candidate's metric regresses
+    beyond the fitted noise band from the best prior value — or when
+    the candidate carries no measurement at all (an errored round can
+    not demonstrate the absence of a regression)."""
+    metric, value = _metric_value(candidate)
+    lines: List[str] = []
+    if metric is None:
+        return False, ["candidate row has no parsed.metric — not a "
+                       "bench row?"]
+    prior = []
+    for row in history:
+        h_metric, h_value = _metric_value(row)
+        if h_metric == metric and h_value is not None:
+            prior.append(h_value)
+    if value is None:
+        err = (candidate.get("parsed") or {}).get("error")
+        return False, [f"FAIL {metric}: candidate has no measurement"
+                       + (f" (error: {err})" if err else "")]
+    if not prior:
+        return True, [f"pass {metric}: {value:.4g} (no history yet — "
+                      f"nothing to regress from)"]
+    band = fit_noise_band(prior)
+    lower = _lower_is_better(metric)
+    best = min(prior) if lower else max(prior)
+    if lower:
+        limit = best * (1.0 + band)
+        regressed = value > limit
+        head = f"{metric}: {value:.4g} vs best prior {best:.4g}"
+    else:
+        limit = best * (1.0 - band)
+        regressed = value < limit
+        head = f"{metric}: {value:.4g} vs best prior {best:.4g}"
+    detail = (f"noise band {band:.1%} from {len(prior)} prior row(s) "
+              f"-> limit {limit:.4g}")
+    if regressed:
+        lines.append(f"FAIL {head} — beyond the {detail}")
+        return False, lines
+    lines.append(f"pass {head} ({detail})")
+    return True, lines
+
+
+def check_files(against: str, *, row: Optional[str] = None,
+                history: Optional[List[str]] = None) -> Tuple[bool,
+                                                              List[str]]:
+    """File-level `perf check`. ``against`` names the round being gated
+    (or, with ``row``, the last known-good round the new row extends).
+    History defaults to every BENCH_*.json beside ``against``; rounds at
+    or after the candidate (and the candidate's own file) are excluded
+    so the gate never checks a round against its own future."""
+    cand_path = row or against
+    cand = _bench_rows(cand_path)[-1]
+    if history:
+        paths = list(history)
+    else:
+        paths = sorted(_glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(against)) or ".",
+            "BENCH_*.json")))
+    cand_n = cand.get("n")
+    rows: List[dict] = []
+    for p in paths:
+        if os.path.abspath(p) == os.path.abspath(cand_path):
+            continue
+        for r in _bench_rows(p):
+            if (row is None and isinstance(cand_n, int)
+                    and isinstance(r.get("n"), int)
+                    and r["n"] >= cand_n):
+                continue
+            rows.append(r)
+    rows.sort(key=lambda r: (r.get("n") is None, r.get("n")))
+    ok, lines = check_rows(cand, rows)
+    lines.insert(0, f"perf check: {os.path.basename(cand_path)} against "
+                    f"{len(rows)} prior row(s)")
+    return ok, lines
+
+
+# --------------------------------------------------------------------------
+# CLI.
+# --------------------------------------------------------------------------
+
+def _add_workload_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--m", type=int, help="work rows")
+    p.add_argument("--n", type=int, help="work cols")
+    p.add_argument("--dtype", help="float32/float64/bfloat16")
+    p.add_argument("--block-size", type=int, help="tournament block "
+                   "width b (default: the n/8 ladder)")
+    p.add_argument("--sweeps", type=float, help="total sweeps executed")
+    p.add_argument("--bulk-sweeps", type=float,
+                   help="sweeps run in the bulk regime")
+    p.add_argument("--pair-solver",
+                   help="pallas | block_rotation | gram-eigh | qr-svd")
+    p.add_argument("--mixed-store", action="store_true", default=None)
+    p.add_argument("--top-k", type=int, help="top-k sketch lane rank")
+    p.add_argument("--device-kind", help="roofline device kind "
+                   "(default: from the manifest, else cpu)")
+
+
+def _workload_from_args(args, base: Optional[dict]) -> dict:
+    wl = dict(base or {})
+    for key in ("m", "n", "dtype", "block_size", "sweeps", "bulk_sweeps",
+                "pair_solver", "mixed_store", "top_k"):
+        v = getattr(args, key)
+        if v is not None:
+            wl[key] = v
+    if "m" not in wl and "n" in wl:
+        wl["m"] = wl["n"]
+    if "m" not in wl or "n" not in wl:
+        raise SystemExit("no workload: pass --manifest with a usable "
+                         "record, or --m/--n explicitly")
+    return wl
+
+
+def _cmd_report(args) -> int:
+    base = kind = None
+    if args.manifest:
+        base, kind = last_workload(args.manifest)
+        if base is None:
+            print(f"warning: no usable workload record in "
+                  f"{args.manifest}", file=sys.stderr)
+    workload = _workload_from_args(args, base)
+    device = device_block(args.device_kind or kind or "cpu")
+    record = build_report(args.trace, workload, device)
+    if args.json:
+        print(json.dumps(record, indent=2))
+    else:
+        print(render_report(record))
+    if args.emit:
+        manifest.append(args.emit, record)
+        print(f"\nappended perf record to {args.emit}", file=sys.stderr)
+    return 0
+
+
+def _cmd_model(args) -> int:
+    workload = _workload_from_args(args, None)
+    device = device_block(args.device_kind or "cpu")
+    phases = phase_costs_for(workload, convention=args.convention)
+    peak, bw = device["peak_flops"], device["hbm_bw"]
+    ridge = peak / bw
+    print(f"analytic model [{args.convention}] — "
+          f"{workload['m']}x{workload['n']} "
+          f"{workload.get('dtype', 'float32')} on "
+          f"{device['device_kind']} (peak {peak / 1e9:.0f} GFLOP/s "
+          f"[{device['peak_flops_source']}], bw {bw / 1e9:.0f} GB/s "
+          f"[{device['hbm_bw_source']}], ridge {ridge:.1f} FLOP/B)")
+    head = (f"{'phase':<18} {'GFLOP':>10} {'GB':>9} {'AI':>8} "
+            f"{'ceiling GFLOP/s':>16} {'bound':<9}")
+    print(head)
+    print("-" * len(head))
+    for name in costmodel.PHASES:
+        cost = phases.get(name)
+        if cost is None:
+            continue
+        ai = cost.intensity
+        ceiling = min(peak, ai * bw) if ai > 0 else bw
+        bound = ("compute" if ai >= ridge else "bandwidth")
+        print(f"{name:<18} {cost.flops / 1e9:>10.3f} "
+              f"{cost.hbm_bytes / 1e9:>9.3f} {ai:>8.2f} "
+              f"{ceiling / 1e9:>16.1f} {bound:<9}")
+    total = costmodel.total_cost(phases)
+    print("-" * len(head))
+    print(f"{'total':<18} {total.flops / 1e9:>10.3f} "
+          f"{total.hbm_bytes / 1e9:>9.3f} {total.intensity:>8.2f}")
+    return 0
+
+
+def _cmd_check(args) -> int:
+    ok, lines = check_files(args.against, row=args.row,
+                            history=args.history or None)
+    print("\n".join(lines))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m svd_jacobi_tpu.perf",
+        description="Roofline performance observatory (stdlib-only "
+                    "read side: no jax, no device).")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("report", help="per-scope roofline table from a "
+                       "profiler trace + manifest")
+    p.add_argument("--trace", required=True,
+                   help=".xplane.pb[.gz] file or a profiler log_dir")
+    p.add_argument("--manifest", help="manifest JSONL supplying the "
+                   "workload (perf or cli/bench records)")
+    _add_workload_flags(p)
+    p.add_argument("--emit", help="append the perf record to this "
+                   "manifest JSONL")
+    p.add_argument("--json", action="store_true",
+                   help="print the record instead of the table")
+    p.set_defaults(fn=_cmd_report)
+
+    p = sub.add_parser("model", help="analytic phase table, no trace")
+    _add_workload_flags(p)
+    p.add_argument("--convention", default="algorithm",
+                   choices=("algorithm", "xla"))
+    p.set_defaults(fn=_cmd_model)
+
+    p = sub.add_parser("check", help="gate a bench row against the "
+                       "BENCH_*.json history's noise band")
+    p.add_argument("--against", required=True,
+                   help="the round being gated (or with --row, the "
+                   "last known-good round)")
+    p.add_argument("--row", help="candidate row file (default: "
+                   "--against itself, gated against earlier rounds)")
+    p.add_argument("--history", nargs="*",
+                   help="explicit history files (default: BENCH_*.json "
+                   "beside --against)")
+    p.set_defaults(fn=_cmd_check)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -361,9 +361,19 @@ class SLOTracker:
             self._bucket_counts(bucket or "_rejected")["shed"] += 1
             self._window.append(0)
 
-    @staticmethod
-    def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
-        if not sorted_vals:
+    # A q-quantile estimate needs at least ceil(1/(1-q)) samples (p50 ->
+    # 2, p99 -> 100): below that the "p99" of a reservoir is just the
+    # max of 2-3 points — a misleading number. Quantiles under the
+    # minimum report None; healthz carries this table per snapshot
+    # (``quantile_min_samples``) so a null field is self-explaining.
+    QUANTILE_MIN_SAMPLES = {0.50: 2, 0.99: 100}
+
+    @classmethod
+    def _quantile(cls, sorted_vals: List[float],
+                  q: float) -> Optional[float]:
+        need = cls.QUANTILE_MIN_SAMPLES.get(q) or math.ceil(
+            1.0 / (1.0 - q))
+        if len(sorted_vals) < need:
             return None
         i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
         return sorted_vals[i]
@@ -394,6 +404,11 @@ class SLOTracker:
             "objective": self.objective,
             "window": len(window),
             "error_budget_burn": miss / (1.0 - self.objective),
+            # Why a latency_p*_s field can be null: fewer samples than
+            # the quantile supports (see QUANTILE_MIN_SAMPLES).
+            "quantile_min_samples": {
+                "p50": self.QUANTILE_MIN_SAMPLES[0.50],
+                "p99": self.QUANTILE_MIN_SAMPLES[0.99]},
             "buckets": buckets,
         }
 

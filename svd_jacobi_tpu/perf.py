@@ -1,0 +1,16 @@
+"""`python -m svd_jacobi_tpu.perf` — the roofline performance
+observatory entry point (report / model / check). The implementation
+lives in `obs.perf`, which is stdlib-only by contract; this shim exists
+so the observatory rides the same `-m` bus as `.analysis` and `.serve`.
+"""
+
+from .obs.perf import (ConvergenceRecorder, build_report, check_files,
+                       device_block, main, render_report)
+
+__all__ = ["ConvergenceRecorder", "build_report", "check_files",
+           "device_block", "main", "render_report"]
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

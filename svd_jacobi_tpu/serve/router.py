@@ -911,6 +911,9 @@ class ReplicaRouter:
             from ..obs.registry import MetricsRegistry
             self.metrics = MetricsRegistry()
             self.metrics.add_collector(self._collect_metrics)
+        # The federated /metrics + /healthz listener (start_http).
+        self._http = None
+        self._http_addr: Optional[Tuple[str, int]] = None
 
     def _replica_config(self, index: int) -> ServeConfig:
         """Replica ``index``'s ServeConfig: the template with a
@@ -957,6 +960,7 @@ class ReplicaRouter:
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
         self._accepting = False
+        self.stop_http()
         self._stop.set()
         if self._sup_thread is not None:
             self._sup_thread.join(timeout)
@@ -1407,6 +1411,9 @@ class ReplicaRouter:
             "rescues": self.total_rescues,
             "ring": self.ring.ownership(b.name for b in self.buckets),
             "stats": self.stats(),
+            "http": (None if self._http_addr is None
+                     else {"host": self._http_addr[0],
+                           "port": self._http_addr[1]}),
         }
         if probe_replicas:
             out["replica_healthz"] = {
@@ -1434,10 +1441,163 @@ class ReplicaRouter:
         return out
 
     def metrics_text(self) -> str:
+        """ONE scrape target for the whole federation: the router's own
+        registry plus every replica's exposition re-emitted with a
+        ``replica="<index>"`` label, # HELP/# TYPE dedup'd per family
+        (first writer wins). Local replicas are read in-process; spool
+        replicas are scraped over HTTP at the REAL listener their
+        heartbeat-carried healthz advertises. A replica that cannot be
+        read degrades to a comment line — the federated scrape stays
+        serviceable under the same chaos the router routes around."""
+        families: Dict[str, dict] = {}
+        comments: List[str] = []
         if self.metrics is None:
-            return ("# svdj router metrics disabled "
-                    "(RouterConfig.metrics=False)\n")
-        return self.metrics.render()
+            comments.append("# svdj router metrics disabled "
+                            "(RouterConfig.metrics=False)")
+        else:
+            self._merge_exposition(self.metrics.render(), None,
+                                   families, comments)
+        for r in self.replicas:
+            try:
+                text = self._replica_exposition(r)
+            except Exception as e:
+                comments.append(f"# svdj-router: replica {r.index} "
+                                f"metrics unavailable: {e}")
+                continue
+            self._merge_exposition(text, str(r.index), families, comments)
+        lines: List[str] = []
+        for fam in families.values():
+            lines.extend(fam["meta"])
+            lines.extend(fam["samples"])
+        lines.extend(comments)
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _replica_exposition(replica: ReplicaHandle) -> str:
+        """One replica's raw Prometheus exposition: in-process for a
+        `LocalReplica`, HTTP for anything behind a transport (the spool
+        heartbeat's healthz carries the ephemeral listener address)."""
+        if isinstance(replica, LocalReplica):
+            if replica.dead:
+                raise ReplicaUnavailable("dead (simulated process loss)")
+            return replica.service.metrics_text()
+        hz = replica.healthz() or {}
+        http = hz.get("http")
+        if not (isinstance(http, dict) and http.get("port")):
+            raise ReplicaUnavailable(
+                "no live /metrics listener advertised in healthz")
+        import urllib.request
+        url = (f"http://{http.get('host', '127.0.0.1')}"
+               f":{int(http['port'])}/metrics")
+        with urllib.request.urlopen(url, timeout=2.0) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    @staticmethod
+    def _merge_exposition(text: str, replica: Optional[str],
+                          families: Dict[str, dict],
+                          comments: List[str]) -> None:
+        """Fold one exposition into the per-family merge accumulator,
+        injecting ``replica=<label>`` into every sample that does not
+        already carry one (the router's own per-replica gauges do).
+        Histogram ``_bucket``/``_sum``/``_count`` samples group under
+        their base family so the merged exposition keeps each family's
+        lines contiguous, as the text format requires."""
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                name = line.split(" ", 3)[2]
+                fam = families.setdefault(
+                    name, {"meta": [], "samples": []})
+                if line not in fam["meta"]:
+                    fam["meta"].append(line)
+                continue
+            if line.startswith("#"):
+                comments.append(line if replica is None
+                                else f"{line}  (replica {replica})")
+                continue
+            head, _, value = line.rpartition(" ")
+            if not head:
+                continue
+            if replica is not None and 'replica="' not in head:
+                if head.endswith("}"):
+                    head = head[:-1] + f',replica="{replica}"}}'
+                else:
+                    head = f'{head}{{replica="{replica}"}}'
+            name = head.split("{", 1)[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if (name.endswith(suffix)
+                        and name[:-len(suffix)] in families):
+                    name = name[:-len(suffix)]
+                    break
+            fam = families.setdefault(name, {"meta": [], "samples": []})
+            fam["samples"].append(f"{head} {value}")
+
+    # -- federated /metrics + /healthz listener (stdlib) --------------------
+
+    @property
+    def http_address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) of the live federated listener, or None."""
+        return self._http_addr
+
+    def start_http(self, host: str = "127.0.0.1", port: int = 0
+                   ) -> Tuple[str, int]:
+        """The federation's single scrape target: GET /metrics returns
+        `metrics_text()` (every replica's exposition replica-labelled,
+        plus the router's own gauges), GET /healthz the federated
+        `healthz()` JSON (inf/nan sanitized). Same stdlib listener
+        shape as `SVDService.start_http`; idempotent; `stop()` shuts it
+        down."""
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        if self._http is not None:
+            return self._http_addr
+        rtr = self
+
+        def _json_safe(obj):
+            if isinstance(obj, dict):
+                return {str(k): _json_safe(v) for k, v in obj.items()}
+            if isinstance(obj, (list, tuple)):
+                return [_json_safe(v) for v in obj]
+            if isinstance(obj, float) and (obj != obj or obj in (
+                    float("inf"), float("-inf"))):
+                return str(obj)
+            return obj
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = rtr.metrics_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    body = _json.dumps(
+                        _json_safe(rtr.healthz())).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes must not spam stderr
+                pass
+
+        self._http = ThreadingHTTPServer((host, int(port)), Handler)
+        self._http_addr = (self._http.server_address[0],
+                           self._http.server_address[1])
+        threading.Thread(target=self._http.serve_forever,
+                         name="svdj-router-http", daemon=True).start()
+        return self._http_addr
+
+    def stop_http(self) -> None:
+        http, self._http, self._http_addr = self._http, None, None
+        if http is not None:
+            http.shutdown()
+            http.server_close()
 
     def _collect_metrics(self, reg) -> None:
         owned: Dict[int, int] = {}

@@ -441,6 +441,13 @@ class SVDService:
             self.metrics.add_collector(self._collect_metrics)
         # Armed one-request XProf windows (`capture_request_trace`).
         self._trace_arms: dict = {}
+        # Perf observatory feed: the latest per-bucket convergence block
+        # (off_rel decay, sweeps-to-tol) off the host-stepped loop's own
+        # stopping reads — zero extra device readback — surfaced under
+        # healthz()["perf"]. Roofline device constants resolve lazily
+        # (first healthz), with provenance.
+        self._last_convergence: dict = {}
+        self._perf_device: Optional[dict] = None
         self._http = None
         self._http_addr: Optional[Tuple[str, int]] = None
 
@@ -1143,8 +1150,55 @@ class SVDService:
             # SLO accounting rides the liveness probe: per-bucket
             # latency quantiles, deadline-miss/shed counts, and the
             # rolling error-budget burn (flight recorder on only).
+            # Quantiles below their documented minimum sample count
+            # read null, with snapshot["quantile_min_samples"] saying
+            # why.
             out["slo"] = self.slo.snapshot()
+        # Perf observatory view: roofline device constants (with
+        # "table" vs estimate provenance) + the latest per-bucket
+        # convergence telemetry from the host-stepped sweep loop.
+        with self._lock:
+            conv = dict(self._last_convergence)
+        out["perf"] = {"device": self._perf_device_block(),
+                       "convergence": conv}
         return out
+
+    def _perf_device_block(self) -> Optional[dict]:
+        """Roofline constants for this process's device, resolved once
+        (healthz stays poll-cheap); None until a device is reachable."""
+        if self._perf_device is None:
+            try:
+                import jax
+                kind = jax.devices()[0].device_kind
+            except Exception:
+                return None
+            from ..obs.perf import device_block
+            self._perf_device = device_block(kind)
+        return self._perf_device
+
+    def _record_convergence(self, bucket: str, st) -> None:
+        """Fold one host-stepped solve's convergence history into the
+        healthz perf feed and the `svdj_sweeps_to_tol` gauge. The
+        history is the (off_rel, stage) pairs `should_continue` already
+        read for its stopping decisions — nothing extra crossed the
+        host link for this."""
+        hist = getattr(st, "convergence_history", None)
+        if not hist:
+            return
+        from ..obs.perf import ConvergenceRecorder
+        rec = ConvergenceRecorder(spectrum=bucket)
+        for off, stage in hist:
+            rec.record(off, stage)
+        tol = float(getattr(st, "tol", 0.0)) or None
+        block = rec.block(tol=tol)
+        with self._lock:
+            self._last_convergence[bucket] = block
+        if (self.metrics is not None
+                and block.get("sweeps_to_tol") is not None):
+            self.metrics.set(
+                "svdj_sweeps_to_tol", block["sweeps_to_tol"],
+                bucket=bucket,
+                help="sweeps to requested tolerance (host-stepped loop)")
 
     def records(self) -> list:
         """The in-memory per-request "serve" records (newest last)."""
@@ -2436,6 +2490,7 @@ class SVDService:
                 if slow is not None:
                     time.sleep(slow)
                 state = st.step(state)
+            self._record_convergence(req.bucket.name, st)
             # Explicit SVDConfig(sigma_refine=True) runs the FULL finish
             # even for sigma/factor-free termination: the compensated
             # refinement needs the recombined factors, and sigma-first

@@ -22,7 +22,7 @@ from __future__ import annotations
 import enum
 import time
 from functools import partial
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -2230,6 +2230,11 @@ class SweepStepper(_SweepControlMixin):
         # "nonfinite" | "deadline" | "cancelled"); decoded into
         # SVDResult.status by finish().
         self._stop_reason = None
+        # Per-sweep (off_rel, stage) pairs, appended from the scalar
+        # should_continue ALREADY pulls for its stopping decision — the
+        # perf observatory's convergence curve at zero extra device
+        # readback (obs.perf.ConvergenceRecorder consumes it).
+        self._off_history: List[Tuple[float, str]] = []
         # Request-level cooperative control (set_control): an absolute
         # monotonic deadline and a cancellation predicate, both checked
         # BETWEEN sweeps — never mid-kernel, never via thread kills.
@@ -2353,6 +2358,13 @@ class SweepStepper(_SweepControlMixin):
         self._prev_off = float("inf")
         self._just_switched = False
 
+    @property
+    def convergence_history(self) -> List[Tuple[float, str]]:
+        """Per-sweep `(off_rel, stage)` pairs recorded by the host loop's
+        own stopping reads — the perf observatory's convergence curve
+        (off_rel decay, sweeps-to-tol) with no extra device readback."""
+        return list(self._off_history)
+
     def step(self, state: SweepState) -> SweepState:
         method, criterion, _ = self._phase()
         if self._just_switched:
@@ -2405,6 +2417,10 @@ class SweepStepper(_SweepControlMixin):
                 return False
             return True
         off = _host_scalar(state.off_rel)
+        # One history point per completed sweep, poisoned values
+        # included — a NaN in the curve is exactly what a postmortem
+        # wants to see.
+        self._off_history.append((float(off), self._stage))
         if not math.isfinite(off):
             # Fail fast on a poisoned statistic; finish() additionally
             # probes the stacks themselves (the deflation mask can hide

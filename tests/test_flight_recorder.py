@@ -120,10 +120,24 @@ class TestSLOTracker:
         b = snap["buckets"]["b32"]
         assert b["served"] == 9 and b["deadline_miss"] == 1
         assert b["shed"] == 1
-        assert b["latency_p50_s"] <= b["latency_p99_s"]
+        # 9 samples: enough for p50 (min 2), NOT for p99 (min 100) —
+        # a small-sample p99 would just be the max of the reservoir, so
+        # it reports null and healthz documents the minimum.
+        assert b["latency_p50_s"] is not None
+        assert b["latency_p99_s"] is None
+        assert snap["quantile_min_samples"] == {"p50": 2, "p99": 100}
         # 2 bad of 10 in the window, objective 0.9 -> burn = 0.2/0.1 = 2
         assert snap["error_budget_burn"] == pytest.approx(2.0)
         assert "error-budget burn" in obsreg.render_slo(snap)
+
+    def test_quantiles_populate_past_minimum(self):
+        slo = SLOTracker(objective=0.9)
+        for i in range(100):
+            slo.observe("b32", 0.001 * (i + 1), ok=True)
+        b = slo.snapshot()["buckets"]["b32"]
+        assert b["latency_p50_s"] is not None
+        assert b["latency_p99_s"] is not None
+        assert b["latency_p50_s"] <= b["latency_p99_s"]
 
     def test_slo_from_records_matches_live_counting(self):
         recs = []

@@ -267,6 +267,40 @@ class TestEphemeralMetricsPort:
             assert len(targets) == 2
             assert len({p for _, p in targets}) == 2
 
+    def test_federated_metrics_one_scrape_target(self, tmp_path):
+        """`ReplicaRouter.metrics_text()` + `start_http`: ONE scrape
+        target for the federation — every replica's exposition re-emitted
+        with a replica label, # HELP/# TYPE dedup'd per family, plus the
+        router's own gauges, all behind a single listener."""
+        import urllib.request
+        cfg = _router_cfg(tmp_path, metrics=True,
+                          serve=_serve_cfg(metrics=True))
+        with ReplicaRouter(cfg) as router:
+            host, port = router.start_http()
+            assert router.healthz()["http"]["port"] == port
+            text = urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10
+            ).read().decode()
+            # Router-side families and replica-side families coexist.
+            assert "svdj_replica_state" in text
+            assert "svdj_queue_depth" in text
+            for i in range(len(router.replicas)):
+                assert f'replica="{i}"' in text
+            # HELP/TYPE dedup'd: one header per family across N replicas.
+            for header in ("# HELP svdj_queue_depth",
+                           "# TYPE svdj_queue_depth"):
+                assert sum(1 for ln in text.splitlines()
+                           if ln.startswith(header)) == 1
+            # Family lines stay contiguous (the text format's rule).
+            fam_lines = [i for i, ln in enumerate(text.splitlines())
+                         if ln.startswith("svdj_queue_depth")]
+            assert fam_lines == list(range(fam_lines[0],
+                                           fam_lines[0] + len(fam_lines)))
+            hz = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10).read())
+            assert hz["ok"] and len(hz["replicas"]) == 2
+        assert router.http_address is None    # stop() closed the listener
+
 
 # ---------------------------------------------------------------------------
 # Federated serving.
