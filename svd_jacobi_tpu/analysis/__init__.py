@@ -51,6 +51,16 @@ properties that decide whether those artifacts stay sane:
     `jnp.linalg.svd`'s rule at the full input shape), no host callbacks
     in the forward/backward trace, and every jitted gradient entry
     (`grad.rules.jit_entries`) carries a retrace budget.
+  * `concurrency`   — graftlock (CONC001-003): the threaded serving
+    stack's lock discipline. A declared lock inventory + partial order
+    in `config.LOCK_ORDER` (router -> service/fleet -> queue/journal ->
+    cache/breaker -> obs), an AST lint for order inversions across call
+    boundaries, guarded-by races, and blocking calls under hot locks
+    (CONC001); an opt-in runtime lock-graph sanitizer whose acquisition
+    graph must stay acyclic under the chaos soaks (CONC002); and
+    condition-variable discipline — predicate-looped, bounded waits,
+    notify under the owning lock (CONC003). `# graftlock: ok(reason)`
+    pragmas, reason mandatory.
   * `aot_checks`    — the entry-registry contract (AOT001):
     `config.RETRACE_BUDGETS` and the serving entry registry
     (`serve.registry.jit_entries`) enumerate EXACTLY the same entry
@@ -60,8 +70,9 @@ properties that decide whether those artifacts stay sane:
 
 `python -m svd_jacobi_tpu.analysis` runs every pass and appends one
 schema-versioned "analysis" record to the run manifest (`obs.manifest`);
-tests/conftest.py runs the cheap passes (AST lint + jaxpr) before every
-tier-1 pytest session so contract violations fail fast.
+tests/conftest.py runs the cheap passes (AST lint + jaxpr + the static
+CONC lock-discipline rules) before every tier-1 pytest session so
+contract violations fail fast.
 """
 
 from __future__ import annotations

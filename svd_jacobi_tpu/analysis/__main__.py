@@ -21,7 +21,7 @@ import time
 from pathlib import Path
 
 PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot",
-              "obs", "route", "grad", "perf")
+              "obs", "route", "grad", "perf", "conc")
 
 
 def _parse_args(argv):
@@ -112,6 +112,16 @@ def main(argv=None) -> int:
             # exactly, and the perf-off hot path stays byte-identical.
             from . import perf_checks
             findings, report = perf_checks.run_all()
+            return findings, report
+        if name == "conc":
+            # The graftlock contract (CONC001-003): the full static
+            # lock-discipline lint (order inversions, guarded-by,
+            # blocking-under-lock, CV discipline, inventory
+            # completeness) plus a chaos soak under the CONC002
+            # instrumented locks whose acquisition graph must be
+            # acyclic.
+            from . import concurrency
+            findings, report = concurrency.run_all()
             return findings, report
         if name == "grad":
             # The differentiable-solver contract (GRAD001): grad traces

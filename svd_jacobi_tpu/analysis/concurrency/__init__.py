@@ -1,0 +1,57 @@
+"""graftlock — concurrency lock-discipline analysis for the threaded
+serving stack (the 12th graftcheck pass family).
+
+The reference parallelizes with MPI/OpenMP/CUDA; our reproduction's
+analogous concurrency surface is ~20 `threading.Lock/RLock/Condition`
+instances across `serve/` and `obs/` coordinating admission, batching,
+lane eviction/rescue, journal recovery, and federation. Their
+correctness rested on soak tests alone; graftlock makes the discipline
+a checked contract, in the lockdep/ThreadSanitizer tradition:
+
+* **CONC001** (`static_lint`, `inventory`) — the static lock-discipline
+  lint against the declared inventory and partial order in
+  `config.LOCK_ORDER` (router -> service/fleet -> queue/journal ->
+  cache/breaker -> obs): nested acquisitions that invert the order
+  (directly or across call boundaries, via the same conservative
+  name-inference style as `ast_lint`), guarded-by inference (an
+  attribute mutated under the class lock in one method and bare in
+  another is a flagged data race), blocking calls — jit dispatch,
+  `block_until_ready`, fsync, socket sends, `.result()`/`.join()` —
+  while holding a router/service/fleet-tier lock, and
+  inventory completeness (every lock construction site in the package
+  must carry a declared tier, both ways).
+* **CONC002** (`sanitizer`) — the opt-in runtime lock-graph sanitizer:
+  `sanitizer.capture()` wraps every lock constructed inside it,
+  records per-thread held-sets and acquisition-order edges into a
+  process-global graph, and `find_cycle()` reports a potential
+  deadlock with the stacks of both closing edges. Zero-cost when off:
+  outside a capture the stdlib factories are untouched and the
+  mutation counter proves it (the OBS002 discipline).
+* **CONC003** (`static_lint`) — condition-variable discipline:
+  `Condition.wait` must be predicate-looped and bounded (a timeout
+  argument, so shutdown paths cannot hang), `notify`/`notify_all`
+  under the owning lock. `serve/queue.py` is the conforming corpus.
+
+Deliberate exceptions are suppressed per line with
+`# graftlock: ok(reason)` — the reason is mandatory; an empty pragma is
+itself a finding. Seeded violation fixtures live under
+`tests/fixtures/conc_violations/` and `tests/test_concurrency.py`
+proves every rule demonstrably fires.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from . import inventory, sanitizer, static_lint  # noqa: F401
+
+
+def run_all() -> Tuple[List, dict]:
+    """The `conc` pass of `python -m svd_jacobi_tpu.analysis`: the full
+    static lint (CONC001 + CONC003 + inventory completeness) over the
+    real package, then a small chaos soak — a 2-lane service with a
+    mid-stream lane kill — under the CONC002 instrumented locks, whose
+    final acquisition graph must be acyclic."""
+    findings = static_lint.lint_package()
+    soak_findings, report = sanitizer.run_soak_probe()
+    return findings + soak_findings, report

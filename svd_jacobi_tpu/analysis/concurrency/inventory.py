@@ -1,0 +1,199 @@
+"""The lock inventory: every `threading.Lock/RLock/Condition`
+construction site in the package, resolved against the declared tiers
+in `config.LOCK_ORDER` (rule CONC001, the completeness half).
+
+The scan is pure AST — no imports of the scanned modules — so it runs
+in the conftest fail-fast hook before jax is touched. Completeness is
+checked BOTH ways, like the AOT001 two-way ledger: a construction site
+with no declared tier fails (a future lock cannot be added without
+deciding where it sits in the order), and a declared row whose site no
+longer exists fails too (the inventory cannot go stale silently).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .. import Finding
+
+_LOCK_KINDS = ("Lock", "RLock", "Condition")
+
+
+@dataclasses.dataclass(frozen=True)
+class LockSite:
+    """One `threading.<kind>()` construction site."""
+
+    rel: str          # module path relative to the package root
+    lineno: int
+    qualname: str     # "Class.attr" | module-level name | "func.local"
+    kind: str         # "Lock" | "RLock" | "Condition"
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def _lock_kind(call: ast.Call) -> Optional[str]:
+    """"Lock"/"RLock"/"Condition" when `call` constructs a threading
+    primitive (`threading.X(...)` or a bare `X(...)` from-import)."""
+    fn = call.func
+    if (isinstance(fn, ast.Attribute) and fn.attr in _LOCK_KINDS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading"):
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_KINDS:
+        return fn.id
+    return None
+
+
+def _assign_qualname(node: ast.AST, scopes: List[ast.AST]) -> str:
+    """The construction site's qualified name from its assignment
+    context: `self.X = ...` inside class C -> "C.X"; a module-level
+    `X = ...` -> "X"; a function-local `X = ...` -> "func.X"."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    cls = next((s.name for s in reversed(scopes)
+                if isinstance(s, ast.ClassDef)), None)
+    fn = next((s.name for s in reversed(scopes)
+               if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))),
+              None)
+    for t in targets:
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self" and cls is not None):
+            return f"{cls}.{t.attr}"
+        if isinstance(t, ast.Name):
+            if fn is not None:
+                return f"{fn}.{t.id}"
+            return t.id
+    # No named target (e.g. a lock passed straight into a call): fall
+    # back to the enclosing scope so the row is still declarable.
+    if fn is not None:
+        return f"{fn}.<expr>"
+    return "<module>.<expr>"
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.sites: List[LockSite] = []
+        self._scopes: List[ast.AST] = []
+        self._stmt: List[ast.stmt] = []
+
+    def _walk_body(self, node):
+        self._scopes.append(node)
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    visit_ClassDef = _walk_body
+    visit_FunctionDef = _walk_body
+    visit_AsyncFunctionDef = _walk_body
+
+    def generic_visit(self, node):
+        is_stmt = isinstance(node, ast.stmt)
+        if is_stmt:
+            self._stmt.append(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        if is_stmt:
+            self._stmt.pop()
+
+    def visit_Call(self, node: ast.Call):
+        kind = _lock_kind(node)
+        if kind is not None:
+            stmt = self._stmt[-1] if self._stmt else node
+            self.sites.append(LockSite(
+                rel=self.rel, lineno=node.lineno,
+                qualname=_assign_qualname(stmt, self._scopes), kind=kind))
+        self.generic_visit(node)
+
+
+def scan_source(source: str, rel: str) -> List[LockSite]:
+    tree = ast.parse(source, filename=rel)
+    v = _SiteVisitor(rel)
+    v.visit(tree)
+    return v.sites
+
+
+def scan_file(path, rel: str) -> List[LockSite]:
+    return scan_source(Path(path).read_text(), rel)
+
+
+def scan_package(root=None) -> List[LockSite]:
+    root = Path(root) if root is not None else package_root()
+    sites: List[LockSite] = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        sites += scan_file(path, rel)
+    return sites
+
+
+def declared_order(order=None) -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """`config.LOCK_ORDER` flattened to
+    (rel, qualname) -> (declared name, tier name)."""
+    if order is None:
+        from ... import config
+        order = config.LOCK_ORDER
+    return {(rel, qual): (name, tier)
+            for name, (rel, qual, tier) in order.items()}
+
+
+def site_names(sites=None, order=None) -> Dict[Tuple[str, int], str]:
+    """(rel, construction lineno) -> declared lock name, for the CONC002
+    sanitizer's frame-based name inference."""
+    sites = scan_package() if sites is None else sites
+    decl = declared_order(order)
+    out: Dict[Tuple[str, int], str] = {}
+    for s in sites:
+        row = decl.get((s.rel, s.qualname))
+        if row is not None:
+            out[(s.rel, s.lineno)] = row[0]
+    return out
+
+
+def check_inventory(sites=None, order=None, *,
+                    pragmas: Optional[Dict[str, Dict[int, str]]] = None
+                    ) -> List[Finding]:
+    """The two-way completeness check: every construction site declared,
+    every declaration backed by a live site. ``pragmas`` maps rel ->
+    {line: reason} (`static_lint._pragmas`) so a deliberate undeclared
+    lock can be suppressed with a justification."""
+    sites = scan_package() if sites is None else sites
+    decl = declared_order(order)
+    findings: List[Finding] = []
+    seen: set = set()
+    for s in sites:
+        key = (s.rel, s.qualname)
+        if key in decl:
+            seen.add(key)
+            continue
+        file_pragmas = (pragmas or {}).get(s.rel, {})
+        if file_pragmas.get(s.lineno) or file_pragmas.get(s.lineno - 1):
+            continue
+        findings.append(Finding(
+            code="CONC001",
+            where=f"{s.rel}:{s.lineno}",
+            message=(f"threading.{s.kind} constructed at {s.qualname!r} "
+                     f"has no declared tier in config.LOCK_ORDER — the "
+                     f"lock inventory must cover every lock in the "
+                     f"package"),
+            suggestion=("add a config.LOCK_ORDER row "
+                        f"('<name>': ({s.rel!r}, {s.qualname!r}, "
+                        f"'<tier>')) placing it in the partial order, or "
+                        "justify it per line with "
+                        "`# graftlock: ok(reason)`")))
+    for key, (name, tier) in sorted(decl.items()):
+        if key not in seen:
+            findings.append(Finding(
+                code="CONC001",
+                where=f"{key[0]}:0",
+                message=(f"config.LOCK_ORDER declares {name!r} at "
+                         f"({key[0]}, {key[1]}) but no such construction "
+                         f"site exists — stale inventory row"),
+                suggestion="update or remove the LOCK_ORDER row"))
+    return findings

@@ -1,0 +1,329 @@
+"""CONC002: the runtime lock-graph sanitizer.
+
+`capture()` swaps the `threading.Lock/RLock/Condition` factories for
+instrumenting ones. Every lock constructed inside the capture records,
+per thread, the set of locks held at each acquisition; first-time
+acquisition-order edges (held L, acquiring M) go into a process-global
+`LockGraph` with the acquiring stack and the holder's acquisition site.
+A cycle in that graph is a potential deadlock — two threads that
+interleave at the wrong moment wedge forever — and is reported with
+both sides' stacks, lockdep-style: the soak does not need to *hit* the
+deadlock window, only to traverse both orders once.
+
+Locks are named by their construction site, resolved through the
+declared inventory (`config.LOCK_ORDER` via `inventory.site_names`) so
+graph nodes carry the same names the static lint uses; foreign locks
+(jax internals, stdlib) fall back to `file.py:lineno` keys and
+participate in cycle detection all the same.
+
+Zero-cost when off (the OBS002 discipline): outside a capture the
+stdlib factories are untouched — `threading.Lock is` the original —
+and `mutation_count()` stays flat, which `tests/test_concurrency.py`
+asserts. Captures are process-global state: one at a time, tests and
+`cli serve-demo --lock-sanitizer` only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import Finding
+from . import inventory
+
+_REAL = {
+    "Lock": threading.Lock,
+    "RLock": threading.RLock,
+    "Condition": threading.Condition,
+}
+
+_MUTATIONS = 0            # incremented on every instrumented-path op
+_ACTIVE: Optional["LockGraph"] = None
+_TLS = threading.local()  # .held: [(key, site)] per thread
+
+
+def mutation_count() -> int:
+    """Sanitizer-path operation count — the zero-cost-when-off guard:
+    this must not move while no capture is active."""
+    return _MUTATIONS
+
+
+def _held() -> List[Tuple[str, str]]:
+    held = getattr(_TLS, "held", None)
+    if held is None:
+        held = _TLS.held = []
+    return held
+
+
+def _site(depth: int) -> Tuple[str, int]:
+    """(filename, lineno) of the lock construction site, ``depth``
+    frames above the factory."""
+    f = sys._getframe(depth)
+    return f.f_code.co_filename, f.f_lineno
+
+
+def _stack(skip: int = 2, limit: int = 8) -> List[str]:
+    """Compact acquiring stack: frame-walk only, no linecache I/O."""
+    out: List[str] = []
+    f: Any = sys._getframe(skip)
+    while f is not None and len(out) < limit:
+        code = f.f_code
+        if "analysis/concurrency" not in code.co_filename.replace("\\", "/"):
+            out.append(f"{Path(code.co_filename).name}:{f.f_lineno} "
+                       f"in {code.co_name}")
+        f = f.f_back
+    return out
+
+
+class LockGraph:
+    """The process-global acquisition-order graph of one capture."""
+
+    def __init__(self, names: Optional[Dict[Tuple[str, int], str]] = None):
+        # Constructed BEFORE the factories are patched, so this is a
+        # real threading.Lock even mid-capture.
+        self._lock = threading.Lock()
+        self._names = names or {}
+        self._root = str(inventory.package_root())
+        # (src, dst) -> {count, threads, src_site, dst_stack}
+        self.edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.nodes: set = set()
+        self.acquisitions = 0
+
+    def key_for(self, filename: str, lineno: int) -> str:
+        if filename.startswith(self._root):
+            rel = Path(filename).as_posix()[len(self._root):].lstrip("/")
+            return self._names.get((rel, lineno), f"{rel}:{lineno}")
+        return f"{Path(filename).name}:{lineno}"
+
+    def _on_acquire(self, key: str, blocking: bool = True) -> None:
+        global _MUTATIONS
+        held = _held()
+        first = all(k != key for k, _ in held)
+        site = _stack(skip=3, limit=1)
+        site_s = site[0] if site else "?"
+        if first and held:
+            srcs = []
+            seen = set()
+            for k, s in held:
+                if k != key and k not in seen:
+                    seen.add(k)
+                    srcs.append((k, s))
+            with self._lock:
+                _MUTATIONS += 1
+                self.acquisitions += 1
+                self.nodes.add(key)
+                for src, src_site in srcs:
+                    edge = self.edges.get((src, key))
+                    if edge is None:
+                        self.edges[(src, key)] = {
+                            "count": 1,
+                            "threads": {threading.current_thread().name},
+                            "src_site": src_site,
+                            "dst_stack": _stack(skip=3),
+                        }
+                    else:
+                        edge["count"] += 1
+                        edge["threads"].add(
+                            threading.current_thread().name)
+        else:
+            with self._lock:
+                _MUTATIONS += 1
+                self.acquisitions += 1
+                self.nodes.add(key)
+        held.append((key, site_s))
+
+    def _on_release(self, key: str) -> None:
+        global _MUTATIONS
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == key:
+                del held[i]
+                break
+        with self._lock:
+            _MUTATIONS += 1
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A node sequence [a, b, ..., a] closing a cycle, or None."""
+        adj: Dict[str, List[str]] = {}
+        with self._lock:
+            for (src, dst) in self.edges:
+                adj.setdefault(src, []).append(dst)
+        color: Dict[str, int] = {}  # 1 = on stack, 2 = done
+        parent: Dict[str, str] = {}
+
+        def dfs(node: str) -> Optional[List[str]]:
+            color[node] = 1
+            for nxt in adj.get(node, ()):
+                if color.get(nxt) == 1:
+                    cyc = [nxt, node]
+                    cur = node
+                    while cur != nxt:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    return list(reversed(cyc))
+                if nxt not in color:
+                    parent[nxt] = node
+                    hit = dfs(nxt)
+                    if hit is not None:
+                        return hit
+            color[node] = 2
+            return None
+
+        for node in sorted(adj):
+            if node not in color:
+                hit = dfs(node)
+                if hit is not None:
+                    return hit
+        return None
+
+    def describe_cycle(self, cycle: List[str]) -> str:
+        lines = [" -> ".join(cycle)]
+        with self._lock:
+            for a, b in zip(cycle, cycle[1:]):
+                edge = self.edges.get((a, b))
+                if edge is None:
+                    continue
+                lines.append(f"  {a} -> {b} (x{edge['count']} on "
+                             f"{', '.join(sorted(edge['threads']))}); "
+                             f"{a} taken at {edge['src_site']}; "
+                             f"{b} taken via: "
+                             + " | ".join(edge["dst_stack"][:4]))
+        return "\n".join(lines)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "locks": sorted(self.nodes),
+                "edge_count": len(self.edges),
+                "acquisitions": self.acquisitions,
+                "edges": sorted(f"{a} -> {b}" for (a, b) in self.edges),
+            }
+
+
+class _InstrumentedLock:
+    """Wraps a real Lock/RLock; records acquire/release order into the
+    capture's graph. Unknown attributes delegate to the inner lock, so
+    `Condition`'s `_release_save`-family protocol reaches the real
+    RLock directly (a waiting thread is blocked and records no edges,
+    so the held-set staying intact across the wait is correct)."""
+
+    __slots__ = ("_inner", "_graft_key", "_graph")
+
+    def __init__(self, inner, key: str, graph: LockGraph):
+        self._inner = inner
+        self._graft_key = key
+        self._graph = graph
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._graph._on_acquire(self._graft_key)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph._on_release(self._graft_key)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<graftlock {self._graft_key!r} wrapping {self._inner!r}>"
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+@contextlib.contextmanager
+def capture(names: Optional[Dict[Tuple[str, int], str]] = None):
+    """Patch the threading lock factories; yield the `LockGraph` that
+    every lock constructed inside the block reports into. One capture
+    at a time, process-global."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("graftlock capture already active")
+    if names is None:
+        names = inventory.site_names()
+    graph = LockGraph(names)
+    _ACTIVE = graph
+
+    def _make(kind: str, filename: str, lineno: int) -> _InstrumentedLock:
+        key = graph.key_for(filename, lineno)
+        return _InstrumentedLock(_REAL[kind](), key, graph)
+
+    def _lock_factory():
+        return _make("Lock", *_site(2))
+
+    def _rlock_factory():
+        return _make("RLock", *_site(2))
+
+    def _condition_factory(lock=None):
+        if lock is None:
+            lock = _make("RLock", *_site(2))
+        return _REAL["Condition"](lock)
+
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    try:
+        yield graph
+    finally:
+        threading.Lock = _REAL["Lock"]
+        threading.RLock = _REAL["RLock"]
+        threading.Condition = _REAL["Condition"]
+        _ACTIVE = None
+
+
+def run_soak_probe() -> Tuple[List[Finding], dict]:
+    """The `conc` pass's dynamic half: a 2-lane service under the
+    instrumented locks, a lane killed mid-stream so the
+    eviction/rescue/probe protocol runs, every ticket terminal, and
+    the final acquisition graph acyclic."""
+    import jax.numpy as jnp  # deferred: the static half must not need jax
+
+    from ...config import SVDConfig
+    from ...resilience import chaos
+    from ...serve import ServeConfig, SVDService
+    from ...utils import matgen
+
+    findings: List[Finding] = []
+    with capture() as graph:
+        cfg = ServeConfig(buckets=((16, 16, "float32"),),
+                          solver=SVDConfig(block_size=4),
+                          lanes=2, max_queue_depth=32)
+        with SVDService(cfg) as svc:
+            mats = [matgen.random_dense(12, 12, seed=70 + i,
+                                        dtype=jnp.float32)
+                    for i in range(6)]
+            with chaos.kill_lane(0):
+                tickets = [svc.submit(a) for a in mats]
+                results = [t.result(timeout=600.0) for t in tickets]
+    non_terminal = sum(1 for r in results if r is None)
+    if non_terminal:
+        findings.append(Finding(
+            code="CONC002", where="analysis/concurrency/sanitizer.py:0",
+            message=(f"soak probe: {non_terminal} tickets never became "
+                     "terminal under the instrumented locks"),
+            suggestion="run tests/test_concurrency.py chaos soak"))
+    cycle = graph.find_cycle()
+    if cycle is not None:
+        findings.append(Finding(
+            code="CONC002", where="analysis/concurrency/sanitizer.py:0",
+            message=("lock acquisition graph has a cycle (potential "
+                     "deadlock):\n" + graph.describe_cycle(cycle)),
+            suggestion=("fix the inverted acquisition or declare the "
+                        "order in config.LOCK_ORDER and restructure")))
+    report = dict(graph.summary(), cycle=cycle,
+                  statuses=sorted({str(getattr(r, "status", None))
+                                   for r in results}))
+    return findings, report

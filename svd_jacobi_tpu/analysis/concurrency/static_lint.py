@@ -1,0 +1,782 @@
+"""CONC001 + CONC003: the static half of graftlock.
+
+The lint is pure AST, in the `ast_lint` tradition of conservative
+name inference: it resolves lock expressions (`self._lock`,
+`self.fleet._lock`, a module-level `_lock`, `self.journal.exclusive()`)
+against the declared inventory in `config.LOCK_ORDER`, walks each
+function with the currently-held lock set, and checks
+
+* **lock order** — an acquisition of M while holding L is legal iff
+  rank(tier(L)) < rank(tier(M)), or L is M and the lock is re-entrant
+  (RLock/Condition). The check crosses call boundaries: per-function
+  summaries of transitively-acquired locks are propagated to a fixpoint
+  over the intra-package call graph, so `recover()` holding the journal
+  and calling a method that takes the service lock is flagged at the
+  call site.
+* **blocking-under-lock** — no jit dispatch, `block_until_ready`,
+  fsync, socket send, `.result()`, `.join()`, sleep, or condition wait
+  while holding a router/service/fleet-tier lock (the worker-wedge
+  class the PR 6 watchdog only catches after the fact). Also
+  propagated transitively.
+* **guarded-by** — an attribute assigned under the class's own
+  declared lock in one method and bare in another is a flagged data
+  race (``__init__``-family methods are exempt: pre-publication).
+* **CONC003** — `Condition.wait` must sit in a predicate loop, carry a
+  timeout, and hold the owning lock; `notify`/`notify_all` must hold
+  the owning lock.
+
+Unresolvable expressions and call targets are skipped, never guessed.
+Deliberate exceptions: `# graftlock: ok(reason)` on the flagged line;
+the reason is mandatory (an empty pragma is itself a finding).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path, PurePosixPath
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ... import config
+from .. import Finding
+from . import inventory
+
+_PRAGMA_RE = re.compile(r"graftlock:\s*ok\(([^)]*)\)")
+
+# Terminal attribute / function names that block (or dispatch work that
+# blocks) — attr-name heuristics, same conservatism as ast_lint.
+_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync (block_until_ready)",
+    "effects_barrier": "device sync (effects_barrier)",
+    "fsync": "fsync",
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "result": ".result() on a future/ticket",
+    "join": ".join() on a thread",
+    "sleep": "sleep",
+    "urlopen": "network I/O",
+    "wait": "wait on a condition/event",
+    "_solve_base": "jit dispatch",
+    "_solve_batched": "jit dispatch",
+    "_solve_ladder": "jit dispatch",
+}
+
+# Lock tiers inside which blocking calls are forbidden (CONC001c): the
+# hot serving locks whose holders stall admission/dispatch for everyone.
+_SCOPED_TIERS = ("router", "service", "fleet")
+
+# Attribute types the one-pass constructor inference cannot see
+# (assigned from a constructor parameter, usually to avoid a circular
+# import). (rel, Class, attr) -> (rel, Class).
+_EXTRA_ATTR_TYPES: Dict[Tuple[str, str, str], Tuple[str, str]] = {
+    ("serve/fleet.py", "Fleet", "service"): ("serve/service.py", "SVDService"),
+    ("serve/fleet.py", "Lane", "service"): ("serve/service.py", "SVDService"),
+    ("serve/router.py", "Replica", "service"): ("serve/service.py", "SVDService"),
+}
+
+# Callables that hand their caller a declared lock. Methods key as
+# (rel, Class, method); module functions as (rel, None, func).
+_RETURNS_LOCK: Dict[Tuple[str, Optional[str], str], Tuple[str, str]] = {
+    ("serve/journal.py", "Journal", "exclusive"):
+        ("serve/journal.py", "Journal._lock"),
+    ("obs/manifest.py", None, "_append_lock"):
+        ("obs/manifest.py", "_append_lock.lock"),
+}
+
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _pragmas(source: str) -> Dict[int, str]:
+    """line -> pragma reason (possibly empty) for every
+    `# graftlock: ok(reason)` comment in ``source``."""
+    out: Dict[int, str] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                m = _PRAGMA_RE.search(tok.string)
+                if m is not None:
+                    out[tok.start[0]] = m.group(1).strip()
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """`self.fleet._lock` -> ["self", "fleet", "_lock"]; None when the
+    expression is not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class _Module:
+    """One parsed file: symbol tables for resolution."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        self.pragmas = _pragmas(source)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        # qualname ("Class.method" | "func") -> (node, class name | None)
+        self.functions: Dict[str, Tuple[ast.AST, Optional[str]]] = {}
+        self.mod_aliases: Dict[str, Tuple[str, str]] = {}  # ("mod"|"pkg", path)
+        self.sym_aliases: Dict[str, Tuple[str, str]] = {}  # (rel, symbol)
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.functions[f"{node.name}.{sub.name}"] = (sub, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = (node, None)
+
+
+class _Linter:
+    def __init__(self, files: Dict[str, str], order=None):
+        self.mods = {rel: _Module(rel, src) for rel, src in files.items()}
+        self.decl = inventory.declared_order(order)
+        # declared name -> (tier, rank)
+        self.tier = {name: tier for (name, tier) in self.decl.values()}
+        self.rank = {name: config.LOCK_TIER_RANK.get(tier, 99)
+                     for name, tier in self.tier.items()}
+        self.sites: List[inventory.LockSite] = []
+        for mod in self.mods.values():
+            self.sites += inventory.scan_source(mod.source, mod.rel)
+        self.kinds: Dict[str, str] = {}
+        for s in self.sites:
+            row = self.decl.get((s.rel, s.qualname))
+            if row is not None:
+                self.kinds.setdefault(row[0], s.kind)
+        # (rel, Class, attr) -> (rel, Class)
+        self.attr_types: Dict[Tuple[str, str, str], Tuple[str, str]] = {}
+        self.findings: List[Finding] = []
+        # per-function summaries, keyed (rel, qualname)
+        self.acquires: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self.blocking: Dict[Tuple[str, str], Dict[str, int]] = {}
+        # call sites: fkey -> [(callee key, lineno, held names at site)]
+        self.calls: Dict[Tuple[str, str],
+                         List[Tuple[Tuple[str, str], int, Tuple[str, ...]]]] = {}
+        # (rel, Class, attr) -> [(method, lineno, under_class_lock)]
+        self.mutations: Dict[Tuple[str, str, str],
+                             List[Tuple[str, int, bool]]] = {}
+
+    # ---------------- symbol resolution ----------------
+
+    def _resolve_imports(self, mod: _Module) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            base_parts = list(PurePosixPath(mod.rel).parent.parts)
+            if node.level:
+                up = node.level - 1
+                base_parts = base_parts[:len(base_parts) - up] if up else base_parts
+            elif node.module and node.module.split(".")[0] == "svd_jacobi_tpu":
+                base_parts = []
+                node_module = ".".join(node.module.split(".")[1:])
+                base_parts += node_module.split(".") if node_module else []
+                self._bind_imports(mod, "/".join(base_parts), node.names)
+                continue
+            else:
+                continue  # external import
+            if node.module:
+                base_parts += node.module.split(".")
+            self._bind_imports(mod, "/".join(base_parts), node.names)
+
+    def _bind_imports(self, mod: _Module, base: str, names) -> None:
+        for alias in names:
+            name, asname = alias.name, alias.asname or alias.name
+            sub = f"{base}/{name}" if base else name
+            if f"{sub}.py" in self.mods:
+                mod.mod_aliases[asname] = ("mod", f"{sub}.py")
+            elif f"{base}.py" in self.mods:
+                mod.sym_aliases[asname] = (f"{base}.py", name)
+            elif any(r.startswith(f"{sub}/") for r in self.mods):
+                mod.mod_aliases[asname] = ("pkg", sub)
+
+    def _class_of(self, mod: _Module, name: str) -> Optional[Tuple[str, str]]:
+        """Resolve a bare name used as a constructor to (rel, Class)."""
+        if name in mod.classes:
+            return (mod.rel, name)
+        sym = mod.sym_aliases.get(name)
+        if sym is not None:
+            rel, symbol = sym
+            target = self.mods.get(rel)
+            if target is not None and symbol in target.classes:
+                return (rel, symbol)
+        return None
+
+    def _callee_class(self, mod: _Module, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(rel, Class) when ``call`` constructs a known package class."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self._class_of(mod, fn.id)
+        chain = _attr_chain(fn)
+        if chain is None:
+            return None
+        state = self._chain_state(mod, None, {}, chain[:-1])
+        if state is not None and state[0] == "mod":
+            target = self.mods.get(state[1])
+            if target is not None and chain[-1] in target.classes:
+                return (state[1], chain[-1])
+        return None
+
+    def _infer_attr_types(self) -> None:
+        self.attr_types.update(_EXTRA_ATTR_TYPES)
+        for mod in self.mods.values():
+            for qual, (fn, cls) in mod.functions.items():
+                if cls is None:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    value = node.value
+                    if isinstance(value, ast.IfExp):
+                        value = (value.body if isinstance(value.body, ast.Call)
+                                 else value.orelse)
+                    if not isinstance(value, ast.Call):
+                        continue
+                    typ = self._callee_class(mod, value)
+                    if typ is None:
+                        continue
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self.attr_types.setdefault(
+                                (mod.rel, cls, t.attr), typ)
+
+    def _local_types(self, mod: _Module, cls: Optional[str],
+                     fn: ast.AST) -> Dict[str, Tuple[str, str]]:
+        """Flow-insensitive local-variable class types inside ``fn``:
+        `j = Journal(...)`, `j = self.journal`, `svc = replica.service`."""
+        out: Dict[str, Tuple[str, str]] = {}
+        for _ in range(2):  # two passes so var-via-var chains settle
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                name = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    typ = self._callee_class(mod, node.value)
+                    if typ is not None:
+                        out[name] = typ
+                    continue
+                chain = _attr_chain(node.value)
+                if chain is not None:
+                    state = self._chain_state(mod, cls, out, chain)
+                    if state is not None and state[0] == "cls":
+                        out[name] = (state[1], state[2])
+        return out
+
+    def _chain_state(self, mod: _Module, cls: Optional[str],
+                     local_types: Dict[str, Tuple[str, str]],
+                     chain: Sequence[str]):
+        """Walk a name chain to ("cls", rel, Class) | ("mod", rel) |
+        None. The first element binds self / a typed local / a module
+        alias; each further element follows attribute types."""
+        if not chain:
+            return None
+        head, rest = chain[0], chain[1:]
+        if head == "self" and cls is not None:
+            state = ("cls", mod.rel, cls)
+        elif head in local_types:
+            rel, c = local_types[head]
+            state = ("cls", rel, c)
+        elif head in mod.mod_aliases:
+            kind, path = mod.mod_aliases[head]
+            state = ("mod", path) if kind == "mod" else ("pkg", path)
+        else:
+            return None
+        for seg in rest:
+            if state[0] == "cls":
+                typ = self.attr_types.get((state[1], state[2], seg))
+                if typ is None:
+                    return None
+                state = ("cls", typ[0], typ[1])
+            elif state[0] == "pkg":
+                nxt = f"{state[1]}/{seg}.py"
+                if nxt in self.mods:
+                    state = ("mod", nxt)
+                elif any(r.startswith(f"{state[1]}/{seg}/") for r in self.mods):
+                    state = ("pkg", f"{state[1]}/{seg}")
+                else:
+                    return None
+            else:  # "mod": attributes of a module are terminal symbols
+                return None
+        return state
+
+    def _resolve_lock(self, mod: _Module, cls: Optional[str],
+                      local_types: Dict[str, Tuple[str, str]],
+                      expr: ast.AST,
+                      local_locks: Optional[Dict[str, str]] = None,
+                      fn_base: Optional[str] = None) -> Optional[str]:
+        """Declared lock name for a lock-valued expression, or None."""
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Name):
+                key = _RETURNS_LOCK.get((mod.rel, None, expr.func.id))
+                if key is not None:
+                    row = self.decl.get(key)
+                    return row[0] if row is not None else None
+                return None
+            chain = _attr_chain(expr.func)
+            if chain is None or len(chain) < 2:
+                return None
+            state = self._chain_state(mod, cls, local_types, chain[:-1])
+            if state is not None and state[0] == "cls":
+                key = _RETURNS_LOCK.get((state[1], state[2], chain[-1]))
+                if key is not None:
+                    row = self.decl.get(key)
+                    return row[0] if row is not None else None
+            return None
+        chain = _attr_chain(expr)
+        if chain is None:
+            return None
+        if len(chain) == 1:
+            if local_locks is not None and chain[0] in local_locks:
+                return local_locks[chain[0]]
+            if fn_base is not None:
+                row = self.decl.get((mod.rel, f"{fn_base}.{chain[0]}"))
+                if row is not None:
+                    return row[0]
+            row = self.decl.get((mod.rel, chain[0]))
+            return row[0] if row is not None else None
+        state = self._chain_state(mod, cls, local_types, chain[:-1])
+        if state is None:
+            return None
+        if state[0] == "cls":
+            row = self.decl.get((state[1], f"{state[2]}.{chain[-1]}"))
+        elif state[0] == "mod":
+            row = self.decl.get((state[1], chain[-1]))
+        else:
+            row = None
+        return row[0] if row is not None else None
+
+    def _resolve_call(self, mod: _Module, cls: Optional[str],
+                      local_types: Dict[str, Tuple[str, str]],
+                      call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(rel, qualname) of an intra-package call target, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in mod.functions:
+                return (mod.rel, fn.id)
+            sym = mod.sym_aliases.get(fn.id)
+            if sym is not None and sym[1] in self.mods.get(sym[0], mod).functions:
+                return sym
+            return None
+        chain = _attr_chain(fn)
+        if chain is None or len(chain) < 2:
+            return None
+        state = self._chain_state(mod, cls, local_types, chain[:-1])
+        if state is None:
+            return None
+        if state[0] == "cls":
+            rel, c = state[1], state[2]
+            target = self.mods.get(rel)
+            if target is not None and f"{c}.{chain[-1]}" in target.functions:
+                return (rel, f"{c}.{chain[-1]}")
+        elif state[0] == "mod":
+            target = self.mods.get(state[1])
+            if target is not None and chain[-1] in target.functions:
+                return (state[1], chain[-1])
+        return None
+
+    # ---------------- the per-function walk ----------------
+
+    def _summarize_function(self, mod: _Module, qual: str,
+                            fn: ast.AST, cls: Optional[str]) -> None:
+        fkey = (mod.rel, qual)
+        acq = self.acquires.setdefault(fkey, {})
+        blk = self.blocking.setdefault(fkey, {})
+        calls = self.calls.setdefault(fkey, [])
+        local_types = self._local_types(mod, cls, fn)
+        method = qual.rsplit(".", 1)[-1]
+        # Locals holding a resolved lock: `lock = _append_lock(path)`,
+        # `j = self.journal.exclusive()` — flow-insensitive, two passes.
+        local_locks: Dict[str, str] = {}
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    got = self._resolve_lock(mod, cls, local_types,
+                                             node.value, local_locks, method)
+                    if got is not None:
+                        local_locks[node.targets[0].id] = got
+
+        def resolve(expr: ast.AST) -> Optional[str]:
+            return self._resolve_lock(mod, cls, local_types, expr,
+                                      local_locks, method)
+
+        def class_locked(held: Sequence[str]) -> bool:
+            for name in held:
+                for (rel, lq), (dname, _tier) in self.decl.items():
+                    if (dname == name and rel == mod.rel and cls is not None
+                            and lq.startswith(f"{cls}.")):
+                        return True
+            return False
+
+        def check_edge(held_name: str, new_name: str, lineno: int,
+                       via: Optional[str] = None) -> None:
+            via_txt = f" (via call to {via})" if via else ""
+            if held_name == new_name:
+                if self.kinds.get(new_name, "Lock") == "Lock":
+                    self.findings.append(Finding(
+                        code="CONC001",
+                        where=f"{mod.rel}:{lineno}",
+                        message=(f"lock {new_name!r} re-acquired while "
+                                 f"already held{via_txt} — it is a plain "
+                                 "threading.Lock, so this self-deadlocks"),
+                        suggestion=("make it an RLock (and declare that "
+                                    "in the inventory) or hoist the "
+                                    "acquisition")))
+                return
+            lr, nr = self.rank.get(held_name, 99), self.rank.get(new_name, 99)
+            if lr < nr:
+                return
+            rel_word = ("inverts the declared order"
+                        if lr > nr else "has no declared order")
+            self.findings.append(Finding(
+                code="CONC001",
+                where=f"{mod.rel}:{lineno}",
+                message=(f"acquiring {new_name!r} (tier "
+                         f"{self.tier.get(new_name, '?')}) while holding "
+                         f"{held_name!r} (tier "
+                         f"{self.tier.get(held_name, '?')}){via_txt} "
+                         f"{rel_word} in config.LOCK_ORDER — a thread "
+                         "taking the same pair in declared order "
+                         "deadlocks against this one"),
+                suggestion=("release the outer lock first, reorder the "
+                            "acquisitions, or justify with "
+                            "`# graftlock: ok(reason)`")))
+
+        def handle_call(call: ast.Call, held: Tuple[str, ...],
+                        loops: int) -> None:
+            chain = _attr_chain(call.func)
+            attr = (chain[-1] if chain else
+                    (call.func.attr if isinstance(call.func, ast.Attribute)
+                     else None))
+            # CONC003: condition-variable discipline.
+            cv = None
+            if chain is not None and len(chain) >= 2:
+                owner = resolve(call.func.value)
+                if owner is not None and self.kinds.get(owner) == "Condition":
+                    cv = owner
+            if cv is not None and attr in ("wait", "wait_for"):
+                if cv not in held:
+                    self.findings.append(Finding(
+                        code="CONC003", where=f"{mod.rel}:{call.lineno}",
+                        message=(f"{cv!r}.{attr}() without holding the "
+                                 "owning lock — raises RuntimeError at "
+                                 "runtime"),
+                        suggestion=f"wrap in `with <{cv}>:`"))
+                if attr == "wait" and loops == 0:
+                    self.findings.append(Finding(
+                        code="CONC003", where=f"{mod.rel}:{call.lineno}",
+                        message=(f"{cv!r}.wait() outside a predicate "
+                                 "loop — spurious wakeups and stolen "
+                                 "notifies make a bare wait incorrect"),
+                        suggestion=("re-check the predicate in a "
+                                    "`while` around the wait")))
+                if not call.args and not any(
+                        kw.arg == "timeout" for kw in call.keywords):
+                    self.findings.append(Finding(
+                        code="CONC003", where=f"{mod.rel}:{call.lineno}",
+                        message=(f"{cv!r}.{attr}() with no timeout — an "
+                                 "unbounded wait cannot observe "
+                                 "shutdown/deadline and hangs stop()"),
+                        suggestion="pass a bounded timeout and re-loop"))
+                return
+            if cv is not None and attr in ("notify", "notify_all"):
+                if cv not in held:
+                    self.findings.append(Finding(
+                        code="CONC003", where=f"{mod.rel}:{call.lineno}",
+                        message=(f"{cv!r}.{attr}() without holding the "
+                                 "owning lock"),
+                        suggestion=f"wrap in `with <{cv}>:`"))
+                return
+            # CONC001c: blocking call under a scoped-tier lock.
+            label = _BLOCKING_ATTRS.get(attr or "")
+            if label is None and isinstance(call.func, ast.Name):
+                label = _BLOCKING_ATTRS.get(call.func.id)
+            if label is not None:
+                blk.setdefault(label, call.lineno)
+                scoped = [h for h in held
+                          if self.tier.get(h) in _SCOPED_TIERS]
+                if scoped:
+                    self.findings.append(Finding(
+                        code="CONC001", where=f"{mod.rel}:{call.lineno}",
+                        message=(f"blocking call ({label}) while holding "
+                                 f"{scoped[-1]!r} (tier "
+                                 f"{self.tier.get(scoped[-1])}) — stalls "
+                                 "every thread contending on that lock "
+                                 "(the worker-wedge class)"),
+                        suggestion=("move the blocking work outside the "
+                                    "lock, or justify with "
+                                    "`# graftlock: ok(reason)`")))
+            callee = self._resolve_call(mod, cls, local_types, call)
+            if callee is not None:
+                calls.append((callee, call.lineno, held))
+
+        def visit_expr(expr: ast.AST, held: Tuple[str, ...],
+                       loops: int) -> None:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    handle_call(node, held, loops)
+
+        def record_mutations(st: ast.stmt, held: Tuple[str, ...]) -> None:
+            if cls is None or not isinstance(st, (ast.Assign, ast.AugAssign,
+                                                  ast.AnnAssign)):
+                return
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    self.mutations.setdefault(
+                        (mod.rel, cls, t.attr), []).append(
+                            (method, t.lineno, class_locked(held)))
+
+        def walk_block(stmts: Sequence[ast.stmt], held: Tuple[str, ...],
+                       loops: int) -> None:
+            extra: List[str] = []  # .acquire()d within this block
+            for st in stmts:
+                cur = held + tuple(extra)
+                # explicit acquire()/release() at statement level
+                if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                    chain = _attr_chain(st.value.func)
+                    if chain and len(chain) >= 2 and chain[-1] in (
+                            "acquire", "release"):
+                        name = resolve(st.value.func.value)
+                        if name is not None:
+                            if chain[-1] == "acquire":
+                                acq.setdefault(name, st.lineno)
+                                for h in cur:
+                                    check_edge(h, name, st.lineno)
+                                extra.append(name)
+                            elif name in extra:
+                                extra.remove(name)
+                            continue
+                walk_stmt(st, cur, loops)
+
+        def walk_stmt(st: ast.stmt, held: Tuple[str, ...],
+                      loops: int) -> None:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                return  # nested defs are not executed inline
+            record_mutations(st, held)
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                new = list(held)
+                for item in st.items:
+                    visit_expr(item.context_expr, tuple(new), loops)
+                    name = resolve(item.context_expr)
+                    if name is not None:
+                        acq.setdefault(name, item.context_expr.lineno)
+                        for h in new:
+                            check_edge(h, name, st.lineno)
+                        new.append(name)
+                walk_block(st.body, tuple(new), loops)
+                return
+            if isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+                if isinstance(st, ast.While):
+                    visit_expr(st.test, held, loops)
+                else:
+                    visit_expr(st.iter, held, loops)
+                walk_block(st.body, held, loops + 1)
+                walk_block(st.orelse, held, loops)
+                return
+            for field in ast.iter_fields(st):
+                value = field[1]
+                if isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        walk_block(value, held, loops)
+                    else:
+                        for v in value:
+                            if isinstance(v, ast.AST):
+                                visit_expr(v, held, loops)
+                elif isinstance(value, ast.AST):
+                    visit_expr(value, held, loops)
+
+        walk_block(fn.body, (), 0)
+
+    # ---------------- cross-function propagation ----------------
+
+    def _fixpoint(self) -> Tuple[Dict, Dict]:
+        """Propagate acquired-lock and blocking summaries over the call
+        graph: trans[fkey] maps lock name / blocking label -> the
+        immediate callee it came through (None when direct)."""
+        trans_acq = {f: {m: None for m in acq}
+                     for f, acq in self.acquires.items()}
+        trans_blk = {f: {b: None for b in blk}
+                     for f, blk in self.blocking.items()}
+        changed = True
+        while changed:
+            changed = False
+            for f, sites in self.calls.items():
+                for callee, _lineno, _held in sites:
+                    for m in trans_acq.get(callee, {}):
+                        if m not in trans_acq[f]:
+                            trans_acq[f][m] = callee[1]
+                            changed = True
+                    for b in trans_blk.get(callee, {}):
+                        if b not in trans_blk[f]:
+                            trans_blk[f][b] = callee[1]
+                            changed = True
+        return trans_acq, trans_blk
+
+    def _check_call_sites(self, trans_acq, trans_blk) -> None:
+        for fkey, sites in self.calls.items():
+            mod = self.mods[fkey[0]]
+            for callee, lineno, held in sites:
+                if not held:
+                    continue
+                via = callee[1]
+                for m in trans_acq.get(callee, {}):
+                    for h in held:
+                        self._edge_at(mod, h, m, lineno, via)
+                scoped = [h for h in held
+                          if self.tier.get(h) in _SCOPED_TIERS]
+                if scoped:
+                    for label in trans_blk.get(callee, {}):
+                        self.findings.append(Finding(
+                            code="CONC001", where=f"{mod.rel}:{lineno}",
+                            message=(f"call to {via} blocks ({label}) "
+                                     f"while holding {scoped[-1]!r} "
+                                     f"(tier {self.tier.get(scoped[-1])})"),
+                            suggestion=("move the call outside the lock "
+                                        "or justify with "
+                                        "`# graftlock: ok(reason)`")))
+
+    def _edge_at(self, mod: _Module, held_name: str, new_name: str,
+                 lineno: int, via: str) -> None:
+        if held_name == new_name:
+            if self.kinds.get(new_name, "Lock") == "Lock":
+                self.findings.append(Finding(
+                    code="CONC001", where=f"{mod.rel}:{lineno}",
+                    message=(f"lock {new_name!r} re-acquired while held "
+                             f"(via call to {via}) — plain Lock, "
+                             "self-deadlock"),
+                    suggestion="make it re-entrant or hoist the call"))
+            return
+        lr, nr = self.rank.get(held_name, 99), self.rank.get(new_name, 99)
+        if lr < nr:
+            return
+        rel_word = ("inverts the declared order" if lr > nr
+                    else "has no declared order")
+        self.findings.append(Finding(
+            code="CONC001", where=f"{mod.rel}:{lineno}",
+            message=(f"call to {via} acquires {new_name!r} (tier "
+                     f"{self.tier.get(new_name, '?')}) while holding "
+                     f"{held_name!r} (tier {self.tier.get(held_name, '?')}) "
+                     f"— {rel_word} in config.LOCK_ORDER"),
+            suggestion=("restructure so the inner lock is taken first "
+                        "or alone, or justify with "
+                        "`# graftlock: ok(reason)`")))
+
+    def _check_guarded_by(self) -> None:
+        for (rel, cls, attr), muts in sorted(self.mutations.items()):
+            body = [(m, ln, lk) for (m, ln, lk) in muts
+                    if m not in _INIT_METHODS]
+            locked = {m for (m, _ln, lk) in body if lk}
+            bare = [(m, ln) for (m, ln, lk) in body if not lk]
+            if not locked or not bare:
+                continue
+            for m, ln in bare:
+                if m in locked:
+                    continue  # mixed within one method: assume staging
+                self.findings.append(Finding(
+                    code="CONC001", where=f"{rel}:{ln}",
+                    message=(f"attribute self.{attr} of {cls} is written "
+                             f"under the class lock in "
+                             f"{', '.join(sorted(locked))} but bare in "
+                             f"{m} — unsynchronized read-modify-write "
+                             "races the locked writers"),
+                    suggestion=("take the class lock around this write "
+                                "or justify with "
+                                "`# graftlock: ok(reason)`")))
+
+    # ---------------- driver ----------------
+
+    def run(self, *, check_inventory: bool = True) -> List[Finding]:
+        for mod in self.mods.values():
+            self._resolve_imports(mod)
+        self._infer_attr_types()
+        if check_inventory:
+            pragmas = {mod.rel: mod.pragmas for mod in self.mods.values()}
+            self.findings += inventory.check_inventory(
+                self.sites, {name: (rel, q, tier) for (rel, q), (name, tier)
+                             in self.decl.items()},
+                pragmas=pragmas)
+        for mod in self.mods.values():
+            for qual, (fn, cls) in mod.functions.items():
+                self._summarize_function(mod, qual, fn, cls)
+        trans_acq, trans_blk = self._fixpoint()
+        self._check_call_sites(trans_acq, trans_blk)
+        self._check_guarded_by()
+        return self._apply_pragmas()
+
+    def _apply_pragmas(self) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple[str, str, str]] = set()
+        for f in self.findings:
+            rel, _, line = f.where.rpartition(":")
+            mod = self.mods.get(rel)
+            reason = None
+            if mod is not None and line.isdigit():
+                # Same line, or a standalone pragma comment just above.
+                reason = (mod.pragmas.get(int(line))
+                          or mod.pragmas.get(int(line) - 1))
+            if reason:
+                continue  # justified
+            key = (f.code, f.where, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(f)
+        for mod in self.mods.values():
+            for line, reason in sorted(mod.pragmas.items()):
+                if not reason:
+                    out.append(Finding(
+                        code="CONC001", where=f"{mod.rel}:{line}",
+                        message=("`# graftlock: ok()` pragma with no "
+                                 "reason — the justification is the "
+                                 "point of the pragma"),
+                        suggestion="state why the exception is safe"))
+        out.sort(key=lambda f: (f.where.rpartition(":")[0],
+                                int(f.where.rpartition(":")[2] or 0)))
+        return out
+
+
+def lint_sources(files: Dict[str, str], order=None, *,
+                 check_inventory: bool = True) -> List[Finding]:
+    return _Linter(files, order=order).run(check_inventory=check_inventory)
+
+
+def lint_file(path, rel: Optional[str] = None, order=None, *,
+              check_inventory: bool = True) -> List[Finding]:
+    """Lint one file (the fixture entry point). ``order`` is a
+    LOCK_ORDER-shaped dict; defaults to the package's."""
+    path = Path(path)
+    rel = rel or path.name
+    return lint_sources({rel: path.read_text()}, order=order,
+                        check_inventory=check_inventory)
+
+
+def lint_package(root=None, order=None) -> List[Finding]:
+    """The real-package lint: every module under ``root`` (default: the
+    installed package), full CONC001 + CONC003 + inventory
+    completeness."""
+    root = Path(root) if root is not None else inventory.package_root()
+    files = {p.relative_to(root).as_posix(): p.read_text()
+             for p in sorted(root.rglob("*.py"))}
+    return lint_sources(files, order=order)

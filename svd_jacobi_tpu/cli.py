@@ -23,10 +23,13 @@ Usage:
         [--profile DIR] [--oracle] [--telemetry]
 
     python -m svd_jacobi_tpu.cli serve-demo [--requests N] [--clients K]
-        [--seed S] [--bucket MxN:dtype ...] [--tight-frac F] ...
+        [--seed S] [--bucket MxN:dtype ...] [--tight-frac F]
+        [--lock-sanitizer] ...
         — seeded closed-loop clients against a live `serve.SVDService`
         (deadlines, admission control, brownout; one "serve" manifest
-        record per request).
+        record per request). --lock-sanitizer runs the demo under the
+        graftlock CONC002 lock-graph sanitizer and exits non-zero on an
+        acquisition cycle (analysis.concurrency.sanitizer).
 
     python -m svd_jacobi_tpu.cli tune [--smoke] [--shapes ...] [--out PATH]
         — the measured autotuner: benchmark the knob grid on the attached
@@ -278,6 +281,13 @@ def _parse_serve_args(argv):
                    help="requests the restart drill pushes through the "
                         "child (kept small: each is slowed so the kill "
                         "window is wide)")
+    p.add_argument("--lock-sanitizer", action="store_true",
+                   help="run the whole demo under the graftlock CONC002 "
+                        "runtime lock-graph sanitizer (instrumented "
+                        "threading.Lock/RLock/Condition): the summary "
+                        "gains a 'lock_graph' section and the demo exits "
+                        "non-zero if the acquisition graph has a cycle "
+                        "(a potential deadlock)")
     # Internal drill plumbing (the orchestrator spawns serve-demo
     # children with these; not for direct use).
     p.add_argument("--_drill-resume", action="store_true",
@@ -295,8 +305,23 @@ def serve_demo(argv) -> int:
     deliberately provokes them), not failures."""
     args = _parse_serve_args(argv)
     if args.restart_drill:
+        if args.lock_sanitizer:
+            raise SystemExit(
+                "--lock-sanitizer instruments THIS process's locks, but "
+                "the restart drill runs its load in child processes — "
+                "pass it to a plain serve-demo run instead")
         return _restart_drill(args)
+    if not args.lock_sanitizer:
+        return _serve_demo_run(args)
+    # CONC002: patch the lock factories BEFORE the service is built so
+    # every lock it mints (service, fleet, queue, journal, breaker,
+    # caches, obs) is instrumented for the whole run.
+    from svd_jacobi_tpu.analysis.concurrency import sanitizer
+    with sanitizer.capture() as graph:
+        return _serve_demo_run(args, lock_graph=graph)
 
+
+def _serve_demo_run(args, lock_graph=None) -> int:
     import os
     import threading
 
@@ -516,9 +541,19 @@ def serve_demo(argv) -> int:
                 "cache_hits": cold[-1]["cache_hits"],
                 "total_s": cold[-1]["total_s"],
             }
+    if lock_graph is not None:
+        # CONC002: the run executed under instrumented locks — publish
+        # the acquisition graph and fail loudly below on any cycle.
+        cycle = lock_graph.find_cycle()
+        summary["lock_graph"] = dict(lock_graph.summary(), cycle=cycle)
     if manifest_path:
         log(f"manifest: {manifest_path}")
     print(json.dumps(summary))
+    if lock_graph is not None and summary["lock_graph"]["cycle"]:
+        log("exit 1: lock acquisition graph has a cycle (potential "
+            "deadlock):\n"
+            + lock_graph.describe_cycle(summary["lock_graph"]["cycle"]))
+        return 1
     ok = (summary["terminal"] == len(plan) and summary["errors"] == 0
           and len(outcomes) == len(plan))
     if ok and args.topk_mix:

@@ -466,6 +466,62 @@ HOT_SCOPES = {
     "grad_sigma": ("grad/rules.py", "_sigma_vjp"),
 }
 
+# The serving stack's declared lock inventory and partial order
+# ("graftlock", analysis.concurrency rule CONC001). Every
+# `threading.Lock/RLock/Condition` the package constructs must appear
+# here with a tier; a thread may only acquire a lock whose tier RANK is
+# strictly greater than every lock it already holds (outermost first):
+#
+#     router -> service/fleet -> queue/journal -> cache/breaker -> obs
+#
+# The five tier groups above are refined into distinct ranks per lock
+# family (LOCK_TIER_RANK) so same-group nesting — e.g. the service lock
+# held across `Fleet.start` — still has a defined direction. Acquiring
+# against the order (or nesting two locks of equal rank) is a CONC001
+# finding unless the line carries a `# graftlock: ok(reason)` pragma;
+# a lock constructed anywhere in the package without a row here fails
+# the inventory-completeness half of CONC001, so a future lock cannot
+# be added without declaring where it sits. Entries are
+# name -> (module path relative to the package root, construction-site
+# qualname — "Class.attr", a module-level variable, or "func.local" for
+# a function-local — and the tier name).
+LOCK_TIER_RANK = {
+    "router": 10,     # federation front door (serve/router.py)
+    "service": 20,    # service-wide state (serve/service.py)
+    "fleet": 22,      # lane supervisor state (serve/fleet.py)
+    "queue": 30,      # per-lane admission queues (serve/queue.py)
+    "journal": 32,    # write-ahead journal appends/rewrite (serve/journal.py)
+    "cache": 40,      # leaf stores: caches, breaker, ticket finalize
+    "obs": 50,        # observability leaves: metrics, spans, manifest
+}
+
+LOCK_ORDER = {
+    "router": ("serve/router.py", "ReplicaRouter._lock", "router"),
+    "service": ("serve/service.py", "SVDService._lock", "service"),
+    "fleet": ("serve/fleet.py", "Fleet._lock", "fleet"),
+    "queue": ("serve/queue.py", "AdmissionQueue._cond", "queue"),
+    "journal": ("serve/journal.py", "Journal._lock", "journal"),
+    "ticket_finalize": ("serve/service.py", "Ticket._finalize_lock",
+                        "cache"),
+    "router_ticket": ("serve/router.py", "RouterTicket._lock", "cache"),
+    "promotion_store": ("serve/cache.py", "PromotionStore._lock", "cache"),
+    "result_cache": ("serve/cache.py", "ResultCache._lock", "cache"),
+    "breaker": ("serve/breaker.py", "CircuitBreaker._lock", "cache"),
+    "metrics_module": ("obs/metrics.py", "_lock", "obs"),
+    "spans": ("obs/spans.py", "SpanRecorder._lock", "obs"),
+    "registry_mutation": ("obs/registry.py", "_MUTATION_LOCK", "obs"),
+    "registry": ("obs/registry.py", "MetricsRegistry._lock", "obs"),
+    "slo": ("obs/registry.py", "SLOTracker._lock", "obs"),
+    "manifest_guard": ("obs/manifest.py", "_APPEND_LOCKS_GUARD", "obs"),
+    "manifest_path": ("obs/manifest.py", "_append_lock.lock", "obs"),
+    "chaos": ("resilience/chaos.py", "_lock", "obs"),
+    "cli_out": ("cli.py", "_serve_demo_run.out_lock", "obs"),
+    # The CONC002 sanitizer's own edge-graph lock: a leaf by
+    # construction (never held while acquiring anything else).
+    "sanitizer_graph": ("analysis/concurrency/sanitizer.py",
+                        "LockGraph._lock", "obs"),
+}
+
 # Roofline attribution join: every HOT_SCOPES profiler scope maps onto
 # one canonical phase of `obs.costmodel.PHASES`, so a trace's per-scope
 # durations can be divided by that phase's analytic FLOP/HBM-byte cost

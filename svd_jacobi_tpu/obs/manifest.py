@@ -31,6 +31,7 @@ import datetime
 import hashlib
 import json
 import threading
+from collections import OrderedDict
 from pathlib import Path
 from typing import Any, Dict, List, NamedTuple, Optional
 
@@ -686,7 +687,17 @@ def _kind_for(record: dict):
 # created at import: minting it lazily would itself race (two threads
 # making the process's first appends could each see None and mint
 # separate guards — and therefore separate per-path locks).
-_APPEND_LOCKS: Dict[str, Any] = {}
+#
+# The map is LRU-BOUNDED: a long-lived process appending to many
+# distinct paths (per-run manifests, per-test journals) must not grow
+# it forever. Eviction only ever removes an IDLE lock (not .locked()),
+# so a writer mid-append keeps exclusivity; the map may exceed the cap
+# while more than _APPEND_LOCKS_MAX locks are simultaneously held. Two
+# threads appending to the same path need the same lock OBJECT only
+# while both are in flight — an idle lock evicted and re-minted later
+# still serializes correctly because nobody holds the old one.
+_APPEND_LOCKS: "OrderedDict[str, Any]" = OrderedDict()
+_APPEND_LOCKS_MAX = 64
 _APPEND_LOCKS_GUARD = threading.Lock()
 
 
@@ -695,6 +706,14 @@ def _append_lock(path: str):
         lock = _APPEND_LOCKS.get(path)
         if lock is None:
             lock = _APPEND_LOCKS[path] = threading.Lock()
+        _APPEND_LOCKS.move_to_end(path)
+        while len(_APPEND_LOCKS) > _APPEND_LOCKS_MAX:
+            victim = next((p for p in _APPEND_LOCKS
+                           if p != path and not _APPEND_LOCKS[p].locked()),
+                          None)
+            if victim is None:
+                break  # everything is held: allow temporary overshoot
+            del _APPEND_LOCKS[victim]
         return lock
 
 

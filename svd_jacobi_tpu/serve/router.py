@@ -1246,6 +1246,7 @@ class ReplicaRouter:
                     for rec in recs:
                         rid = rec["id"]
                         moved.append(rid)
+                        # graftlock: ok(journal->router inversion is rescue-only — the journal here belongs to the fenced+quiesced dead replica, so no live path can hold the router lock while waiting on it; rebinding must stay inside the exclusive section so a crashed rescue replays cleanly)
                         with self._lock:
                             rt = self._outstanding.get(rid)
                             replica.outstanding.discard(rid)

@@ -810,6 +810,7 @@ class SVDService:
             # its terminal record (the re-entrant journal lock admits
             # the nested finalize appends).
             for ticket, rec, status, error in terminal:
+                # graftlock: ok(journal->service inversion is startup-only — recover() runs single-threaded between construction and first traffic, so no thread can hold the service lock while waiting on this journal; the finalizes must stay inside the exclusive section for scan+compact atomicity)
                 self._recover_terminal(ticket, rec, status, error=error)
             # Compact to exactly the re-admitted debt (attempt-bumped,
             # original admit times kept): a second crash replays only

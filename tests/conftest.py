@@ -46,8 +46,12 @@ def pytest_sessionstart(session):
     if os.environ.get("SVDJ_SKIP_GRAFTCHECK"):
         return
     from svd_jacobi_tpu.analysis import ast_lint, jaxpr_checks, render_findings
+    from svd_jacobi_tpu.analysis.concurrency import static_lint
     findings = ast_lint.lint_package()
     findings += jaxpr_checks.check_default_entries(include_mesh=True)
+    # graftlock static rules (CONC001/CONC003 + lock-inventory
+    # completeness): pure AST, no jax — cheap enough for every session.
+    findings += static_lint.lint_package()
     if findings:
         raise pytest.UsageError(render_findings(
             findings,

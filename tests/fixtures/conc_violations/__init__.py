@@ -1,0 +1,8 @@
+"""Seeded graftlock (CONC001-003) violation fixtures.
+
+Never imported by the package — parsed by tests/test_concurrency.py
+with per-fixture LOCK_ORDER dicts to prove every rule demonstrably
+fires (and that `# graftlock: ok(reason)` pragmas suppress). The one
+exception is conc002_deadlock.py, which IS imported and executed under
+`sanitizer.capture()` to seed a runtime lock-graph cycle.
+"""

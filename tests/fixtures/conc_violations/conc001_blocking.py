@@ -1,0 +1,31 @@
+"""Seeded CONC001 blocking-under-lock violations: fsync, `.result()`,
+`block_until_ready`, and a sleep reached through a call, all while
+holding a service-tier lock — the worker-wedge class."""
+
+import os
+import threading
+import time
+
+
+class Hot:
+    def __init__(self):
+        self._lock = threading.Lock()     # service tier (test order)
+
+    def fsync_under_lock(self, fd):
+        with self._lock:
+            os.fsync(fd)                  # CONC001: fsync under hot lock
+
+    def result_under_lock(self, fut):
+        with self._lock:
+            return fut.result()           # CONC001: .result() under lock
+
+    def device_sync_under_lock(self, x):
+        with self._lock:
+            x.block_until_ready()         # CONC001: device sync under lock
+
+    def _stall_helper(self):
+        time.sleep(0.01)
+
+    def blocking_via_call(self):
+        with self._lock:
+            self._stall_helper()          # CONC001: sleeps via call

@@ -1,0 +1,28 @@
+"""Seeded CONC001 guarded-by violation: `value` is written under the
+class lock in one method and bare in another — the bare write races
+the locked read-modify-write. `__init__` writes are exempt
+(pre-publication), and the pragma'd staging write is suppressed."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()     # service tier (test order)
+        self.value = 0
+        self.epoch = 0
+
+    def locked_bump(self):
+        with self._lock:
+            self.value += 1
+
+    def racy_reset(self):
+        self.value = 0                    # CONC001: bare vs locked_bump
+
+    def locked_epoch(self):
+        with self._lock:
+            self.epoch += 1
+
+    def staged_epoch(self):
+        # graftlock: ok(fixture justification: caller guarantees quiescence)
+        self.epoch = 0

@@ -1,0 +1,58 @@
+"""Seeded CONC001 lock-order violations.
+
+The test declares `_outer` at tier router, `_inner` at tier obs, and
+`_peer_a`/`_peer_b` both at tier cache. Expected findings: the direct
+inversion, the same-rank pair, the inversion reached through a call,
+the plain-Lock re-acquisition, and the empty pragma (whose inversion
+also still fires). The justified pragma suppresses its inversion.
+"""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._outer = threading.Lock()    # router tier (test order)
+        self._inner = threading.Lock()    # obs tier
+        self._peer_a = threading.Lock()   # cache tier
+        self._peer_b = threading.Lock()   # cache tier
+
+    def forward(self):
+        with self._outer:
+            with self._inner:             # fine: router -> obs
+                pass
+
+    def inverted(self):
+        with self._inner:
+            with self._outer:             # CONC001: obs -> router
+                pass
+
+    def same_rank(self):
+        with self._peer_a:
+            with self._peer_b:            # CONC001: no declared order
+                pass
+
+    def take_outer(self):
+        with self._outer:
+            pass
+
+    def inverted_via_call(self):
+        with self._inner:
+            self.take_outer()             # CONC001: inversion via call
+
+    def self_deadlock(self):
+        with self._inner:
+            with self._inner:             # CONC001: plain Lock re-taken
+                pass
+
+    def inverted_but_justified(self):
+        with self._inner:
+            # graftlock: ok(fixture justification: outer is quiesced here)
+            with self._outer:
+                pass
+
+    def empty_pragma(self):
+        with self._inner:
+            # graftlock: ok()
+            with self._outer:             # CONC001 x2: inversion + bare pragma
+                pass

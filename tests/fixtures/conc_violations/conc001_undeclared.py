@@ -1,0 +1,14 @@
+"""Seeded CONC001 inventory-completeness violations: two locks with no
+declared tier (the test lints with an empty LOCK_ORDER), one excused by
+a justified pragma."""
+
+import threading
+
+_global_lock = threading.Lock()           # CONC001: undeclared
+
+
+class Orphan:
+    def __init__(self):
+        self._mystery = threading.RLock()  # CONC001: undeclared
+        # graftlock: ok(fixture justification: scratch lock, never nested)
+        self._excused = threading.Lock()

@@ -1,0 +1,28 @@
+"""Seeded CONC002 runtime deadlock: two locks taken in opposite orders
+by two (sequential) threads. The program never actually wedges — that
+is the point of lockdep-style detection: traversing both orders once is
+enough for the acquisition graph to close the a->b->a cycle."""
+
+import threading
+
+
+def build_cycle():
+    a = threading.Lock()
+    b = threading.Lock()
+    hits = []
+
+    def ab():
+        with a:
+            with b:
+                hits.append("ab")
+
+    def ba():
+        with b:
+            with a:                       # the inverted order
+                hits.append("ba")
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn, name=f"conc002-{fn.__name__}")
+        t.start()
+        t.join()
+    return hits

@@ -1,0 +1,37 @@
+"""Seeded CONC003 condition-variable violations: an unlooped wait, an
+unbounded wait, and a notify without the owning lock (which is also a
+CONC001 guarded-by hit on `ready` — the bare write races the locked
+ones). `ok_wait`/`ok_notify` are the conforming shapes and must stay
+clean."""
+
+import threading
+
+
+class Waiter:
+    def __init__(self):
+        self._cond = threading.Condition()   # queue tier (test order)
+        self.ready = False
+
+    def ok_wait(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(0.1)
+
+    def unlooped_wait(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait(0.1)      # CONC003: not predicate-looped
+
+    def unbounded_wait(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait()         # CONC003: no timeout
+
+    def notify_outside(self):
+        self.ready = True                 # CONC001: bare write to ready
+        self._cond.notify_all()           # CONC003: lock not held
+
+    def ok_notify(self):
+        with self._cond:
+            self.ready = True
+            self._cond.notify_all()

@@ -1,0 +1,352 @@
+"""graftlock (`svd_jacobi_tpu.analysis.concurrency`): CONC001 static
+lock discipline, CONC002 runtime lock-graph sanitizer, CONC003
+condition-variable discipline.
+
+The fixture corpus under tests/fixtures/conc_violations/ proves every
+rule demonstrably fires (with per-fixture LOCK_ORDER declarations); the
+real package must lint clean; the chaos soaks run green under the
+instrumented locks with an acyclic final acquisition graph; and the
+sanitizer is provably zero-cost when off (the OBS002 discipline).
+"""
+
+import importlib.util
+import threading
+from collections import Counter
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from svd_jacobi_tpu import SVDConfig
+from svd_jacobi_tpu.analysis.concurrency import (inventory, sanitizer,
+                                                 static_lint)
+from svd_jacobi_tpu.obs import manifest
+from svd_jacobi_tpu.resilience import chaos
+from svd_jacobi_tpu.serve import ServeConfig, SVDService
+from svd_jacobi_tpu.utils import matgen
+from svd_jacobi_tpu import config as pkg_config
+
+pytestmark = pytest.mark.conc
+
+FIXDIR = Path(__file__).parent / "fixtures" / "conc_violations"
+
+
+def _lint(name, order):
+    return static_lint.lint_file(FIXDIR / name, rel=name, order=order)
+
+
+def _codes(findings):
+    return dict(Counter(f.code for f in findings))
+
+
+def _lines(findings):
+    return sorted(int(f.where.rsplit(":", 1)[1]) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CONC001: lock order, guarded-by, blocking-under-lock, inventory.
+
+
+class TestLockOrderFixture:
+    ORDER = {
+        "outer": ("conc001_lock_order.py", "Box._outer", "router"),
+        "inner": ("conc001_lock_order.py", "Box._inner", "obs"),
+        "peer_a": ("conc001_lock_order.py", "Box._peer_a", "cache"),
+        "peer_b": ("conc001_lock_order.py", "Box._peer_b", "cache"),
+    }
+
+    def test_every_order_rule_fires(self):
+        fs = _lint("conc001_lock_order.py", self.ORDER)
+        assert _codes(fs) == {"CONC001": 6}
+        by_line = {int(f.where.rsplit(":", 1)[1]): f.message for f in fs}
+        assert "inverts the declared order" in by_line[27]   # direct
+        assert "no declared order" in by_line[32]            # same rank
+        assert "via call" not in by_line[27]
+        assert "Box.take_outer" in by_line[41]               # via call
+        assert "self-deadlock" in by_line[45]                # Lock re-taken
+        assert "no reason" in by_line[56]                    # empty pragma
+        assert "inverts the declared order" in by_line[57]   # not excused
+
+    def test_justified_pragma_suppresses(self):
+        fs = _lint("conc001_lock_order.py", self.ORDER)
+        # The `inverted_but_justified` with-block (line 51) must NOT
+        # appear: its pragma carries a reason.
+        assert 51 not in _lines(fs)
+
+    def test_forward_order_is_clean(self):
+        fs = _lint("conc001_lock_order.py", self.ORDER)
+        assert 21 not in _lines(fs) and 22 not in _lines(fs)
+
+
+class TestGuardedByFixture:
+    ORDER = {"counter": ("conc001_guarded_by.py", "Counter._lock",
+                         "service")}
+
+    def test_bare_write_flagged_once(self):
+        fs = _lint("conc001_guarded_by.py", self.ORDER)
+        assert _codes(fs) == {"CONC001": 1}
+        (f,) = fs
+        assert f.where.endswith(":20")
+        assert "locked_bump" in f.message and "racy_reset" in f.message
+
+    def test_init_and_pragma_exempt(self):
+        lines = _lines(_lint("conc001_guarded_by.py", self.ORDER))
+        assert 12 not in lines and 13 not in lines   # __init__ writes
+        assert 27 not in lines                       # pragma'd staging
+
+
+class TestBlockingFixture:
+    ORDER = {"hot": ("conc001_blocking.py", "Hot._lock", "service")}
+
+    def test_blocking_calls_fire(self):
+        fs = _lint("conc001_blocking.py", self.ORDER)
+        assert _codes(fs) == {"CONC001": 4}
+        assert _lines(fs) == [16, 20, 24, 31]
+        msgs = " | ".join(f.message for f in fs)
+        assert "fsync" in msgs and "result" in msgs
+        assert "block_until_ready" in msgs
+        assert "Hot._stall_helper" in msgs            # transitive sleep
+
+
+class TestInventoryFixture:
+    def test_undeclared_locks_fire(self):
+        fs = _lint("conc001_undeclared.py", {})
+        assert _codes(fs) == {"CONC001": 2}
+        assert _lines(fs) == [7, 12]
+        assert all("no declared tier" in f.message for f in fs)
+        # line 14 (`_excused`) is pragma'd with a reason: suppressed.
+
+    def test_stale_declared_row_fires(self):
+        fs = _lint("conc001_undeclared.py", {
+            "ghost": ("conc001_undeclared.py", "Nope._lock", "obs")})
+        stale = [f for f in fs if "stale inventory row" in f.message]
+        assert len(stale) == 1 and "ghost" in stale[0].message
+
+
+class TestCVFixture:
+    ORDER = {"cv": ("conc003_cv.py", "Waiter._cond", "queue")}
+
+    def test_cv_rules_fire(self):
+        fs = _lint("conc003_cv.py", self.ORDER)
+        assert _codes(fs) == {"CONC003": 3, "CONC001": 1}
+        by_line = {int(f.where.rsplit(":", 1)[1]): f for f in fs}
+        assert "predicate loop" in by_line[23].message
+        assert "no timeout" in by_line[28].message
+        assert "without holding the owning lock" in by_line[32].message
+        # The bare `ready` write is ALSO a guarded-by hit (CONC001).
+        assert by_line[31].code == "CONC001"
+
+    def test_conforming_shapes_clean(self):
+        lines = _lines(_lint("conc003_cv.py", self.ORDER))
+        for ln in (17, 18, 35, 36, 37):   # ok_wait / ok_notify bodies
+            assert ln not in lines
+
+
+# ---------------------------------------------------------------------------
+# The real package: clean lint, complete inventory.
+
+
+class TestRealPackage:
+    def test_package_lints_clean(self):
+        fs = static_lint.lint_package()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_inventory_covers_every_lock(self):
+        # Two-way: every construction site declared, every declared row
+        # alive — with NO pragma escape (the package's own locks must
+        # all carry tiers; pragmas are for fixtures and scratch code).
+        fs = inventory.check_inventory()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_declared_tiers_are_ranked(self):
+        for name, (rel, qual, tier) in pkg_config.LOCK_ORDER.items():
+            assert tier in pkg_config.LOCK_TIER_RANK, (name, tier)
+
+    def test_site_names_resolve_the_serving_locks(self):
+        names = set(inventory.site_names().values())
+        assert {"service", "fleet", "queue", "journal",
+                "router"} <= names
+
+
+# ---------------------------------------------------------------------------
+# CONC002: the runtime sanitizer.
+
+
+def _import_fixture(name):
+    spec = importlib.util.spec_from_file_location(name, FIXDIR / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSanitizer:
+    def test_seeded_cycle_detected_with_both_stacks(self):
+        fix = _import_fixture("conc002_deadlock")
+        with sanitizer.capture() as graph:
+            hits = fix.build_cycle()
+        assert sorted(hits) == ["ab", "ba"]
+        cycle = graph.find_cycle()
+        assert cycle is not None and cycle[0] == cycle[-1]
+        desc = graph.describe_cycle(cycle)
+        assert "->" in desc and "conc002_deadlock.py" in desc
+        assert "taken at" in desc and "taken via" in desc
+        # Both directions were traversed on distinct named threads.
+        assert "conc002-ab" in desc or "conc002-ba" in desc
+
+    def test_acyclic_when_orders_agree(self):
+        with sanitizer.capture() as graph:
+            # Separate lines: keys are construction sites, and two locks
+            # minted on one line would share a key (re-entrant, no edge).
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        assert graph.find_cycle() is None
+        assert graph.summary()["edge_count"] == 1
+
+    def test_reentrant_rlock_records_no_self_edge(self):
+        with sanitizer.capture() as graph:
+            r = threading.RLock()
+            with r:
+                with r:
+                    pass
+        assert all(src != dst for (src, dst) in graph.edges)
+        assert not sanitizer._held()     # balanced on this thread
+
+    def test_condition_wait_keeps_held_set_balanced(self):
+        with sanitizer.capture() as graph:
+            cond = threading.Condition()
+            with cond:
+                cond.wait(0.01)          # timeout path
+            assert not sanitizer._held()
+        assert graph.acquisitions > 0
+
+    def test_zero_cost_when_off(self):
+        # Off path: the stdlib factories are THE originals and the
+        # sanitizer mutation counter does not move.
+        assert threading.Lock is sanitizer._REAL["Lock"]
+        assert threading.RLock is sanitizer._REAL["RLock"]
+        assert threading.Condition is sanitizer._REAL["Condition"]
+        before = sanitizer.mutation_count()
+        lk = threading.Lock()
+        for _ in range(50):
+            with lk:
+                pass
+        cv = threading.Condition()
+        with cv:
+            cv.notify_all()
+        assert sanitizer.mutation_count() == before
+
+    def test_capture_restores_after_exception(self):
+        with pytest.raises(RuntimeError, match="boom"):
+            with sanitizer.capture():
+                raise RuntimeError("boom")
+        assert threading.Lock is sanitizer._REAL["Lock"]
+
+    def test_nested_capture_refused(self):
+        with sanitizer.capture():
+            with pytest.raises(RuntimeError, match="already active"):
+                with sanitizer.capture():
+                    pass
+        assert threading.Lock is sanitizer._REAL["Lock"]
+
+    def test_lock_names_resolve_to_inventory(self):
+        # A lock constructed at a declared package site gets its
+        # declared name as its graph key.
+        g = sanitizer.LockGraph(inventory.site_names())
+        root = inventory.package_root()
+        row = pkg_config.LOCK_ORDER["queue"]
+        site = next(s for s in inventory.scan_package()
+                    if (s.rel, s.qualname) == (row[0], row[1]))
+        assert g.key_for(str(root / site.rel), site.lineno) == "queue"
+
+
+# ---------------------------------------------------------------------------
+# Chaos soaks under the instrumented locks.
+
+
+@pytest.mark.chaos
+class TestInstrumentedSoaks:
+    def test_kill_lane_soak_acyclic(self):
+        """The PR 6 eviction/rescue ladder under CONC002: a 2-lane
+        service, one lane killed mid-stream, concurrent clients — every
+        ticket terminal OK, and the final acquisition graph (service,
+        fleet, queue, journal, breaker, caches, obs...) acyclic."""
+        import jax.numpy as jnp
+        with sanitizer.capture() as graph:
+            cfg = ServeConfig(buckets=((16, 16, "float32"),),
+                              solver=SVDConfig(block_size=4),
+                              lanes=2, max_queue_depth=32)
+            with SVDService(cfg) as svc:
+                mats = [matgen.random_dense(12, 12, seed=500 + i,
+                                            dtype=jnp.float32)
+                        for i in range(8)]
+                results = []
+                res_lock = threading.Lock()
+
+                def client(chunk):
+                    got = [svc.submit(a).result(timeout=600.0)
+                           for a in chunk]
+                    with res_lock:
+                        results.extend(got)
+
+                with chaos.kill_lane(0):
+                    ts = [threading.Thread(target=client,
+                                           args=(mats[i::2],))
+                          for i in range(2)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+        assert len(results) == 8
+        assert all(r.status.name == "OK" for r in results)
+        cycle = graph.find_cycle()
+        assert cycle is None, graph.describe_cycle(cycle)
+        summary = graph.summary()
+        assert summary["edge_count"] > 0
+        assert {"service", "queue"} <= set(summary["locks"])
+
+    def test_run_soak_probe_green(self):
+        """The `conc` analysis pass's own dynamic probe: no findings,
+        acyclic, and the report carries the graph summary."""
+        findings, report = sanitizer.run_soak_probe()
+        assert findings == [], "\n".join(f.render() for f in findings)
+        assert report["cycle"] is None
+        assert report["acquisitions"] > 0
+        assert report["statuses"] == ["SolveStatus.OK"]
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the per-path append-lock map is LRU-bounded.
+
+
+class TestAppendLockBound:
+    def test_map_is_bounded(self):
+        base = len(manifest._APPEND_LOCKS)
+        for i in range(manifest._APPEND_LOCKS_MAX * 3):
+            manifest._append_lock(f"/tmp/graftlock-bound-{i}")
+        assert len(manifest._APPEND_LOCKS) <= manifest._APPEND_LOCKS_MAX
+        assert base <= manifest._APPEND_LOCKS_MAX + 1
+
+    def test_held_lock_survives_eviction_pressure(self):
+        lk = manifest._append_lock("/tmp/graftlock-held")
+        lk.acquire()
+        try:
+            for i in range(manifest._APPEND_LOCKS_MAX * 3):
+                manifest._append_lock(f"/tmp/graftlock-pressure-{i}")
+            # Identity preserved while held: a concurrent appender to
+            # the same path MUST contend on this same object.
+            assert manifest._append_lock("/tmp/graftlock-held") is lk
+        finally:
+            lk.release()
+
+    def test_append_still_correct_after_eviction(self, tmp_path):
+        p = tmp_path / "m.jsonl"
+        manifest.append_jsonl(p, {"n": 1}, fsync=False)
+        for i in range(manifest._APPEND_LOCKS_MAX * 2):
+            manifest._append_lock(f"/tmp/graftlock-churn-{i}")
+        manifest.append_jsonl(p, {"n": 2}, fsync=False)   # re-minted lock
+        lines = [ln for ln in p.read_text().splitlines() if ln]
+        assert len(lines) == 2
