@@ -855,10 +855,19 @@ def _serve_federation(flags) -> None:
 
     Flags: --bucket=MxN:dtype (default 48x32:float32) --requests=N
            --clients=C --deadline-s=D
+           --transport=local|http (http: every replica is a live
+             in-process `serve.transport.HttpReplicaServer` and the
+             router reaches it only over `HttpReplica` RPCs — the rows
+             are suffixed `_http` and their delta vs the local rows is
+             the wire-protocol overhead; kill-one goes through lease
+             expiry + fenced journal rescue instead of the in-process
+             death signal)
     """
+    import dataclasses
     import os
     import tempfile
     import threading
+    from pathlib import Path
 
     import jax
     platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
@@ -882,25 +891,52 @@ def _serve_federation(flags) -> None:
     requests = int(flags.get("requests", "32"))
     clients = int(flags.get("clients", "8"))
     deadline_s = float(flags.get("deadline-s", "600"))
+    transport = flags.get("transport", "local")
+    if transport not in ("local", "http"):
+        raise SystemExit(f"--transport={transport!r}: local|http")
+    suffix = "_http" if transport == "http" else ""
     mats = [np.asarray(matgen.random_dense(bucket.m - 4, bucket.n - 2,
                                            seed=1000 + i,
                                            dtype=jnp.dtype(bucket.dtype)))
             for i in range(min(requests, 16))]
 
     def build(n_replicas):
+        serve_cfg = ServeConfig(
+            buckets=(bucket,), solver=SVDConfig(),
+            max_queue_depth=max(64, 2 * requests),
+            result_cache_bytes=64 << 20,
+            brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+        state_dir = tempfile.mkdtemp(prefix="svdj-fed-")
         cfg = RouterConfig(
-            replicas=n_replicas,
-            serve=ServeConfig(
-                buckets=(bucket,), solver=SVDConfig(),
-                max_queue_depth=max(64, 2 * requests),
-                result_cache_bytes=64 << 20,
-                brownout_sigma_only_at=2.0, brownout_shed_at=2.0),
-            state_dir=tempfile.mkdtemp(prefix="svdj-fed-"),
+            replicas=n_replicas, serve=serve_cfg, state_dir=state_dir,
             supervise_interval_s=0.02, heartbeat_timeout_s=2.0,
             probe_interval_s=0.25)
-        return ReplicaRouter(cfg).start()
+        if transport != "http":
+            return ReplicaRouter(cfg).start(), []
+        # HTTP federation: each replica is an in-process server with its
+        # own journal + fence token; the router only speaks RPC to it.
+        from svd_jacobi_tpu.serve.transport import (HttpReplica,
+                                                    HttpReplicaServer)
+        servers, handles = [], []
+        for i in range(n_replicas):
+            rdir = Path(state_dir) / f"replica-{i}"
+            rc = dataclasses.replace(
+                serve_cfg, journal_path=str(rdir / "journal.jsonl"),
+                compute_digest=True)
+            # warmup=True: router.warmup() only reaches LOCAL replicas,
+            # so HTTP servers AOT-warm at boot (replica 0 fills the
+            # shared persistent cache, later replicas warm from hits).
+            server = HttpReplicaServer(rc, warmup=True).start()
+            servers.append(server)
+            handles.append(HttpReplica(i, server.address, rc.journal_path))
+        return ReplicaRouter(cfg, replicas=handles).start(), servers
 
-    def closed_loop(router, kill_at=None):
+    def shutdown(router, servers):
+        router.stop(drain=True, timeout=60.0)
+        for server in servers:
+            server.stop(drain=True, timeout=30.0)
+
+    def closed_loop(router, kill_at=None, servers=None):
         outcomes, lock, counter = [], threading.Lock(), [0]
         killed = threading.Event()
 
@@ -916,7 +952,12 @@ def _serve_federation(flags) -> None:
                     killed.set()
                     victim = router.ring.owner(bucket.name,
                                                input_digest(mats[0]))
-                    router.replicas[victim].simulate_kill()
+                    if servers:
+                        # HTTP: kill the SERVER (the handle only learns
+                        # through lease expiry + fenced rescue).
+                        servers[victim].simulate_kill()
+                    else:
+                        router.replicas[victim].simulate_kill()
                 a = mats[i % len(mats)]
                 t0 = time.perf_counter()
                 try:
@@ -942,18 +983,18 @@ def _serve_federation(flags) -> None:
 
     rows = {}
     for n_replicas in (1, 2):
-        router = build(n_replicas)
+        router, servers = build(n_replicas)
         try:
             router.warmup(timeout=1800.0)
             outcomes, wall = closed_loop(router)
         finally:
-            router.stop(drain=True, timeout=60.0)
+            shutdown(router, servers)
         lat = sorted(d for d, _, _ in outcomes)
         q = (lambda p: round(lat[min(len(lat) - 1,
                                      int(p * len(lat)))] * 1e3, 2)
              if lat else None)
         row = {
-            "metric": f"serve_federation_{bucket.name}_r{n_replicas}",
+            "metric": f"serve_federation_{bucket.name}_r{n_replicas}{suffix}",
             "value": round(len(outcomes) / wall, 2),
             "unit": "requests/s",
             "replicas": n_replicas, "clients": clients,
@@ -967,28 +1008,31 @@ def _serve_federation(flags) -> None:
         rows[n_replicas] = row
     if rows[1]["value"]:
         print(json.dumps({
-            "metric": f"serve_federation_scaling_{bucket.name}",
+            "metric": f"serve_federation_scaling_{bucket.name}{suffix}",
             "value": round(rows[2]["value"] / rows[1]["value"], 3),
             "unit": "x vs 1 replica",
             "ok": all(r["ok"] == r["requests"] for r in rows.values()),
         }))
 
     # Availability under replica death: kill the owner mid-load.
-    router = build(2)
+    router, servers = build(2)
     try:
         router.warmup(timeout=1800.0)
         with chaos.slow_solve(0.05, shots=requests):
-            outcomes, wall = closed_loop(router, kill_at=requests // 3)
+            outcomes, wall = closed_loop(router, kill_at=requests // 3,
+                                         servers=servers)
         rescued = router.total_rescues
         hz = router.healthz(probe_replicas=False)
+        net = ([dict(r.net_stats) for r in router.replicas]
+               if transport == "http" else None)
     finally:
-        router.stop(drain=True, timeout=60.0)
+        shutdown(router, servers)
     lat_ok = sorted(d for d, ok, _ in outcomes if ok)
     q = (lambda p: round(lat_ok[min(len(lat_ok) - 1,
                                     int(p * len(lat_ok)))] * 1e3, 2)
          if lat_ok else None)
     print(json.dumps({
-        "metric": f"serve_federation_kill_one_{bucket.name}",
+        "metric": f"serve_federation_kill_one_{bucket.name}{suffix}",
         "value": round(sum(1 for _, ok, _ in outcomes if ok)
                        / max(1, len(outcomes)), 4),
         "unit": "terminal-OK fraction under 1-of-2 replica death",
@@ -999,10 +1043,11 @@ def _serve_federation(flags) -> None:
         "p50_ms": q(0.50), "p99_ms": q(0.99),
         "wall_s": round(wall, 3),
         "replicas_active_after": hz["active"],
+        **({"net": net} if net else {}),
     }))
 
     # Resubmit-hits-owner latency: the cached fast path behind the ring.
-    router = build(2)
+    router, servers = build(2)
     try:
         router.warmup(timeout=1800.0)
         a = mats[0]
@@ -1015,10 +1060,10 @@ def _serve_federation(flags) -> None:
             laps.append(time.perf_counter() - t0)
             assert res.path == "cache", res.path
     finally:
-        router.stop(drain=True, timeout=60.0)
+        shutdown(router, servers)
     laps.sort()
     print(json.dumps({
-        "metric": f"serve_federation_resubmit_hit_{bucket.name}",
+        "metric": f"serve_federation_resubmit_hit_{bucket.name}{suffix}",
         "value": round(laps[len(laps) // 2] * 1e3, 3),
         "unit": "ms p50 end-to-end (byte-identical resubmit, cache hit "
                 "on the ring owner)",

@@ -21,7 +21,7 @@ import time
 from pathlib import Path
 
 PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot",
-              "obs", "route", "grad", "perf", "conc")
+              "obs", "route", "grad", "perf", "conc", "net")
 
 
 def _parse_args(argv):
@@ -122,6 +122,13 @@ def main(argv=None) -> int:
             # acyclic.
             from . import concurrency
             findings, report = concurrency.run_all()
+            return findings, report
+        if name == "net":
+            # ROUTE001's wire-transport extension: a retried submit
+            # after a lost ACK admits exactly once on a live HTTP
+            # replica (idempotency keys + journal-proven exactly-once).
+            from . import route_checks
+            findings, report = route_checks.run_net()
             return findings, report
         if name == "grad":
             # The differentiable-solver contract (GRAD001): grad traces

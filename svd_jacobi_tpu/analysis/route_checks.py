@@ -257,6 +257,103 @@ def run_rescue_case() -> tuple:
     return findings, report
 
 
+def run_idempotency_case() -> tuple:
+    """Rule 3 — RPC IDEMPOTENCY (the ``net`` pass family): a retried
+    submit after a lost ACK admits EXACTLY once. A live in-process
+    `serve.transport.HttpReplicaServer` serves one request over the
+    wire; the identical record is then re-sent (the lost-ACK retry as
+    the client would replay it) and must come back
+    ``{"ok": true, "dup": true}`` — and the journal, read RAW (record
+    lines, not collapsed ids), must hold exactly ONE admit and ONE
+    finalize for the id. Returns (findings, report)."""
+    import json
+    import tempfile
+    import time
+    from pathlib import Path
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import SVDConfig
+    from ..serve import ServeConfig
+    from ..serve.cache import input_digest
+    from ..serve.transport import (WIRE_VERSION, HttpReplica,
+                                   HttpReplicaServer)
+    from ..utils import matgen
+
+    findings: List[Finding] = []
+    report: dict = {}
+    rid = "net-idem-0"
+    tmp = Path(tempfile.mkdtemp(prefix="route001-net-"))
+    cfg = ServeConfig(
+        buckets=((32, 32, "float32"),),
+        solver=SVDConfig(pair_solver="pallas"),
+        journal_path=str(tmp / "journal.jsonl"),
+        compute_digest=True, max_queue_depth=16,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    server = HttpReplicaServer(cfg).start()
+    try:
+        replica = HttpReplica(0, server.address, cfg.journal_path)
+        a = np.asarray(matgen.random_dense(32, 32, seed=3,
+                                           dtype=jnp.float32))
+        sub = replica.submit(a, request_id=rid, deadline_s=300.0,
+                             digest=input_digest(a))
+        # The lost-ACK retry: the same idempotency key again. The
+        # server dedupes BEFORE decoding (live bookkeeping + its
+        # write-ahead journal), so the payload may be elided.
+        dup = replica._rpc("submit", "/v1/submit", body={
+            "kind": "submit", "wire_version": WIRE_VERSION, "id": rid,
+            "t_wall": time.time(), "input": None})
+        report["dup_ack"] = dup
+        if not (dup.get("ok") and dup.get("dup")):
+            findings.append(Finding(
+                code=CODE, where="serve.transport.HttpReplicaServer",
+                message=f"retried submit of {rid!r} was not ACKed as a "
+                        f"duplicate (got {dup}) — a lost-ACK retry "
+                        f"would double-admit",
+                suggestion="dedupe submits against outstanding/results "
+                           "and the write-ahead journal"))
+        res = None
+        t0 = time.time()
+        while res is None and time.time() - t0 < 300:
+            res = sub.poll(0.1)
+        ok = (res is not None and res.error is None
+              and res.status is not None and res.status.name == "OK")
+        report["status"] = (None if res is None else
+                            (res.status.name if res.status else res.error))
+        if not ok:
+            findings.append(Finding(
+                code=CODE, where="route_checks.run_idempotency_case",
+                message=f"wire-served solve {rid!r} not OK "
+                        f"({report['status']})",
+                suggestion="fix the HTTP serving path first"))
+    finally:
+        server.stop(drain=True, timeout=60.0)
+    # Exactly-once, proven from the RAW journal stream: one admit
+    # record, one finalize record for the id (id-keyed scan views
+    # would collapse a double-admit silently).
+    kinds = {"admit": 0, "finalize": 0}
+    for line in Path(cfg.journal_path).read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("id") == rid and rec.get("kind") in kinds:
+            kinds[rec["kind"]] += 1
+    report["journal_records"] = dict(kinds)
+    for kind, n in kinds.items():
+        if n != 1:
+            findings.append(Finding(
+                code=CODE, where="serve.transport.HttpReplicaServer",
+                message=f"journal holds {n} {kind} record(s) for "
+                        f"{rid!r} after a retried submit — exactly-once "
+                        f"is broken at the wire seam",
+                suggestion="the receiver must admit each idempotency "
+                           "key at most once (journal write-ahead + "
+                           "rid dedupe)"))
+    return findings, report
+
+
 def run_all(seed_skew: bool = False) -> tuple:
     """The whole ROUTE001 pass. Returns (findings, report)."""
     findings = check_ring_determinism(seed_skew=seed_skew)
@@ -266,3 +363,10 @@ def run_all(seed_skew: bool = False) -> tuple:
     findings += rescue_findings
     report["rescue"] = rescue_report
     return findings, report
+
+
+def run_net() -> tuple:
+    """The ``net`` pass family: ROUTE001's wire-transport extension
+    (RPC idempotency over a live HTTP replica)."""
+    findings, report = run_idempotency_case()
+    return findings, {"idempotency": report}

@@ -243,6 +243,21 @@ def _parse_serve_args(argv):
                         "its own journal; consistent-hash routing, "
                         "replica-death journal rescue). 1 = a plain "
                         "single service (default)")
+    p.add_argument("--transport", default="local",
+                   choices=["local", "http"],
+                   help="replica transport with --replicas > 1: 'local' "
+                        "= in-process handles (default); 'http' = every "
+                        "replica behind the versioned HTTP wire protocol "
+                        "(serve.transport) — RPC retries with jittered "
+                        "backoff, deadline-budget decay, idempotency "
+                        "keys, leases and fencing tokens, end to end")
+    p.add_argument("--net-chaos", action="store_true",
+                   help="with --transport=http: put each replica behind "
+                        "a fault-injecting TCP proxy "
+                        "(resilience.netfault) with one dropped and one "
+                        "delayed request armed per replica — the demo "
+                        "must still close every request (retries + "
+                        "idempotency absorb the chaos)")
     p.add_argument("--lanes", type=int, default=1,
                    help="solve lanes (fleet mode when > 1): one worker "
                         "per lane, per-lane fault domains with bucket-"
@@ -371,6 +386,8 @@ def _serve_demo_run(args, lock_graph=None) -> int:
                       journal_path=args.journal,
                       compile_cache_dir=args.compile_cache)
     replicas = max(1, args.replicas)
+    http_servers = []      # --transport=http: in-process replica servers
+    http_proxies = []      # --net-chaos: fault proxies on the wire
     if replicas > 1:
         # Federated mode: N in-process service replicas behind the
         # consistent-hash router, each with its OWN journal under the
@@ -393,11 +410,48 @@ def _serve_demo_run(args, lock_graph=None) -> int:
         state_dir = (Path(args.report_dir) / "router-state"
                      if args.report_dir != "off"
                      else Path(tempfile.mkdtemp(prefix="svdj-router-")))
-        svc = ReplicaRouter(RouterConfig(
-            replicas=replicas, serve=cfg,
-            state_dir=str(state_dir),
-            manifest_path=manifest_path))
+        rcfg = RouterConfig(replicas=replicas, serve=cfg,
+                            state_dir=str(state_dir),
+                            manifest_path=manifest_path)
+        if args.transport == "http":
+            # Federation over the wire: every replica is a live
+            # in-process HTTP server (its own journal + fence token
+            # under the state dir) and the router only ever talks to it
+            # through `HttpReplica` RPCs — optionally through the
+            # fault-injecting proxy (--net-chaos).
+            import dataclasses as _dc
+            from svd_jacobi_tpu.serve.transport import (HttpReplica,
+                                                        HttpReplicaServer)
+            handles = []
+            for i in range(replicas):
+                rdir = Path(state_dir) / f"replica-{i}"
+                rc = _dc.replace(
+                    cfg, journal_path=str(rdir / "journal.jsonl"),
+                    compute_digest=True, manifest_path=manifest_path)
+                server = HttpReplicaServer(rc).start()
+                http_servers.append(server)
+                addr = server.address
+                if args.net_chaos:
+                    from svd_jacobi_tpu.resilience.netfault import \
+                        FaultyProxy
+                    proxy = FaultyProxy(addr).start()
+                    proxy.arm("drop", shots=1)
+                    proxy.arm("delay", shots=1, value=0.2)
+                    http_proxies.append(proxy)
+                    addr = proxy.address
+                handles.append(HttpReplica(
+                    i, addr, rc.journal_path,
+                    manifest_path=manifest_path))
+            svc = ReplicaRouter(rcfg, replicas=handles)
+        else:
+            if args.net_chaos:
+                raise SystemExit("--net-chaos needs --transport=http "
+                                 "(the fault proxy sits on the wire)")
+            svc = ReplicaRouter(rcfg)
     else:
+        if args.transport == "http" or args.net_chaos:
+            raise SystemExit("--transport=http needs --replicas > 1 "
+                             "(the wire protocol federates replicas)")
         svc = SVDService(cfg)
 
     if args.drill_resume:
@@ -503,6 +557,10 @@ def _serve_demo_run(args, lock_graph=None) -> int:
         th.join(timeout=900.0)
     health = svc.healthz()   # live snapshot, BEFORE the shutdown flips it
     svc.stop(drain=True, timeout=60.0)
+    for server in http_servers:
+        server.stop(drain=True, timeout=30.0)
+    for proxy in http_proxies:
+        proxy.stop()
     wall = time.perf_counter() - t0
 
     by_status = {}
@@ -528,12 +586,23 @@ def _serve_demo_run(args, lock_graph=None) -> int:
     if replicas > 1:
         summary["replicas"] = replicas
         summary["rescues"] = svc.total_rescues
+        summary["transport"] = args.transport
+        if http_servers:
+            # Per-replica net-discipline stats (retries, failovers,
+            # quarantines — the same families the manifest records).
+            summary["net"] = {r.index: dict(r.net_stats)
+                              for r in svc.replicas}
+        if http_proxies:
+            summary["net_chaos"] = {
+                "stats": [dict(p.stats) for p in http_proxies],
+                "unconsumed": [p.unconsumed() for p in http_proxies]}
     if warmup_s is not None:
         summary["warmup_s"] = warmup_s
         all_records = list(svc.records())
         if replicas > 1:
             for rep in svc.replicas:
-                all_records += rep.service.records()
+                if hasattr(rep, "service"):     # local handles only
+                    all_records += rep.service.records()
         cold = [r for r in all_records if r.get("kind") == "coldstart"]
         if cold:
             summary["coldstart"] = {

@@ -515,6 +515,16 @@ LOCK_ORDER = {
     "manifest_guard": ("obs/manifest.py", "_APPEND_LOCKS_GUARD", "obs"),
     "manifest_path": ("obs/manifest.py", "_append_lock.lock", "obs"),
     "chaos": ("resilience/chaos.py", "_lock", "obs"),
+    # HTTP transport bookkeeping: both sides are leaf-adjacent — the
+    # server lock guards only the outstanding/result dicts (never held
+    # across a service call or journal I/O), the client lock guards the
+    # breaker/lease counters (never held across a network round trip).
+    "transport_server": ("serve/transport.py",
+                         "HttpReplicaServer._lock", "cache"),
+    "transport_client": ("serve/transport.py", "HttpReplica._lock",
+                         "cache"),
+    # Fault-proxy counters: a pure leaf (armed-shot/stat bookkeeping).
+    "netfault": ("resilience/netfault.py", "FaultyProxy._lock", "obs"),
     "cli_out": ("cli.py", "_serve_demo_run.out_lock", "obs"),
     # The CONC002 sanitizer's own edge-graph lock: a leaf by
     # construction (never held while acquiring anything else).

@@ -120,6 +120,23 @@ _ROUTER_SCHEMA: Dict[str, Any] = {
                                   # probe | healthz
     "replica": (int, type(None)),
 }
+# Network-transport events ("net", written by serve.transport's
+# HttpReplica client and HttpReplicaServer): one record per RPC retry /
+# timeout / failover / lease grant-or-expiry / fence / fence refusal /
+# quarantine transition / partition heal, so a multi-HOST deployment's
+# whole unreliable-network history — who retried what, which lease
+# lapsed, which fencing token refused whose stale finalization —
+# reconstructs from the same manifest stream as everything else
+# (`obs.registry.registry_from_manifest` rebuilds the svdj_rpc_*
+# counters from exactly these records). ``replica`` is None for
+# transport-wide events.
+_NET_SCHEMA: Dict[str, Any] = {
+    "event": str,                 # rpc_retry | rpc_timeout | rpc_error |
+                                  # failover | lease_grant |
+                                  # lease_expired | fence | fence_refused
+                                  # | quarantine | heal | partition_heal
+    "replica": (int, type(None)),
+}
 # Autotuner search records ("tune", written by tune.search per searched
 # shape): the full measured grid — baseline knobs/time, every candidate
 # point's knobs/time/ok, and the winning knob set — plus the id/hash of
@@ -515,6 +532,36 @@ def build_router(*, event: str, replica: Optional[int] = None,
     return record
 
 
+def build_net(*, event: str, replica: Optional[int] = None,
+              **extra) -> dict:
+    """Assemble a schema-valid network-transport event record
+    (`serve.transport`).
+
+    ``event`` enumerates the unreliable-network happenings worth
+    reconstructing: ``rpc_retry`` (``op``/``attempt``/``delay_s``),
+    ``rpc_timeout`` / ``rpc_error`` (``op``/``error`` — a budget- or
+    attempt-exhausted RPC), ``failover`` (``op``/``from_replica`` — the
+    ring walked past an unreachable host), ``lease_grant`` /
+    ``lease_expired`` (``token``/``ttl_s``), ``fence`` (a fencing token
+    bump or delivery, ``token``), ``fence_refused`` (a stale token
+    refused, ``token``/``held_token``), ``quarantine`` / ``heal`` (the
+    half-open connection breaker's transitions), and ``partition_heal``
+    (a quarantined host answered again). ``replica`` is the subject
+    replica's index, or None for transport-wide events. ``extra`` rides
+    along like in `build`."""
+    record = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "net",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "environment": environment(),
+        "event": str(event),
+        "replica": None if replica is None else int(replica),
+    }
+    record.update(extra)
+    validate(record)
+    return record
+
+
 def build_perf(*, source: str, workload: dict, device: dict,
                scopes: List[dict], unscoped_s: float = 0.0,
                unattributed_s: float = 0.0,
@@ -620,6 +667,10 @@ def _validate_cache(record: dict, errors: List[str]) -> None:
 
 def _validate_router(record: dict, errors: List[str]) -> None:
     _check_fields(record, _ROUTER_SCHEMA, "record", errors)
+
+
+def _validate_net(record: dict, errors: List[str]) -> None:
+    _check_fields(record, _NET_SCHEMA, "record", errors)
 
 
 def _validate_perf(record: dict, errors: List[str]) -> None:
@@ -943,6 +994,26 @@ def _summarize_router(record: dict) -> str:
     return line
 
 
+def _summarize_net(record: dict) -> str:
+    rep = record.get("replica")
+    line = (f"net {record.get('event', '?')} @ "
+            f"{record.get('timestamp', '?')}"
+            + (f"  replica={rep}" if rep is not None else ""))
+    if record.get("op") is not None:
+        line += f"  op={record['op']}"
+    if record.get("attempt") is not None:
+        line += f"  attempt={record['attempt']}"
+    if record.get("token") is not None:
+        line += f"  token={record['token']}"
+        if record.get("held_token") is not None:
+            line += f"<held {record['held_token']}"
+    if record.get("ttl_s") is not None:
+        line += f"  ttl={record['ttl_s']}s"
+    if record.get("error"):
+        line += f"\n  error: {record['error']}"
+    return line
+
+
 def _summarize_cache(record: dict) -> str:
     line = (f"cache {record.get('store', '?')}/{record.get('event', '?')}"
             f" @ {record.get('timestamp', '?')}")
@@ -1131,6 +1202,7 @@ for _name, _builder, _validator, _summarizer in (
         ("tune", build_tune, _validate_tune, _summarize_tune),
         ("fleet", build_fleet, _validate_fleet, _summarize_fleet),
         ("router", build_router, _validate_router, _summarize_router),
+        ("net", build_net, _validate_net, _summarize_net),
         ("cache", build_cache, _validate_cache, _summarize_cache),
         ("coldstart", build_coldstart, _validate_coldstart,
          _summarize_coldstart),

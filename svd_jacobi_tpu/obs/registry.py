@@ -563,6 +563,39 @@ def registry_from_manifest(records: List[dict]) -> MetricsRegistry:
                         ok=str(bool(rec.get("ok"))).lower(),
                         replica=rep_l,
                         help="quarantined-replica probes")
+        elif kind == "net":
+            event = str(rec.get("event", "?"))
+            rep = rec.get("replica")
+            rep_l = "" if rep is None else str(rep)
+            op = str(rec.get("op", "") or "")
+            if event == "rpc_retry":
+                reg.inc("svdj_rpc_retries_total", op=op, replica=rep_l,
+                        help="replica RPC attempts retried after a "
+                             "transport error")
+            elif event in ("rpc_timeout", "rpc_error"):
+                reg.inc("svdj_rpc_failures_total", op=op, replica=rep_l,
+                        cause=("timeout" if event == "rpc_timeout"
+                               else "error"),
+                        help="replica RPCs exhausted (deadline budget "
+                             "or attempt cap)")
+            elif event == "failover":
+                reg.inc("svdj_rpc_failovers_total", op=op,
+                        replica=rep_l,
+                        help="submits failed over past an unreachable "
+                             "host in ring order")
+            elif event in ("lease_grant", "lease_expired"):
+                reg.inc("svdj_replica_leases_total", replica=rep_l,
+                        event=event,
+                        help="replica lease grants and expiries")
+            elif event in ("fence", "fence_refused"):
+                reg.inc("svdj_fence_events_total", replica=rep_l,
+                        event=event,
+                        help="fencing-token bumps/deliveries and stale-"
+                             "token refusals")
+            elif event in ("quarantine", "heal", "partition_heal"):
+                reg.inc("svdj_connection_quarantine_total",
+                        replica=rep_l, event=event,
+                        help="half-open connection breaker transitions")
         elif kind == "cache":
             reg.inc("svdj_cache_events_total",
                     store=str(rec.get("store", "?")),

@@ -462,3 +462,24 @@ def corrupt_checkpoint(path, mode: str = "truncate") -> Path:
         raise ValueError(f"unknown corruption mode {mode!r}")
     path.write_bytes(bytes(data))
     return path
+
+
+def net_proxy(upstream, **faults):
+    """Convenience handle on the network-chaos lane: a started
+    `resilience.netfault.FaultyProxy` in front of ``upstream`` with
+    ``faults`` pre-armed (kind -> shots, e.g. ``drop=2``; pass
+    ``delay=(shots, seconds)`` for valued faults). The caller owns
+    `stop()` — use it as a context manager::
+
+        with chaos.net_proxy(server.address, drop=1) as proxy:
+            replica = HttpReplica(0, proxy.address, journal_path)
+    """
+    from .netfault import FaultyProxy
+    proxy = FaultyProxy(upstream)
+    for kind, spec in faults.items():
+        if isinstance(spec, tuple):
+            shots, value = spec
+            proxy.arm(kind, shots=int(shots), value=float(value))
+        else:
+            proxy.arm(kind, shots=int(spec))
+    return proxy

@@ -1,0 +1,259 @@
+"""Fault-injecting in-process TCP proxy: the network-chaos twin of
+`resilience.chaos` (which injects faults INSIDE a replica; this module
+injects them BETWEEN replicas).
+
+`FaultyProxy` sits between an `HttpReplica` client and an
+`HttpReplicaServer` (or any TCP upstream) on a loopback port and
+applies armed faults per FORWARDED REQUEST, following the chaos
+module's armed-shot discipline — a test arms N shots of one fault kind,
+the proxy consumes them deterministically, unconsumed shots are a test
+bug the drill can assert on:
+
+  * ``drop``       — accept the connection, read the request, close
+                     without forwarding (the submit never happened;
+                     the client sees a reset -> retry -> idempotency);
+  * ``delay``      — forward after sleeping ``delay_s`` (timeout /
+                     deadline-budget pressure);
+  * ``duplicate``  — forward the SAME request to the upstream twice,
+                     return the first response (at-least-once delivery;
+                     the receiver's dedupe must make it exactly-once);
+  * ``blackhole_reply`` — forward the request, swallow the upstream's
+                     response, close (the LOST-ACK case: the work
+                     happened, the client cannot know);
+  * ``partition``  — while engaged (`partition()` / `heal()`), every
+                     connection is accepted and dropped without
+                     forwarding: a full bidirectional partition. Not
+                     shot-counted — it is a STATE, flipped by the test
+                     (``flap`` = partition for a duration).
+
+Single-connection HTTP only (the stdlib client sends
+``Connection: close``), which keeps "one connection == one request ==
+one fault decision" exact."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_FAULT_KINDS = ("drop", "delay", "duplicate", "blackhole_reply")
+
+
+def _read_http_request(conn: socket.socket,
+                       timeout: float = 5.0) -> bytes:
+    """Read ONE full HTTP request (headers + Content-Length body) off
+    the connection; empty bytes when the client vanished first."""
+    conn.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = conn.recv(65536)
+        if not chunk:
+            return b""
+        buf = buf + chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n")[1:]:
+        name, _, val = line.partition(b":")
+        if name.strip().lower() == b"content-length":
+            try:
+                length = int(val.strip())
+            except ValueError:
+                length = 0
+    while len(rest) < length:
+        chunk = conn.recv(65536)
+        if not chunk:
+            break
+        rest = rest + chunk
+    return head + b"\r\n\r\n" + rest
+
+
+class FaultyProxy:
+    """See module docstring. ``upstream`` is ``(host, port)``; the
+    proxy listens on an ephemeral loopback port (`address`). Faults are
+    armed per kind with shot counters (`arm`); `partition()` is a state
+    toggle; `stats` counts what actually happened."""
+
+    def __init__(self, upstream: Tuple[str, int],
+                 host: str = "127.0.0.1"):
+        self.upstream = (str(upstream[0]), int(upstream[1]))
+        self._lock = threading.Lock()
+        self._armed: Dict[str, dict] = {}       # kind -> {shots, value}
+        self._partitioned = False
+        self.stats: Dict[str, int] = {"forwarded": 0}
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(64)
+        self.address: Tuple[str, int] = self._listener.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._flap_threads: List[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FaultyProxy":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="svdj-netfault",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(2.0)
+        for t in self._flap_threads:
+            t.join(2.0)
+
+    def __enter__(self) -> "FaultyProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- fault arming -------------------------------------------------------
+
+    def arm(self, kind: str, shots: int = 1,
+            value: float = 0.0) -> None:
+        """Arm ``shots`` shots of one fault kind (``value`` is the
+        delay for ``delay``). Unknown kinds are a loud test bug."""
+        if kind not in _FAULT_KINDS:
+            raise ValueError(f"unknown net fault {kind!r} "
+                             f"(one of {_FAULT_KINDS})")
+        with self._lock:
+            self._armed[kind] = {"shots": int(shots),
+                                 "value": float(value)}
+
+    def unconsumed(self) -> Dict[str, int]:
+        """Remaining armed shots per kind (a drill asserting {} proves
+        every armed fault actually fired)."""
+        with self._lock:
+            return {k: v["shots"] for k, v in self._armed.items()
+                    if v["shots"] > 0}
+
+    def _consume(self) -> Optional[Tuple[str, float]]:
+        """Consume at most ONE armed fault for this request, in
+        deterministic kind order."""
+        with self._lock:
+            for kind in _FAULT_KINDS:
+                slot = self._armed.get(kind)
+                if slot is not None and slot["shots"] > 0:
+                    slot["shots"] -= 1
+                    return kind, slot["value"]
+        return None
+
+    # -- partition state ----------------------------------------------------
+
+    def partition(self) -> None:
+        """Engage a full bidirectional partition (every connection is
+        dropped without forwarding) until `heal`."""
+        with self._lock:
+            self._partitioned = True
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitioned = False
+
+    def partitioned(self) -> bool:
+        with self._lock:
+            return self._partitioned
+
+    def flap(self, down_s: float) -> threading.Thread:
+        """Partition NOW, heal after ``down_s`` — the mid-rescue flap
+        drill. Returns the healing thread (joinable)."""
+        self.partition()
+        t = threading.Thread(
+            target=lambda: (time.sleep(down_s), self.heal()),
+            name="svdj-netfault-flap", daemon=True)
+        t.start()
+        self._flap_threads.append(t)
+        return t
+
+    # -- the proxy loop -----------------------------------------------------
+
+    def _bump(self, key: str) -> None:
+        with self._lock:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return      # listener closed
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _forward_once(self, request: bytes) -> bytes:
+        """One upstream exchange: connect, send, read the full response
+        until the upstream closes (the server replies Connection:
+        close per the stdlib client's request header)."""
+        up = socket.create_connection(self.upstream, timeout=10.0)
+        try:
+            up.sendall(request)
+            up.settimeout(10.0)
+            resp = b""
+            while True:
+                chunk = up.recv(65536)
+                if not chunk:
+                    return resp
+                resp = resp + chunk
+        finally:
+            up.close()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            if self.partitioned():
+                # Full partition: read nothing, forward nothing. The
+                # abrupt close is what a blackholed SYN looks like to a
+                # short-timeout client: connection error.
+                self._bump("partition_dropped")
+                return
+            request = _read_http_request(conn)
+            if not request:
+                return
+            fault = self._consume()
+            if fault is not None:
+                kind, value = fault
+                self._bump(kind)
+                if kind == "drop":
+                    return      # request read, never forwarded
+                if kind == "delay":
+                    time.sleep(value)
+                if kind == "duplicate":
+                    # At-least-once delivery: the upstream sees the
+                    # SAME request twice; the client sees one reply.
+                    first = self._forward_once(request)
+                    try:
+                        self._forward_once(request)
+                    except OSError:
+                        pass
+                    conn.sendall(first)
+                    self._bump("forwarded")
+                    return
+                if kind == "blackhole_reply":
+                    # The LOST ACK: the work happens upstream, the
+                    # reply dies here.
+                    try:
+                        self._forward_once(request)
+                    except OSError:
+                        pass
+                    return
+            resp = self._forward_once(request)
+            if resp:
+                conn.sendall(resp)
+                self._bump("forwarded")
+        except OSError:
+            self._bump("proxy_errors")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

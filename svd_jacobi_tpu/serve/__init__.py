@@ -40,6 +40,17 @@ The request-level robustness layer (PR 4) on top of the solve-level one
     probe recovery, one shared persistent compile-cache namespace
     (replica 2 warm-boots with zero fresh compiles), and ``"router"``
     manifest records — README "Federated serving";
+  * multi-host HTTP transport (`transport`): the federation over an
+    UNRELIABLE network — a versioned JSON wire protocol mapping 1:1
+    onto the Ticket lifecycle, per-RPC timeouts with deadline-budget
+    decay across hops, bounded decorrelated-jitter retries, idempotency
+    keys (retried submits after a lost ACK admit exactly once), replica
+    leases with monotonic FENCING tokens (`bump_fence_token` /
+    `StaleFenceError`) so a partitioned-but-alive replica can never
+    double-serve rescued debt, half-open connection quarantine, and
+    partition-healed reconciliation — chaos-tested against the
+    fault-injecting proxy (`resilience.netfault`), README "Federated
+    serving: multi-host HTTP transport";
   * two-phase σ-first serving + content-addressed result cache
     (`cache`): ``submit(phase="sigma")`` returns σ at interactive
     latency with the solve's checkpointed stage retained under a byte
@@ -68,7 +79,8 @@ from .breaker import BreakerState, Brownout, CircuitBreaker
 from .buckets import Bucket, BucketSet, as_bucket
 from .cache import PromotionError, PromotionStore, ResultCache, input_digest
 from .fleet import Fleet, Lane, LaneState
-from .journal import Journal, JournalLockedError
+from .journal import (Journal, JournalLockedError, StaleFenceError,
+                      bump_fence_token, fence_token_path, read_fence_token)
 from .queue import AdmissionError, AdmissionQueue, AdmissionReason, Request
 from .registry import (CompileCounter, EntryKey, EntryRegistry,
                        enable_persistent_cache, jit_entries)
@@ -76,15 +88,20 @@ from .router import (HashRing, LocalReplica, ReplicaRouter, ReplicaState,
                      RouterConfig, RouterTicket, SpoolReplica,
                      run_spool_replica)
 from .service import ServeConfig, ServeResult, SVDService, Ticket
+from .transport import (HttpReplica, HttpReplicaServer, TransportError,
+                        run_http_replica)
 
 __all__ = [
     "AdmissionError", "AdmissionQueue", "AdmissionReason", "Bucket",
     "BucketSet", "BreakerState", "Brownout", "CircuitBreaker",
     "CompileCounter", "EntryKey", "EntryRegistry", "Fleet", "HashRing",
+    "HttpReplica", "HttpReplicaServer",
     "Journal", "JournalLockedError", "Lane", "LaneState", "LocalReplica",
     "PromotionError", "PromotionStore", "ReplicaRouter", "ReplicaState",
     "Request", "ResultCache", "RouterConfig", "RouterTicket",
-    "ServeConfig", "ServeResult", "SpoolReplica", "SVDService", "Ticket",
-    "as_bucket", "enable_persistent_cache", "input_digest", "jit_entries",
-    "run_spool_replica",
+    "ServeConfig", "ServeResult", "SpoolReplica", "StaleFenceError",
+    "SVDService", "Ticket", "TransportError",
+    "as_bucket", "bump_fence_token", "enable_persistent_cache",
+    "fence_token_path", "input_digest", "jit_entries", "read_fence_token",
+    "run_http_replica", "run_spool_replica",
 ]

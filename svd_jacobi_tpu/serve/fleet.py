@@ -86,7 +86,8 @@ class LaneState(enum.Enum):
 
 def heartbeat_stale(now: float, heartbeat: float, *, busy: bool,
                     holds_work: bool, idle_timeout_s: float,
-                    busy_timeout_s: float) -> bool:
+                    busy_timeout_s: float,
+                    lease_until: Optional[float] = None) -> bool:
     """The two-tier heartbeat-staleness verdict, shared by the lane
     supervisor (`Fleet._tick`) and the replica router's supervisor one
     fault-domain up (`serve.router`): while ``busy`` (blocked inside a
@@ -95,7 +96,19 @@ def heartbeat_stale(now: float, heartbeat: float, *, busy: bool,
     staleness only matters while the subject HOLDS work — there is
     nothing to rescue off an idle one, and a loaded host can starve an
     idle poll loop past the timeout without anything being wrong
-    (evicting it would just churn the fleet)."""
+    (evicting it would just churn the fleet).
+
+    ``lease_until`` adds the NETWORK ring's lease semantics
+    (serve.transport): an unexpired lease is a liveness PROMISE the
+    subject earned by answering a recent health RPC — while it holds,
+    heartbeat age is never staleness (a transient RPC hiccup inside the
+    lease window must not evict a healthy remote replica). Once the
+    lease expires the ordinary two-tier verdict resumes: the subject is
+    then "partitioned or dead", and for a remote replica those are
+    indistinguishable by construction — the FENCING token (not this
+    verdict) is what makes acting on the distinction safe."""
+    if lease_until is not None and now < lease_until:
+        return False
     if not holds_work:
         return False
     return now - heartbeat > (busy_timeout_s if busy else idle_timeout_s)

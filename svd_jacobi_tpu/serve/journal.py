@@ -79,6 +79,15 @@ class JournalLockedError(RuntimeError):
     declared dead (`Journal.break_lock`)."""
 
 
+class StaleFenceError(RuntimeError):
+    """A rescue hand-off carried a fencing token OLDER than one the
+    receiver already accepted for the same fault domain: the sender is
+    a partitioned/raced rescuer acting on a view of the world that a
+    newer rescue has already superseded. Refused LOUDLY (plus a
+    ``fence_refused`` journal audit record) — admitting it would
+    double-serve debt the newer rescue owns."""
+
+
 def host_boot_id() -> str:
     """This host's boot identity: a pid is only meaningful within one
     boot (pids restart from scratch after a reboot, so a stale lock's
@@ -87,6 +96,18 @@ def host_boot_id() -> str:
         return Path("/proc/sys/kernel/random/boot_id").read_text().strip()
     except OSError:
         return "boot-unknown"
+
+
+def host_identity() -> str:
+    """This host's name, for the lockfile's cross-host ownership check:
+    on a SHARED filesystem (the multi-host federation's deployment
+    model) a lock minted on another machine carries a pid + boot id
+    that mean nothing here — `os.kill(pid, 0)` would probe an unrelated
+    local process and the boot id would always look "rebooted". The
+    host name is what lets `_acquire_lock` refuse to auto-break remote
+    locks instead of silently treating every remote owner as dead."""
+    import platform
+    return platform.node() or "host-unknown"
 
 
 def _pid_alive(pid: int) -> bool:
@@ -99,6 +120,16 @@ def _pid_alive(pid: int) -> bool:
     except OSError:
         return False
     return True
+
+
+def _lock_is_remote(owner: dict) -> bool:
+    """True when a lockfile payload names ANOTHER machine as its minter.
+    Pre-host-field lockfiles (older writers) have no host claim and keep
+    the original same-host treatment — the cross-host refusal only
+    applies where the lockfile can actually prove remoteness."""
+    owner_host = owner.get("host")
+    return (isinstance(owner_host, str)
+            and owner_host != host_identity())
 
 
 class JournalState(NamedTuple):
@@ -239,6 +270,7 @@ class Journal:
         lane must not need an operator to rm a lockfile."""
         payload = json.dumps({
             "pid": os.getpid(), "boot_id": host_boot_id(),
+            "host": host_identity(),
             "token": secrets.token_hex(8), "t_wall": time.time(),
             "path": str(self.path)}, sort_keys=True)
         self._lock_path.parent.mkdir(parents=True, exist_ok=True)
@@ -256,6 +288,20 @@ class Journal:
             except FileExistsError:
                 owner = self._read_lock_owner()
                 pid = owner.get("pid")
+                if _lock_is_remote(owner):
+                    # Minted on ANOTHER machine (shared filesystem): the
+                    # pid/boot-id liveness probe below is only valid on
+                    # the lock-holder's host — a remote owner can never
+                    # be proven dead from here, so auto-breaking would
+                    # silently steal a LIVE remote replica's journal.
+                    raise JournalLockedError(
+                        f"journal {self.path} is exclusively locked by "
+                        f"host {owner.get('host')!r} (pid {pid}, locked "
+                        f"at {owner.get('t_wall')}) — liveness cannot be "
+                        f"probed across machines. If that host is truly "
+                        f"gone, fence the fault domain and break the "
+                        f"lock explicitly: Journal.break_lock(path, "
+                        f"force=True)")
                 alive = (owner.get("boot_id") == host_boot_id()
                          and isinstance(pid, int) and _pid_alive(pid))
                 if alive:
@@ -295,14 +341,34 @@ class Journal:
                 pass
 
     @classmethod
-    def break_lock(cls, path) -> bool:
+    def break_lock(cls, path, *, force: bool = False) -> bool:
         """FORCE-remove a journal path's lockfile — the rescue path's
         explicit override, legitimate only once the lock's owner has
         been declared dead by a supervisor (the owner's pid may still be
         alive when the 'replica' was an in-process handle, which is why
-        this cannot be the automatic dead-pid lane). Returns True when a
+        this cannot be the automatic dead-pid lane). A lock minted on
+        ANOTHER machine (shared filesystem) additionally requires
+        ``force=True``: no local supervisor can have probed a remote
+        owner's liveness, so breaking it is only legitimate on the
+        FENCED cross-machine rescue path (the fencing token was bumped
+        first — `bump_fence_token` — so even a live remote owner can no
+        longer finalize against this journal). Returns True when a
         lockfile existed."""
         lock = Path(str(Path(path)) + ".lock")
+        if not force:
+            try:
+                owner = json.loads(lock.read_text())
+            except (OSError, json.JSONDecodeError):
+                owner = {}
+            if _lock_is_remote(owner):
+                raise JournalLockedError(
+                    f"journal {path}: refusing to break a lock minted by "
+                    f"remote host {owner.get('host')!r} (pid "
+                    f"{owner.get('pid')}) — its liveness cannot be "
+                    f"probed from {host_identity()!r}. Bump the fence "
+                    f"token for this fault domain first, then break "
+                    f"with force=True (the fenced cross-machine rescue "
+                    f"path does exactly this)")
         try:
             lock.unlink()
             return True
@@ -382,6 +448,20 @@ class Journal:
                 "seq": next(self._seq), "id": str(request_id),
                 "t_wall": time.time(), "status": str(status)})
 
+    def append_audit(self, kind: str, **fields) -> float:
+        """Append one AUDIT record (e.g. ``fence_refused`` — a stale
+        fencing token loudly refused, the split-brain forensics trail).
+        Audit kinds are deliberately outside the admit/dispatch/finalize
+        lifecycle: `scan` ignores unknown kinds, so audit records ride
+        the same fsync'd stream without perturbing replay — an old
+        reader sees them as no-ops, a forensics pass reads them raw."""
+        rec = {"journal_version": JOURNAL_VERSION, "kind": str(kind),
+               "t_wall": time.time(), "host": host_identity()}
+        rec.update(fields)
+        with self._lock:
+            rec["seq"] = next(self._seq)
+            return self._timed_append(rec)
+
     # -- readers ------------------------------------------------------------
 
     def scan(self, *, quarantine: bool = True) -> JournalState:
@@ -444,3 +524,57 @@ class Journal:
                 pass  # some filesystems reject directory fsync; best-effort
             # Fresh sequence numbers follow the rewritten prefix.
             self._seq = itertools.count(len(admit_records))
+
+
+# -- fencing tokens (cross-machine rescue, serve.transport) -------------------
+#
+# One monotonically increasing integer PER FAULT DOMAIN (per journal
+# path), persisted in ``<journal>.fence`` next to the journal on the
+# shared filesystem. A rescuer bumps it BEFORE stealing the domain's
+# journal; every debt hand-off carries the bumped token and every
+# replica remembers the token it booted under — a partitioned-but-alive
+# replica that comes back sees a higher token on disk and must refuse
+# to finalize anything (loudly, `append_audit("fence_refused")`), which
+# is what makes cross-machine rescue exactly-once even when "dead" was
+# really "partitioned". Plain read-modify-write + atomic rename: two
+# RACING rescuers may mint the same token, and the receiving service's
+# ledger (`SVDService.admit_journal_debt`) treats an equal token's
+# duplicate request ids as idempotent replays — either interleaving
+# admits each request exactly once.
+
+
+def fence_token_path(journal_path) -> Path:
+    return Path(str(Path(journal_path)) + ".fence")
+
+
+def read_fence_token(journal_path) -> int:
+    """The fault domain's current fencing token (0 = never fenced)."""
+    try:
+        payload = json.loads(fence_token_path(journal_path).read_text())
+        return int(payload.get("token", 0))
+    except (OSError, ValueError, TypeError, json.JSONDecodeError):
+        return 0
+
+
+def bump_fence_token(journal_path, *, minted_by: str = "rescue") -> int:
+    """Advance the fault domain's fencing token (atomic rename + fsync,
+    the `utils.checkpoint` discipline) and return the new value. Called
+    by a rescuer BEFORE it breaks the domain's journal lock: from this
+    instant, any replica still bound to the old token is fenced out of
+    finalizing against this journal."""
+    path = fence_token_path(journal_path)
+    token = read_fence_token(journal_path) + 1
+    payload = json.dumps({
+        "token": token, "t_wall": time.time(),
+        "minted_by": str(minted_by), "host": host_identity()},
+        sort_keys=True)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + f".tmp-{os.getpid()}")
+    fd = os.open(str(tmp), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        os.write(fd, payload.encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    return token

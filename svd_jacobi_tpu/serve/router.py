@@ -350,6 +350,13 @@ class RouterTicket:
         self._result: Optional[ServeResult] = None
         self._lock = threading.Lock()
         self._binding: Optional[tuple] = None   # (replica, sub)
+        # Hard wall-clock bound (requests WITH a deadline only): past
+        # ``_deadline_wall + _grace_s`` the client self-serves DEADLINE
+        # instead of polling a blackholed replica forever — under a
+        # network partition no outbox file / RPC reply may EVER come,
+        # and the client's liveness must not depend on one.
+        self._deadline_wall: Optional[float] = None
+        self._grace_s: float = 15.0
 
     def _bind(self, replica, sub) -> None:
         with self._lock:
@@ -385,12 +392,32 @@ class RouterTicket:
         if binding is not None:
             binding[1].cancel()
 
+    def _past_wall(self) -> bool:
+        return (self._deadline_wall is not None
+                and time.time() > self._deadline_wall + self._grace_s)
+
+    def _serve_wall_deadline(self) -> None:
+        """Self-serve the DEADLINE verdict: the request's wall-clock
+        budget (plus the rescue grace) is spent and the bound replica
+        may be blackholed — a partition must degrade to a LOUD deadline,
+        never to a client hung on a reply that cannot come."""
+        from ..solver import SolveStatus
+        self._resolve_once(ServeResult(
+            u=None, s=None, v=None, status=SolveStatus.DEADLINE,
+            error=None, sweeps=0, bucket=self.bucket,
+            queue_wait_s=0.0, solve_time_s=None,
+            path="client_deadline", degraded=True,
+            request_id=self.request_id), None)
+
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         while True:
             if self._done.is_set():
                 return self._result
+            if self._past_wall():
+                self._serve_wall_deadline()
+                continue
             with self._lock:
                 binding = self._binding
             slice_s = 0.05
@@ -419,6 +446,12 @@ class ReplicaHandle:
     behind an atomic-rename file spool)."""
 
     kind = "?"
+    # Whether a FINALIZED result outlives the replica's death: a spool
+    # outbox file or an in-process Ticket does, an HTTP replica's
+    # in-memory result window does not — the rescue resolves such
+    # finalized-but-unfetched requests loudly instead of leaving their
+    # router tickets polling a host that can never answer.
+    results_survive_death = True
 
     def __init__(self, index: int, journal_path):
         self.index = int(index)
@@ -430,6 +463,13 @@ class ReplicaHandle:
         self.rescued_off = 0
         self.routes = 0
         self.outstanding: set = set()     # rids currently bound here
+        # Staleness-clock floor (monotonic): bumped when the ROUTER
+        # hands this replica work out-of-band (rescued debt). An idle
+        # replica legitimately stops beating; the moment re-homed debt
+        # makes it `holds_work()`, its heartbeat age must be measured
+        # from the hand-off, not from the idle era — otherwise the
+        # supervisor evicts the rescue target on the very next tick.
+        self.hb_floor = time.monotonic()
         self.last_probe = 0.0
         self.last_respawn = 0.0
         self.probe_sub = None
@@ -442,15 +482,30 @@ class ReplicaHandle:
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None: ...
     def submit(self, a, **kw): ...
-    def admit_debt(self, records) -> Dict[str, Any]: ...
+    def admit_debt(self, records, *, fence_token: Optional[int] = None,
+                   fence_domain: Optional[str] = None) -> Dict[str, Any]:
+        ...
     def alive(self) -> bool: ...
     def heartbeat_age(self, now: float) -> float: ...
     def busy(self) -> bool: ...
     def holds_work(self) -> bool: ...
     def healthz(self) -> Optional[dict]: ...
     def respawn(self) -> None: ...
-    def fence(self) -> None: ...
+    def fence(self, token: Optional[int] = None) -> Optional[int]: ...
     def quiesce(self, timeout: float = 2.0) -> None: ...
+
+    def death_cause(self) -> str:
+        """Why `alive()` is False, as an eviction-cause label. The
+        network transport distinguishes ``lease_expired`` (partitioned
+        OR dead — the fencing token makes acting on it safe) and
+        ``replica_fenced`` from plain process death."""
+        return "replica_dead"
+
+    def lease_until(self, now: float) -> Optional[float]:
+        """Monotonic expiry of an unexpired liveness lease (the network
+        transport's promise — `fleet.heartbeat_stale` trusts it over
+        the heartbeat age); None when this transport has no leases."""
+        return None
 
     def unconsumed_debt(self, exclude) -> List[dict]:
         """Transport-level write-ahead records the replica accepted but
@@ -524,10 +579,12 @@ class LocalReplica(ReplicaHandle):
                 f"replica {self.index} is dead (simulated process loss)")
         return _LocalSub(self.service.submit(a, **kw))
 
-    def admit_debt(self, records) -> Dict[str, Any]:
+    def admit_debt(self, records, *, fence_token: Optional[int] = None,
+                   fence_domain: Optional[str] = None) -> Dict[str, Any]:
         if self.dead:
             raise ReplicaUnavailable(f"replica {self.index} is dead")
-        tickets = self.service.admit_journal_debt(records)
+        tickets = self.service.admit_journal_debt(
+            records, fence_token=fence_token, fence_domain=fence_domain)
         return {rid: _LocalSub(t) for rid, t in tickets.items()}
 
     def freeze_heartbeat(self, wedge_s: float) -> None:
@@ -582,13 +639,17 @@ class LocalReplica(ReplicaHandle):
         self._died_at = time.monotonic()
         self.service._chaos_kill()
 
-    def fence(self) -> None:
+    def fence(self, token: Optional[int] = None) -> Optional[int]:
         """STONITH before rescue: an alive-but-sick replica (stale
         heartbeat, bad outcomes, stuck breaker) is hard-stopped so it
         cannot keep serving requests whose debt the rescue is about to
         re-home — without the fence, everything it still held would be
-        double-served and its journal rewritten under a live writer."""
+        double-served and its journal rewritten under a live writer.
+        ``token`` is the fencing token the rescuer minted (unused here:
+        an in-process kill is synchronous and cannot race the rescue
+        the way a partitioned remote process can)."""
         self.simulate_kill()
+        return token
 
     def quiesce(self, timeout: float = 2.0) -> None:
         """Bounded wait for the dead service's workers to reach their
@@ -744,10 +805,13 @@ class SpoolReplica(ReplicaHandle):
                 pass
         return out
 
-    def admit_debt(self, records) -> Dict[str, Any]:
+    def admit_debt(self, records, *, fence_token: Optional[int] = None,
+                   fence_domain: Optional[str] = None) -> Dict[str, Any]:
         name = f"00-debt-{time.time_ns()}.json"
         _write_json_atomic(self.inbox / name,
-                           {"kind": "debt", "records": list(records)})
+                           {"kind": "debt", "records": list(records),
+                            "fence_token": fence_token,
+                            "fence_domain": fence_domain})
         return {rec["id"]: _SpoolSub(self.outbox / f"{rec['id']}.json",
                                      rec["id"])
                 for rec in records}
@@ -795,16 +859,20 @@ class SpoolReplica(ReplicaHandle):
         self._created = time.monotonic()
         self.generation += 1
 
-    def fence(self) -> None:
+    def fence(self, token: Optional[int] = None) -> Optional[int]:
         """STONITH before rescue: tell a possibly-still-alive replica
         process to exit IMMEDIATELY without serving anything else (the
         spool loop `os._exit`s on the fence command — SIGKILL semantics,
         queued work stays as journal debt). A no-op for a process that
         is already gone: the fence file just sits in the inbox, and a
         RESPAWNED replica consumes-and-ignores any fence older than its
-        own boot."""
+        own boot. ``token`` is the rescuer's fencing token, carried for
+        the audit trail (the spool transport shares a filesystem, so
+        the token FILE next to the journal is what a comeback reads)."""
         _write_json_atomic(self.inbox / "000-fence.json",
-                           {"kind": "fence", "t_wall": time.time()})
+                           {"kind": "fence", "t_wall": time.time(),
+                            "token": token})
+        return token
 
     def quiesce(self, timeout: float = 2.0) -> None:
         """Bounded wait for the fenced process to actually be gone
@@ -855,6 +923,12 @@ class RouterConfig:
     # (cheap when the shared compile cache is hot; the drill proves 0
     # fresh compiles).
     respawn_warmup: bool = False
+    # Client-side hard wall: a request with a deadline resolves (DEADLINE,
+    # loudly) at most this long AFTER its deadline expired, even when its
+    # replica is blackholed and no result file / RPC will ever answer —
+    # `RouterTicket.result` self-serves the verdict. The grace covers the
+    # rescue path (re-homed debt still finishing near the deadline).
+    client_grace_s: float = 15.0
     manifest_path: Optional[str] = None
     max_records: int = 2048
     metrics: bool = False
@@ -1041,6 +1115,9 @@ class ReplicaRouter:
                     continue
                 raise    # client fault: no replica can fix the request
             ticket = RouterTicket(rid, digest, bucket.name, router=self)
+            if deadline_s is not None and deadline_s != float("inf"):
+                ticket._deadline_wall = time.time() + float(deadline_s)
+                ticket._grace_s = self.config.client_grace_s
             ticket._bind(replica, sub)
             with self._lock:
                 self._outstanding[rid] = ticket
@@ -1115,13 +1192,15 @@ class ReplicaRouter:
             if replica.state is ReplicaState.ACTIVE:
                 cause = None
                 if not replica.alive():
-                    cause = "replica_dead"
+                    cause = replica.death_cause()
                 elif heartbeat_stale(
-                        now, now - replica.heartbeat_age(now),
+                        now, now - min(replica.heartbeat_age(now),
+                                       now - replica.hb_floor),
                         busy=replica.busy(),
                         holds_work=replica.holds_work(),
                         idle_timeout_s=cfg.heartbeat_timeout_s,
-                        busy_timeout_s=cfg.step_timeout_s):
+                        busy_timeout_s=cfg.step_timeout_s,
+                        lease_until=replica.lease_until(now)):
                     cause = "heartbeat_stale"
                 elif replica.bad_streak >= cfg.failure_threshold:
                     cause = "bad_outcomes"
@@ -1204,10 +1283,22 @@ class ReplicaRouter:
         # still alive — stale heartbeat, bad outcomes, stuck breaker —
         # must stop serving BEFORE its journal is stolen, or everything
         # it still holds is double-served under a rewritten journal.
-        # Already-dead replicas ignore the fence by construction.
-        replica.fence()
+        # Already-dead replicas ignore the fence by construction. The
+        # fencing TOKEN is minted before anything else: a partitioned
+        # replica that never hears the fence RPC still finds the bumped
+        # token on disk and self-fences, and a racing second rescuer's
+        # older token is refused by every debt receiver
+        # (`SVDService.admit_journal_debt` -> `StaleFenceError`).
+        from .journal import bump_fence_token
+        fence_token = bump_fence_token(
+            replica.journal_path,
+            minted_by=f"router-rescue-{replica.index}")
+        replica.fence(fence_token)
         replica.quiesce(timeout=3.0)
-        Journal.break_lock(replica.journal_path)
+        # force=True: this IS the fenced cross-host path — the token
+        # bump above is the authorization `break_lock` asks for before
+        # it will touch a lock minted on another host.
+        Journal.break_lock(replica.journal_path, force=True)
         j = Journal(replica.journal_path, exclusive=True)
         moved: List[str] = []
         targets_used: List[int] = []
@@ -1241,7 +1332,12 @@ class ReplicaRouter:
                         groups.setdefault(target.index, []).append(rec)
                 for idx, recs in groups.items():
                     target = self._replica(idx)
-                    subs = target.admit_debt(recs)
+                    subs = target.admit_debt(
+                        recs, fence_token=fence_token,
+                        fence_domain=replica.journal_path)
+                    # The admit answered: the target is alive RIGHT NOW.
+                    # Restart its staleness clock — see `hb_floor`.
+                    target.hb_floor = time.monotonic()
                     targets_used.append(idx)
                     for rec in recs:
                         rid = rec["id"]
@@ -1254,6 +1350,34 @@ class ReplicaRouter:
                                 target.outstanding.add(rid)
                         if rt is not None and rid in subs:
                             rt._bind(target, subs[rid])
+                lost: List[str] = []
+                if not replica.results_survive_death:
+                    # Finalized on the dead replica, result never
+                    # fetched: the result lived only in the dead
+                    # process, and journal exactly-once forbids a
+                    # silent re-solve — resolve the still-outstanding
+                    # ticket LOUDLY (the transport's finalized-but-lost
+                    # submit answer, at the router level).
+                    for rid, status in sorted(state.finalized.items()):
+                        # graftlock: ok(journal->router inversion is rescue-only — same justification as the rebind loop above: the journal belongs to the fenced+quiesced dead replica, no live path holds the router lock while waiting on it)
+                        with self._lock:
+                            rt = self._outstanding.get(rid)
+                            bound_here = rid in replica.outstanding
+                        if rt is None or not bound_here:
+                            continue
+                        if rt._resolve_once(ServeResult(
+                                u=None, s=None, v=None, status=None,
+                                error=(f"request finalized {status} on "
+                                       f"replica {replica.index} before "
+                                       f"it died ({cause}); the result "
+                                       f"did not survive (journal "
+                                       f"exactly-once forbids a silent "
+                                       f"re-solve)"),
+                                sweeps=0, bucket=rt.bucket,
+                                queue_wait_s=0.0, solve_time_s=None,
+                                path="replica_rescue", degraded=True,
+                                request_id=rid), replica):
+                            lost.append(rid)
                 for rec in orphans:
                     # No healthy replica left: loud terminal, exactly
                     # like the fleet's no-healthy-lane rescue.
@@ -1304,7 +1428,8 @@ class ReplicaRouter:
         self._record(event="rescue", replica=replica.index, cause=cause,
                      count=len(moved), request_ids=moved,
                      targets=sorted(set(targets_used)),
-                     orphaned=len(debt) - len(moved), torn=state.torn)
+                     orphaned=len(debt) - len(moved), torn=state.torn,
+                     lost_results=lost, fence_token=fence_token)
 
     # -- recovery -----------------------------------------------------------
 
@@ -1764,8 +1889,12 @@ def run_spool_replica(spool_dir, config: ServeConfig, *,
                     continue
                 if kind == "debt":
                     try:
-                        outstanding.update(
-                            svc.admit_journal_debt(rec["records"]))
+                        ft = rec.get("fence_token")
+                        outstanding.update(svc.admit_journal_debt(
+                            rec["records"],
+                            fence_token=(None if ft is None
+                                         else int(ft)),
+                            fence_domain=rec.get("fence_domain")))
                     except Exception as e:
                         # A malformed rescue batch must not kill the
                         # replica loop; the router's own debt accounting

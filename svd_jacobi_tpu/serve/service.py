@@ -418,11 +418,30 @@ class SVDService:
         # `JournalLockedError` (two replicas interleaving fsync'd
         # records into one journal would silently corrupt the
         # exactly-once story — serve.journal module docstring).
-        from .journal import Journal
+        from .journal import Journal, read_fence_token
         self.journal = (Journal(config.journal_path, exclusive=True)
                         if config.journal_path is not None else None)
+        # This replica's OWN fault-domain fencing token, acknowledged at
+        # boot: a respawn after a cross-machine rescue adopts whatever
+        # token the rescuer minted (its debt is tombstoned, so adopting
+        # is safe). `_journal_finalize` refuses to append once the disk
+        # token outruns this — a zombie worker whose solve outlived a
+        # fenced rescue must NOT land a duplicate FINALIZE in a journal
+        # another host already scanned and compacted.
+        self._own_fence_token = (
+            read_fence_token(config.journal_path)
+            if config.journal_path is not None else 0)
         # request_id -> Ticket of journal-recovered requests (`recover`).
         self.recovered: dict = {}
+        # Fencing-token ledger of the cross-machine rescue lane
+        # (`admit_journal_debt`): fault domain (the dead journal's path)
+        # -> (highest fencing token accepted, rids already admitted
+        # under that domain). A batch carrying a LOWER token than the
+        # ledger's is a stale rescuer — refused loudly (StaleFenceError
+        # + a "fence_refused" journal audit record); an equal/newer
+        # token's duplicate rid is an idempotent replay and is skipped.
+        # Guarded by self._lock.
+        self._rescue_fences: dict = {}
         self._last_reload_error: Optional[str] = None
         # Serving flight recorder (obs.registry / obs.spans): live
         # metrics + SLO accounting + per-request span timelines. None
@@ -894,7 +913,9 @@ class SVDService:
         return ticket, req, None, None
 
     def admit_journal_debt(self, records, *,
-                           via: str = "replica_rescue") -> dict:
+                           via: str = "replica_rescue",
+                           fence_token: Optional[int] = None,
+                           fence_domain: Optional[str] = None) -> dict:
         """Re-admit ANOTHER replica's journaled-but-unfinalized requests
         onto THIS service — the replica router's rescue lane
         (`serve.router`), mirroring the lane supervisor's rescue one
@@ -909,9 +930,59 @@ class SVDService:
         scans the dead journal under its (broken-then-reacquired) lock
         and skips finalized ids, this journal's write-ahead admit makes
         a second rescue replayable, and `Ticket._finalize_once` wins
-        in-process races. Returns ``{request_id: Ticket}``."""
+        in-process races. Returns ``{request_id: Ticket}``.
+
+        ``fence_token``/``fence_domain`` are the CROSS-MACHINE rescue
+        discipline (serve.transport): the token the rescuer minted for
+        the dead replica's fault domain (`journal.bump_fence_token`,
+        ``fence_domain`` = the dead journal's path). A token older than
+        one this service already accepted for the domain raises
+        `StaleFenceError` loudly (plus a ``fence_refused`` journal
+        audit record) — two rescuers racing over the same debt resolve
+        to exactly-once: the newer token wins, an equal token's
+        duplicate rids are skipped as idempotent replays."""
+        from .journal import StaleFenceError
         tickets: dict = {}
         queued: list = []
+        records = list(records)
+        if fence_token is not None:
+            domain = str(fence_domain or "_default")
+            token = int(fence_token)
+            with self._lock:
+                held, seen = self._rescue_fences.get(domain,
+                                                     (0, set()))
+                stale = token < held
+                dups: list = []
+                if not stale:
+                    fresh = []
+                    for rec in records:
+                        rid = str(rec["id"])
+                        if rid in seen:
+                            dups.append(rid)
+                        else:
+                            seen.add(rid)
+                            fresh.append(rec)
+                    self._rescue_fences[domain] = (max(held, token),
+                                                   seen)
+                    records = fresh
+            if stale:
+                self._bump("fence_refused")
+                if self.journal is not None:
+                    self.journal.append_audit(
+                        "fence_refused", domain=domain, token=token,
+                        held_token=held, via=via,
+                        ids=[str(r.get("id")) for r in records])
+                raise StaleFenceError(
+                    f"rescue batch for domain {domain} carries fencing "
+                    f"token {token} < accepted {held}: a newer rescue "
+                    f"owns this debt — refusing to double-admit "
+                    f"{len(records)} record(s)")
+            if dups:
+                self._bump(*(["fence_dup_skipped"] * len(dups)))
+                if self.journal is not None:
+                    self.journal.append_audit(
+                        "fence_dup_skipped", domain=domain, token=token,
+                        via=via, ids=dups)
         now_wall, now_mono = time.time(), time.monotonic()
         for rec in records:
             rid = rec["id"]
@@ -2883,6 +2954,28 @@ class SVDService:
         exactly-once finalization absorbs — a crashed worker would be
         strictly worse."""
         if self.journal is None:
+            return
+        # Fence gate (the STALE-FINALIZATION refusal of the rescue
+        # discipline): if a rescuer bumped this journal's fencing token
+        # since boot, another host has scanned + compacted this journal
+        # and re-homed its debt — a late finalize from a zombie worker
+        # here would be a DUPLICATE in the federation's exactly-once
+        # accounting. Refuse loudly: audit record instead of finalize
+        # (scan ignores audit kinds, so the tombstone story is intact).
+        from .journal import read_fence_token
+        try:
+            disk_token = read_fence_token(self.config.journal_path)
+        except Exception:
+            disk_token = 0
+        if disk_token > self._own_fence_token:
+            self._bump("stale_finalize_refused")
+            try:
+                self.journal.append_audit(
+                    "stale_finalize_refused", id=request_id,
+                    status=status, token=disk_token,
+                    held_token=self._own_fence_token)
+            except Exception:
+                pass
             return
         try:
             self._observe_journal_append(

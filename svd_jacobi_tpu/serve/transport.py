@@ -1,0 +1,1219 @@
+"""Multi-host HTTP replica transport: the federation over an UNRELIABLE
+network.
+
+`serve.router` federates replicas over two transports that both assume
+a reliable substrate: `LocalReplica` (shared memory) and `SpoolReplica`
+(a local filesystem rename is atomic and never times out). This module
+adds the third shape — `HttpReplica` — where every hop can be dropped,
+delayed, duplicated, or blackholed, and "the replica is dead" is
+indistinguishable from "the network is partitioned". The discipline:
+
+  * **Versioned wire protocol** (``WIRE_VERSION``): versioned JSON over
+    stdlib HTTP, mapping 1:1 onto the Ticket lifecycle — ``/v1/submit``
+    ``/v1/status`` ``/v1/result`` ``/v1/promote`` ``/v1/cancel``
+    ``/v1/debt`` ``/v1/fence`` ``/v1/lease`` ``/v1/stop`` ``/healthz``.
+    Every endpoint answers HTTP 200 with ``{"ok": bool, ...}`` so an
+    HTTP-level error always means TRANSPORT failure, never an
+    application verdict — retries stay safe.
+  * **Deadline-budget decay**: the remaining wall-clock budget of the
+    REQUEST (``t_wall + deadline_s - now``), not a fresh per-hop clock,
+    bounds every RPC attempt and every backoff sleep across hops.
+  * **Bounded retries with decorrelated jitter**: `launch
+    ._backoff_delay` (the parallel launcher's tested backoff), capped
+    by the remaining budget.
+  * **Idempotency keys**: the request id + oriented-input digest ride
+    every submit; the receiver dedupes against its own write-ahead
+    journal and live bookkeeping, so a retry after a lost ACK is
+    exactly-once (the duplicate gets ``{"ok": true, "dup": true}``).
+  * **Leases, not pings**: a successful healthz renews a client-side
+    lease (``lease_ttl_s``); an unexpired lease is a liveness promise
+    (`fleet.heartbeat_stale` consumes it), an expired one means
+    "partitioned OR dead" — the router may not know which, and does
+    not need to: the fencing token makes acting on it safe.
+  * **Fencing tokens** (`journal.bump_fence_token`): the rescuer bumps
+    the dead fault domain's monotonic token BEFORE breaking the journal
+    lock; `SVDService.admit_journal_debt` refuses stale tokens loudly
+    (`StaleFenceError` + a ``fence_refused`` audit record), and a
+    partitioned-but-alive replica self-fences the moment it observes a
+    newer token on disk (`HttpReplicaServer._check_fence`) — it can
+    come back, but it cannot double-serve debt that was rescued away.
+  * **Half-open connection quarantine**: ``quarantine_threshold``
+    consecutive transport errors open the client breaker (submits fail
+    with ZERO network I/O -> instant ring failover); after a cooldown
+    one probe flows half-open, and a success closes it (``heal``).
+  * **Partition-healed reconciliation**: the first successful healthz
+    after a lease lapse emits ``partition_heal`` and re-grants the
+    lease via a formal ``/v1/lease`` RPC; a replica that was rescued
+    meanwhile reports ``fenced`` instead and stays dead until respawn.
+
+Every network event appends an offline-reconstructable ``"net"``
+manifest record (`obs.manifest.build_net` -> ``svdj_rpc_*`` metric
+families via `obs.registry.registry_from_manifest`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..parallel import launch as _launch
+from .journal import (Journal, StaleFenceError, bump_fence_token,
+                      decode_array, host_boot_id, host_identity,
+                      read_fence_token)
+from .queue import AdmissionError, AdmissionReason
+from .router import (ReplicaHandle, ReplicaUnavailable, _decode_result,
+                     _encode_result, _trim_healthz, _write_json_atomic)
+from .service import SVDService
+
+WIRE_VERSION = 1
+
+# Results kept addressable after finalization (a consumed-but-unforgotten
+# window; the client `cleanup()` forgets eagerly, this bound is the leak
+# backstop for clients that never do).
+_RESULT_WINDOW = 512
+
+
+class TransportError(RuntimeError):
+    """An RPC failed at the TRANSPORT level after its retry budget
+    (connect refused / reset / timed out / torn response) — the
+    application verdict is unknown, which is exactly why every write
+    carries an idempotency key."""
+
+
+# -- wire helpers --------------------------------------------------------------
+
+
+def _http_json(url: str, *, method: str = "GET",
+               body: Optional[dict] = None,
+               timeout: float = 1.0) -> dict:
+    """One JSON-over-HTTP exchange. Raises OSError/URLError flavors on
+    transport failure; a non-JSON or non-dict body is a transport
+    failure too (a proxy tore the response)."""
+    data = None
+    headers = {"Accept": "application/json"}
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        payload = json.loads(resp.read().decode())
+    if not isinstance(payload, dict):
+        raise TransportError(f"torn response from {url}: "
+                             f"{type(payload).__name__}")
+    return payload
+
+
+# -- the server side (one replica process / thread) ----------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Stdlib request handler dispatching into the owning
+    `HttpReplicaServer` (``self.server.owner``). Always 200 + JSON."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):     # noqa: N802 (stdlib name)
+        pass    # chaos drills flood connections; stderr stays quiet
+
+    def _reply(self, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass    # the client (or the fault proxy) hung up mid-reply
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        try:
+            rec = json.loads(raw.decode()) if raw else {}
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return {}
+        return rec if isinstance(rec, dict) else {}
+
+    def do_GET(self):      # noqa: N802
+        owner = self.server.owner
+        parsed = urllib.parse.urlsplit(self.path)
+        q = urllib.parse.parse_qs(parsed.query)
+        rid = (q.get("id") or [None])[0]
+        try:
+            if parsed.path == "/healthz":
+                self._reply(owner.handle_healthz())
+            elif parsed.path == "/v1/status":
+                self._reply(owner.handle_status(rid))
+            elif parsed.path == "/v1/result":
+                self._reply(owner.handle_result(rid))
+            else:
+                self._reply({"ok": False,
+                             "error": f"unknown path {parsed.path}"})
+        except Exception as e:
+            self._reply({"ok": False,
+                         "error": f"{type(e).__name__}: {e}"})
+
+    def do_POST(self):     # noqa: N802
+        owner = self.server.owner
+        path = urllib.parse.urlsplit(self.path).path
+        body = self._body()
+        try:
+            if path == "/v1/submit":
+                self._reply(owner.handle_submit(body))
+            elif path == "/v1/debt":
+                self._reply(owner.handle_debt(body))
+            elif path == "/v1/promote":
+                self._reply(owner.handle_promote(body))
+            elif path == "/v1/cancel":
+                self._reply(owner.handle_cancel(body))
+            elif path == "/v1/forget":
+                self._reply(owner.handle_forget(body))
+            elif path == "/v1/fence":
+                self._reply(owner.handle_fence(body))
+            elif path == "/v1/lease":
+                self._reply(owner.handle_lease(body))
+            elif path == "/v1/stop":
+                self._reply(owner.handle_stop())
+            else:
+                self._reply({"ok": False,
+                             "error": f"unknown path {path}"})
+        except StaleFenceError as e:
+            self._reply({"ok": False, "stale_fence": True,
+                         "error": str(e)})
+        except AdmissionError as e:
+            self._reply({"ok": False, "rejected": e.reason.name,
+                         "error": e.detail})
+        except Exception as e:
+            self._reply({"ok": False,
+                         "error": f"{type(e).__name__}: {e}"})
+
+
+class _Listener(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "HttpReplicaServer" = None
+
+    def handle_error(self, request, client_address):
+        pass    # connection chaos is the POINT of the net drills
+
+
+class HttpReplicaServer:
+    """One replica fault domain behind the versioned HTTP wire protocol
+    — `run_spool_replica`'s counterpart for a network transport. Boot
+    replays the journal (a restarted replica recovers its OWN remaining
+    debt before taking new work), then every endpoint maps onto the
+    Ticket lifecycle. Run it in-process (tests, the two-"host" drill:
+    `start()` / `stop()` / `simulate_kill()`) or as a process main via
+    `run_http_replica`.
+
+    Lock discipline (graftlock CONC001): ``self._lock`` guards ONLY the
+    bookkeeping dicts (outstanding / results / reservation); it is never
+    held across a service call, a ticket wait, journal I/O, or a
+    response write."""
+
+    def __init__(self, config, *, host: str = "127.0.0.1", port: int = 0,
+                 warmup: bool = False, subprocess_mode: bool = False):
+        if config.journal_path is None:
+            raise ValueError("an HTTP replica needs its own journal_path "
+                             "(the fencing contract lives there)")
+        self.config = config
+        self.host = str(host)
+        self.port = int(port)
+        self.warmup = bool(warmup)
+        self.subprocess_mode = bool(subprocess_mode)
+        self.boot_wall = time.time()
+        self.svc: Optional[SVDService] = None
+        self.coldstart: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._outstanding: Dict[str, Any] = {}      # rid -> live Ticket
+        self._done_tickets: "OrderedDict[str, Any]" = OrderedDict()
+        self._results: "OrderedDict[str, dict]" = OrderedDict()
+        self._transpose: Dict[str, bool] = {}
+        self._reserved: set = set()     # rids mid-admission (dup race)
+        self._journal_seen: set = set()
+        self._finalized_prev: Dict[str, str] = {}
+        self._fenced = False
+        self._stop_requested = False
+        # Fence token this boot acknowledged: a HIGHER token on disk
+        # means a rescuer claimed this domain's debt while we were
+        # partitioned — self-fence, never double-serve.
+        self._fence_ack = 0
+        self._fence_checked = 0.0
+        self._httpd: Optional[_Listener] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "HttpReplicaServer":
+        cfg = self.config
+        if Path(cfg.journal_path).exists():
+            st0 = Journal(cfg.journal_path).scan(quarantine=False)
+            self._journal_seen = set(st0.admits) | set(st0.finalized)
+            self._finalized_prev = dict(st0.finalized)
+        self.svc = SVDService(cfg)
+        self._fence_ack = read_fence_token(cfg.journal_path)
+        if self._journal_seen:
+            self._outstanding.update(self.svc.recover())
+        self.svc.start()
+        if self.warmup:
+            self.svc.warmup(timeout=600.0)
+            cold = [r for r in self.svc.records()
+                    if r.get("kind") == "coldstart"]
+            if cold:
+                self.coldstart = {
+                    "fresh_compiles": cold[-1]["fresh_compiles"],
+                    "cache_hits": cold[-1]["cache_hits"],
+                    "backend_compiles": cold[-1]["backend_compiles"],
+                    "total_s": cold[-1]["total_s"]}
+        self._httpd = _Listener((self.host, self.port), _Handler)
+        self._httpd.owner = self
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="svdj-http-replica", daemon=True)
+        self._http_thread.start()
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = 30.0) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self.svc is not None and not self._fenced:
+            try:
+                self.svc.stop(drain=drain, timeout=timeout)
+            except Exception:
+                pass
+
+    def simulate_kill(self) -> None:
+        """The in-process SIGKILL twin for the two-"host" drill: the
+        service dies mid-work (queued requests stay as journal debt,
+        the journal lock stays held) AND the listener goes away — every
+        subsequent RPC is a connection error, exactly like a dead
+        host."""
+        self._fenced = True
+        if self.svc is not None:
+            self.svc._chaos_kill()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- fencing ------------------------------------------------------------
+
+    def _check_fence(self) -> bool:
+        """Self-fence when the DISK token outran the acknowledged one: a
+        rescuer claimed this fault domain's debt while this process was
+        partitioned away. Rate-limited (a disk stat per RPC would be
+        silly); the subprocess run loop also calls it so a fully
+        HTTP-partitioned replica still notices via the shared
+        filesystem."""
+        if self._fenced:
+            return True
+        now = time.monotonic()
+        if now - self._fence_checked < 0.05:
+            return self._fenced
+        self._fence_checked = now
+        disk = read_fence_token(self.config.journal_path)
+        if disk > self._fence_ack:
+            try:
+                if self.svc is not None and self.svc.journal is not None:
+                    self.svc.journal.append_audit(
+                        "self_fence", token=disk,
+                        held_token=self._fence_ack)
+            except Exception:
+                pass
+            self._fence_now()
+        return self._fenced
+
+    def _fence_now(self) -> None:
+        """STONITH on the serving side: stop finalizing ANYTHING. The
+        workers exit without serving (`_chaos_kill` — queued work stays
+        as journal debt for the rescuer); the listener stays up so
+        healthz can answer ``fenced: true`` (the router's reconciliation
+        reads it), but submit/debt refuse."""
+        if self._fenced:
+            return
+        self._fenced = True
+        if self.svc is not None:
+            try:
+                self.svc._chaos_kill()
+            except Exception:
+                pass
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _collect(self) -> None:
+        """Move finalized tickets into the bounded result window.
+        Encoding happens OUTSIDE the lock (factors can be megabytes)."""
+        with self._lock:
+            done = [(rid, t) for rid, t in self._outstanding.items()
+                    if t.done()]
+        for rid, t in done:
+            res = t.result(0)
+            enc = _encode_result(res)
+            enc["transposed"] = self._transpose.get(rid, False)
+            with self._lock:
+                self._outstanding.pop(rid, None)
+                self._results[rid] = enc
+                self._done_tickets[rid] = t
+                while len(self._results) > _RESULT_WINDOW:
+                    old, _ = self._results.popitem(last=False)
+                    self._transpose.pop(old, None)
+                while len(self._done_tickets) > _RESULT_WINDOW:
+                    self._done_tickets.popitem(last=False)
+
+    def _busy(self) -> bool:
+        if self.svc is None or self._fenced:
+            return False
+        return any(l.in_step for l in self.svc.fleet.lanes)
+
+    def _holds_work(self) -> bool:
+        if self.svc is None:
+            return False
+        with self._lock:
+            if self._outstanding:
+                return True
+        if self._fenced:
+            return False
+        return any(l.in_flight or l.queue.depth() > 0
+                   for l in self.svc.fleet.lanes)
+
+    # -- endpoint handlers --------------------------------------------------
+
+    def handle_healthz(self) -> dict:
+        self._check_fence()
+        hz = None
+        if self.svc is not None and not self._fenced:
+            try:
+                hz = _trim_healthz(self.svc)
+            except Exception:
+                hz = None
+        return {
+            "ok": not self._fenced,
+            "wire_version": WIRE_VERSION,
+            "fenced": self._fenced,
+            "pid": os.getpid(),
+            "boot_id": host_boot_id(),
+            "host": host_identity(),
+            "t_wall": time.time(),
+            "busy": self._busy(),
+            "holds_work": self._holds_work(),
+            "fence_token": self._fence_ack,
+            "coldstart": self.coldstart,
+            "healthz": hz,
+        }
+
+    def handle_submit(self, rec: dict) -> dict:
+        if self._check_fence():
+            return {"ok": False, "fenced": True}
+        rid = str(rec.get("id"))
+        if int(rec.get("wire_version", WIRE_VERSION)) != WIRE_VERSION:
+            return {"ok": False,
+                    "error": (f"wire version "
+                              f"{rec.get('wire_version')} != "
+                              f"{WIRE_VERSION}")}
+        # Idempotency gate: a retried submit after a lost ACK (or a
+        # proxy-duplicated one racing on another handler thread) must
+        # admit EXACTLY once. Check-and-reserve under the lock; the
+        # admission itself runs outside it.
+        with self._lock:
+            if (rid in self._outstanding or rid in self._results
+                    or rid in self._reserved):
+                return {"ok": True, "dup": True}
+            if rid in self._journal_seen:
+                dup = True
+            else:
+                dup = False
+                self._reserved.add(rid)
+        if dup:
+            # A previous life journaled this id. A finalized-but-lost
+            # result is reported LOUDLY (exactly-once forbids a silent
+            # re-solve); an admitted-but-unfinalized one is already
+            # back in flight via the boot-time recover().
+            st = self._finalized_prev.get(rid)
+            if st is not None:
+                with self._lock:
+                    absent = (rid not in self._results
+                              and rid not in self._outstanding)
+                    if absent:
+                        self._results[rid] = {
+                            "id": rid, "status": None,
+                            "error": (f"request finalized {st} before a "
+                                      f"crash; the result did not "
+                                      f"survive the restart (journal "
+                                      f"exactly-once forbids a silent "
+                                      f"re-solve)"),
+                            "sweeps": 0, "bucket": None,
+                            "queue_wait_s": 0.0, "solve_time_s": None,
+                            "path": "recovery", "degraded": False,
+                            "u": None, "s": None, "v": None}
+            return {"ok": True, "dup": True}
+        try:
+            a = decode_array(rec["input"])        # ORIENTED payload
+            deadline_s = rec.get("deadline_s")
+            if deadline_s is not None:
+                # Deadline-budget decay across the hop: the budget
+                # decays from the CLIENT's submit wall time, so retries
+                # and queueing on the far side all spend the same
+                # clock.
+                deadline_s = (float(rec["t_wall"]) + float(deadline_s)
+                              - time.time())
+            t = self.svc.submit(
+                a, request_id=rid,
+                compute_u=bool(rec.get("compute_u", True)),
+                compute_v=bool(rec.get("compute_v", True)),
+                deadline_s=deadline_s,
+                top_k=rec.get("top_k"),
+                phase=str(rec.get("phase", "full")),
+                digest=(rec.get("input") or {}).get("data_sha256"))
+            with self._lock:
+                self._outstanding[rid] = t
+                self._transpose[rid] = bool(rec.get("transposed", False))
+                self._reserved.discard(rid)
+            return {"ok": True, "dup": False}
+        except BaseException:
+            with self._lock:
+                self._reserved.discard(rid)
+            raise       # _Handler maps AdmissionError / errors to JSON
+
+    def handle_debt(self, body: dict) -> dict:
+        if self._check_fence():
+            return {"ok": False, "fenced": True}
+        records = list(body.get("records") or ())
+        fence_token = body.get("fence_token")
+        fence_domain = body.get("fence_domain")
+        # Receiver-side rid dedupe closes the failover-after-lost-ACK
+        # hole: a request the router already failed over HERE (same
+        # idempotency key) must not be admitted a second time when its
+        # first home dies and the rescue re-homes the journal debt.
+        fresh, dups = [], []
+        with self._lock:
+            for rec in records:
+                rid = str(rec.get("id"))
+                if (rid in self._outstanding or rid in self._results
+                        or rid in self._reserved
+                        or rid in self._journal_seen):
+                    dups.append(rid)
+                else:
+                    fresh.append(rec)
+        admitted: List[str] = []
+        if fresh or fence_token is not None:
+            tickets = self.svc.admit_journal_debt(
+                fresh,
+                fence_token=(None if fence_token is None
+                             else int(fence_token)),
+                fence_domain=fence_domain)
+            with self._lock:
+                self._outstanding.update(tickets)
+            admitted = sorted(tickets)
+        if dups and fence_token is not None:
+            # A fenced rescue replaying rids already live HERE (the
+            # equal-token idempotent case, caught by the transport-level
+            # dedupe before the service's fence ledger could see it):
+            # still audited — the journal must show every dup the
+            # exactly-once discipline skipped, whichever layer caught it.
+            self.svc._bump(*(["fence_dup_skipped"] * len(dups)))
+            if self.svc.journal is not None:
+                self.svc.journal.append_audit(
+                    "fence_dup_skipped",
+                    domain=str(fence_domain or "_default"),
+                    token=int(fence_token), via="transport_dedupe",
+                    ids=sorted(dups))
+        return {"ok": True, "admitted": admitted, "dups": sorted(dups)}
+
+    def handle_status(self, rid: Optional[str]) -> dict:
+        self._collect()
+        rid = str(rid)
+        with self._lock:
+            if rid in self._results:
+                return {"ok": True, "known": True, "done": True}
+            if rid in self._outstanding:
+                return {"ok": True, "known": True, "done": False}
+        return {"ok": True, "known": False, "done": False}
+
+    def handle_result(self, rid: Optional[str]) -> dict:
+        self._collect()
+        rid = str(rid)
+        with self._lock:
+            enc = self._results.get(rid)
+            pending = rid in self._outstanding
+        if enc is not None:
+            return {"ok": True, "result": enc}
+        return {"ok": False, "pending": pending,
+                "known": pending}
+
+    def handle_promote(self, body: dict) -> dict:
+        if self._check_fence():
+            return {"ok": False, "fenced": True}
+        rid = str(body.get("id"))
+        timeout_s = body.get("timeout_s")
+        self._collect()
+        with self._lock:
+            t = self._outstanding.get(rid) or self._done_tickets.get(rid)
+            transposed = self._transpose.get(rid, False)
+        if t is None:
+            return {"ok": False,
+                    "error": f"unknown or expired request {rid!r}"}
+        res = t.promote(None if timeout_s is None else float(timeout_s))
+        enc = _encode_result(res)
+        enc["transposed"] = transposed
+        return {"ok": True, "result": enc}
+
+    def handle_cancel(self, body: dict) -> dict:
+        rid = str(body.get("id"))
+        with self._lock:
+            t = self._outstanding.get(rid)
+        if t is not None:
+            t.cancel()
+        return {"ok": True, "known": t is not None}
+
+    def handle_forget(self, body: dict) -> dict:
+        rid = str(body.get("id"))
+        with self._lock:
+            known = self._results.pop(rid, None) is not None
+            self._done_tickets.pop(rid, None)
+            self._transpose.pop(rid, None)
+        return {"ok": True, "known": known}
+
+    def handle_fence(self, body: dict) -> dict:
+        t_wall = float(body.get("t_wall", 0.0))
+        if t_wall < self.boot_wall:
+            # A fence older than this boot targeted a PAST life; the
+            # respawn must not re-die on it.
+            return {"ok": True, "ignored": True}
+        token = body.get("token")
+        if token is not None and int(token) > self._fence_ack:
+            # An explicit fence RPC carries the rescuer's token; ack'ing
+            # it here means a later _check_fence of the SAME token does
+            # not double-audit.
+            self._fence_ack = int(token)
+        self._fence_now()
+        return {"ok": True, "fenced": True}
+
+    def handle_lease(self, body: dict) -> dict:
+        self._check_fence()
+        return {
+            "ok": not self._fenced,
+            "fenced": self._fenced,
+            "ttl_s": float(body.get("ttl_s", 0.0)),
+            "fence_token": self._fence_ack,
+            "boot_id": host_boot_id(),
+            "pid": os.getpid(),
+            "t_wall": time.time(),
+        }
+
+    def handle_stop(self) -> dict:
+        self._stop_requested = True
+        if not self.subprocess_mode:
+            # In-thread servers stop synchronously from the test
+            # harness; a wire-level stop only flags.
+            pass
+        return {"ok": True}
+
+
+def run_http_replica(config, *, host: str = "127.0.0.1", port: int = 0,
+                     warmup: bool = False, announce_path=None,
+                     max_runtime_s: Optional[float] = None,
+                     poll_s: float = 0.05) -> int:
+    """Process main for one HTTP replica (`tests/_http_worker.py` and
+    ``cli serve-demo --transport=http`` spawn this). Binds, announces
+    the REAL (ephemeral) port atomically, then loops watching the fence
+    token on the shared filesystem — a replica partitioned at the HTTP
+    layer still notices its domain was rescued. Exit codes: 0 clean
+    stop, 4 runtime fuse, 5 fenced."""
+    server = HttpReplicaServer(config, host=host, port=port,
+                               warmup=warmup, subprocess_mode=True)
+    server.start()
+    if announce_path is not None:
+        _write_json_atomic(Path(announce_path), {
+            "host": server.host, "port": server.port,
+            "pid": os.getpid(), "boot_id": host_boot_id(),
+            "t_wall": time.time()})
+    t_end = (None if max_runtime_s is None
+             else time.monotonic() + max_runtime_s)
+    rc: Optional[int] = None
+    try:
+        while rc is None:
+            if server._check_fence():
+                rc = 5
+                break
+            if server._stop_requested:
+                rc = 0
+                break
+            if t_end is not None and time.monotonic() > t_end:
+                rc = 4
+                break
+            time.sleep(poll_s)
+    finally:
+        # A fenced replica must NOT drain (finalizing rescued work
+        # would double-serve it) — `stop` already skips the service
+        # when fenced.
+        server.stop(drain=rc == 0, timeout=30.0)
+    return int(rc or 0)
+
+
+# -- the client side (the router's handle) -------------------------------------
+
+
+class _HttpSub:
+    """Uniform poll surface over a request living on an HTTP replica.
+    Every poll is a single-attempt RPC that BYPASSES the breaker (the
+    ticket's own deadline/wall bound governs how long a client keeps
+    asking a blackholed host)."""
+
+    _MIN_POLL_S = 0.02
+
+    def __init__(self, replica: "HttpReplica", request_id: str):
+        self.replica = replica
+        self.request_id = str(request_id)
+        self._last = 0.0
+
+    def done(self) -> bool:
+        try:
+            resp = self.replica._rpc(
+                "status", f"/v1/status?id={self.request_id}",
+                method="GET", attempts=1, record_failures=False,
+                probe=True)
+        except Exception:
+            return False
+        return bool(resp.get("done"))
+
+    def poll(self, slice_s: float) -> Optional[Any]:
+        now = time.monotonic()
+        gap = self._MIN_POLL_S - (now - self._last)
+        if gap > 0:
+            time.sleep(min(gap, max(slice_s, 0.0)))
+        self._last = time.monotonic()
+        try:
+            resp = self.replica._rpc(
+                "result", f"/v1/result?id={self.request_id}",
+                method="GET", attempts=1, record_failures=False,
+                probe=True)
+        except Exception:
+            time.sleep(min(slice_s, 0.05))
+            return None
+        if not resp.get("ok"):
+            if resp.get("pending"):
+                time.sleep(min(slice_s, self._MIN_POLL_S))
+            return None
+        return _decode_result(resp["result"])
+
+    def cancel(self) -> None:
+        try:
+            self.replica._rpc("cancel", "/v1/cancel", method="POST",
+                              body={"id": self.request_id}, attempts=1,
+                              record_failures=False, probe=True)
+        except Exception:
+            pass
+
+    def cleanup(self) -> None:
+        """Forget the consumed result server-side (a result can carry
+        megabytes of base64 factors; the federation must not hold one
+        per served request until the window evicts it)."""
+        try:
+            self.replica._rpc("forget", "/v1/forget", method="POST",
+                              body={"id": self.request_id}, attempts=1,
+                              record_failures=False, probe=True)
+        except Exception:
+            pass
+
+
+class HttpReplica(ReplicaHandle):
+    """The router's handle on a replica across an unreliable network
+    (module docstring for the full discipline). ``address`` is
+    ``(host, port)``; ``journal_path`` must be the replica's journal on
+    a filesystem THIS process can reach — the fencing token lives next
+    to it, and cross-machine rescue without a shared (or replicated)
+    journal namespace is not a thing this transport pretends to do."""
+
+    kind = "http"
+    # A finalized result lives only in the server's in-memory window:
+    # it does NOT survive the replica's death (unlike a spool outbox
+    # file) — the router's rescue resolves finalized-but-unfetched
+    # requests loudly instead of polling a dead host forever.
+    results_survive_death = False
+
+    def __init__(self, index: int, address: Tuple[str, int],
+                 journal_path, *,
+                 lease_ttl_s: float = 2.0,
+                 rpc_timeout_s: float = 1.0,
+                 rpc_attempts: int = 3,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 1.0,
+                 quarantine_threshold: int = 3,
+                 quarantine_cooldown_s: float = 1.0,
+                 boot_grace_s: float = 10.0,
+                 hz_interval_s: float = 0.1,
+                 respawn_cmd=None,
+                 manifest_path=None,
+                 max_net_records: int = 2048):
+        super().__init__(index, journal_path)
+        self.address = (str(address[0]), int(address[1]))
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.rpc_attempts = max(1, int(rpc_attempts))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.quarantine_threshold = max(1, int(quarantine_threshold))
+        self.quarantine_cooldown_s = float(quarantine_cooldown_s)
+        self.boot_grace_s = float(boot_grace_s)
+        self.hz_interval_s = float(hz_interval_s)
+        self.manifest_path = manifest_path
+        self.max_net_records = int(max_net_records)
+        self._respawn_cmd = respawn_cmd
+        self._lock = threading.Lock()
+        self.net_records: List[dict] = []
+        self.net_stats: Dict[str, int] = {}
+        # Connection breaker (half-open quarantine).
+        self._fail_streak = 0
+        self._breaker = "closed"        # closed | open | half-open
+        self._open_until = 0.0
+        # Lease (monotonic clock — leases are a LOCAL promise).
+        self._lease_until = 0.0
+        self._lease_ever = False
+        self._lease_lapse_logged = False
+        self._remote_fenced = False
+        self._hz_cache: dict = {}
+        self._hz_read = 0.0
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    # -- net observability --------------------------------------------------
+
+    def _net(self, event: str, **extra) -> None:
+        """One ``"net"`` manifest record (never raises; observability
+        must not take down the transport). Called OUTSIDE self._lock."""
+        try:
+            from .. import obs
+            rec = obs.manifest.build_net(event=event,
+                                         replica=self.index, **extra)
+            with self._lock:
+                self.net_stats[event] = self.net_stats.get(event, 0) + 1
+                if self.max_net_records > 0:
+                    self.net_records.append(rec)
+                    del self.net_records[:-self.max_net_records]
+            if self.manifest_path is not None:
+                obs.manifest.append(self.manifest_path, rec)
+        except Exception:
+            pass
+
+    # -- breaker ------------------------------------------------------------
+
+    def _breaker_gate(self, probe: bool) -> None:
+        """Raise `ReplicaUnavailable` with ZERO network I/O while the
+        breaker is open (probes bypass: they ARE the half-open path)."""
+        if probe:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if self._breaker == "open":
+                if now < self._open_until:
+                    raise ReplicaUnavailable(
+                        f"replica {self.index} connection quarantined "
+                        f"({self._fail_streak} consecutive transport "
+                        f"errors; half-open in "
+                        f"{self._open_until - now:.2f}s)")
+                self._breaker = "half-open"    # let THIS call probe
+
+    def _note_success(self) -> None:
+        healed = False
+        with self._lock:
+            if self._breaker != "closed":
+                healed = True
+            self._breaker = "closed"
+            self._fail_streak = 0
+        if healed:
+            self._net("heal")
+
+    def _note_failure(self) -> None:
+        opened = False
+        with self._lock:
+            self._fail_streak += 1
+            if self._breaker == "half-open":
+                self._breaker = "open"
+                self._open_until = (time.monotonic()
+                                    + self.quarantine_cooldown_s)
+            elif (self._breaker == "closed"
+                    and self._fail_streak >= self.quarantine_threshold):
+                self._breaker = "open"
+                self._open_until = (time.monotonic()
+                                    + self.quarantine_cooldown_s)
+                opened = True
+        if opened:
+            self._net("quarantine", streak=self._fail_streak)
+
+    # -- the RPC core -------------------------------------------------------
+
+    def _rpc(self, op: str, path: str, *, method: str = "POST",
+             body: Optional[dict] = None,
+             attempts: Optional[int] = None,
+             timeout_s: Optional[float] = None,
+             budget_end: Optional[float] = None,
+             record_failures: bool = True,
+             probe: bool = False) -> dict:
+        """One RPC under the full network discipline: breaker gate,
+        per-attempt timeout bounded by the REMAINING request budget
+        (wall clock — ``budget_end``), bounded retries with
+        decorrelated jitter (`launch._backoff_delay`), and a ``net``
+        record per retry/terminal failure."""
+        self._breaker_gate(probe)
+        attempts = self.rpc_attempts if attempts is None else attempts
+        timeout_s = self.rpc_timeout_s if timeout_s is None else timeout_s
+        url = self.base_url + path
+        prev_delay = 0.0
+        last: Optional[BaseException] = None
+        for attempt in range(1, attempts + 1):
+            per_attempt = timeout_s
+            if budget_end is not None:
+                remaining = budget_end - time.time()
+                if remaining <= 0:
+                    last = TransportError(
+                        f"{op}: deadline budget exhausted before "
+                        f"attempt {attempt}")
+                    break
+                per_attempt = min(per_attempt, remaining)
+            try:
+                payload = _http_json(url, method=method, body=body,
+                                     timeout=max(per_attempt, 1e-3))
+                if record_failures or probe:
+                    self._note_success()
+                return payload
+            except (urllib.error.URLError, ConnectionError,
+                    socket.timeout, TimeoutError, OSError,
+                    json.JSONDecodeError, TransportError) as e:
+                last = e
+                if attempt >= attempts:
+                    break
+                delay = _launch._backoff_delay(
+                    self.backoff_base_s, prev_delay, self.backoff_cap_s)
+                if budget_end is not None:
+                    delay = min(delay, max(0.0,
+                                           budget_end - time.time()))
+                prev_delay = delay
+                self._net("rpc_retry", op=op, attempt=attempt,
+                          error=type(e).__name__)
+                if delay > 0:
+                    _launch._sleep(delay)
+        timed_out = isinstance(last, (socket.timeout, TimeoutError)) or (
+            isinstance(last, urllib.error.URLError)
+            and isinstance(getattr(last, "reason", None),
+                           (socket.timeout, TimeoutError)))
+        if isinstance(last, TransportError) and "budget" in str(last):
+            timed_out = True
+        if record_failures:
+            self._note_failure()
+            self._net("rpc_timeout" if timed_out else "rpc_error",
+                      op=op, attempt=attempts,
+                      error=type(last).__name__)
+        raise TransportError(
+            f"{op} to replica {self.index} ({url}) failed after "
+            f"{attempts} attempt(s): {type(last).__name__}: {last}"
+        ) from last
+
+    # -- submit / debt ------------------------------------------------------
+
+    def submit(self, a, *, compute_u=True, compute_v=True,
+               deadline_s=None, request_id=None, top_k=None,
+               phase="full", digest=None):
+        """Submit one request over the wire. Orientation happens HERE
+        (like `SpoolReplica.submit` — the worker solves the oriented
+        payload verbatim, the result decode swaps the factors back);
+        the record is admit-shaped and carries the idempotency key
+        (id + oriented digest) so ANY number of retries admits once.
+        Transport failure -> `ReplicaUnavailable` (the router fails
+        over along the ring — a ``failover`` net record marks it)."""
+        import numpy as _np
+        rid = str(request_id)
+        a = _np.asarray(a)
+        transposed = a.ndim == 2 and a.shape[0] < a.shape[1]
+        oriented = a.T if transposed else a
+        if transposed:
+            compute_u, compute_v = compute_v, compute_u
+        m, n = (int(d) for d in oriented.shape)
+        from .journal import _encode_array
+        t_wall = time.time()
+        rec = {
+            "kind": "submit", "wire_version": WIRE_VERSION, "id": rid,
+            "t_wall": t_wall, "attempt": 1,
+            "deadline_s": (None if deadline_s is None
+                           else float(deadline_s)),
+            "m": m, "n": n,
+            "orig_shape": [int(d) for d in a.shape],
+            "transposed": bool(transposed),
+            "bucket": None,
+            "compute_u": bool(compute_u), "compute_v": bool(compute_v),
+            "degraded": False, "brownout": "FULL",
+            "top_k": None if top_k is None else int(top_k),
+            "phase": str(phase),
+            "input": _encode_array(oriented, digest=digest),
+        }
+        budget_end = None
+        if deadline_s is not None and deadline_s != float("inf"):
+            budget_end = t_wall + float(deadline_s)
+        try:
+            resp = self._rpc("submit", "/v1/submit", body=rec,
+                             budget_end=budget_end)
+        except (TransportError, ReplicaUnavailable) as e:
+            self._net("failover", op="submit",
+                      error=type(e).__name__)
+            raise ReplicaUnavailable(
+                f"replica {self.index} unreachable for submit: {e}"
+            ) from e
+        if resp.get("ok"):
+            return _HttpSub(self, rid)
+        if resp.get("fenced"):
+            with self._lock:
+                self._remote_fenced = True
+            self._net("failover", op="submit", error="fenced")
+            raise ReplicaUnavailable(
+                f"replica {self.index} is fenced (mid-rescue)")
+        rejected = resp.get("rejected")
+        if rejected is not None:
+            raise AdmissionError(AdmissionReason[rejected],
+                                 str(resp.get("error") or rejected))
+        raise ReplicaUnavailable(
+            f"replica {self.index} refused submit: "
+            f"{resp.get('error')}")
+
+    def admit_debt(self, records, *, fence_token=None,
+                   fence_domain=None) -> Dict[str, Any]:
+        """Re-home rescued journal debt onto this replica, carrying the
+        fencing token the rescuer minted. `StaleFenceError` propagates
+        (a LOSING rescuer must hear it loudly); receiver-side dups are
+        fine — they are already being served here."""
+        body = {
+            "wire_version": WIRE_VERSION,
+            "records": list(records),
+            "fence_token": (None if fence_token is None
+                            else int(fence_token)),
+            "fence_domain": (None if fence_domain is None
+                             else str(fence_domain)),
+        }
+        resp = self._rpc("debt", "/v1/debt", body=body,
+                         timeout_s=max(self.rpc_timeout_s, 5.0))
+        if resp.get("stale_fence"):
+            raise StaleFenceError(
+                str(resp.get("error") or "stale fence token"))
+        if resp.get("fenced"):
+            raise ReplicaUnavailable(
+                f"replica {self.index} is fenced (cannot take debt)")
+        if not resp.get("ok"):
+            raise ReplicaUnavailable(
+                f"replica {self.index} refused debt: "
+                f"{resp.get('error')}")
+        return {str(rec["id"]): _HttpSub(self, str(rec["id"]))
+                for rec in records}
+
+    # -- liveness: leases ---------------------------------------------------
+
+    def _refresh(self, force: bool = False) -> dict:
+        """Rate-limited healthz poll; a SUCCESS renews the lease. The
+        first grant (and every re-grant after a lapse — the partition
+        healed) goes through the formal ``/v1/lease`` RPC and emits the
+        lease/heal net records."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._hz_read < self.hz_interval_s:
+                return self._hz_cache
+            self._hz_read = now      # rate-limit failures too
+        try:
+            hz = self._rpc("healthz", "/healthz", method="GET",
+                           attempts=1, record_failures=False,
+                           probe=True)
+        except Exception:
+            return self._hz_cache
+        fenced = bool(hz.get("fenced"))
+        first = healed = newly_fenced = False
+        with self._lock:
+            self._hz_cache = hz
+            self._hz_read = time.monotonic()
+            if fenced:
+                newly_fenced = not self._remote_fenced
+                self._remote_fenced = True
+            else:
+                lapsed = (self._lease_ever
+                          and time.monotonic() >= self._lease_until)
+                first = not self._lease_ever
+                healed = lapsed
+                self._lease_until = (time.monotonic()
+                                     + self.lease_ttl_s)
+                self._lease_ever = True
+                self._lease_lapse_logged = False
+        if newly_fenced:
+            self._net("fence", token=hz.get("fence_token"))
+        if first or healed:
+            try:
+                self._rpc("lease", "/v1/lease", method="POST",
+                          body={"ttl_s": self.lease_ttl_s},
+                          attempts=1, record_failures=False, probe=True)
+            except Exception:
+                pass    # the healthz success already renewed it
+            self._net("lease_grant", ttl=self.lease_ttl_s)
+            if healed:
+                self._net("partition_heal")
+        return hz
+
+    def alive(self) -> bool:
+        self._refresh()
+        now = time.monotonic()
+        with self._lock:
+            if self._remote_fenced:
+                return False
+            if self._lease_ever and now < self._lease_until:
+                return True
+            ever = self._lease_ever
+            log_lapse = ever and not self._lease_lapse_logged
+            if log_lapse:
+                self._lease_lapse_logged = True
+        if not ever:
+            # Never contacted: alive-by-grace while it boots.
+            return (now - self._created) < self.boot_grace_s
+        if log_lapse:
+            self._net("lease_expired",
+                      ttl=self.lease_ttl_s)
+        return False
+
+    def death_cause(self) -> str:
+        with self._lock:
+            if self._remote_fenced:
+                return "replica_fenced"
+            if self._lease_ever:
+                return "lease_expired"
+        return "replica_dead"
+
+    def lease_until(self, now: float) -> Optional[float]:
+        """The unexpired-lease liveness promise on the supervisor's
+        monotonic clock (`fleet.heartbeat_stale(lease_until=...)`);
+        None before first contact."""
+        with self._lock:
+            return self._lease_until if self._lease_ever else None
+
+    # -- health surfaces (cached; the supervisor polls these hot) -----------
+
+    def heartbeat_age(self, now: float) -> float:
+        self._refresh()
+        with self._lock:
+            t = self._hz_cache.get("t_wall")
+        if not isinstance(t, (int, float)):
+            return now - self._created
+        return max(0.0, time.time() - float(t))
+
+    def busy(self) -> bool:
+        self._refresh()
+        with self._lock:
+            return bool(self._hz_cache.get("busy"))
+
+    def holds_work(self) -> bool:
+        if self.outstanding:
+            return True
+        self._refresh()
+        with self._lock:
+            return bool(self._hz_cache.get("holds_work"))
+
+    def healthz(self) -> Optional[dict]:
+        self._refresh()
+        with self._lock:
+            return self._hz_cache.get("healthz")
+
+    # -- lifecycle / rescue surfaces ----------------------------------------
+
+    def start(self) -> None:
+        pass    # the process is started by the harness / supervisor
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        try:
+            self._rpc("stop", "/v1/stop", method="POST", body={},
+                      attempts=1, record_failures=False, probe=True)
+        except Exception:
+            pass
+
+    def fence(self, token: Optional[int] = None) -> Optional[int]:
+        """STONITH across the network: mint (or receive) the fault
+        domain's next fencing token, then best-effort TELL the replica.
+        The FILE is authoritative — a partitioned replica that never
+        hears this RPC still self-fences when it next reads the token
+        (`HttpReplicaServer._check_fence`); the RPC just makes the
+        common case fast."""
+        if token is None:
+            token = bump_fence_token(
+                self.journal_path,
+                minted_by=f"router-fence-{self.index}")
+        self._net("fence", token=int(token))
+        try:
+            self._rpc("fence", "/v1/fence", method="POST",
+                      body={"t_wall": time.time(), "token": int(token)},
+                      attempts=1,
+                      timeout_s=min(self.rpc_timeout_s, 0.5),
+                      record_failures=False, probe=True)
+        except Exception:
+            pass
+        with self._lock:
+            self._remote_fenced = True
+        return int(token)
+
+    def quiesce(self, timeout: float = 2.0) -> None:
+        """Bounded wait for the fenced replica to stop answering as a
+        live server (fenced healthz or no answer at all) — raw probes
+        bypassing the breaker, so quarantine state cannot wedge the
+        rescue."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                hz = self._rpc("healthz", "/healthz", method="GET",
+                               attempts=1,
+                               timeout_s=min(self.rpc_timeout_s, 0.5),
+                               record_failures=False, probe=True)
+            except Exception:
+                return          # unreachable == quiesced for our purposes
+            if hz.get("fenced") or not hz.get("ok"):
+                return
+            time.sleep(0.05)
+
+    def respawn(self) -> None:
+        if self._respawn_cmd is None:
+            return    # the harness owns process lifecycle
+        addr = self._respawn_cmd()
+        if (isinstance(addr, tuple) and len(addr) == 2):
+            self.address = (str(addr[0]), int(addr[1]))
+        with self._lock:
+            self._remote_fenced = False
+            self._lease_ever = False
+            self._lease_until = 0.0
+            self._lease_lapse_logged = False
+            self._fail_streak = 0
+            self._breaker = "closed"
+            self._hz_cache = {}
+            self._hz_read = 0.0
+        self._created = time.monotonic()
+        self.generation += 1
+
+    def unconsumed_debt(self, exclude) -> List[dict]:
+        """Empty by construction: an HTTP submit is ACKed only AFTER
+        the receiver journaled it (`SVDService.submit` write-ahead),
+        so there is no accepted-but-unjournaled seam like the spool
+        inbox — an un-ACKed submit was never handed over, and the
+        router failed it over at submit time."""
+        return []

@@ -1,0 +1,86 @@
+"""Subprocess driver for the network-chaos lane (tests/test_transport.py):
+a REAL HTTP replica process the partition drill can blackhole behind the
+fault proxy or SIGKILL mid-load — run as
+
+    python tests/_http_worker.py serve --journal J --announce A \
+        [--cache C] [--warmup] [--replica N] [--max-runtime-s S]
+
+``serve`` runs `serve.transport.run_http_replica`: build the service
+(journal write-ahead, shared persistent compile cache), replay the
+journal if one exists (a RESPAWNED replica recovers its own remaining
+debt), optionally AOT-warm from the shared cache namespace (healthz then
+reports the coldstart's ``fresh_compiles`` — the drill asserts a warm
+respawn reads 0), bind an ephemeral port, write ``{host, port, pid,
+boot_id}`` to the --announce file, then serve until a wire-level stop, a
+FENCE (exit 5 — a rescued-away replica must not keep serving), or the
+runtime fuse (exit 4). The process is designed to be SIGKILL'd or
+partitioned: everything the router's rescue needs (journal + lockfile +
+fence token) is on the shared filesystem, nothing in memory matters.
+"""
+
+import argparse
+import faulthandler
+import os
+import signal
+import sys
+
+# Stuck-worker forensics: `kill -USR1 <pid>` dumps every thread's stack
+# to stderr (the drill captures it in worker-<i>.log).
+faulthandler.register(signal.SIGUSR1)
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+BUCKET = (48, 32, "float32")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("command", choices=["serve"])
+    p.add_argument("--journal", required=True)
+    p.add_argument("--announce", required=True,
+                   help="file to write the bound {host, port, pid} to "
+                        "(ports are ephemeral; the parent reads this)")
+    p.add_argument("--cache", default=None)
+    p.add_argument("--warmup", action="store_true")
+    p.add_argument("--replica", type=int, default=0)
+    p.add_argument("--slow-s", type=float, default=0.0,
+                   help="per-sweep host delay on every dispatch (widens "
+                        "the parent's kill window deterministically)")
+    p.add_argument("--max-runtime-s", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.serve import ServeConfig
+    from svd_jacobi_tpu.serve.transport import run_http_replica
+
+    slow_cm = None
+    if args.slow_s > 0:
+        from svd_jacobi_tpu.resilience import chaos
+        # The reference must outlive this function call: a dropped
+        # contextmanager is GC'd, which runs its finally and DISARMS
+        # the hook.
+        slow_cm = chaos.slow_solve(args.slow_s, shots=10 ** 6)
+        slow_cm.__enter__()
+
+    config = ServeConfig(
+        buckets=(BUCKET,),
+        solver=SVDConfig(pair_solver="pallas"),
+        journal_path=args.journal,
+        compile_cache_dir=args.cache,
+        compute_digest=True,
+        result_cache_bytes=16 << 20,
+        max_queue_depth=64,
+        brownout_sigma_only_at=2.0, brownout_shed_at=2.0)
+    return run_http_replica(config, warmup=args.warmup,
+                            announce_path=args.announce,
+                            max_runtime_s=args.max_runtime_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

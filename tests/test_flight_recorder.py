@@ -346,7 +346,16 @@ class TestServiceScrape:
             assert svc.submit(
                 _mat(30, 30, seed=9)).result(timeout=300.0).status.name \
                 == "OK"
-            series = parse_prometheus(svc.metrics_text())
+            # The client unblocks the instant the ticket flips, BEFORE
+            # the worker's finalize append (best-effort journaling is
+            # deliberately off the client's critical path) — give the
+            # append a moment rather than racing it.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                series = parse_prometheus(svc.metrics_text())
+                if series.get("svdj_journal_appends_total") == 3.0:
+                    break
+                time.sleep(0.02)
         # admit + dispatch + finalize = 3 fsync'd appends observed.
         assert series.get("svdj_journal_fsync_seconds_count") == 3.0
         assert series.get("svdj_journal_appends_total") == 3.0

@@ -1,0 +1,890 @@
+"""Network-chaos lane (serve.transport + resilience.netfault): the HTTP
+replica transport under an unreliable wire — idempotent retries after
+lost ACKs, leases vs partitions, fencing-token split-brain refusal, the
+half-open connection breaker, the client-side wall bound, cross-host
+journal lock ownership, and the in-process two-"host" drill (drops +
+delays + a partition + a replica death with zero lost requests). The
+real two-SUBPROCESS partition drill (tests/_http_worker.py) runs in the
+chaos+slow lane."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from svd_jacobi_tpu import SVDConfig  # noqa: E402
+from svd_jacobi_tpu.obs import manifest  # noqa: E402
+from svd_jacobi_tpu.obs.registry import registry_from_manifest  # noqa: E402
+from svd_jacobi_tpu.resilience import chaos  # noqa: E402
+from svd_jacobi_tpu.resilience.netfault import FaultyProxy  # noqa: E402
+from svd_jacobi_tpu.serve import (AdmissionError, Journal,  # noqa: E402
+                                  JournalLockedError, ReplicaRouter,
+                                  ReplicaState, RouterConfig, ServeConfig,
+                                  StaleFenceError, SVDService,
+                                  bump_fence_token, read_fence_token)
+from svd_jacobi_tpu.serve.fleet import heartbeat_stale  # noqa: E402
+from svd_jacobi_tpu.serve.journal import (_lock_is_remote,  # noqa: E402
+                                          host_identity)
+from svd_jacobi_tpu.serve.router import ReplicaUnavailable  # noqa: E402
+from svd_jacobi_tpu.serve.transport import (WIRE_VERSION,  # noqa: E402
+                                            HttpReplica, HttpReplicaServer,
+                                            TransportError)
+from svd_jacobi_tpu.utils import matgen  # noqa: E402
+
+pytestmark = pytest.mark.net
+
+BUCKETS = ((32, 32, "float64"),)
+SOLVER = SVDConfig(block_size=4)
+
+
+def _serve_cfg(tmp_path, idx=0, **over):
+    base = dict(buckets=BUCKETS, solver=SOLVER, max_queue_depth=32,
+                brownout_sigma_only_at=2.0, brownout_shed_at=2.0,
+                result_cache_bytes=16 << 20, compute_digest=True,
+                journal_path=str(tmp_path / f"journal-{idx}.jsonl"))
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _mat(m, n, seed):
+    return np.asarray(matgen.random_dense(m, n, seed=seed,
+                                          dtype=jnp.float64))
+
+
+def _sref(a):
+    return np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+
+
+def _wait(pred, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _journal_counts(path, ids):
+    """Per-id admit/finalize counts from the RAW journal stream (what a
+    postmortem would read — not the in-memory bookkeeping)."""
+    admits, finals = {}, {}
+    recs, _ = manifest.read_jsonl_tolerant(path, quarantine=False)
+    for r in recs:
+        rid = r.get("id")
+        if rid not in ids:
+            continue
+        if r.get("kind") == "admit":
+            admits[rid] = admits.get(rid, 0) + 1
+        elif r.get("kind") == "finalize":
+            finals[rid] = finals.get(rid, 0) + 1
+    return admits, finals
+
+
+def _audits(path, kind):
+    """Audit records (journal.append_audit) ride the journal stream
+    with their kind as the record kind."""
+    recs, _ = manifest.read_jsonl_tolerant(path, quarantine=False)
+    return [r for r in recs if r.get("kind") == kind]
+
+
+def _poll_result(replica, sub, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        res = sub.poll(0.05)
+        if res is not None:
+            return res
+    raise TimeoutError(f"no result for {sub.request_id}")
+
+
+# ---------------------------------------------------------------------------
+# Satellite: cross-host journal lock ownership.
+
+
+class TestCrossHostLock:
+    def _remote_lock(self, journal):
+        lock = Path(str(journal) + ".lock")
+        lock.write_text(json.dumps({
+            "pid": 12345, "boot_id": "another-boot",
+            "host": "some-other-machine", "token": "deadbeef",
+            "t_wall": time.time(), "path": str(journal)}))
+        return lock
+
+    def test_lock_is_remote_unit(self):
+        assert _lock_is_remote({"host": "some-other-machine"})
+        assert not _lock_is_remote({"host": host_identity()})
+        # Pre-host-field lockfiles (older writers) keep the same-host
+        # treatment — remoteness must be PROVEN, not assumed.
+        assert not _lock_is_remote({})
+        assert not _lock_is_remote({"host": 7})
+
+    def test_remote_lock_refused_on_open(self, tmp_path):
+        """A lock minted on another machine can never be auto-broken:
+        its pid/boot-id liveness means nothing here."""
+        journal = tmp_path / "j.jsonl"
+        self._remote_lock(journal)
+        with pytest.raises(JournalLockedError) as ei:
+            Journal(journal, exclusive=True)
+        msg = str(ei.value)
+        assert "some-other-machine" in msg
+        assert "force=True" in msg
+
+    def test_break_lock_refuses_remote_without_force(self, tmp_path):
+        journal = tmp_path / "j.jsonl"
+        lock = self._remote_lock(journal)
+        with pytest.raises(JournalLockedError) as ei:
+            Journal.break_lock(journal)
+        assert "fence" in str(ei.value).lower()
+        assert lock.exists()
+        # force=True is the FENCED cross-machine rescue path.
+        assert Journal.break_lock(journal, force=True)
+        assert not lock.exists()
+
+    def test_same_host_live_owner_still_refused(self, tmp_path):
+        """The cross-host refusal must not regress the same-host rule:
+        a second live opener on THIS host still fails loudly."""
+        journal = tmp_path / "j.jsonl"
+        j = Journal(journal, exclusive=True)
+        try:
+            with pytest.raises(JournalLockedError) as ei:
+                Journal(journal, exclusive=True)
+            assert "LIVE process" in str(ei.value)
+            # And same-host break_lock (no force) still works — the
+            # supervisor's declared-dead override is a local decision.
+            assert Journal.break_lock(journal)
+        finally:
+            j.release()
+
+
+# ---------------------------------------------------------------------------
+# The wire protocol on a clean network.
+
+
+class TestWireProtocol:
+    def test_submit_solve_result_roundtrip(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address,
+                              tmp_path / "journal-0.jsonl")
+            a = _mat(30, 24, seed=1)
+            sub = rep.submit(a, deadline_s=300.0, request_id="wire-0")
+            res = _poll_result(rep, sub)
+            assert res.error is None and res.status.name == "OK"
+            assert np.abs(np.asarray(res.s, np.float64)
+                          - _sref(a)).max() < 1e-8
+            # Wide input: orientation is client-side, factors swap back.
+            b = _mat(24, 30, seed=2)
+            res2 = _poll_result(rep, rep.submit(
+                b, deadline_s=300.0, request_id="wire-1"))
+            assert res2.status.name == "OK"
+            assert np.abs(np.asarray(res2.s, np.float64)
+                          - _sref(b)).max() < 1e-8
+            assert res2.u.shape[0] == 24 and res2.v.shape[0] == 30
+            # forget: the consumed result is released server-side.
+            sub.cleanup()
+            assert not rep._rpc("status", "/v1/status?id=wire-0",
+                                method="GET", attempts=1)["done"]
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_healthz_shape(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address,
+                              tmp_path / "journal-0.jsonl")
+            hz = rep._refresh(force=True)
+            assert hz["ok"] and not hz["fenced"]
+            assert hz["wire_version"] == WIRE_VERSION
+            assert hz["pid"] == os.getpid()
+            assert hz["fence_token"] == 0
+            assert hz["host"] == host_identity()
+            assert rep.alive()
+            # The first contact granted a lease via the formal RPC.
+            assert rep.net_stats.get("lease_grant") == 1
+            assert rep.lease_until(time.monotonic()) is not None
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_wire_version_mismatch_refused(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address,
+                              tmp_path / "journal-0.jsonl")
+            resp = rep._rpc("submit", "/v1/submit", body={
+                "kind": "submit", "wire_version": WIRE_VERSION + 1,
+                "id": "vX", "t_wall": time.time(), "input": None})
+            assert not resp["ok"]
+            assert "wire version" in resp["error"]
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_unknown_path_is_an_answer_not_an_error(self, tmp_path):
+        """HTTP-level errors mean TRANSPORT failure only; an unknown
+        path still answers 200 + ok=false."""
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address,
+                              tmp_path / "journal-0.jsonl")
+            resp = rep._rpc("nope", "/v1/nope", body={}, attempts=1)
+            assert resp == {"ok": False, "error": "unknown path /v1/nope"}
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_rejection_maps_to_admission_error(self, tmp_path):
+        server = HttpReplicaServer(
+            _serve_cfg(tmp_path, max_queue_depth=32)).start()
+        try:
+            rep = HttpReplica(0, server.address,
+                              tmp_path / "journal-0.jsonl")
+            with pytest.raises(AdmissionError) as ei:
+                # 64x64 routes to no declared bucket: the SERVER's
+                # admission verdict crosses the wire as a typed reason,
+                # not a transport failure.
+                rep.submit(np.zeros((64, 64)), deadline_s=30.0,
+                           request_id="bad-shape")
+            assert ei.value.reason.name == "NO_BUCKET"
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# Idempotency under duplication / lost ACKs (the fault proxy on the wire).
+
+
+class TestIdempotency:
+    def test_duplicated_submit_admits_once(self, tmp_path):
+        journal = tmp_path / "journal-0.jsonl"
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                proxy.arm("duplicate", shots=1)
+                rep = HttpReplica(0, proxy.address, journal)
+                a = _mat(28, 20, seed=3)
+                sub = rep.submit(a, deadline_s=300.0,
+                                 request_id="dup-0")
+                res = _poll_result(rep, sub)
+                assert res.status.name == "OK"
+                assert proxy.unconsumed() == {}
+            admits, finals = _journal_counts(journal, {"dup-0"})
+            assert admits == {"dup-0": 1}
+            assert finals == {"dup-0": 1}
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_lost_ack_retry_admits_once(self, tmp_path):
+        """The tentpole's core scenario: the submit is DELIVERED but
+        its ACK is blackholed — the client must retry (it cannot know),
+        and the retry must be exactly-once."""
+        journal = tmp_path / "journal-0.jsonl"
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                proxy.arm("blackhole_reply", shots=1)
+                rep = HttpReplica(0, proxy.address, journal,
+                                  rpc_timeout_s=0.5)
+                a = _mat(28, 20, seed=4)
+                sub = rep.submit(a, deadline_s=300.0,
+                                 request_id="ack-0")
+                res = _poll_result(rep, sub)
+                assert res.status.name == "OK"
+                # The retry really happened (attempt 1's ACK was eaten).
+                assert rep.net_stats.get("rpc_retry", 0) >= 1
+                assert proxy.unconsumed() == {}
+            admits, finals = _journal_counts(journal, {"ack-0"})
+            assert admits == {"ack-0": 1}
+            assert finals == {"ack-0": 1}
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_dropped_submit_is_retried_transparently(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                proxy.arm("drop", shots=1)
+                proxy.arm("delay", shots=1, value=0.1)
+                rep = HttpReplica(0, proxy.address,
+                                  tmp_path / "journal-0.jsonl",
+                                  rpc_timeout_s=0.5)
+                res = _poll_result(rep, rep.submit(
+                    _mat(26, 20, seed=5), deadline_s=300.0,
+                    request_id="drop-0"))
+                assert res.status.name == "OK"
+                assert rep.net_stats.get("rpc_retry", 0) >= 1
+                assert proxy.unconsumed() == {}
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_duplicated_debt_admits_once(self, tmp_path):
+        """Partition-during-rescue flap: the debt hand-off is delivered
+        TWICE (a proxy retransmit) — the receiver's rid dedupe + the
+        service's fence ledger keep it exactly-once."""
+        journal = tmp_path / "journal-0.jsonl"
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                proxy.arm("duplicate", shots=1)
+                rep = HttpReplica(0, proxy.address, journal)
+                rec = {
+                    "kind": "admit", "id": "debt-0",
+                    "t_wall": time.time(), "attempt": 1,
+                    "deadline_s": 300.0, "m": 32, "n": 32,
+                    "orig_shape": [28, 20], "transposed": False,
+                    "bucket": "32x32:float64",
+                    "compute_u": True, "compute_v": True,
+                    "degraded": False, "brownout": "FULL",
+                    "top_k": None, "phase": "full",
+                    "input": __import__(
+                        "svd_jacobi_tpu.serve.journal",
+                        fromlist=["_encode_array"])._encode_array(
+                            _mat(28, 20, seed=6)),
+                }
+                subs = rep.admit_debt(
+                    [rec], fence_token=1,
+                    fence_domain=str(tmp_path / "dead.jsonl"))
+                res = _poll_result(rep, subs["debt-0"])
+                assert res.status.name == "OK"
+                assert proxy.unconsumed() == {}
+            admits, finals = _journal_counts(journal, {"debt-0"})
+            assert admits == {"debt-0": 1}
+            assert finals == {"debt-0": 1}
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# Leases, fencing, split-brain.
+
+
+class TestLeaseAndFencing:
+    def test_lease_survives_short_partition(self, tmp_path):
+        """An unexpired lease is a liveness promise: a partition
+        SHORTER than the TTL must not declare the replica dead."""
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                rep = HttpReplica(0, proxy.address,
+                                  tmp_path / "journal-0.jsonl",
+                                  lease_ttl_s=2.0, rpc_timeout_s=0.3,
+                                  hz_interval_s=0.05)
+                assert rep.alive()
+                proxy.partition()
+                assert rep.alive()        # lease still holds
+                proxy.heal()
+                assert _wait(lambda: rep.alive(), timeout=5.0)
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_lease_expiry_then_partition_heal(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                rep = HttpReplica(0, proxy.address,
+                                  tmp_path / "journal-0.jsonl",
+                                  lease_ttl_s=0.3, rpc_timeout_s=0.2,
+                                  hz_interval_s=0.02)
+                assert rep.alive()
+                proxy.partition()
+                assert _wait(lambda: not rep.alive(), timeout=5.0)
+                assert rep.death_cause() == "lease_expired"
+                assert rep.net_stats.get("lease_expired") == 1
+                proxy.heal()
+                assert _wait(lambda: rep.alive(), timeout=5.0)
+                # The re-grant is a formal reconciliation event.
+                assert rep.net_stats.get("partition_heal") == 1
+                assert rep.net_stats.get("lease_grant", 0) >= 2
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_self_fence_on_disk_token(self, tmp_path):
+        """A partitioned-but-ALIVE replica observes a newer fence token
+        on the shared filesystem and stops serving — it can never
+        double-serve debt a rescuer claimed."""
+        journal = tmp_path / "journal-0.jsonl"
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address, journal)
+            assert rep.alive()
+            token = bump_fence_token(journal, minted_by="test-rescuer")
+            assert token == 1
+
+            def fenced():
+                hz = rep._refresh(force=True)
+                return bool(hz.get("fenced"))
+            assert _wait(fenced, timeout=5.0)
+            assert not rep.alive()
+            assert rep.death_cause() == "replica_fenced"
+            # Fenced refusals on the write paths.
+            resp = rep._rpc("submit", "/v1/submit", body={
+                "kind": "submit", "wire_version": WIRE_VERSION,
+                "id": "post-fence", "t_wall": time.time(),
+                "input": None})
+            assert resp == {"ok": False, "fenced": True}
+            with pytest.raises(ReplicaUnavailable):
+                rep.admit_debt([], fence_token=None, fence_domain=None)
+            # The self-fence is journal-audited.
+            audits = _audits(journal, "self_fence")
+            assert len(audits) == 1 and audits[0]["token"] == 1
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_stale_fence_refused_split_brain(self, tmp_path):
+        """Two rescuers race over the same dead domain: the NEWER token
+        wins, the older one is refused loudly + audited, an EQUAL
+        token's duplicate rids are skipped as idempotent replays."""
+        journal = tmp_path / "journal-0.jsonl"
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address, journal)
+            domain = str(tmp_path / "dead.jsonl")
+            from svd_jacobi_tpu.serve.journal import _encode_array
+
+            def debt(rid, seed):
+                return {"kind": "admit", "id": rid,
+                        "t_wall": time.time(), "attempt": 1,
+                        "deadline_s": 300.0, "m": 32, "n": 32,
+                        "orig_shape": [28, 20], "transposed": False,
+                        "bucket": "32x32:float64", "compute_u": True,
+                        "compute_v": True, "degraded": False,
+                        "brownout": "FULL", "top_k": None,
+                        "phase": "full",
+                        "input": _encode_array(_mat(28, 20, seed=seed))}
+
+            subs = rep.admit_debt([debt("race-0", 7)], fence_token=2,
+                                  fence_domain=domain)
+            assert _poll_result(rep, subs["race-0"]).status.name == "OK"
+            # The LOSING rescuer (older token) hears the refusal.
+            with pytest.raises(StaleFenceError):
+                rep.admit_debt([debt("race-1", 8)], fence_token=1,
+                               fence_domain=domain)
+            refusals = _audits(journal, "fence_refused")
+            assert len(refusals) == 1
+            assert refusals[0]["token"] == 1
+            assert refusals[0]["held_token"] == 2
+            # An EQUAL token replaying the same rid is idempotent.
+            subs2 = rep.admit_debt([debt("race-0", 7)], fence_token=2,
+                                   fence_domain=domain)
+            assert set(subs2) == {"race-0"}   # a poll surface, no re-admit
+            admits, _ = _journal_counts(journal, {"race-0", "race-1"})
+            assert admits == {"race-0": 1}
+            assert _audits(journal, "fence_dup_skipped")
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_fence_rpc_older_than_boot_ignored(self, tmp_path):
+        """A respawned replica must not re-die on a fence aimed at its
+        PREVIOUS life."""
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            rep = HttpReplica(0, server.address,
+                              tmp_path / "journal-0.jsonl")
+            resp = rep._rpc("fence", "/v1/fence", body={
+                "t_wall": server.boot_wall - 10.0})
+            assert resp == {"ok": True, "ignored": True}
+            assert rep._refresh(force=True)["ok"]
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+    def test_heartbeat_stale_lease_unit(self):
+        # An unexpired lease vetoes staleness outright.
+        assert not heartbeat_stale(
+            10.0, 0.0, busy=False, holds_work=True, idle_timeout_s=1.0,
+            busy_timeout_s=5.0, lease_until=11.0)
+        # Expired lease: the ordinary two-tier verdict resumes.
+        assert heartbeat_stale(
+            10.0, 0.0, busy=False, holds_work=True, idle_timeout_s=1.0,
+            busy_timeout_s=5.0, lease_until=9.0)
+        assert not heartbeat_stale(
+            10.0, 0.0, busy=False, holds_work=False, idle_timeout_s=1.0,
+            busy_timeout_s=5.0, lease_until=9.0)
+
+
+# ---------------------------------------------------------------------------
+# The half-open connection breaker.
+
+
+class TestConnectionBreaker:
+    def test_quarantine_opens_and_heals(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            live = server.address
+            rep = HttpReplica(0, ("127.0.0.1", 1),   # nothing listens
+                              tmp_path / "journal-0.jsonl",
+                              rpc_attempts=1, rpc_timeout_s=0.2,
+                              quarantine_threshold=2,
+                              quarantine_cooldown_s=0.2)
+            a = _mat(26, 20, seed=9)
+            for _ in range(2):
+                with pytest.raises(ReplicaUnavailable):
+                    rep.submit(a, deadline_s=30.0, request_id="q-0")
+            assert rep.net_stats.get("quarantine") == 1
+            # Open breaker: the next submit fails with ZERO network I/O
+            # (instant ring failover), not another timeout.
+            t0 = time.monotonic()
+            with pytest.raises(ReplicaUnavailable) as ei:
+                rep.submit(a, deadline_s=30.0, request_id="q-1")
+            assert time.monotonic() - t0 < 0.1
+            assert "quarantined" in str(ei.value)
+            # Cooldown passes, the address heals -> half-open probe
+            # closes the breaker.
+            rep.address = live
+            time.sleep(0.25)
+            res = _poll_result(rep, rep.submit(
+                a, deadline_s=300.0, request_id="q-2"))
+            assert res.status.name == "OK"
+            assert rep.net_stats.get("heal", 0) >= 1
+        finally:
+            server.stop(drain=True, timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the client-side wall bound (a blackholed replica cannot
+# hang the router's client).
+
+
+class TestClientWallBound:
+    def test_blackholed_replica_resolves_client_deadline(self, tmp_path):
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        proxy = FaultyProxy(server.address).start()
+        try:
+            rep = HttpReplica(0, proxy.address,
+                              tmp_path / "journal-0.jsonl",
+                              lease_ttl_s=600.0, rpc_timeout_s=0.3)
+            cfg = RouterConfig(
+                replicas=1, serve=_serve_cfg(tmp_path, idx=9),
+                state_dir=str(tmp_path / "router-state"),
+                supervise_interval_s=0.05, heartbeat_timeout_s=600.0,
+                client_grace_s=0.5)
+            with chaos.slow_solve(1.0, shots=8):
+                router = ReplicaRouter(cfg, replicas=[rep]).start()
+                try:
+                    t = router.submit(_mat(28, 20, seed=10),
+                                      deadline_s=1.0)
+                    # The replica answers the submit, then vanishes.
+                    proxy.partition()
+                    t0 = time.monotonic()
+                    res = t.result(timeout=60.0)
+                    took = time.monotonic() - t0
+                finally:
+                    router.stop(drain=False, timeout=5.0)
+            # deadline (1.0s) + grace (0.5s), not the 60s client
+            # timeout and not forever.
+            assert res.status is not None
+            assert res.status.name == "DEADLINE"
+            assert res.path == "client_deadline"
+            assert res.degraded
+            assert took < 20.0
+        finally:
+            proxy.stop()
+            server.stop(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Net observability: records -> offline metric reconstruction.
+
+
+class TestNetObservability:
+    def test_registry_reconstruction(self, tmp_path):
+        mpath = tmp_path / "manifest.jsonl"
+        server = HttpReplicaServer(_serve_cfg(tmp_path)).start()
+        try:
+            with FaultyProxy(server.address) as proxy:
+                rep = HttpReplica(0, proxy.address,
+                                  tmp_path / "journal-0.jsonl",
+                                  rpc_timeout_s=0.3,
+                                  manifest_path=str(mpath))
+                assert rep.healthz() is not None   # grants the lease
+                proxy.arm("drop", shots=1)
+                res = _poll_result(rep, rep.submit(
+                    _mat(26, 20, seed=11), deadline_s=300.0,
+                    request_id="obs-0"))
+                assert res.status.name == "OK"
+        finally:
+            server.stop(drain=True, timeout=30.0)
+        recs, torn = manifest.read_jsonl_tolerant(mpath, quarantine=False)
+        assert torn == 0
+        assert all(r.get("kind") == "net" for r in recs)
+        reg = registry_from_manifest(recs)
+        assert reg.value("svdj_rpc_retries_total", op="submit",
+                         replica="0") >= 1
+        assert reg.value("svdj_replica_leases_total", replica="0",
+                         event="lease_grant") >= 1
+        # The live counters agree with the offline reconstruction.
+        assert rep.net_stats["rpc_retry"] == reg.value(
+            "svdj_rpc_retries_total", op="submit", replica="0")
+
+
+# ---------------------------------------------------------------------------
+# The in-process two-"host" drill: drops + delays + a partition + a
+# replica death, closed loop, zero lost requests, exactly-once across
+# the federation, fencing audited.
+
+
+class TestTwoHostDrill:
+    def test_chaos_drill_zero_lost_exactly_once(self, tmp_path):
+        cache = tmp_path / "shared-cache"
+        servers, proxies = [], []
+        journals = [tmp_path / f"journal-{i}.jsonl" for i in (0, 1)]
+        try:
+            for i in (0, 1):
+                cfg = _serve_cfg(tmp_path, idx=i,
+                                 compile_cache_dir=str(cache))
+                servers.append(HttpReplicaServer(cfg, warmup=True).start())
+                proxy = FaultyProxy(servers[i].address).start()
+                proxy.arm("drop", shots=1)
+                proxy.arm("delay", shots=1, value=0.1)
+                proxies.append(proxy)
+            # Replica 1 (the survivor-to-be) warm-booted from the cache
+            # namespace replica 0 populated.
+            assert servers[1].coldstart is not None
+            assert servers[1].coldstart["fresh_compiles"] == 0
+
+            handles = [
+                HttpReplica(i, proxies[i].address, journals[i],
+                            lease_ttl_s=1.0, rpc_timeout_s=0.5,
+                            hz_interval_s=0.05, boot_grace_s=5.0)
+                for i in (0, 1)]
+            cfg = RouterConfig(
+                replicas=2, serve=_serve_cfg(tmp_path, idx=8),
+                state_dir=str(tmp_path / "router-state"),
+                supervise_interval_s=0.05, heartbeat_timeout_s=2.0,
+                probe_interval_s=0.5, probe_timeout_s=180.0)
+            router = ReplicaRouter(cfg, replicas=handles).start()
+            try:
+                rng = np.random.default_rng(0)
+                mats = [rng.standard_normal((28, 20)) for _ in range(8)]
+                from svd_jacobi_tpu.serve import input_digest
+                victim = router.ring.owner("32x32:float64",
+                                           input_digest(mats[0]))
+                survivor = 1 - victim
+                # The first dispatches are pinned slow (process-global
+                # shots), so the kill below lands while the victim is
+                # mid-solve — it dies holding journaled-but-unfinalized
+                # debt, never a finalized-but-unfetched result (which
+                # would be a LOUD lost-result error, a different
+                # drill).
+                with chaos.slow_solve(1.5, shots=4):
+                    tickets = [router.submit(m, deadline_s=600.0,
+                                             request_id=f"net-{i:02d}")
+                               for i, m in enumerate(mats)]
+                    # One short partition on the SURVIVOR: shorter than
+                    # its lease TTL, so the lease absorbs it (no
+                    # eviction) and the wire chaos rides on top.
+                    proxies[survivor].flap(0.4)
+                    # Kill the victim once it holds journaled-but-
+                    # UNFINALIZED debt: the rescue must re-home it.
+                    assert _wait(
+                        lambda: bool(Journal(journals[victim]).scan(
+                            quarantine=False).unfinalized),
+                        timeout=120.0)
+                    servers[victim].simulate_kill()
+                    results = [t.result(timeout=600.0) for t in tickets]
+                # Zero lost requests; every result matches the oracle.
+                for m, res in zip(mats, results):
+                    assert res.error is None, res
+                    assert res.status.name == "OK"
+                    assert np.abs(np.asarray(res.s, np.float64)
+                                  - _sref(m)).max() < 1e-6
+                assert router.total_rescues >= 1
+                # The rescue was FENCED: token minted before the lock
+                # broke, recorded in the router's rescue record.
+                assert read_fence_token(journals[victim]) >= 1
+                rescues = [r for r in router.records()
+                           if r.get("event") == "rescue"]
+                assert rescues and rescues[-1].get("fence_token", 0) >= 1
+                # Exactly-once across the federation, from the RAW
+                # journal streams (merged postmortem view).
+                ids = {t.request_id for t in tickets}
+                finals_all = {}
+                for jp in journals:
+                    _, finals = _journal_counts(jp, ids)
+                    assert all(c == 1 for c in finals.values()), finals
+                    for rid in finals:
+                        finals_all[rid] = finals_all.get(rid, 0) + 1
+                assert set(finals_all) == ids
+                assert all(c == 1 for c in finals_all.values()), finals_all
+                # All armed chaos actually fired.
+                for proxy in proxies:
+                    assert proxy.unconsumed() == {}
+                # The wire discipline was exercised, not bypassed.
+                stats = {}
+                for h in handles:
+                    for k, v in h.net_stats.items():
+                        stats[k] = stats.get(k, 0) + v
+                assert stats.get("rpc_retry", 0) >= 1
+                assert stats.get("lease_grant", 0) >= 2
+            finally:
+                router.stop(drain=False, timeout=10.0)
+        finally:
+            for proxy in proxies:
+                proxy.stop()
+            for server in servers:
+                server.stop(drain=False, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# The real two-SUBPROCESS partition drill (chaos + slow): a LIVE but
+# partitioned worker process is rescued away, self-fences through the
+# shared filesystem (exit code 5), and its warm respawn pays zero fresh
+# compiles.
+
+
+def _spawn_http_worker(tmp_path, idx, cache, warmup=True, slow_s=0.0):
+    journal = tmp_path / f"journal-{idx}.jsonl"
+    announce = tmp_path / f"announce-{idx}.json"
+    announce.unlink(missing_ok=True)
+    argv = [sys.executable,
+            str(Path(__file__).resolve().parent / "_http_worker.py"),
+            "serve", "--journal", str(journal),
+            "--announce", str(announce),
+            "--cache", str(cache), "--replica", str(idx),
+            "--max-runtime-s", "900"]
+    if warmup:
+        argv.append("--warmup")
+    if slow_s > 0:
+        argv += ["--slow-s", str(slow_s)]
+    log = open(tmp_path / f"worker-{idx}.log", "a")
+    proc = subprocess.Popen(argv, stdout=log, stderr=log)
+    return proc, journal, announce
+
+
+def _wait_announce(announce, timeout=240.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if announce.exists():
+            try:
+                return json.loads(announce.read_text())
+            except json.JSONDecodeError:
+                pass
+        time.sleep(0.1)
+    raise TimeoutError(f"no announce at {announce}")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestSubprocessPartitionDrill:
+    def test_partitioned_worker_rescued_fenced_respawned(self, tmp_path):
+        cache = tmp_path / "shared-cache"
+        procs, proxies = {}, {}
+        try:
+            # Worker 0 solves SLOWLY (5s/sweep): the partition below
+            # always lands while its debt is journaled-but-unfinalized,
+            # so the fenced rescue re-homes it — and when the zombie's
+            # solve finally completes, the stale-finalize gate (disk
+            # fence token) refuses the duplicate. No warmup: the slow
+            # hook would crawl through it too, and worker 0's cold
+            # first dispatch only widens the window. Worker 1 stays
+            # fast and warms the shared cache.
+            p0, journal0, announce0 = _spawn_http_worker(
+                tmp_path, 0, cache, warmup=False, slow_s=5.0)
+            ann0 = _wait_announce(announce0)
+            procs[0] = p0
+            p1, journal1, announce1 = _spawn_http_worker(
+                tmp_path, 1, cache, slow_s=0.10)
+            ann1 = _wait_announce(announce1)
+            procs[1] = p1
+            # Worker 0 sits behind the fault proxy so the drill can
+            # PARTITION it (alive, reachable disk, unreachable wire).
+            proxies[0] = FaultyProxy(
+                (ann0["host"], ann0["port"])).start()
+
+            replicas = [
+                HttpReplica(0, proxies[0].address, journal0,
+                            lease_ttl_s=1.0, rpc_timeout_s=0.5,
+                            hz_interval_s=0.05),
+                HttpReplica(1, (ann1["host"], ann1["port"]), journal1,
+                            lease_ttl_s=1.0, rpc_timeout_s=0.5,
+                            hz_interval_s=0.05),
+            ]
+            cfg = RouterConfig(
+                replicas=2,
+                serve=ServeConfig(
+                    buckets=((48, 32, "float32"),),
+                    solver=SVDConfig(pair_solver="pallas"),
+                    max_queue_depth=64,
+                    brownout_sigma_only_at=2.0, brownout_shed_at=2.0),
+                state_dir=str(tmp_path),
+                supervise_interval_s=0.05,
+                heartbeat_timeout_s=2.0,
+                probe_interval_s=0.5, probe_timeout_s=180.0)
+            router = ReplicaRouter(cfg, replicas=replicas).start()
+            try:
+                rng = np.random.default_rng(0)
+                mats = [rng.standard_normal((40, 30)).astype(np.float32)
+                        for _ in range(8)]
+                tickets = [router.submit(m, deadline_s=600.0,
+                                         request_id=f"part-{i:02d}")
+                           for i, m in enumerate(mats)]
+                # Partition worker 0 once it holds journaled-but-
+                # unfinalized debt. The process stays ALIVE — only the
+                # wire goes dark.
+                assert _wait(lambda: bool(Journal(journal0).scan(
+                    quarantine=False).unfinalized), timeout=120.0)
+                proxies[0].partition()
+
+                results = [t.result(timeout=600.0) for t in tickets]
+                for m, res in zip(mats, results):
+                    assert res.error is None, res
+                    assert res.status.name == "OK"
+                    sref = np.linalg.svd(np.asarray(m, np.float64),
+                                         compute_uv=False)
+                    assert np.abs(np.asarray(res.s, np.float64)
+                                  - sref).max() < 5e-4
+                assert router.total_rescues >= 1
+
+                # The partitioned-but-alive worker self-fences through
+                # the shared filesystem and EXITS with the fence code —
+                # it never double-serves the rescued debt.
+                assert read_fence_token(journal0) >= 1
+                assert procs[0].wait(timeout=120.0) == 5
+                assert _audits(journal0, "self_fence")
+
+                # Exactly-once across both journals.
+                ids = {t.request_id for t in tickets}
+                finals_all = {}
+                for jp in (journal0, journal1):
+                    _, finals = _journal_counts(jp, ids)
+                    assert all(c == 1 for c in finals.values()), finals
+                    for rid in finals:
+                        finals_all[rid] = finals_all.get(rid, 0) + 1
+                assert set(finals_all) == ids
+                assert all(c == 1 for c in finals_all.values())
+
+                # Respawn: a fresh process on the SAME journal (the
+                # fence token on disk is now its acknowledged baseline)
+                # — reachable directly, warm from the shared cache.
+                proxies[0].heal()
+
+                def respawn():
+                    p, _, ann = _spawn_http_worker(tmp_path, 0, cache,
+                                                   warmup=True)
+                    procs[0] = p
+                    a = _wait_announce(ann)
+                    return (a["host"], a["port"])
+                replicas[0]._respawn_cmd = respawn
+                assert _wait(lambda: replicas[0].state
+                             is ReplicaState.ACTIVE, timeout=240.0)
+                hz = replicas[0]._refresh(force=True)
+                assert hz["ok"] and not hz["fenced"]
+                assert hz["pid"] == procs[0].pid
+                # Warm respawn: zero fresh compiles off the shared
+                # persistent cache namespace.
+                assert hz["coldstart"] is not None
+                assert hz["coldstart"]["fresh_compiles"] == 0
+                assert hz["coldstart"]["cache_hits"] > 0
+            finally:
+                router.stop(drain=True, timeout=60.0)
+        finally:
+            for proxy in proxies.values():
+                proxy.stop()
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
