@@ -54,6 +54,14 @@ Usage:
                                   against one cache dir; the warm row
                                   must report ZERO fresh compiles —
                                   PROFILE.md item 26)
+         --serve-tenants         (multi-tenant fairness A/B: the seeded
+                                  adversarial flood schedule paced in
+                                  real time through the pre-tenancy
+                                  anonymous surface vs the QoS front
+                                  door — victim p50/p99 + goodput and
+                                  abuser served/shed per arm, plus the
+                                  victim-p99 isolation ratio row —
+                                  PROFILE.md item 35)
          --serve-metrics-overhead (same-session A/B of the closed-loop
                                   throughput fleet with the flight
                                   recorder ON vs OFF: interleaved laps
@@ -445,6 +453,160 @@ def _serve_throughput(flags) -> None:
             "unit": "x vs 1 lane",
             "ok": (r["ok"] == r["requests"] and b1["ok"] == b1["requests"]),
         }))
+
+
+def _serve_tenants(flags) -> None:
+    """--serve-tenants: multi-tenant fairness A/B (PROFILE.md item 35).
+    The seeded adversarial flood schedule (`resilience.chaos.
+    adversarial_tenant` — the SAME schedule the chaos drills and
+    `serve-demo --adversary` replay) is paced through a live service
+    twice, in real time: once through the PRE-TENANCY surface (every
+    submit anonymous, one FIFO lane — the victim queues behind the
+    whole flood) and once through the QoS front door (victim "alice"
+    weight 4, abuser "mallory" token-bucket rate-limited, weighted-fair
+    dequeue sheds the flood at the door). One JSON row per arm with the
+    victim's p50/p99 end-to-end latency + goodput and the abuser's
+    served/shed counts, then the headline isolation row: victim p99
+    no-QoS over QoS — the number the front door exists for.
+
+    Flags: --bucket=MxN:dtype     (default 64x48:float32)
+           --victims=N            (victim submits; default 12)
+           --abuse-factor=K       (abuser floods K x victims; default 4)
+           --victim-interval-ms   (victim pacing; default 60)
+           --abuser-rate=R        (QoS arm: abuser admits/s; default 2)
+    """
+    import os
+    import threading
+
+    import jax
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from svd_jacobi_tpu.serve import as_bucket
+    bucket = as_bucket(flags.get("bucket", "64x48:float32"))
+    if bucket.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+    if "tuning-table" in flags:
+        from svd_jacobi_tpu import tune
+        tune.set_active_table(flags["tuning-table"])
+
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu import SVDConfig
+    from svd_jacobi_tpu.resilience import chaos
+    from svd_jacobi_tpu.serve import (AdmissionError, ServeConfig,
+                                      SVDService)
+    from svd_jacobi_tpu.utils import matgen
+
+    victims = int(flags.get("victims", "12"))
+    abuse_factor = int(flags.get("abuse-factor", "4"))
+    interval_s = float(flags.get("victim-interval-ms", "60")) / 1e3
+    abuser_rate = float(flags.get("abuser-rate", "2"))
+    events = chaos.adversarial_tenant(
+        "flood", n_victim=victims, abuse_factor=abuse_factor,
+        victim_interval_s=interval_s)
+    # Host-side numpy inputs, premade: the paced dispatcher must spend
+    # its tick submitting, not generating.
+    mats = {s: np.asarray(matgen.random_dense(
+                bucket.m, bucket.n, seed=s,
+                dtype=jnp.dtype(bucket.dtype)))
+            for s in sorted({ev["mat_seed"] for ev in events})}
+
+    def one_arm(qos_on: bool) -> dict:
+        tenancy = (dict(tenants={"alice": {"weight": 4.0},
+                                 "mallory": {"rate": abuser_rate,
+                                             "burst": 2.0}})
+                   if qos_on else {})
+        cfg = ServeConfig(
+            buckets=(bucket,), solver=SVDConfig(),
+            max_queue_depth=max(64, 2 * len(events)),
+            # Brownout off: a degraded response would change the work
+            # between arms and poison the comparison.
+            brownout_sigma_only_at=2.0, brownout_shed_at=2.0,
+            **tenancy)
+        svc = SVDService(cfg).start()
+        svc.warmup(timeout=1800.0)
+        lock = threading.Lock()
+        lat = {"alice": [], "mallory": []}
+        shed = {"alice": 0, "mallory": 0}
+        waiters = []
+
+        def waiter(ticket, who, t_sub):
+            ok = False
+            try:
+                res = ticket.result(timeout=1800.0)
+                ok = (res.error is None and res.status is not None
+                      and res.status.name == "OK")
+            except Exception:
+                pass
+            with lock:
+                lat[who].append((time.perf_counter() - t_sub, ok))
+
+        t0 = time.perf_counter()
+        for ev in events:
+            lag = t0 + ev["at_s"] - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            t_sub = time.perf_counter()
+            try:
+                # The pre-tenancy arm submits ANONYMOUSLY (the exact
+                # single-caller surface); the QoS arm carries identity.
+                ticket = svc.submit(
+                    mats[ev["mat_seed"]],
+                    tenant=(ev["tenant"] if qos_on else None))
+            except AdmissionError:
+                with lock:
+                    shed[ev["tenant"]] += 1
+                continue
+            th = threading.Thread(target=waiter,
+                                  args=(ticket, ev["tenant"], t_sub),
+                                  daemon=True)
+            th.start()
+            waiters.append(th)
+        for th in waiters:
+            th.join(timeout=1800.0)
+        wall = time.perf_counter() - t0
+        svc.stop(drain=True, timeout=60.0)
+        out = {"wall_s": round(wall, 3)}
+        for who in ("alice", "mallory"):
+            xs = sorted(d for d, _ in lat[who])
+            q = (lambda p: round(xs[min(len(xs) - 1,
+                                        int(p * len(xs)))] * 1e3, 2)
+                 if xs else None)
+            out[who] = {"submits": len(lat[who]) + shed[who],
+                        "served": len(lat[who]),
+                        "ok": sum(1 for _, ok in lat[who] if ok),
+                        "shed": shed[who],
+                        "p50_ms": q(0.50), "p99_ms": q(0.99)}
+        return out
+
+    rows = {}
+    for qos_on in (False, True):
+        arm = "qos" if qos_on else "noqos"
+        r = one_arm(qos_on)
+        rows[arm] = r
+        print(json.dumps({
+            "metric": f"serve_tenants_{arm}_{bucket.name}",
+            "value": r["alice"]["p99_ms"],
+            "unit": "ms victim p99",
+            "victims": victims, "abuse_factor": abuse_factor,
+            "victim_interval_ms": interval_s * 1e3,
+            "alice": r["alice"], "mallory": r["mallory"],
+            "wall_s": r["wall_s"],
+            "device": str(jax.devices()[0]),
+        }))
+    a, b = rows["noqos"]["alice"], rows["qos"]["alice"]
+    print(json.dumps({
+        "metric": f"serve_tenant_isolation_{bucket.name}",
+        "value": (round(a["p99_ms"] / b["p99_ms"], 2)
+                  if a["p99_ms"] and b["p99_ms"] else None),
+        "unit": "x victim p99, no-QoS / QoS",
+        "victim_goodput": {"noqos": a["ok"], "qos": b["ok"]},
+        "abuser_shed_qos": rows["qos"]["mallory"]["shed"],
+        "ok": (a["ok"] == a["submits"] and b["ok"] == b["submits"]
+               and rows["qos"]["mallory"]["shed"] > 0),
+    }))
 
 
 def _serve_metrics_overhead(flags) -> None:
@@ -1196,6 +1358,9 @@ def main() -> None:
         return
     if "serve-throughput" in flags:
         _serve_throughput(flags)
+        return
+    if "serve-tenants" in flags:
+        _serve_tenants(flags)
         return
     if "serve-metrics-overhead" in flags:
         _serve_metrics_overhead(flags)

@@ -21,7 +21,7 @@ import time
 from pathlib import Path
 
 PASS_NAMES = ("ast", "jaxpr", "hlo", "recompile", "serve", "tune", "aot",
-              "obs", "route", "grad", "perf", "conc", "net")
+              "obs", "route", "grad", "perf", "conc", "net", "qos")
 
 
 def _parse_args(argv):
@@ -129,6 +129,16 @@ def main(argv=None) -> int:
             # replica (idempotency keys + journal-proven exactly-once).
             from . import route_checks
             findings, report = route_checks.run_net()
+            return findings, report
+        if name == "qos":
+            # The multi-tenant front-door contract (QOS001): every
+            # per-request serving metric is tenant-labeled (live and in
+            # the manifest reconstruction, SLO twins agreeing), WFQ
+            # dequeue is fair/work-conserving/starvation-free on a
+            # seeded schedule, and tenancy adds ZERO new jit entries
+            # (host-side identity never reaches a trace key).
+            from . import qos_checks
+            findings, report = qos_checks.run_all()
             return findings, report
         if name == "grad":
             # The differentiable-solver contract (GRAD001): grad traces

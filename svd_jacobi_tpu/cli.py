@@ -263,6 +263,31 @@ def _parse_serve_args(argv):
                         "per lane, per-lane fault domains with bucket-"
                         "affinity routing, work stealing, and lane "
                         "eviction/rescue/probe recovery")
+    # --- multi-tenancy & QoS (tenant-aware admission + WFQ dequeue) ------
+    p.add_argument("--tenants", type=int, default=0, metavar="N",
+                   help="declare N equal-weight tenants "
+                        "(tenant-0..tenant-(N-1)) and spread the request "
+                        "plan round-robin across them; the summary gains "
+                        "a per-tenant SLO section reconstructed from "
+                        "validated serve records. 0 = the single-tenant "
+                        "legacy surface (default)")
+    p.add_argument("--adversary", default=None, metavar="MODE",
+                   choices=["flood", "burst", "resubmit",
+                            "deadline_abuse"],
+                   help="fairness drill (needs --tenants >= 2): replay "
+                        "the seeded resilience.chaos.adversarial_tenant "
+                        "schedule — the SAME schedule the '-m chaos' "
+                        "tenancy tests replay for a given seed — victim "
+                        "'alice' (weight 4) against abuser 'mallory' "
+                        "(rate-limited; budget-capped under "
+                        "deadline_abuse), plus N-2 equal-weight "
+                        "bystanders; exits non-zero on any fairness-band "
+                        "violation (a victim or bystander request not "
+                        "OK, the abuser never shed, or anyone but the "
+                        "abuser rejected)")
+    p.add_argument("--adversary-victims", type=int, default=8,
+                   help="victim submits in the drill schedule (the "
+                        "abuser floods 4x that)")
     p.add_argument("--report-dir", default="reports",
                    help="manifest directory (per-request 'serve' JSONL "
                         "records appended to <dir>/manifest.jsonl); "
@@ -377,6 +402,38 @@ def _serve_demo_run(args, lock_graph=None) -> int:
         jax.config.update("jax_enable_x64", True)
     manifest_path = (None if args.report_dir == "off"
                      else str(Path(args.report_dir) / "manifest.jsonl"))
+    # Multi-tenant front door: named tenants get declared QoS policies
+    # and the plan (or the adversarial drill schedule) carries tenant
+    # identity on every submit. --tenants 0 keeps the exact pre-tenancy
+    # single-caller surface.
+    tenant_names = []
+    tenancy_kw = {}
+    if args.adversary:
+        if args.tenants < 2:
+            raise SystemExit("--adversary needs --tenants >= 2 "
+                             "(victim + abuser; extras are bystanders)")
+        if args.replicas > 1:
+            raise SystemExit(
+                "--adversary needs --replicas 1: the drill's token/"
+                "budget arithmetic is per-replica, and the federated "
+                "fairness path is covered by the '-m chaos' tenancy "
+                "tests (tests/test_tenancy.py)")
+        bystanders = [f"tenant-{i}" for i in range(2, args.tenants)]
+        tenants_cfg = {"alice": {"weight": 4.0}}
+        if args.adversary == "deadline_abuse":
+            # The abuser's hour-long deadline promises blow its 10%
+            # share of the deadline budget immediately.
+            tenants_cfg["mallory"] = {"budget_share": 0.1}
+            tenancy_kw["max_deadline_budget_s"] = 120.0
+        else:
+            tenants_cfg["mallory"] = {"rate": 0.5, "burst": 2.0}
+        for name in bystanders:
+            tenants_cfg[name] = {"weight": 1.0}
+        tenancy_kw["tenants"] = tenants_cfg
+        tenancy_kw["queue_ordering"] = "edf"
+    elif args.tenants > 0:
+        tenant_names = [f"tenant-{i}" for i in range(args.tenants)]
+        tenancy_kw["tenants"] = {t: {"weight": 1.0} for t in tenant_names}
     cfg = ServeConfig(buckets=buckets, solver=SVDConfig(),
                       max_queue_depth=args.queue_depth,
                       manifest_path=manifest_path,
@@ -384,7 +441,8 @@ def _serve_demo_run(args, lock_graph=None) -> int:
                       batch_window_s=max(0.0, args.batch_window_ms) / 1e3,
                       lanes=max(1, args.lanes),
                       journal_path=args.journal,
-                      compile_cache_dir=args.compile_cache)
+                      compile_cache_dir=args.compile_cache,
+                      **tenancy_kw)
     replicas = max(1, args.replicas)
     http_servers = []      # --transport=http: in-process replica servers
     http_proxies = []      # --net-chaos: fault proxies on the wire
@@ -484,6 +542,12 @@ def _serve_demo_run(args, lock_graph=None) -> int:
         return 0 if all(s in ("OK", "DEADLINE") for s in results.values()) \
             else 1
 
+    if args.adversary:
+        # Fairness drill: replay the seeded adversarial-tenant schedule
+        # instead of the closed-loop plan (single replica, asserted
+        # above) and judge the band from validated serve records.
+        return _adversary_drill_run(args, svc, bucket_set[0], log)
+
     # Seeded request plan, built up front so the run is reproducible: a
     # shape drawn within a random bucket, plus the deadline class. A
     # draw from a "topk" bucket ALWAYS submits with top_k (a full
@@ -514,10 +578,13 @@ def _serve_demo_run(args, lock_graph=None) -> int:
                 i = next_i[0]
                 next_i[0] += 1
             m, n, dtype, tight, seed, top_k = plan[i]
+            tenant = (tenant_names[i % len(tenant_names)]
+                      if tenant_names else None)
             a = matgen.random_dense(m, n, seed=seed, dtype=jnp.dtype(dtype))
             deadline = (args.tight_ms / 1e3) if tight else args.deadline_s
             try:
-                t = svc.submit(a, deadline_s=deadline, top_k=top_k)
+                t = svc.submit(a, deadline_s=deadline, top_k=top_k,
+                               tenant=tenant)
             except AdmissionError as e:
                 with out_lock:
                     outcomes.append({"i": i, "terminal": True, "tight": tight,
@@ -583,6 +650,20 @@ def _serve_demo_run(args, lock_graph=None) -> int:
     }
     if args.topk_mix:
         summary["topk_requests"] = sum(1 for p in plan if p[5] is not None)
+    if tenant_names:
+        # Per-tenant SLO totals reconstructed from VALIDATED serve
+        # records — the same offline path `cli.py metrics --slo` walks,
+        # so the summary's numbers are the manifest's, not in-process
+        # counters.
+        from svd_jacobi_tpu.obs.registry import tenant_slo_from_records
+        all_records = list(svc.records())
+        if replicas > 1:
+            for rep in svc.replicas:
+                if hasattr(rep, "service"):     # local handles only
+                    all_records += rep.service.records()
+        summary["tenants"] = {
+            t: _tenant_totals(snap)
+            for t, snap in tenant_slo_from_records(all_records).items()}
     if replicas > 1:
         summary["replicas"] = replicas
         summary["rescues"] = svc.total_rescues
@@ -640,6 +721,132 @@ def _serve_demo_run(args, lock_graph=None) -> int:
             f"({len(plan) - summary['terminal']} non-terminal, "
             f"{summary['errors']} errors)")
     return 0 if ok else 1
+
+
+def _tenant_totals(snap):
+    """Collapse one tenant's per-bucket SLO snapshot to flat totals."""
+    tot = {"served": 0, "ok": 0, "deadline_miss": 0, "error": 0,
+           "shed": 0}
+    for counts in snap["buckets"].values():
+        for key in tot:
+            tot[key] += int(counts.get(key, 0))
+    return tot
+
+
+def _adversary_drill_run(args, svc, bucket, log) -> int:
+    """``serve-demo --tenants N --adversary MODE``: the fairness drill.
+
+    Replays the seeded ``resilience.chaos.adversarial_tenant`` schedule
+    (the SAME schedule the ``-m chaos`` tenancy tests replay for this
+    seed) against the live service: victim "alice" (weight 4) plus N-2
+    equal-weight bystanders submit alongside abuser "mallory", whose
+    policy caps it per mode (token-bucket rate for flood/burst/resubmit,
+    a 10% deadline-budget share under deadline_abuse). Submits are
+    sequential — determinism lives in the token/budget arithmetic, not
+    in sleeps — and the band is judged from VALIDATED serve records
+    (`obs.registry.tenant_slo_from_records`), not in-process counters.
+
+    Exit non-zero on any fairness-band violation: a victim or bystander
+    submit not served OK, the abuser never shed, or a rejection landing
+    on anyone but the abuser (or with the wrong reason)."""
+    import jax.numpy as jnp
+
+    from svd_jacobi_tpu.obs.registry import tenant_slo_from_records
+    from svd_jacobi_tpu.resilience import chaos
+    from svd_jacobi_tpu.serve import AdmissionError
+    from svd_jacobi_tpu.utils import matgen
+
+    n_victim = max(1, args.adversary_victims)
+    events = chaos.adversarial_tenant(args.adversary, n_victim=n_victim,
+                                      abuse_factor=4, seed=args.seed)
+    bystanders = [f"tenant-{i}" for i in range(2, args.tenants)]
+
+    def mat(seed):
+        return matgen.random_dense(bucket.m, bucket.n, seed=seed,
+                                   dtype=jnp.dtype(bucket.dtype))
+
+    submits: dict = {}
+    rejections = []
+    errors = 0
+
+    def fire(tenant, seed, deadline_s):
+        nonlocal errors
+        submits[tenant] = submits.get(tenant, 0) + 1
+        try:
+            t = svc.submit(mat(seed), tenant=tenant,
+                           deadline_s=deadline_s)
+        except AdmissionError as e:
+            rejections.append({"tenant": tenant, "reason": e.reason.name})
+            return
+        res = t.result(timeout=600.0)
+        if res.error:
+            errors += 1
+
+    t0 = time.perf_counter()
+    svc.start()
+    if args.warmup:
+        svc.warmup(timeout=600.0)
+    for ev in events:
+        deadline = ev["deadline_s"]
+        if args.adversary == "deadline_abuse" and ev["tenant"] == "alice":
+            # Victim deadlines are generous-but-finite; the abuser's
+            # hour-long promises are the attack.
+            deadline = 60.0
+        fire(ev["tenant"], ev["mat_seed"], deadline)
+        if ev["tenant"] == "alice":
+            # Bystander load rides alongside every victim submit, so
+            # the band also proves innocent third parties stay whole.
+            for bi, name in enumerate(bystanders):
+                fire(name, 30_000 + 1_000 * bi + ev["mat_seed"] % 1_000,
+                     deadline)
+    health = svc.healthz()   # live snapshot, BEFORE the shutdown flips it
+    svc.stop(drain=True, timeout=60.0)
+    wall = time.perf_counter() - t0
+
+    totals = {t: _tenant_totals(snap) for t, snap in
+              tenant_slo_from_records(svc.records()).items()}
+    expected_reason = ("DEADLINE_BUDGET"
+                       if args.adversary == "deadline_abuse"
+                       else "RATE_LIMITED")
+    violations = []
+    for name in ["alice"] + bystanders:
+        tot = totals.get(name, {"ok": 0, "shed": 0})
+        want = submits.get(name, 0)
+        if tot["ok"] != want or tot["shed"] != 0:
+            violations.append(
+                f"{name}: ok={tot['ok']}/{want} shed={tot['shed']} — "
+                "every victim/bystander submit must be served OK")
+    if totals.get("mallory", {}).get("shed", 0) < 1:
+        violations.append("mallory: never shed — the abuse was not "
+                          "contained")
+    bad = [r for r in rejections
+           if r["tenant"] != "mallory" or r["reason"] != expected_reason]
+    if bad:
+        violations.append(f"unexpected rejections: {bad}")
+    if errors:
+        violations.append(f"{errors} errored request(s)")
+
+    by_reason: dict = {}
+    for r in rejections:
+        key = f"{r['tenant']}:{r['reason']}"
+        by_reason[key] = by_reason.get(key, 0) + 1
+    print(json.dumps({
+        "adversary": args.adversary,
+        "seed": args.seed,
+        "events": len(events),
+        "submits": submits,
+        "tenants": totals,
+        "rejections": by_reason,
+        "fairness_ok": not violations,
+        "violations": violations,
+        "wall_s": wall,
+        "health_tenants": health.get("tenants"),
+    }))
+    if violations:
+        log("exit 1: fairness band violated:\n  - "
+            + "\n  - ".join(violations))
+        return 1
+    return 0
 
 
 def _restart_drill(args) -> int:

@@ -504,6 +504,11 @@ LOCK_ORDER = {
     "ticket_finalize": ("serve/service.py", "Ticket._finalize_lock",
                         "cache"),
     "router_ticket": ("serve/router.py", "RouterTicket._lock", "cache"),
+    # Multi-tenant QoS table: one per service, SHARED by every lane's
+    # admission queue (rates/fairness are per-service promises). A leaf
+    # by construction — acquired under a queue's condition (queue 30 ->
+    # cache 40), never held across anything that blocks.
+    "tenant_table": ("serve/queue.py", "TenantTable._lock", "cache"),
     "promotion_store": ("serve/cache.py", "PromotionStore._lock", "cache"),
     "result_cache": ("serve/cache.py", "ResultCache._lock", "cache"),
     "breaker": ("serve/breaker.py", "CircuitBreaker._lock", "cache"),

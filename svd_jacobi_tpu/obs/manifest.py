@@ -108,6 +108,10 @@ _SERVE_PHASE_FIELDS: Dict[str, Any] = {
     "phase": str,                       # "full" | "sigma" | "promote"
     "promoted_from": (str, type(None)),
     "digest": (str, type(None)),
+    # The submitting tenant (multi-tenant front door). Optional so
+    # pre-tenancy streams stay valid; type-checked when present — the
+    # per-tenant SLO/fairness reconstruction keys on it.
+    "tenant": str,
 }
 # Federation events ("router", written by serve.router): one record per
 # replica state transition / journal rescue / routing decision / probe /
@@ -354,7 +358,8 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
                 k: Optional[int] = None,
                 phase: str = "full",
                 promoted_from: Optional[str] = None,
-                digest: Optional[str] = None, **extra) -> dict:
+                digest: Optional[str] = None,
+                tenant: str = "default", **extra) -> dict:
     """Assemble a schema-valid per-request serving record
     (`serve.SVDService`). ``batch_id``/``batch_size``/``batch_tier``
     identify a COALESCED dispatch (micro-batched solve lane): every
@@ -368,9 +373,11 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
     two-phase serving stage this record closes ("full" | "sigma" |
     "promote"); a "promote" record carries ``promoted_from`` — the
     sigma-phase request id whose retained solve state it resumed — so a
-    σ-then-promote pair reconstructs from the stream. ``extra``
-    (degraded, deadline_s, sweeps, error, ...) rides along like in
-    `build`."""
+    σ-then-promote pair reconstructs from the stream. ``tenant`` is the
+    submitting tenant ("default" on the single-caller surface) — it
+    makes per-tenant SLO and fairness accounting reconstructable
+    offline. ``extra`` (degraded, deadline_s, sweeps, error, ...) rides
+    along like in `build`."""
     record = {
         "schema_version": SCHEMA_VERSION,
         "kind": "serve",
@@ -394,6 +401,7 @@ def build_serve(*, request_id: str, m: int, n: int, dtype: str,
         "promoted_from": (None if promoted_from is None
                           else str(promoted_from)),
         "digest": None if digest is None else str(digest),
+        "tenant": str(tenant),
     }
     record.update(extra)
     validate(record)

@@ -434,6 +434,15 @@ class SLOTracker:
 # -- offline reconstruction from manifest records ---------------------------
 
 
+# The load-class rejection statuses (offline mirror of the live
+# `slo.shed` gate in serve.service.submit — keep the two lists in
+# lockstep; the tenancy analysis pass cross-checks agreement on real
+# traffic).
+_SHED_STATUSES = ("REJECTED_BROWNOUT_SHED", "REJECTED_QUEUE_FULL",
+                  "REJECTED_DEADLINE_BUDGET", "REJECTED_RATE_LIMITED",
+                  "REJECTED_NO_LANE")
+
+
 def slo_from_records(records: List[dict], *, objective: float = 0.99
                      ) -> dict:
     """SLO snapshot reconstructed from "serve" manifest records alone —
@@ -442,14 +451,13 @@ def slo_from_records(records: List[dict], *, objective: float = 0.99
     slo = SLOTracker(objective=objective, window=2 ** 31 - 1,
                      reservoir=2 ** 20)
     # Load-class rejections burn the error budget; client errors
-    # (NO_BUCKET, NONFINITE_INPUT) and shutdown do not — mirrors the
-    # live SLOTracker feed in serve.service exactly, so a live
-    # healthz()["slo"] and this reconstruction agree on the same
-    # traffic. (Bucket attribution of sheds differs by design: rejected
-    # serve records carry bucket=None, so offline sheds land under
-    # "_rejected".)
-    _SHED_STATUSES = ("REJECTED_BROWNOUT_SHED", "REJECTED_QUEUE_FULL",
-                      "REJECTED_DEADLINE_BUDGET", "REJECTED_NO_LANE")
+    # (NO_BUCKET, NONFINITE_INPUT, UNKNOWN_TENANT) and shutdown do not
+    # — mirrors the live SLOTracker feed in serve.service exactly, so a
+    # live healthz()["slo"] and this reconstruction agree on the same
+    # traffic. RATE_LIMITED is load-class: the service chose to reject
+    # it under the tenant's QoS contract. (Bucket attribution of sheds
+    # differs by design: rejected serve records carry bucket=None, so
+    # offline sheds land under "_rejected".)
     for rec in records:
         if rec.get("kind") != "serve":
             continue
@@ -466,6 +474,40 @@ def slo_from_records(records: List[dict], *, objective: float = 0.99
                     deadline_miss=(status == "DEADLINE"),
                     error=(status == "ERROR"))
     return slo.snapshot()
+
+
+def tenant_slo_from_records(records: List[dict], *,
+                            objective: float = 0.99) -> dict:
+    """Per-tenant SLO snapshots reconstructed from "serve" manifest
+    records alone: ``{tenant: snapshot}`` with the same snapshot shape
+    as `SLOTracker.snapshot` — the offline twin of the live
+    ``healthz()["tenants"][t]["slo"]`` trackers, and the substrate the
+    adversarial-tenant fairness drills assert against (records, not
+    timers). A pre-tenancy record without a "tenant" field lands under
+    "default", so old streams reconstruct unchanged."""
+    trackers: Dict[str, SLOTracker] = {}
+    for rec in records:
+        if rec.get("kind") != "serve":
+            continue
+        tenant = str(rec.get("tenant", "default"))
+        slo = trackers.get(tenant)
+        if slo is None:
+            slo = trackers[tenant] = SLOTracker(
+                objective=objective, window=2 ** 31 - 1,
+                reservoir=2 ** 20)
+        status = str(rec.get("status", ""))
+        bucket = rec.get("bucket") or "_rejected"
+        if status.startswith("REJECTED_"):
+            if status in _SHED_STATUSES:
+                slo.shed(bucket)
+            continue
+        wait = rec.get("queue_wait_s") or 0.0
+        solve = rec.get("solve_time_s") or 0.0
+        slo.observe(bucket, float(wait) + float(solve),
+                    ok=(status == "OK"),
+                    deadline_miss=(status == "DEADLINE"),
+                    error=(status == "ERROR"))
+    return {t: slo.snapshot() for t, slo in sorted(trackers.items())}
 
 
 def render_slo(snap: dict) -> str:
@@ -499,22 +541,28 @@ def registry_from_manifest(records: List[dict]) -> MetricsRegistry:
         if kind == "serve":
             status = str(rec.get("status", "?"))
             bucket = rec.get("bucket") or "none"
+            # Pre-tenancy records carry no tenant field -> "default",
+            # matching the live emit sites' label set exactly.
+            tenant = str(rec.get("tenant", "default"))
             if status.startswith("REJECTED_"):
                 reg.inc("svdj_requests_rejected_total",
                         reason=status[len("REJECTED_"):].lower(),
+                        tenant=tenant,
                         help="requests rejected at admission")
                 continue
             reg.inc("svdj_requests_finalized_total", status=status,
                     path=str(rec.get("path", "?")),
-                    phase=str(rec.get("phase", "full")),
+                    phase=str(rec.get("phase", "full")), tenant=tenant,
                     help="requests reaching a terminal status")
             if rec.get("queue_wait_s") is not None:
                 reg.observe("svdj_queue_wait_seconds",
                             float(rec["queue_wait_s"]), bucket=bucket,
+                            tenant=tenant,
                             help="admission-to-dispatch queue wait")
             if rec.get("solve_time_s") is not None:
                 reg.observe("svdj_solve_seconds",
                             float(rec["solve_time_s"]), bucket=bucket,
+                            tenant=tenant,
                             help="dispatch-to-finish solve time")
             if rec.get("sweeps") is not None:
                 reg.inc("svdj_sweeps_total", float(rec["sweeps"]),

@@ -65,6 +65,11 @@ detection/recovery machinery of this repo actually works:
     compile-cache entry on disk (`serve.registry`'s executable cache),
     proving a corrupt entry degrades to a loud fresh compile, never a
     crash or a garbage executable.
+  * `adversarial_tenant(mode)` — seeded adversarial multi-tenant
+    traffic schedules (flooding, bursty, byte-identical-resubmit-heavy,
+    deadline-abusing) for the fairness drills: the well-behaved
+    tenant's goodput and p99 must hold (asserted from validated serve
+    records, not timers) while the abuser is rate-limited/browned-out.
 
 Everything here is deterministic: a hook fires at an exact sweep index /
 byte offset, never at random, so chaos-lane failures replay exactly.
@@ -483,3 +488,89 @@ def net_proxy(upstream, **faults):
         else:
             proxy.arm(kind, shots=int(spec))
     return proxy
+
+
+# -- adversarial multi-tenant traffic mixes ---------------------------------
+
+# The recognized adversary behaviours for `adversarial_tenant`:
+#   * "flood"          — the abuser submits far faster than its fair
+#     share, evenly spaced (steady-state overload: the rate limiter and
+#     WFQ must hold the victim's goodput/p99).
+#   * "burst"          — the same excess volume delivered in dense
+#     bursts with quiet gaps (token-bucket burst credit + queue-depth
+#     pressure: brownout pricing must shed the abuser first).
+#   * "resubmit"       — byte-identical resubmit-heavy traffic (every
+#     abuser submit reuses one matrix seed): with per-tenant cache keys
+#     the abuser gets NO cross-tenant hits and keeps paying admission.
+#   * "deadline_abuse" — every abuser request carries a huge deadline,
+#     trying to exhaust the shared deadline budget; per-tenant budget
+#     shares must keep the victim admitting.
+ADVERSARY_MODES = ("flood", "burst", "resubmit", "deadline_abuse")
+
+
+def adversarial_tenant(mode, *, n_victim=20, abuse_factor=5,
+                       seed=0, abuser="mallory", victim="alice",
+                       victim_interval_s=0.02,
+                       abuse_deadline_s=3600.0):
+    """Deterministic adversarial-tenant traffic schedule (the fairness
+    drills' single source of truth — tests and `cli.py serve-demo
+    --adversary` replay the SAME schedule for a given seed).
+
+    Returns a list of submit events sorted by ``at_s`` (seconds from
+    drill start), each a dict::
+
+        {"at_s": float, "tenant": str, "mat_seed": int,
+         "deadline_s": Optional[float], "resubmit": bool}
+
+    ``mat_seed`` keys the matrix generator, so byte-identical resubmits
+    are expressed as repeated seeds (``resubmit=True`` marks them); the
+    driver owns actual matrix generation and submission. Determinism:
+    same (mode, kwargs) -> same schedule, no randomness at fire time.
+    """
+    import random
+    if mode not in ADVERSARY_MODES:
+        raise ValueError(f"unknown adversary mode {mode!r} "
+                         f"(known: {ADVERSARY_MODES})")
+    rng = random.Random(int(seed))
+    n_victim = int(n_victim)
+    n_abuse = n_victim * int(abuse_factor)
+    span = n_victim * float(victim_interval_s)
+    events = []
+    for i in range(n_victim):
+        events.append({"at_s": i * float(victim_interval_s),
+                       "tenant": str(victim),
+                       "mat_seed": 10_000 + i,
+                       "deadline_s": None, "resubmit": False})
+    if mode == "flood":
+        step = span / max(1, n_abuse)
+        for j in range(n_abuse):
+            events.append({"at_s": j * step, "tenant": str(abuser),
+                           "mat_seed": 20_000 + j,
+                           "deadline_s": None, "resubmit": False})
+    elif mode == "burst":
+        # Bursts of ~10 land together, gaps in between; the jitter
+        # inside a burst is seeded, not timed.
+        burst = 10
+        n_bursts = max(1, n_abuse // burst)
+        for b in range(n_bursts):
+            t0 = (b + 0.5) * span / n_bursts
+            for j in range(burst):
+                events.append({"at_s": t0 + rng.uniform(0.0, 1e-3),
+                               "tenant": str(abuser),
+                               "mat_seed": 20_000 + b * burst + j,
+                               "deadline_s": None, "resubmit": False})
+    elif mode == "resubmit":
+        step = span / max(1, n_abuse)
+        for j in range(n_abuse):
+            events.append({"at_s": j * step, "tenant": str(abuser),
+                           "mat_seed": 20_000,       # SAME bytes each time
+                           "deadline_s": None, "resubmit": j > 0})
+    else:   # deadline_abuse
+        step = span / max(1, n_abuse)
+        for j in range(n_abuse):
+            events.append({"at_s": j * step, "tenant": str(abuser),
+                           "mat_seed": 20_000 + j,
+                           "deadline_s": float(abuse_deadline_s),
+                           "resubmit": False})
+    events.sort(key=lambda e: (e["at_s"], e["tenant"], e["mat_seed"]))
+    return events

@@ -93,6 +93,23 @@ class Bucket(NamedTuple):
 
 BucketSpec = Union[Bucket, Tuple, str]
 
+# Weighted-fair-queueing charge reference: the classic 64x64 full
+# bucket's per-sweep cost. A dequeue charges its tenant the routed
+# bucket's cost over this, so "fair share" is fair in WORK, not request
+# count — a tenant submitting big buckets spends its share faster than
+# one submitting small ones.
+_WFQ_REF_COST = 64 * 64 * 64
+
+
+def admission_cost(bucket: Optional[Bucket]) -> float:
+    """The WFQ charge of dequeuing one request routed to ``bucket``
+    (`serve.queue.TenantTable.charge`). Floored at 1.0 so a tiny (or
+    bucket-less rescue) request still spends a full dequeue — fairness
+    must not be gameable by slicing work arbitrarily fine."""
+    if bucket is None:
+        return 1.0
+    return max(1.0, bucket.cost / _WFQ_REF_COST)
+
 
 def as_bucket(spec: BucketSpec) -> Bucket:
     """Coerce a bucket spec: a Bucket, an (m, n, dtype[, kind[, k]])

@@ -107,6 +107,9 @@ class PromotionState:
     top_k: Optional[int]
     digest: Optional[str]         # input digest when the cache computed one
     lane: int
+    # The submitting tenant — promote-time result-cache stores key under
+    # it (tenant isolation holds across the σ→promote flow too).
+    tenant: str = "default"
     # -- kind="state": the checkpointed stage -----------------------------
     path: str = "kernel"          # "kernel" | "xla" (which finish jit)
     top: Any = None

@@ -126,10 +126,15 @@ class Lane:
     lock."""
 
     def __init__(self, index: int, *, max_depth: int, budget_s: float,
-                 breaker_threshold: int, device=None):
+                 breaker_threshold: int, device=None, qos=None,
+                 ordering: str = "fifo"):
         from .breaker import CircuitBreaker
         self.index = int(index)
-        self.queue = AdmissionQueue(max_depth, budget_s)
+        # ``qos`` is the service's ONE shared TenantTable (or None):
+        # per-lane tables would multiply each tenant's rate limit and
+        # fair share by the lane count.
+        self.queue = AdmissionQueue(max_depth, budget_s, qos=qos,
+                                    ordering=ordering)
         self.breaker = CircuitBreaker(breaker_threshold)
         self.device = device          # None = default placement (lanes=1)
         self.state = LaneState.ACTIVE
@@ -206,7 +211,9 @@ class Fleet:
             Lane(i, max_depth=cfg.max_queue_depth,
                  budget_s=cfg.max_deadline_budget_s,
                  breaker_threshold=cfg.breaker_threshold,
-                 device=devices[i])
+                 device=devices[i],
+                 qos=getattr(service, "tenant_table", None),
+                 ordering=cfg.queue_ordering)
             for i in range(self.size)]
         # Bucket affinity: declaration order modulo lane count. Stable
         # across the service's lifetime so a bucket's jit cache stays

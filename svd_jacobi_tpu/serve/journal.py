@@ -426,6 +426,10 @@ class Journal:
                            else float(req.deadline_s)),
             "top_k": None if req.top_k is None else int(req.top_k),
             "phase": str(getattr(req, "phase", "full")),
+            # Tenant attribution survives replica death: recovery and
+            # cross-replica debt rescue rebuild the Request (and its SLO
+            # accounting) under the ORIGINAL tenant, not the rescuer's.
+            "tenant": str(getattr(req, "tenant", "default")),
             "input": _encode_array(req.a, payload_mode,
                                    digest=getattr(req, "digest", None)),
         }

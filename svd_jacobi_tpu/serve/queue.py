@@ -15,6 +15,25 @@ rejections on top before calling `admit`:
     may promise: every queued deadline is a promise to answer by then,
     and a service that keeps promising past its throughput converts every
     deadline into a DEADLINE status — better to reject at the door.
+
+Multi-tenant QoS (the caller-ring fault domain) also lives here: every
+`Request` carries a ``tenant`` identity, and a queue built with a shared
+`TenantTable` adds
+
+  * ``RATE_LIMITED`` — the tenant's token-bucket rate limit is
+    exhausted (checked LAST, so a rejection for any other reason never
+    burns a token — rejections must never leak budget of any kind);
+  * a per-tenant SHARE of the deadline-budget cap
+    (`TenantPolicy.budget_share`), so one deadline-abusing tenant
+    cannot promise away the whole queue's future;
+  * weighted-fair dequeue across tenants (`TenantPolicy.weight`,
+    cost-weighted via `buckets.admission_cost`, work-conserving: with
+    one live tenant the pick degenerates to plain FIFO/EDF);
+  * an EDF-vs-FIFO ordering knob (`ServeConfig.queue_ordering`).
+
+With no table and the default ordering, every path below is
+byte-identical to the pre-tenancy queue — today's single-caller
+surface is the ``tenant="default"`` special case.
 """
 
 from __future__ import annotations
@@ -24,9 +43,15 @@ import dataclasses
 import enum
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Mapping, Optional
 
-from .buckets import Bucket
+from .buckets import Bucket, admission_cost
+
+#: The implicit tenant of every caller that never says otherwise: all
+#: pre-tenancy surfaces (bare ``submit``, old journals, v1 wire records
+#: without a tenant key) resolve here, so the single-caller behavior is
+#: the default tenant's behavior, byte for byte.
+DEFAULT_TENANT = "default"
 
 
 class AdmissionReason(enum.Enum):
@@ -34,6 +59,14 @@ class AdmissionReason(enum.Enum):
 
     QUEUE_FULL = "queue_full"
     DEADLINE_BUDGET = "deadline_budget"
+    # Per-tenant QoS: the tenant's token-bucket rate limit is exhausted.
+    # Checked LAST in `admit` (after depth and both budget rules) so a
+    # rejection for any other reason never consumes a token.
+    RATE_LIMITED = "rate_limited"
+    # The API token on the wire resolves to no tenant in
+    # `ServeConfig.api_tokens` — an identity failure, not a load
+    # condition: never a router failover reason, never an SLO shed.
+    UNKNOWN_TENANT = "unknown_tenant"
     NO_BUCKET = "no_bucket"
     NONFINITE_INPUT = "nonfinite_input"
     BROWNOUT_SHED = "brownout_shed"
@@ -104,17 +137,201 @@ class Request:
     # its eventual serve record carries this as ``path`` so the rescue
     # reconstructs from the stream. None for ordinary submits.
     via: Optional[str] = None
+    # First-class caller identity (multi-tenant front door): resolved at
+    # submit (explicit name, or `ServeConfig.api_tokens` on the wire),
+    # carried through the journal, debt rescue, and every serve record
+    # so per-tenant attribution survives replica death.
+    tenant: str = DEFAULT_TENANT
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Declared QoS of one tenant (`ServeConfig.tenants` values).
+
+    Every field defaults to the single-caller behavior — an undeclared
+    tenant is indistinguishable from today's sole caller: weight 1.0,
+    no rate limit, priority 1.0 (brownout rungs exactly at the
+    configured thresholds), no reserved deadline-budget share.
+    """
+
+    weight: float = 1.0               # weighted-fair dequeue share
+    rate: Optional[float] = None      # sustained admits/second (None = off)
+    burst: Optional[float] = None     # bucket capacity (None -> max(rate, 1))
+    priority: float = 1.0             # brownout price: < 1 degrades EARLIER
+    budget_share: Optional[float] = None  # fraction of the queue budget cap
+
+    def __post_init__(self):
+        if not self.weight > 0:
+            raise ValueError(f"tenant weight must be > 0, got {self.weight}")
+        if self.rate is not None and not self.rate > 0:
+            raise ValueError(f"tenant rate must be > 0, got {self.rate}")
+        if self.burst is not None and not self.burst > 0:
+            raise ValueError(f"tenant burst must be > 0, got {self.burst}")
+        if not self.priority > 0:
+            raise ValueError(
+                f"tenant priority must be > 0, got {self.priority}")
+        if (self.budget_share is not None
+                and not 0.0 < self.budget_share <= 1.0):
+            raise ValueError(f"tenant budget_share must be in (0, 1], "
+                             f"got {self.budget_share}")
+
+
+_DEFAULT_POLICY = TenantPolicy()
+
+
+def as_tenant_policy(spec) -> TenantPolicy:
+    """Coerce a `ServeConfig.tenants` value: a TenantPolicy, or a
+    mapping of its field names (the config-file-friendly spelling)."""
+    if isinstance(spec, TenantPolicy):
+        return spec
+    if isinstance(spec, Mapping):
+        unknown = set(spec) - {f.name for f in
+                               dataclasses.fields(TenantPolicy)}
+        if unknown:
+            raise ValueError(f"unknown TenantPolicy fields: "
+                             f"{sorted(unknown)}")
+        return TenantPolicy(**spec)
+    raise TypeError(f"cannot coerce {type(spec).__name__} to TenantPolicy")
+
+
+class TokenBucket:
+    """Deterministic token bucket: refill is a pure function of the
+    monotonic clock the caller passes IN (never read here), so tests
+    replay exactly. Guarded by `TenantTable._lock`."""
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.stamp = float(now)
+
+    def _refill(self, now: float) -> None:
+        if now > self.stamp:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.stamp) * self.rate)
+            self.stamp = now
+
+    def peek(self, now: float) -> float:
+        """Tokens available at ``now`` (refills; consumes nothing)."""
+        self._refill(now)
+        return self.tokens
+
+    def take(self, now: float) -> None:
+        """Consume one token. The caller gates on `peek` first; under a
+        cross-lane peek/take race the level may transiently dip a hair
+        below zero (bounded by the lane count) and the next refill
+        absorbs it — deterministic single-lane runs never see it."""
+        self._refill(now)
+        self.tokens -= 1.0
+
+
+class TenantTable:
+    """Shared per-tenant QoS state of ONE service: the token buckets and
+    the weighted-fair virtual clock. A single table is shared by every
+    lane's `AdmissionQueue` — rates and fairness are per-SERVICE
+    promises; per-lane buckets would multiply a tenant's rate by the
+    lane count — so it carries its own leaf lock (config.LOCK_ORDER
+    ``tenant_table``, cache tier): acquired under a queue's condition,
+    never the reverse, never held across anything that blocks."""
+
+    def __init__(self, policies: Optional[Mapping] = None,
+                 now: Optional[float] = None):
+        now = time.monotonic() if now is None else float(now)
+        self.policies: Dict[str, TenantPolicy] = {
+            str(name): as_tenant_policy(spec)
+            for name, spec in (policies or {}).items()}
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, TokenBucket] = {
+            name: TokenBucket(p.rate,
+                              p.burst if p.burst is not None
+                              else max(p.rate, 1.0), now)
+            for name, p in self.policies.items() if p.rate is not None}
+        # WFQ virtual finish times. The floor tracks the clock of the
+        # last-served start: an idle tenant's clock is clamped up to it
+        # on its next dequeue, so idleness banks no credit (a returning
+        # tenant is served promptly but cannot starve the others back).
+        self._vtime: Dict[str, float] = {}
+        self._vfloor = 0.0
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        return self.policies.get(tenant, _DEFAULT_POLICY)
+
+    def has_tokens(self, tenant: str, now: float) -> bool:
+        b = self._buckets.get(tenant)
+        if b is None:
+            return True               # no rate limit declared
+        with self._lock:
+            return b.peek(now) >= 1.0
+
+    def take_token(self, tenant: str, now: float) -> None:
+        b = self._buckets.get(tenant)
+        if b is not None:
+            with self._lock:
+                b.take(now)
+
+    def pick(self, live: List[str]) -> str:
+        """The WFQ tenant to serve next among ``live`` (tenant names in
+        FIFO order of their head request): smallest effective virtual
+        time wins, ties to the earliest queued head — deterministic,
+        and work-conserving because the caller only ever passes tenants
+        that HAVE queued work."""
+        with self._lock:
+            best, best_v = live[0], None
+            for t in live:
+                v = max(self._vtime.get(t, 0.0), self._vfloor)
+                if best_v is None or v < best_v:
+                    best, best_v = t, v
+            return best
+
+    def charge(self, tenant: str, cost: float) -> None:
+        """Advance the tenant's virtual finish time by ``cost`` over its
+        weight — called at EVERY dequeue path (plain pop, coalescing
+        follower, steal), so bypass pops still spend the share."""
+        w = self.policy(tenant).weight
+        with self._lock:
+            start = max(self._vtime.get(tenant, 0.0), self._vfloor)
+            self._vtime[tenant] = start + float(cost) / w
+            self._vfloor = start
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Per-tenant QoS view (healthz): declared policy + live bucket
+        level + virtual clock."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for name, p in self.policies.items():
+                b = self._buckets.get(name)
+                out[name] = {
+                    "weight": p.weight, "priority": p.priority,
+                    "rate": p.rate, "budget_share": p.budget_share,
+                    "tokens": None if b is None else round(b.peek(now), 3),
+                    "vtime": round(self._vtime.get(name, 0.0), 6),
+                }
+            return out
 
 
 class AdmissionQueue:
-    """Thread-safe bounded FIFO with the two queue-level admission rules."""
+    """Thread-safe bounded queue with the queue-level admission rules.
+
+    Plain FIFO by default; a shared `TenantTable` (``qos``) adds the
+    per-tenant rate/budget-share admission rules and weighted-fair
+    dequeue, and ``ordering="edf"`` dequeues earliest-deadline-first
+    (within the WFQ pick when a table is live, across the whole queue
+    otherwise; deadline-less requests sort last, ties stay FIFO)."""
 
     def __init__(self, max_depth: int,
-                 max_deadline_budget_s: float = float("inf")):
+                 max_deadline_budget_s: float = float("inf"), *,
+                 qos: Optional[TenantTable] = None,
+                 ordering: str = "fifo"):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if ordering not in ("fifo", "edf"):
+            raise ValueError(f"ordering must be 'fifo' or 'edf', "
+                             f"got {ordering!r}")
         self.max_depth = int(max_depth)
         self.max_deadline_budget_s = float(max_deadline_budget_s)
+        self.qos = qos
+        self.ordering = str(ordering)
         self._q: collections.deque = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -166,6 +383,7 @@ class AdmissionQueue:
                     AdmissionReason.QUEUE_FULL,
                     f"queue depth {len(self._q)} at max_depth "
                     f"{self.max_depth}")
+            tenant = getattr(req, "tenant", DEFAULT_TENANT)
             if req.deadline is not None:
                 # Condition's default lock is an RLock, so the re-entrant
                 # read of the one budget definition is safe.
@@ -177,21 +395,90 @@ class AdmissionQueue:
                         f"aggregate queued deadline budget "
                         f"{budget + add:.3f}s would exceed "
                         f"{self.max_deadline_budget_s:.3f}s")
+                # Per-tenant share of the same cap: one deadline-abusing
+                # tenant may only promise away its declared slice.
+                pol = (self.qos.policy(tenant) if self.qos is not None
+                       else None)
+                if (pol is not None and pol.budget_share is not None
+                        and self.max_deadline_budget_s != float("inf")):
+                    cap = pol.budget_share * self.max_deadline_budget_s
+                    mine = sum(max(0.0, r.deadline - now) for r in self._q
+                               if r.deadline is not None
+                               and not r.cancel.is_set()
+                               and getattr(r, "tenant",
+                                           DEFAULT_TENANT) == tenant)
+                    if mine + add > cap:
+                        raise AdmissionError(
+                            AdmissionReason.DEADLINE_BUDGET,
+                            f"tenant {tenant!r} queued deadline budget "
+                            f"{mine + add:.3f}s would exceed its "
+                            f"{pol.budget_share:.0%} share "
+                            f"({cap:.3f}s) of the cap")
+            # Token-bucket rate limit, LAST: a rejection for any reason
+            # above must never have consumed a token (the budget-leak
+            # audit of every rejection path), and nothing after the take
+            # can fail.
+            if self.qos is not None:
+                if not self.qos.has_tokens(tenant, now):
+                    pol = self.qos.policy(tenant)
+                    raise AdmissionError(
+                        AdmissionReason.RATE_LIMITED,
+                        f"tenant {tenant!r} is over its "
+                        f"{pol.rate:g} admits/s rate limit")
+                self.qos.take_token(tenant, now)
             self._q.append(req)
             self._cond.notify()
 
+    def _select(self) -> int:
+        """Index of the next request to dequeue under the tenancy policy
+        (caller holds the condition, ``_q`` non-empty). Index 0 — the
+        plain FIFO head — whenever the policy cannot change the answer,
+        so tenancy-off dequeue is byte-identical to the pre-tenancy
+        queue and WFQ is work-conserving with one live tenant."""
+        idxs = list(range(len(self._q)))
+        if self.qos is not None:
+            live: List[str] = []
+            for r in self._q:
+                t = getattr(r, "tenant", DEFAULT_TENANT)
+                if t not in live:
+                    live.append(t)
+            if len(live) > 1:
+                pick = self.qos.pick(live)
+                idxs = [i for i in idxs
+                        if getattr(self._q[i], "tenant",
+                                   DEFAULT_TENANT) == pick]
+        if self.ordering == "edf":
+            inf = float("inf")
+            return min(idxs, key=lambda i: (
+                inf if self._q[i].deadline is None
+                else self._q[i].deadline, i))
+        return idxs[0]
+
+    def _account(self, req: Request) -> None:
+        """Charge the dequeued request's tenant on the shared WFQ clock
+        — every removal path that hands work to a worker (plain pop,
+        coalescing follower, steal) spends the share."""
+        if self.qos is not None:
+            self.qos.charge(getattr(req, "tenant", DEFAULT_TENANT),
+                            admission_cost(req.bucket))
+
     def pop(self, timeout: Optional[float] = None) -> Optional[Request]:
-        """Oldest request; blocks until one arrives or the queue closes
-        (``timeout=None`` — no idle polling: `admit` and `close` notify
-        the condition). Returns None when closed-and-empty, or after an
-        explicit ``timeout`` expires."""
+        """Next request under the dequeue policy (FIFO head by default);
+        blocks until one arrives or the queue closes (``timeout=None`` —
+        no idle polling: `admit` and `close` notify the condition).
+        Returns None when closed-and-empty, or after an explicit
+        ``timeout`` expires."""
         with self._cond:
             while not self._q and not self._closed:
                 if not self._cond.wait(timeout):
                     return None
             if not self._q:
                 return None          # closed and drained
-            return self._q.popleft()
+            i = self._select()
+            req = self._q[i]
+            del self._q[i]
+            self._account(req)
+            return req
 
     def pop_same_bucket(self, bucket: Bucket, limit: int,
                         deadline: Optional[float] = None,
@@ -236,6 +523,7 @@ class AdmissionQueue:
                         break
                     if r.bucket == bucket:
                         self._q.remove(r)
+                        self._account(r)
                         out.append(r)
                 if len(out) >= limit or self._closed or barrier is not None:
                     return out
@@ -270,6 +558,7 @@ class AdmissionQueue:
             for r in self._q:
                 if not r.probe:
                     self._q.remove(r)
+                    self._account(r)
                     return r
             return None
 

@@ -104,8 +104,12 @@ from .service import ServeConfig, ServeResult, SVDService
 
 # Admission reasons that mean "this replica cannot take it right now,
 # but a sibling might" — the router fails these over along the ring.
-# Client-fault reasons (NO_BUCKET, NONFINITE_INPUT) re-raise untouched:
-# no replica can fix the request.
+# Client-fault reasons (NO_BUCKET, NONFINITE_INPUT, UNKNOWN_TENANT)
+# re-raise untouched: no replica can fix the request. RATE_LIMITED is
+# deliberately NOT here either — each replica enforces the tenant's
+# admits/s independently, so failing a rate-limited submit over would
+# multiply the tenant's effective rate by the replica count (an
+# adversarial tenant could farm the ring for free capacity).
 _FAILOVER_REASONS = frozenset({
     AdmissionReason.SHUTDOWN, AdmissionReason.QUEUE_FULL,
     AdmissionReason.DEADLINE_BUDGET, AdmissionReason.BROWNOUT_SHED,
@@ -338,13 +342,18 @@ class RouterTicket:
     request's new replica — the client never learns its replica died),
     resolves EXACTLY once (first writer wins, mirroring
     `Ticket._finalize_once` at the router level). ``digest`` is the
-    oriented-input SHA-256 the ring routed by — the resubmit key."""
+    oriented-input SHA-256 the ring routed by — the resubmit key.
+    ``tenant`` is the EXPLICIT tenant name the client submitted under
+    ("default" when none — an api_token resolves on the replica, not
+    here, so the router never learns the token map)."""
 
     def __init__(self, request_id: str, digest: Optional[str],
-                 bucket: Optional[str], router=None):
+                 bucket: Optional[str], router=None,
+                 tenant: str = "default"):
         self.request_id = str(request_id)
         self.digest = digest
         self.bucket = bucket
+        self.tenant = str(tenant)
         self._router = router
         self._done = threading.Event()
         self._result: Optional[ServeResult] = None
@@ -729,7 +738,7 @@ class SpoolReplica(ReplicaHandle):
 
     def submit(self, a, *, compute_u=True, compute_v=True,
                deadline_s=None, request_id=None, top_k=None,
-               phase="full", digest=None):
+               phase="full", digest=None, tenant=None, api_token=None):
         """Write one ADMIT-SHAPED submit record into the inbox: the
         record carries the oriented payload plus the full journal-admit
         field set, so an inbox file the replica never got to consume is
@@ -767,6 +776,10 @@ class SpoolReplica(ReplicaHandle):
             "phase": str(phase),
             "input": _encode_array(oriented, digest=digest),
         }
+        if tenant is not None:
+            rec["tenant"] = str(tenant)
+        if api_token is not None:
+            rec["api_token"] = str(api_token)
         _write_json_atomic(self.inbox / f"{rid}.json", rec)
         return _SpoolSub(self.outbox / f"{rid}.json", rid)
 
@@ -1057,14 +1070,20 @@ class ReplicaRouter:
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
                top_k: Optional[int] = None,
-               phase: str = "full") -> RouterTicket:
+               phase: str = "full",
+               tenant: Optional[str] = None,
+               api_token: Optional[str] = None) -> RouterTicket:
         """Admit one request into the federation: route by the
         consistent-hash ring — ``(bucket, digest)`` so byte-identical
         resubmits hit the replica owning the cached result — failing
         over past quarantined/refusing replicas in deterministic ring
         order, or raise `AdmissionError` (``NO_REPLICA`` when the whole
         federation is down; client-fault reasons re-raised from the
-        replica untouched)."""
+        replica untouched). ``tenant``/``api_token`` pass through to
+        the replica verbatim and resolve THERE — and per-tenant QoS
+        rejections (RATE_LIMITED, UNKNOWN_TENANT) are NOT failover
+        reasons: failing a rate-limited request over the ring would
+        multiply the tenant's admitted rate by the replica count."""
         import numpy as _np
 
         from ..resilience import chaos
@@ -1105,7 +1124,8 @@ class ReplicaRouter:
                 sub = replica.submit(
                     a, compute_u=compute_u, compute_v=compute_v,
                     deadline_s=deadline_s, request_id=rid, top_k=top_k,
-                    phase=phase, digest=digest)
+                    phase=phase, digest=digest, tenant=tenant,
+                    api_token=api_token)
             except ReplicaUnavailable as e:
                 last = AdmissionError(AdmissionReason.SHUTDOWN, str(e))
                 continue
@@ -1114,7 +1134,9 @@ class ReplicaRouter:
                     last = e
                     continue
                 raise    # client fault: no replica can fix the request
-            ticket = RouterTicket(rid, digest, bucket.name, router=self)
+            tenant_label = "default" if tenant is None else str(tenant)
+            ticket = RouterTicket(rid, digest, bucket.name, router=self,
+                                  tenant=tenant_label)
             if deadline_s is not None and deadline_s != float("inf"):
                 ticket._deadline_wall = time.time() + float(deadline_s)
                 ticket._grace_s = self.config.client_grace_s
@@ -1127,10 +1149,12 @@ class ReplicaRouter:
             if self.metrics is not None:
                 self.metrics.inc("svdj_router_routes_total",
                                  replica=idx, bucket=bucket.name,
+                                 tenant=tenant_label,
                                  help="requests routed to a replica")
             self._record(event="route", replica=idx, request_id=rid,
                          bucket=bucket.name, digest=digest,
-                         owner=pref[0], failover=(idx != pref[0]))
+                         owner=pref[0], failover=(idx != pref[0]),
+                         tenant=tenant_label)
             # Armed replica death fires AFTER the submit landed (the
             # request is write-ahead journaled on the replica): the
             # durable state the rescue replays is exactly "this request
@@ -1949,7 +1973,9 @@ def run_spool_replica(spool_dir, config: ServeConfig, *,
                                    # digest — no third hash of the same
                                    # bytes on the replica.
                                    digest=(rec.get("input") or {}).get(
-                                       "data_sha256"))
+                                       "data_sha256"),
+                                   tenant=rec.get("tenant"),
+                                   api_token=rec.get("api_token"))
                     outstanding[rid] = t
                     transpose_out[rid] = bool(rec.get("transposed",
                                                       False))

@@ -65,7 +65,24 @@ from ..config import DEFAULT_BATCH_TIERS, DEFAULT_SERVE_BUCKETS, SVDConfig
 from .breaker import BreakerState, Brownout
 from .buckets import BucketSet
 from .fleet import Fleet, Lane, LaneState
-from .queue import AdmissionError, AdmissionReason, Request
+from .queue import (DEFAULT_TENANT, AdmissionError, AdmissionReason,
+                    Request, TenantTable)
+
+
+class _NullSLO:
+    """Metrics-off stand-in for a per-tenant SLOTracker: accepts the
+    same calls and does nothing, so tenant call sites never branch on
+    the flight recorder (the OBS002 free-when-off contract — per-tenant
+    trackers are only MINTED when metrics are on)."""
+
+    def observe(self, *a, **k):
+        pass
+
+    def shed(self, *a, **k):
+        pass
+
+
+_NULL_SLO = _NullSLO()
 
 
 class ServeResult(NamedTuple):
@@ -327,6 +344,31 @@ class ServeConfig:
     # SLO availability objective: the error-budget burn gauge reads
     # miss_rate / (1 - objective) over the rolling outcome window.
     slo_objective: float = 0.99
+    # --- multi-tenant front door (per-tenant QoS; serve.queue) -----------
+    # Declared tenants: name -> TenantPolicy (or a mapping of its fields
+    # weight / rate / burst / priority / budget_share). None/empty keeps
+    # the single-caller queue byte-identical (no TenantTable exists);
+    # callers may still pass any tenant name — undeclared tenants get
+    # the default policy (weight 1, no rate limit, priority 1).
+    tenants: Optional[dict] = None
+    # API-token identity map for the wire: token -> tenant name
+    # (`serve.transport` resolves the submit record's ``api_token``
+    # through this; an unknown token is rejected UNKNOWN_TENANT, never
+    # silently defaulted). None = no token auth: the wire's optional
+    # ``tenant`` field is trusted as-is, like an in-process caller.
+    api_tokens: Optional[dict] = None
+    # Dequeue ordering: "fifo" (arrival order — the pre-tenancy
+    # behavior) or "edf" (earliest deadline first; deadline-less
+    # requests sort last, ties stay FIFO). With declared tenants the
+    # ordering applies WITHIN the weighted-fair tenant pick.
+    queue_ordering: str = "fifo"
+    # Result-cache tenant isolation: by default the content-addressed
+    # cache key includes the tenant, so a byte-identical resubmit from a
+    # DIFFERENT tenant never observes another tenant's cached result
+    # (or its sub-millisecond timing signature). True restores
+    # cross-tenant sharing for deployments where all tenants are one
+    # trust domain.
+    shared_result_cache: bool = False
 
 
 class SVDService:
@@ -366,8 +408,24 @@ class SVDService:
         if config.journal_payload not in ("full", "digest"):
             raise ValueError(f"journal_payload must be 'full' or "
                              f"'digest', got {config.journal_payload!r}")
+        if config.queue_ordering not in ("fifo", "edf"):
+            raise ValueError(f"queue_ordering must be 'fifo' or 'edf', "
+                             f"got {config.queue_ordering!r}")
         self._tiers = tiers
         self.config = config
+        # Multi-tenant QoS: ONE TenantTable shared by every lane's queue
+        # (rates and fairness are per-service promises), None when no
+        # tenant is declared so the single-caller queue stays
+        # byte-identical. Construction validates every declared policy.
+        self.tenant_table = (TenantTable(config.tenants)
+                            if config.tenants else None)
+        # Per-tenant outcome counters (admitted / served / rejected:*),
+        # guarded by self._lock like `_stats` — the healthz()["tenants"]
+        # and fairness-drill substrate, live regardless of metrics.
+        self._tenant_stats: dict = {}
+        # Per-tenant SLO trackers (lazily minted per first outcome) —
+        # only when the flight recorder is ON, like `self.slo`.
+        self.tenant_slo: dict = {}
         self._records: list = []
         self._stats: dict = {}
         self._lock = threading.Lock()
@@ -909,7 +967,8 @@ class SVDService:
             deadline_s=deadline_s, submitted=now_mono,
             cancel=ticket._cancel, ticket=ticket,
             top_k=rec.get("top_k"), rank_mode=bucket.kind,
-            phase=str(rec.get("phase", "full")), digest=digest)
+            phase=str(rec.get("phase", "full")), digest=digest,
+            tenant=str(rec.get("tenant", DEFAULT_TENANT)))
         return ticket, req, None, None
 
     def admit_journal_debt(self, records, *,
@@ -1050,7 +1109,8 @@ class SVDService:
             breaker=self.breaker.state().value,
             brownout=str(rec.get("brownout", "FULL")), degraded=False,
             deadline_s=rec.get("deadline_s"), error=error,
-            k=rec.get("top_k"), phase=str(rec.get("phase", "full")))
+            k=rec.get("top_k"), phase=str(rec.get("phase", "full")),
+            tenant=str(rec.get("tenant", DEFAULT_TENANT)))
         return True
 
     def reload(self, *, buckets=None, solver: Optional[SVDConfig] = None,
@@ -1197,6 +1257,9 @@ class SVDService:
             in_flight = next((r.id for l in self.fleet.lanes
                               for r in l.in_flight), None)
             stats = dict(self._stats)
+            tenant_stats = {t: dict(s)
+                            for t, s in self._tenant_stats.items()}
+            tenant_slo = dict(self.tenant_slo)
         out = {
             "ok": alive,
             "ready": self.ready(),
@@ -1226,6 +1289,27 @@ class SVDService:
             # read null, with snapshot["quantile_min_samples"] saying
             # why.
             out["slo"] = self.slo.snapshot()
+        if self.tenant_table is not None or tenant_stats:
+            # Per-tenant QoS view: declared policy + live token-bucket
+            # level (QoS on), the always-live per-tenant counters, and
+            # the per-tenant error-budget burn (flight recorder on).
+            # Every tenant that DECLARED a policy or TOUCHED the
+            # service appears — a flooded tenant's rate_limited count
+            # and burn are visible even while it is being rejected.
+            tenants: dict = {}
+            qos_snap = (self.tenant_table.snapshot()
+                        if self.tenant_table is not None else {})
+            for t in sorted(set(qos_snap) | set(tenant_stats)
+                            | set(tenant_slo)):
+                entry: dict = {}
+                if t in qos_snap:
+                    entry["qos"] = qos_snap[t]
+                entry["stats"] = tenant_stats.get(t, {})
+                slo_t = tenant_slo.get(t)
+                if slo_t is not None:
+                    entry["slo"] = slo_t.snapshot()
+                tenants[t] = entry
+            out["tenants"] = tenants
         # Perf observatory view: roofline device constants (with
         # "table" vs estimate provenance) + the latest per-bucket
         # convergence telemetry from the host-stepped sweep loop.
@@ -1301,8 +1385,10 @@ class SVDService:
         deadline budget per lane, lane/breaker state, brownout level,
         cache sizes, journal fsync accounting, SLO quantiles/burn — is
         sampled when someone scrapes, so live-state changes cost the hot
-        path nothing. Deliberately avoids the service lock (each source
-        has its own); a scrape can never deadlock a finalize."""
+        path nothing. Avoids the service lock except for one O(tenants)
+        dict copy (collectors run OUTSIDE the registry lock, and
+        service->obs is the sanctioned tier order, so a scrape can
+        never deadlock a finalize)."""
         from .fleet import LaneState as _LS
         _BREAKER_CODE = {BreakerState.CLOSED: 0, BreakerState.HALF_OPEN: 1,
                          BreakerState.OPEN: 2}
@@ -1338,6 +1424,20 @@ class SVDService:
                     help="cumulative journal append+fsync time")
         if self.slo is not None:
             self.slo.export_to(reg)
+        if self.tenant_table is not None:
+            for t, q in self.tenant_table.snapshot().items():
+                reg.set("svdj_tenant_weight", float(q["weight"]),
+                        tenant=t, help="declared WFQ weight per tenant")
+                if q.get("tokens") is not None:
+                    reg.set("svdj_tenant_tokens", float(q["tokens"]),
+                            tenant=t,
+                            help="live rate-limit token-bucket level")
+        with self._lock:
+            trackers = dict(self.tenant_slo)
+        for t, slo in trackers.items():
+            reg.set("svdj_tenant_error_budget_burn", slo.burn_rate(),
+                    tenant=t,
+                    help="per-tenant rolling error-budget burn rate")
 
     # The span-event emitter every lifecycle site funnels through: one
     # attribute check on the off path, nothing else.
@@ -1465,27 +1565,60 @@ class SVDService:
 
     # -- admission ----------------------------------------------------------
 
-    def _brownout(self) -> Brownout:
+    def _brownout(self, tenant: str = DEFAULT_TENANT) -> Brownout:
         # Aggregate fill over the fleet: brownout is an overload signal,
         # and a fleet with one backed-up lane but idle siblings is not
         # overloaded (stealing will drain it).
         fill = (sum(l.queue.depth() for l in self.fleet.lanes)
                 / sum(l.queue.max_depth for l in self.fleet.lanes))
-        if fill >= self.config.brownout_shed_at:
+        # Priced brownout: a tenant's priority SCALES the fill it may
+        # ride out — priority 1.0 (the default policy, and every tenant
+        # when no table exists) hits the rungs exactly at the configured
+        # thresholds, priority 0.5 is degraded to σ-only and shed at
+        # half the fill (low-priority traffic pays for headroom first),
+        # priority 2.0 stays full-service twice as deep.
+        price = (1.0 if self.tenant_table is None
+                 else self.tenant_table.policy(tenant).priority)
+        if fill >= self.config.brownout_shed_at * price:
             return Brownout.SHED
-        if fill >= self.config.brownout_sigma_only_at:
+        if fill >= self.config.brownout_sigma_only_at * price:
             return Brownout.SIGMA_ONLY
         return Brownout.FULL
+
+    def _resolve_tenant(self, tenant: Optional[str],
+                        api_token: Optional[str]) -> str:
+        """The request's tenant identity: an explicit name wins (an
+        in-process caller is one trust domain), else the API token
+        resolves through `ServeConfig.api_tokens` — an unknown token is
+        rejected UNKNOWN_TENANT, never silently defaulted — else the
+        default tenant (today's single-caller surface)."""
+        if tenant is not None:
+            return str(tenant)
+        if api_token is not None:
+            mapped = (self.config.api_tokens or {}).get(str(api_token))
+            if mapped is None:
+                raise AdmissionError(
+                    AdmissionReason.UNKNOWN_TENANT,
+                    "api token resolves to no tenant in "
+                    "ServeConfig.api_tokens")
+            return str(mapped)
+        return DEFAULT_TENANT
 
     def submit(self, a, *, compute_u: bool = True, compute_v: bool = True,
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
                top_k: Optional[int] = None,
                phase: str = "full",
-               digest: Optional[str] = None) -> Ticket:
+               digest: Optional[str] = None,
+               tenant: Optional[str] = None,
+               api_token: Optional[str] = None) -> Ticket:
         """Admit one request: returns a `Ticket` or raises
         `AdmissionError` (reason: SHUTDOWN | NO_BUCKET | BROWNOUT_SHED |
-        QUEUE_FULL | DEADLINE_BUDGET). ``deadline_s`` is relative to now;
+        QUEUE_FULL | DEADLINE_BUDGET | RATE_LIMITED | UNKNOWN_TENANT).
+        ``tenant`` names the caller for QoS/attribution (omitted = the
+        default tenant — the exact pre-tenancy surface); ``api_token``
+        instead resolves through `ServeConfig.api_tokens` (the wire's
+        identity path). ``deadline_s`` is relative to now;
         the solve stops cooperatively within one sweep of it. None
         inherits ``default_deadline_s``; an explicit ``float("inf")``
         means NO deadline even when a default is configured (exempt from
@@ -1563,7 +1696,13 @@ class SVDService:
         brown = self._brownout()
         journaled = False
         bucket_name: Optional[str] = None   # set once routing succeeds
+        tenant_name = DEFAULT_TENANT        # until identity resolves
         try:
+            # Identity first: everything below (brownout price, rate
+            # limit, cache key, attribution) hangs off the tenant.
+            tenant_name = self._resolve_tenant(tenant, api_token)
+            if self.tenant_table is not None:
+                brown = self._brownout(tenant_name)   # priced rungs
             if not self.ready():
                 raise AdmissionError(AdmissionReason.SHUTDOWN,
                                      "service is not accepting requests")
@@ -1624,14 +1763,16 @@ class SVDService:
                         orig_shape=orig_shape,
                         transposed=transposed, compute_u=compute_u,
                         compute_v=compute_v, top_k=top_k, brown=brown,
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s, tenant=tenant_name)
                     if hit is not None:
                         return hit
             if brown is Brownout.SHED:
                 raise AdmissionError(
                     AdmissionReason.BROWNOUT_SHED,
                     f"queue fill {self.queue.depth()}/"
-                    f"{self.queue.max_depth} at shed threshold")
+                    f"{self.queue.max_depth} at shed threshold"
+                    + (f" (tenant {tenant_name!r} priced)"
+                       if tenant_name != DEFAULT_TENANT else ""))
             now = time.monotonic()
             ticket = Ticket(rid, self, phase)
             ticket.digest = digest
@@ -1647,7 +1788,7 @@ class SVDService:
                 deadline_s=deadline_s, submitted=now,
                 cancel=ticket._cancel, ticket=ticket,
                 top_k=top_k, rank_mode=bucket.kind,
-                phase=phase, digest=digest)
+                phase=phase, digest=digest, tenant=tenant_name)
             # Bucket-affinity routing: the bucket's home lane, or the
             # next ACTIVE one (lane 0 always, when lanes == 1). Raises
             # NO_LANE when the whole fleet is quarantined.
@@ -1668,6 +1809,7 @@ class SVDService:
             if self.metrics is not None:
                 self.metrics.inc("svdj_requests_admitted_total",
                                  bucket=bucket.name, phase=phase,
+                                 tenant=tenant_name,
                                  help="requests admitted to a lane queue")
                 self._span(rid, "admit", bucket=bucket.name, phase=phase)
                 self._span(rid, "queued", lane=lane.index)
@@ -1685,20 +1827,25 @@ class SVDService:
             if journaled:
                 self._journal_finalize(rid, f"REJECTED_{e.reason.name}")
             self._bump("rejected", f"rejected:{e.reason.value}")
+            self._bump_tenant(tenant_name, "rejected",
+                              f"rejected:{e.reason.value}")
             if self.metrics is not None:
                 self.metrics.inc("svdj_requests_rejected_total",
-                                 reason=e.reason.value,
+                                 reason=e.reason.value, tenant=tenant_name,
                                  help="requests rejected at admission")
                 self._span(rid, "admit", rejected=True,
                            reason=e.reason.value)
                 if e.reason in (AdmissionReason.BROWNOUT_SHED,
                                 AdmissionReason.QUEUE_FULL,
                                 AdmissionReason.DEADLINE_BUDGET,
+                                AdmissionReason.RATE_LIMITED,
                                 AdmissionReason.NO_LANE):
                     # Load-class rejections burn the error budget; a
-                    # client error (NO_BUCKET, NONFINITE_INPUT) does not.
+                    # client error (NO_BUCKET, NONFINITE_INPUT,
+                    # UNKNOWN_TENANT) does not.
                     self.slo.shed(None if bucket_name is None
                                   else bucket_name)
+                    self._tenant_slo_for(tenant_name).shed(bucket_name)
             self._record(request_id=rid, orig_shape=orig_shape, dtype=dtype,
                          bucket=None, queue_wait_s=0.0, solve_time_s=None,
                          status=f"REJECTED_{e.reason.name}", path="rejected",
@@ -1706,9 +1853,10 @@ class SVDService:
                          brownout=brown.name, degraded=False,
                          deadline_s=deadline_s, error=e.detail,
                          rank_mode="topk" if top_k is not None else "full",
-                         k=top_k, phase=phase)
+                         k=top_k, phase=phase, tenant=tenant_name)
             raise
         self._bump("submitted")
+        self._bump_tenant(tenant_name, "submitted")
         return ticket
 
     # -- content-addressed result cache (serve.cache.ResultCache) -----------
@@ -1735,23 +1883,34 @@ class SVDService:
 
     def _cache_key(self, digest: str, bucket, *, m: int, n: int,
                    transposed: bool, compute_u: bool, compute_v: bool,
-                   top_k: Optional[int]) -> tuple:
+                   top_k: Optional[int],
+                   tenant: str = DEFAULT_TENANT) -> tuple:
         """The result-cache identity: everything that shapes the answer.
         The digest covers the oriented bytes and ``(m, n)`` their
         LOGICAL shape (byte-identical buffers reshaped differently can
         route to the same padded bucket — their factors differ);
         ``transposed`` keeps an A-vs-Aᵀ client pair from sharing; the
         bucket + resolved-config hash cover routing and every solver
-        knob; the flags/k cover which factors exist at what rank."""
+        knob; the flags/k cover which factors exist at what rank. The
+        TENANT is part of the identity by default — a byte-identical
+        resubmit from another tenant must not observe a hit (the hit
+        itself leaks "someone else already submitted these bytes", a
+        timing/result side channel). `ServeConfig.shared_result_cache`
+        opts back into cross-tenant sharing by collapsing the slot to
+        None. Appended LAST: `ResultCache.invalidate` matches on
+        ``key[0] == digest`` and must keep flushing every tenant's
+        entries for a changed matrix."""
         return (digest, int(m), int(n), bucket.name,
                 self._cfg_hash_for(bucket),
                 bool(transposed), bool(compute_u), bool(compute_v),
-                None if top_k is None else int(top_k))
+                None if top_k is None else int(top_k),
+                None if self.config.shared_result_cache else str(tenant))
 
     def _cache_store(self, *, request_id: str, digest: str, bucket,
                      m: int, n: int, transposed: bool, compute_u: bool,
                      compute_v: bool, top_k: Optional[int],
-                     u, s, v, status, sweeps: int) -> None:
+                     u, s, v, status, sweeps: int,
+                     tenant: str = DEFAULT_TENANT) -> None:
         """The ONE result-cache store path (full-phase finalize AND
         promote): host-copy the factors, store under the content key,
         and record the event — but only when the cache actually took
@@ -1767,7 +1926,8 @@ class SVDService:
         }
         key = self._cache_key(digest, bucket, m=m, n=n,
                               transposed=transposed, compute_u=compute_u,
-                              compute_v=compute_v, top_k=top_k)
+                              compute_v=compute_v, top_k=top_k,
+                              tenant=tenant)
         stored, evicted = self.result_cache.put(key, entry)
         if stored:
             self._record_cache(
@@ -1780,16 +1940,19 @@ class SVDService:
                       m: int, n: int,
                       orig_shape, transposed: bool, compute_u: bool,
                       compute_v: bool, top_k: Optional[int], brown,
-                      deadline_s) -> Optional[Ticket]:
+                      deadline_s,
+                      tenant: str = DEFAULT_TENANT) -> Optional[Ticket]:
         """The admission fast-path: a cache hit finalizes the request
         right here — an O(ms) host-copy finalize, zero solver dispatch,
         no queue slot — with a "cache" hit event and an ordinary "serve"
-        record (path="cache") in the stream. None on miss."""
+        record (path="cache") in the stream. None on miss. The tenant
+        is part of the lookup key (see `_cache_key`), so a resubmit
+        from a different tenant misses by default."""
         from ..solver import SolveStatus
         key = self._cache_key(digest, bucket, m=m, n=n,
                               transposed=transposed,
                               compute_u=compute_u, compute_v=compute_v,
-                              top_k=top_k)
+                              top_k=top_k, tenant=tenant)
         entry = self.result_cache.get(key)
         if entry is None:
             return None
@@ -1805,21 +1968,25 @@ class SVDService:
         self._record_cache("result", "hit", request_id=rid, digest=digest)
         self._bump("submitted", "served", "cache_hits", "status:OK",
                    "path:cache")
+        self._bump_tenant(tenant, "submitted", "served", "cache_hits",
+                          "status:OK")
         if self.metrics is not None:
             self._span(rid, "admit", bucket=bucket.name)
             self._span(rid, "cache_hit", digest=digest[:12])
             self._span(rid, "finalize", status="OK", path="cache")
             self.metrics.inc("svdj_requests_finalized_total", status="OK",
-                             path="cache", phase="full",
+                             path="cache", phase="full", tenant=tenant,
                              help="requests reaching a terminal status")
             self.slo.observe(bucket.name, 0.0, ok=True)
+            self._tenant_slo_for(tenant).observe(bucket.name, 0.0, ok=True)
         self._record(request_id=rid, orig_shape=orig_shape,
                      dtype=bucket.dtype, bucket=bucket.name,
                      queue_wait_s=0.0, solve_time_s=0.0, status="OK",
                      path="cache", breaker=self.breaker.state().value,
                      brownout=brown.name, degraded=False,
                      deadline_s=deadline_s, sweeps=int(entry["sweeps"]),
-                     rank_mode=bucket.kind, k=top_k, digest=digest)
+                     rank_mode=bucket.kind, k=top_k, digest=digest,
+                     tenant=tenant)
         return ticket
 
     def _maybe_cache_result(self, req: Request, result: ServeResult,
@@ -1841,7 +2008,8 @@ class SVDService:
                           compute_v=req.compute_v, top_k=req.top_k,
                           u=result.u, s=result.s, v=result.v,
                           status=int(result.status),
-                          sweeps=int(result.sweeps))
+                          sweeps=int(result.sweeps),
+                          tenant=getattr(req, "tenant", DEFAULT_TENANT))
 
     def invalidate_cached(self, digest: Optional[str] = None) -> int:
         """Explicit cache invalidation — the client's "this matrix
@@ -2045,6 +2213,7 @@ class SVDService:
                 self.metrics.observe(
                     "svdj_queue_wait_seconds", queue_wait,
                     bucket=req.bucket.name,
+                    tenant=getattr(req, "tenant", DEFAULT_TENANT),
                     help="admission-to-dispatch queue wait")
                 self._span(req.id, "dispatch", lane=lane.index, path=path)
             win = self._trace_window_for(req, lane)
@@ -2173,6 +2342,7 @@ class SVDService:
                 self.metrics.observe(
                     "svdj_queue_wait_seconds", t_d - rq.submitted,
                     bucket=rq.bucket.name,
+                    tenant=getattr(rq, "tenant", DEFAULT_TENANT),
                     help="admission-to-dispatch queue wait")
                 self._span(rq.id, "dispatch", lane=lane.index,
                            path="base", batch_id=batch_id)
@@ -2675,7 +2845,8 @@ class SVDService:
         common = dict(bucket=req.bucket, m=req.m, n=req.n,
                       transposed=req.transposed, compute_u=req.compute_u,
                       compute_v=req.compute_v, top_k=req.top_k,
-                      digest=req.digest, lane=lane.index)
+                      digest=req.digest, lane=lane.index,
+                      tenant=getattr(req, "tenant", DEFAULT_TENANT))
         if payload is not None and payload.get("promotable"):
             ps = PromotionState(
                 kind="state", path=payload["path"], top=payload["top"],
@@ -2767,7 +2938,8 @@ class SVDService:
                               compute_u=ps.compute_u,
                               compute_v=ps.compute_v, top_k=ps.top_k,
                               u=u, s=s, v=v, status=int(status),
-                              sweeps=sweeps)
+                              sweeps=sweeps,
+                              tenant=getattr(ps, "tenant", DEFAULT_TENANT))
         self._bump("served", "promotions", f"status:{status.name}")
         if self.metrics is not None:
             self.metrics.inc("svdj_promotions_total", status=status.name,
@@ -2785,7 +2957,8 @@ class SVDService:
                      breaker=self.breaker.state().value, brownout="FULL",
                      degraded=False, deadline_s=None, sweeps=sweeps,
                      rank_mode=ps.bucket.kind, k=ps.top_k,
-                     phase="promote", promoted_from=rid)
+                     phase="promote", promoted_from=rid,
+                     tenant=getattr(ps, "tenant", DEFAULT_TENANT))
         return result
 
     @staticmethod
@@ -2858,29 +3031,34 @@ class SVDService:
         if not req.ticket._finalize_once(result):
             return False
         self._journal_finalize(req.id, status_name)
+        tenant = getattr(req, "tenant", DEFAULT_TENANT)
         if self.metrics is not None:
             self.metrics.inc("svdj_requests_finalized_total",
                              status=status_name, path=path,
-                             phase=req.phase,
+                             phase=req.phase, tenant=tenant,
                              help="requests reaching a terminal status")
             if solve_time is not None:
                 self.metrics.observe("svdj_solve_seconds", solve_time,
-                                     bucket=req.bucket.name,
+                                     bucket=req.bucket.name, tenant=tenant,
                                      help="dispatch-to-finish solve time")
                 self._span(req.id, "finish", status=status_name)
             latency = queue_wait + (solve_time or 0.0)
             self.metrics.observe("svdj_request_latency_seconds", latency,
-                                 bucket=req.bucket.name,
+                                 bucket=req.bucket.name, tenant=tenant,
                                  help="end-to-end request latency")
             if status_name == "DEADLINE":
                 self.metrics.inc("svdj_deadline_miss_total",
-                                 bucket=req.bucket.name,
+                                 bucket=req.bucket.name, tenant=tenant,
                                  help="requests finalized DEADLINE")
             self._span(req.id, "finalize", status=status_name, path=path)
             self.slo.observe(req.bucket.name, latency,
                              ok=(status_name == "OK"),
                              deadline_miss=(status_name == "DEADLINE"),
                              error=(status_name == "ERROR"))
+            self._tenant_slo_for(tenant).observe(
+                req.bucket.name, latency, ok=(status_name == "OK"),
+                deadline_miss=(status_name == "DEADLINE"),
+                error=(status_name == "ERROR"))
         self._bump("served", f"status:{status_name}",
                    *(["path:ladder"] if path == "ladder" else []),
                    *(["degraded"] if req.degraded else []),
@@ -2888,6 +3066,8 @@ class SVDService:
                      else []),
                    *([f"rank_mode:{req.rank_mode}"]
                      if req.rank_mode != "full" else []))
+        self._bump_tenant(tenant, "served", f"status:{status_name}",
+                          *(["degraded"] if req.degraded else []))
         # A router-rescued request's record path carries its provenance
         # ("replica_rescue") instead of the generic "base" — the ladder
         # and control paths stay visible as themselves.
@@ -2905,7 +3085,7 @@ class SVDService:
             batch_id=batch_id, batch_size=batch_size,
             batch_tier=batch_tier, lane=lane,
             rank_mode=req.rank_mode, k=req.top_k, phase=req.phase,
-            digest=req.digest)
+            digest=req.digest, tenant=tenant)
         return True
 
     def _finalize_rescue(self, req: Request, status_name: str,
@@ -2990,6 +3170,33 @@ class SVDService:
             for k in keys:
                 self._stats[k] = self._stats.get(k, 0) + 1
 
+    def _bump_tenant(self, tenant: str, *keys: str) -> None:
+        """Per-tenant counters, mirroring `_bump`'s aggregate ones.
+        Always live (like `_stats`) — they feed `healthz()["tenants"]`
+        and the fairness drills even with the flight recorder off."""
+        with self._lock:
+            stats = self._tenant_stats.setdefault(str(tenant), {})
+            for k in keys:
+                stats[k] = stats.get(k, 0) + 1
+
+    def _tenant_slo_for(self, tenant: str):
+        """The lazily-minted per-tenant SLOTracker (metrics-on only,
+        mirroring `self.slo`; a no-op stub when the flight recorder is
+        off so call sites never branch). Lazy because the tenant set is
+        open — undeclared tenants get the default policy AND their own
+        error budget."""
+        if self.metrics is None:
+            return _NULL_SLO
+        tenant = str(tenant)
+        with self._lock:
+            tracker = self.tenant_slo.get(tenant)
+            if tracker is None:
+                from .. import obs
+                tracker = obs.registry.SLOTracker(
+                    objective=self.config.slo_objective)
+                self.tenant_slo[tenant] = tracker
+            return tracker
+
     def _record(self, *, request_id: str, orig_shape: Tuple[int, int],
                 dtype: str, bucket: Optional[str], queue_wait_s: float,
                 solve_time_s: Optional[float], status: str, path: str,
@@ -3004,7 +3211,8 @@ class SVDService:
                 k: Optional[int] = None,
                 phase: str = "full",
                 promoted_from: Optional[str] = None,
-                digest: Optional[str] = None) -> None:
+                digest: Optional[str] = None,
+                tenant: str = DEFAULT_TENANT) -> None:
         from .. import obs
         record = obs.manifest.build_serve(
             request_id=request_id, m=orig_shape[0], n=orig_shape[1],
@@ -3019,7 +3227,8 @@ class SVDService:
             lane=(None if lane is None else int(lane)),
             rank_mode=str(rank_mode), k=(None if k is None else int(k)),
             phase=str(phase), promoted_from=promoted_from,
-            digest=(None if digest is None else str(digest)))
+            digest=(None if digest is None else str(digest)),
+            tenant=str(tenant))
         self._store(record)
 
     def _record_cache(self, store: str, event: str, *,

@@ -485,7 +485,14 @@ class HttpReplicaServer:
                 deadline_s=deadline_s,
                 top_k=rec.get("top_k"),
                 phase=str(rec.get("phase", "full")),
-                digest=(rec.get("input") or {}).get("data_sha256"))
+                digest=(rec.get("input") or {}).get("data_sha256"),
+                # Tenant identity over the wire: an explicit tenant
+                # name wins, else an api_token resolved against the
+                # RECEIVING service's ServeConfig.api_tokens; both
+                # absent -> the default tenant (pre-tenancy clients
+                # keep working byte-for-byte).
+                tenant=rec.get("tenant"),
+                api_token=rec.get("api_token"))
             with self._lock:
                 self._outstanding[rid] = t
                 self._transpose[rid] = bool(rec.get("transposed", False))
@@ -936,12 +943,15 @@ class HttpReplica(ReplicaHandle):
 
     def submit(self, a, *, compute_u=True, compute_v=True,
                deadline_s=None, request_id=None, top_k=None,
-               phase="full", digest=None):
+               phase="full", digest=None, tenant=None, api_token=None):
         """Submit one request over the wire. Orientation happens HERE
         (like `SpoolReplica.submit` — the worker solves the oriented
         payload verbatim, the result decode swaps the factors back);
         the record is admit-shaped and carries the idempotency key
         (id + oriented digest) so ANY number of retries admits once.
+        ``tenant``/``api_token`` ride the wire verbatim and resolve on
+        the RECEIVING side (against its ServeConfig.api_tokens); both
+        None keeps the record byte-identical to the pre-tenancy wire.
         Transport failure -> `ReplicaUnavailable` (the router fails
         over along the ring — a ``failover`` net record marks it)."""
         import numpy as _np
@@ -969,6 +979,10 @@ class HttpReplica(ReplicaHandle):
             "phase": str(phase),
             "input": _encode_array(oriented, digest=digest),
         }
+        if tenant is not None:
+            rec["tenant"] = str(tenant)
+        if api_token is not None:
+            rec["api_token"] = str(api_token)
         budget_end = None
         if deadline_s is not None and deadline_s != float("inf"):
             budget_end = t_wall + float(deadline_s)
