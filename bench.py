@@ -157,6 +157,45 @@ def _mfu(gflops: float, device_kind: str):
     return round(gflops * 1e9 / peak, 4), est
 
 
+def _model_hbm_gbps(cfg, m, n, dtype_name, pair_solver, sweeps, t_s,
+                    novec, top_k):
+    """(modeled GB/s, resolved lane): the cost model's solve HBM bytes
+    (obs.costmodel.solve_costs — the SAME model the roofline observatory
+    joins traces against) over the measured wall time. The bandwidth-side
+    twin of `mfu`: a lane that cuts traffic at equal FLOPs (resident:
+    ~R x fewer apply bytes per sweep) moves THIS number even when
+    GFLOP/s barely does. Modeled bytes, not counters — comparable across
+    rows, honest about its provenance via `hbm_bw_source`."""
+    import numpy as _np
+    from svd_jacobi_tpu import solver as _solver
+    from svd_jacobi_tpu.obs import costmodel
+    mm, nn = (m, n) if m >= n else (n, m)
+    ps = pair_solver
+    if ps == "auto":
+        from svd_jacobi_tpu.tune import tables as _tables
+        ps = _tables.resolve(nn, mm, dtype_name).pair_solver or "pallas"
+    b = cfg.pick_block_size(nn, m=mm, dtype=dtype_name)
+    rr = None
+    if ps == "resident":
+        if b % 2:
+            b += 1
+        k = max(1, -(-nn // (2 * b)))
+        rr = _solver._resolve_rounds_resident(
+            cfg, nn, mm, _np.dtype(dtype_name), 2 * k - 1)
+    # Staged kernel lanes spend all but the ~2 polish sweeps in bulk
+    # (the solver's measured bulk->polish crossover on the bench
+    # spectra); single-stage lanes are all-polish.
+    bulk = (max(0.0, float(sweeps) - 2.0)
+            if ps in ("hybrid", "block_rotation", "resident") else 0.0)
+    phases = costmodel.solve_costs(
+        mm, nn, block_size=b, dtype=dtype_name, pair_solver=ps,
+        sweeps=max(float(sweeps), 1.0), bulk_sweeps=bulk,
+        compute_u=not novec, compute_v=not novec,
+        top_k=top_k, rounds_resident=rr)
+    return round(costmodel.total_cost(phases).hbm_bytes / t_s / 1e9,
+                 3), ps
+
+
 def _force(tree):
     from svd_jacobi_tpu.utils._exec import force
     return force(tree)
@@ -1343,10 +1382,42 @@ def _grad_bench(flags, args) -> None:
     }))
 
 
+def _check_against_gate(row: dict, against: str) -> bool:
+    """Append-and-gate: check one bench row against the BENCH_*.json
+    history beside the named round, under the fitted per-metric noise
+    band (obs.perf.check_rows). Returns ok; report lines go to stderr.
+    Callers exit rc 4 on a regression (distinct from solve/backend
+    failures)."""
+    import glob as _glob
+    import os as _os
+    from svd_jacobi_tpu.obs.perf import check_rows
+    hist = []
+    for p in sorted(_glob.glob(_os.path.join(
+            _os.path.dirname(_os.path.abspath(against)) or ".",
+            "BENCH_*.json"))):
+        with open(p) as fh:
+            data = json.load(fh)
+        hist += data if isinstance(data, list) else [data]
+    ok, lines = check_rows({"parsed": row}, hist)
+    print("\n".join(lines), file=sys.stderr)
+    return ok
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = dict(f.lstrip("-").split("=", 1) if "=" in f else (f.lstrip("-"), "1")
                  for f in sys.argv[1:] if f.startswith("--"))
+    if "check-row" in flags:
+        # --check-row=FILE.json --check-against=BENCH_rXX.json: run the
+        # perf gate on an ALREADY-MEASURED (or synthetic) row without
+        # solving anything — the tier-1 hook that keeps the gate's code
+        # path exercised on hosts where a real solve row is too slow.
+        if "check-against" not in flags:
+            raise SystemExit("--check-row requires --check-against=FILE")
+        with open(flags["check-row"]) as fh:
+            synth_row = json.load(fh)
+        sys.exit(0 if _check_against_gate(synth_row,
+                                          flags["check-against"]) else 4)
     if "grad" in flags:
         _grad_bench(flags, args)
         return
@@ -1481,6 +1552,10 @@ def main() -> None:
         precondition=flags.get("precondition", "auto"),
         block_size=(int(flags["block-size"]) if "block-size" in flags
                     else None),
+        # --rounds-resident=R: residency depth for --pair-solver=resident
+        # (clamped to the sweep's round count; table/default when unset).
+        rounds_resident=(int(flags["rounds-resident"])
+                         if "rounds-resident" in flags else None),
         mixed_bulk=({"on": True, "off": False, "auto": None}
                     [flags.get("mixed-bulk", "auto")]),
         mixed_store=flags.get("mixed-store", "auto"),
@@ -1731,6 +1806,11 @@ def main() -> None:
     gflops = flops / t_ours / 1e9
     device_kind = jax.devices()[0].device_kind
     mfu, mfu_est = _mfu(gflops, device_kind)
+    sweeps_meas = (int(r.sweeps) if np.ndim(r.sweeps) == 0
+                   else int(np.max(np.asarray(r.sweeps))))
+    hbm_gbps, model_lane = _model_hbm_gbps(
+        cfg, m, n, dtype_name, pair_solver, sweeps_meas, t_ours,
+        novec, top_k)
     tag = "_novec" if novec else ""
     lane = ("_topk_k%d" % top_k if top_k is not None
             else "_tall" if tall_vs_pad else "")
@@ -1746,9 +1826,13 @@ def main() -> None:
         "baseline_time_s": (round(t_base, 4) if t_base is not None else None),
         "baseline": (base_name if t_base is not None or not attempted_baseline
                      else f"{base_name}: FAILED TO COMPILE/RUN"),
-        "sweeps": int(r.sweeps) if np.ndim(r.sweeps) == 0 else int(
-            np.max(np.asarray(r.sweeps))),
+        "sweeps": sweeps_meas,
         "mfu": mfu,
+        # Modeled solve HBM bytes over measured time (see
+        # _model_hbm_gbps) and the lane the model priced (auto rows
+        # name what auto routed to).
+        "hbm_gbps": hbm_gbps,
+        "hbm_model_lane": model_lane,
         # Provenance of every derived (per-peak / per-bandwidth) metric
         # in this row: "table" = tabulated device constant,
         # "peak_est"/"bw_est" = the documented fallback estimate.
@@ -1852,6 +1936,8 @@ def main() -> None:
                    "gflops": round(gflops, 2),
                    "vs_baseline": row["vs_baseline"],
                    "mfu": row["mfu"],
+                   "hbm_gbps": row["hbm_gbps"],
+                   "hbm_model_lane": row["hbm_model_lane"],
                    **extras},
             stages=[{"name": "best_of_reps", "time_s": float(t_ours)}],
             telemetry=events,
@@ -1864,23 +1950,7 @@ def main() -> None:
         print(f"manifest: {manifest_path}", file=sys.stderr)
 
     if "check-against" in flags:
-        # Append-and-gate in one run: the headline row just produced is
-        # checked against the BENCH_*.json history beside the named
-        # round, under the fitted per-metric noise band. rc 4 is the
-        # regression exit (distinct from solve/backend failures).
-        import glob as _glob
-        from svd_jacobi_tpu.obs.perf import check_rows
-        against = flags["check-against"]
-        hist = []
-        for p in sorted(_glob.glob(os.path.join(
-                os.path.dirname(os.path.abspath(against)) or ".",
-                "BENCH_*.json"))):
-            with open(p) as fh:
-                data = json.load(fh)
-            hist += data if isinstance(data, list) else [data]
-        ok, lines = check_rows({"parsed": row}, hist)
-        print("\n".join(lines), file=sys.stderr)
-        if not ok:
+        if not _check_against_gate(row, flags["check-against"]):
             sys.exit(4)
 
 

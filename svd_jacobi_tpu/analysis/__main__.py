@@ -110,6 +110,10 @@ def main(argv=None) -> int:
             # cost model agrees with compiled.cost_analysis() on every
             # registry entry, the SCOPE_PHASES join covers HOT_SCOPES
             # exactly, and the perf-off hot path stays byte-identical.
+            # Plus the static VMEM-budget check (VMEM001): every shipped
+            # Pallas-lane geometry (serve buckets + the tuning table's
+            # TPU kernel rows) fits its per-grid-step footprint model,
+            # and the seeded over-budget fixture fires.
             from . import perf_checks
             findings, report = perf_checks.run_all()
             return findings, report

@@ -70,7 +70,10 @@ def _single_probe(name: str, a, config: SVDConfig, *, compute_u=True,
                            else "solver._svd_pallas"),
                 "block_rotation": ("solver._svd_block_rotation_donated"
                                    if config.donate_input
-                                   else "solver._svd_block_rotation")}[entry]
+                                   else "solver._svd_block_rotation"),
+                "resident": ("solver._svd_resident_donated"
+                             if config.donate_input
+                             else "solver._svd_resident")}[entry]
     return EntryProbe(name=name, fn=fn, args=(a_in,), kwargs=kwargs,
                       entry_id=entry_id)
 
@@ -82,6 +85,7 @@ def _batched_probe(name: str, a, config: SVDConfig, *, compute_u=True,
         a, config, compute_u=compute_u, compute_v=compute_v)
     entry_id = {"pallas_batched": "solver._svd_pallas_batched",
                 "block_rotation_batched": "solver._svd_block_rotation_batched",
+                "resident_batched": "solver._svd_resident_batched",
                 "padded_batched": "solver._svd_padded_batched"}[entry]
     return EntryProbe(name=name, fn=fn, args=(a_in,), kwargs=kwargs,
                       entry_id=entry_id, telemetry_key=None)
@@ -124,6 +128,12 @@ def single_device_probes(include_f64: Optional[bool] = None) -> List[EntryProbe]
         # ["pallas_block_rotation"]).
         _single_probe("pallas_block_rotation", a32,
                       SVDConfig(pair_solver="block_rotation")),
+        # The VMEM-resident grouped-round lane (carried-Gram factor
+        # solves + one fused panel visit per R rounds): single-device —
+        # its collective budget is declared ZERO
+        # (config.COLLECTIVE_BUDGET["pallas_resident"]).
+        _single_probe("pallas_resident", a32,
+                      SVDConfig(pair_solver="resident")),
     ]
     probes += sketch_probes()
     if include_f64:

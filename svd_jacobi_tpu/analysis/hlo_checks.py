@@ -215,7 +215,7 @@ def check_default_entries(include_mesh: bool = True) -> List[Finding]:
     # sketch/TSQR stage jits of the top-k/tall lanes (matmul/QR chains —
     # any collective here would be hand-written, never legitimate).
     for name in ("pallas_batched", "pallas_block_rotation",
-                 "sketch_project", "tsqr_tall"):
+                 "pallas_resident", "sketch_project", "tsqr_tall"):
         if name in singles:
             findings += check_collective_budget(singles[name])
     if include_mesh:

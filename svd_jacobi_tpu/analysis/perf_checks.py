@@ -26,6 +26,22 @@ ground truth XLA computes for free:
      the observatory: importing obs.perf, exercising a
      `ConvergenceRecorder`, and resolving roofline device constants is
      host-side only and must not perturb any entry's lowering.
+
+VMEM001 — static VMEM-budget check (rides the same pass). Every Pallas
+lane declares a per-grid-step working-set model
+(`ops.pallas_apply._pick_chunk`, `ops.pallas_resident.footprint`); this
+check evaluates it for every geometry the repo SHIPS — the declared
+serve buckets under the current backend's routing, and the tuning
+table's TPU kernel-lane rows at their class-representative shapes — and
+fails loudly with the offending (m, b, R, dtype) when a routed,
+kernel-eligible lane cannot pick a usable row chunk, instead of letting
+Mosaic error (or the runtime guard silently fall back) at solve time.
+The resident lane's factor stacks grow as R*k*(2b)^2, so each resident
+row also reports its engagement envelope (the largest n_pad whose
+footprint still fits at the row's (b, R)) — a shipped row whose envelope
+sits below its own size class can never engage and is a finding too.
+The seeded over-budget fixture (R doubled past the budget at the large
+class geometry) MUST fire, proving the detector can fail.
 """
 
 from __future__ import annotations
@@ -175,12 +191,151 @@ def check_perf_off_hlo() -> List[Finding]:
     return findings
 
 
+# Kernel-path lanes: a geometry routed to one of these engages compiled
+# Pallas kernels when its block width is lane-aligned (b % 128 == 0).
+_KERNEL_LANES = ("pallas", "block_rotation", "resident", "hybrid")
+
+# Class-representative shapes for the tuning table's TPU rows: the floor
+# of each kernel-relevant size class plus the medium ceiling (k doubles
+# across the class while b stays fixed, so the ceiling is the in-class
+# worst case for the resident factor stacks).
+_TABLE_SHAPES = (2048, 4096, 8190, 8192)
+_TABLE_DEVICES = (("tpu", "tpu-v5-lite"),)
+
+
+def _kernel_geometry(n: int, b: int) -> tuple:
+    """(b, k, n_pad) the kernel path would use: the even-b fix-up and the
+    pair-count round-up of solver._plan. Kernel sweeps run on the
+    QR-preconditioned n_pad x n_pad triangle, so n_pad is also the row
+    count the apply kernels see."""
+    if b % 2:
+        b += 1
+    k = max(1, -(-n // (2 * b)))
+    return b, k, 2 * k * b
+
+
+def _resident_envelope(b: int, r: int) -> int:
+    """Largest n_pad = 2*k*b whose resident footprint still fits at
+    (b, r) — the lane's engagement envelope; beyond it the runtime guard
+    (`pallas_resident.supported`) falls back to the XLA twin."""
+    from ..ops import pallas_resident as _resident
+
+    k, last = 1, 0
+    while _resident.footprint(2 * k * b, b, k, r)["fits"]:
+        last = 2 * k * b
+        k += 1
+        if k > 4096:
+            break
+    return last
+
+
+def _vmem_rows(source: str, n: int, dtype: str, resolved) -> list:
+    """Footprint rows for one routed geometry: the shared exchange/apply
+    kernel (rides every kernel lane) and, when routed, the resident
+    megakernel."""
+    from ..ops import pallas_apply as pa
+    from ..ops import pallas_resident as _resident
+
+    b, k, n_pad = _kernel_geometry(n, resolved.block_size)
+    lane = resolved.pair_solver or "pallas"
+    eligible = bool(b % 128 == 0 and lane in _KERNEL_LANES)
+    chunk = int(pa._pick_chunk(n_pad, b, 6, pa._gram_fixed_bytes(b)))
+    rows = [{
+        "source": source, "lane": "pallas_apply.apply_exchange",
+        "n": n, "m": n_pad, "b": b, "k": k, "r": 1, "dtype": dtype,
+        "row_chunk": chunk, "fits": bool(chunk > 0), "eligible": eligible,
+        "routed_solver": lane,
+    }]
+    if lane == "resident":
+        r = int(resolved.rounds_resident or _resident.DEFAULT_ROUNDS)
+        r = max(1, min(r, 2 * k - 1))
+        fp = _resident.footprint(n_pad, b, k, r)
+        fp.update(source=source, n=n, dtype=dtype, eligible=eligible,
+                  routed_solver=lane,
+                  envelope_n=_resident_envelope(b, r))
+        rows.append(fp)
+    return rows
+
+
+def check_vmem_budget(*, fixture_oversize: bool = False) -> tuple:
+    """VMEM001 (see module docstring). Returns (findings, report rows).
+    ``fixture_oversize`` appends a deliberately over-budget geometry
+    (the large-class shape with R forced past the factor-stack budget)
+    that MUST produce a finding — the seeded-fixture proof."""
+    from .. import config as _config
+    from ..ops import pallas_resident as _resident
+    from ..tune import tables as _tables
+
+    rows: list = []
+    # 1. The declared serve buckets under the CURRENT backend's routing
+    #    (on a CPU host these resolve small, kernel-ineligible block
+    #    widths — informational; on a TPU serve host they are the actual
+    #    shipped compile geometries).
+    for bucket in _config.DEFAULT_SERVE_BUCKETS:
+        m, n, dtype = bucket[0], bucket[1], bucket[2]
+        res = _tables.resolve(n, m, dtype)
+        rows += _vmem_rows(f"serve_bucket[{m}x{n}]", n, dtype, res)
+    # 2. The tuning table's TPU kernel-lane rows at class-representative
+    #    shapes — static, so a CPU-only CI still validates what the
+    #    table promises a v5-lite host.
+    for backend, kind in _TABLE_DEVICES:
+        for n in _TABLE_SHAPES:
+            res = _tables.resolve(n, n, "float32", backend=backend,
+                                  device_kind=kind)
+            if (res.pair_solver or "pallas") in _KERNEL_LANES:
+                rows += _vmem_rows(f"table[{kind} {n}x{n}]", n,
+                                   "float32", res)
+    if fixture_oversize:
+        b, k, n = 256, 16, 8192
+        r = 4 * _resident.DEFAULT_ROUNDS
+        fp = _resident.footprint(2 * k * b, b, k, r)
+        fp.update(source="fixture_oversize", n=n, dtype="float32",
+                  eligible=True, routed_solver="resident",
+                  envelope_n=_resident_envelope(b, r))
+        rows.append(fp)
+
+    findings: List[Finding] = []
+    for row in rows:
+        if not (row["eligible"] and not row["fits"]):
+            continue
+        where = f"{row['source']}:{row['lane']}"
+        findings.append(Finding(
+            code="VMEM001", where=where,
+            message=(f"per-grid-step VMEM footprint over budget: lane "
+                     f"{row['lane']} at m={row['m']} b={row['b']} "
+                     f"R={row['r']} dtype={row['dtype']} picks no usable "
+                     f"row chunk (step_bytes "
+                     f"{row.get('step_bytes', 0):,} at the minimum "
+                     f"chunk) — Mosaic would reject this geometry or "
+                     f"the runtime guard would silently fall back"),
+            suggestion=("lower rounds_resident (the factor stacks are "
+                        "R*k*(2b)^2*4 bytes) or route the class to "
+                        "pair_solver='block_rotation' / 'pallas'")))
+    # A shipped resident row whose envelope can't reach its own class
+    # floor would never engage — dead configuration, also a finding.
+    for row in rows:
+        if (row["lane"] != "pallas_resident.apply_group"
+                or row["source"].startswith("fixture")
+                or not row["eligible"] or not row["fits"]):
+            continue
+        if row["envelope_n"] < row["n"]:
+            findings.append(Finding(
+                code="VMEM001", where=f"{row['source']}:{row['lane']}",
+                message=(f"resident row engages nominally but its "
+                         f"envelope (n_pad <= {row['envelope_n']}) sits "
+                         f"below the checked shape n={row['n']}"),
+                suggestion="lower rounds_resident for this class"))
+    return findings, rows
+
+
 def run_all() -> tuple:
-    """The PERF001 pass body (analysis.__main__ 'perf'). Returns
-    (findings, report)."""
+    """The PERF001 + VMEM001 pass body (analysis.__main__ 'perf').
+    Returns (findings, report)."""
     findings, rows = check_model_agreement()
     findings += check_scope_phase_join()
     findings += check_perf_off_hlo()
+    vmem_findings, vmem_rows = check_vmem_budget()
+    findings += vmem_findings
     # Seeded drifted-model fixture: a model off by ~9x (one lost n^3
     # term's magnitude) MUST trip the detector.
     drift_findings, _ = check_model_agreement(drift_factor=9.0)
@@ -191,6 +346,22 @@ def run_all() -> tuple:
                      "the agreement detector itself is broken (real "
                      "drift would pass unnoticed)"),
             suggestion="check check_model_agreement's ratio math"))
+    # Seeded over-budget VMEM fixture: R forced 4x past the shipped
+    # large-class grouping MUST trip the footprint detector.
+    vmem_fixture_findings, _ = check_vmem_budget(fixture_oversize=True)
+    vmem_fixture_fired = any(f.where.startswith("fixture_oversize")
+                             for f in vmem_fixture_findings)
+    if not vmem_fixture_fired:
+        findings.append(Finding(
+            code="VMEM001", where="fixture_oversize",
+            message=("seeded over-budget resident geometry produced "
+                     "zero findings — the VMEM footprint detector "
+                     "itself is broken (a real overflow would reach "
+                     "Mosaic as a compile error)"),
+            suggestion=("check pallas_resident.footprint / "
+                        "_pick_chunk's budget math")))
     report = {"model": rows, "tolerance_factor": MODEL_TOL_FACTOR,
-              "drift_fixture_fired": bool(drift_findings)}
+              "drift_fixture_fired": bool(drift_findings),
+              "vmem": vmem_rows,
+              "vmem_fixture_fired": vmem_fixture_fired}
     return findings, report

@@ -75,8 +75,8 @@ def _parse_args(argv):
     p.add_argument("--distributed", action="store_true",
                    help="solve over a mesh of all visible devices")
     p.add_argument("--pair-solver", default="auto",
-                   choices=["auto", "pallas", "block_rotation", "qr-svd",
-                            "gram-eigh", "hybrid"])
+                   choices=["auto", "pallas", "block_rotation", "resident",
+                            "qr-svd", "gram-eigh", "hybrid"])
     p.add_argument("--precondition", default="auto",
                    choices=["auto", "on", "off", "double"],
                    help="QR preconditioning mode (Pallas path; 'double' = "

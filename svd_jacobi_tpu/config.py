@@ -45,9 +45,21 @@ class SVDConfig:
     # Pallas interpreter on CPU), qr-svd for f64 (gesvj-class high relative
     # accuracy) and for tiny inputs; the tuning tables may route eligible
     # classes to "block_rotation" (the MXU-native blocked-rotation lane:
-    # eigh-accumulated bulk rounds + kernel polish, ops/block_rotate.py).
+    # eigh-accumulated bulk rounds + kernel polish, ops/block_rotate.py) or
+    # "resident" (the VMEM-resident grouped-round lane: R tournament
+    # rounds' factors solved against a carried Gram and applied per panel
+    # visit, ops/pallas_resident.py — ~R x less sweep HBM traffic).
     pair_solver: str = "auto"  # "auto" | "pallas" | "block_rotation" |
-    #                            "qr-svd" | "gram-eigh" | "hybrid"
+    #                            "resident" | "qr-svd" | "gram-eigh" |
+    #                            "hybrid"
+    # Residency depth R of the "resident" lane: how many consecutive
+    # tournament rounds are solved against the carried Gram and applied
+    # in ONE VMEM visit of the panel stacks. Larger R amortizes more HBM
+    # traffic (the apply bytes scale ~1/R) but holds R*k (2b)^2 rotation
+    # factors resident, shrinking the usable row chunk. None = tuning
+    # table, falling back to ops.pallas_resident.DEFAULT_ROUNDS; clamped
+    # to the sweep's 2k-1 rounds.
+    rounds_resident: Optional[int] = None
     # --- Pallas-path options (pair_solver="pallas") ---
     # QR preconditioning: norm-sort columns, factor A P = Q1 R, run Jacobi
     # on L = R^T (Drmac-style: graded triangular factors converge in ~25%
@@ -263,6 +275,14 @@ COLLECTIVE_BUDGET = {
     "pallas_block_rotation": {"collective_permute": 0, "all_reduce": 0,
                               "all_gather": 0, "all_to_all": 0,
                               "reduce_scatter": 0},
+    # The single-device VMEM-resident entry (solver._svd_resident — the
+    # grouped-round lane: R rounds' factors solved against the carried
+    # Gram, applied in one panel visit): like the block-rotation lane, a
+    # single-device kernel/matmul chain — zero collectives of any kind,
+    # always.
+    "pallas_resident": {"collective_permute": 0, "all_reduce": 0,
+                        "all_gather": 0, "all_to_all": 0,
+                        "reduce_scatter": 0},
     # The sketch/TSQR stage jits of the top-k and tall lanes
     # (solver._sketch_project_jit / _tsqr_jit): single-device matmul/QR
     # chains — zero collectives of any kind, always (on a mesh the
@@ -313,6 +333,17 @@ RETRACE_BUDGETS = {
     "solver._svd_block_rotation_batched": 1,
     "solver._sweep_step_block_jit": 1,
     "solver._sweep_step_block_batched_jit": 1,
+    # VMEM-resident lane (pair_solver="resident"): the fused entries and
+    # the host-stepped bulk-sweep twins. Same once-per-problem-key
+    # contract as the block-rotation lane (the resident bucket also
+    # counts the shared pallas polish entry, which the serve registry
+    # enumerates); the residency depth r_rounds is a STATIC tuning-table
+    # value per bucket, so it cannot leak per-request retraces.
+    "solver._svd_resident": 1,
+    "solver._svd_resident_donated": 1,
+    "solver._svd_resident_batched": 1,
+    "solver._sweep_step_resident_jit": 1,
+    "solver._sweep_step_resident_batched_jit": 1,
     "sharded._svd_sharded_jit": 1,
     # Serving-layer entries — the host-stepped kernel sweeps that
     # `serve.SVDService` drives. Every request is padded to one of the
@@ -457,6 +488,12 @@ HOT_SCOPES = {
     # region that replaces the latency-bound per-step rotation chain
     # during the bulk phase.
     "block_solve": ("ops/block_rotate.py", "accumulate"),
+    # The VMEM-resident lane's two hot regions: solving a residency
+    # group's R rounds of 2b x 2b factors against the carried Gram
+    # (resident_solve) and the one fused panel visit that applies all R
+    # rounds (resident_apply — the traffic the lane exists to collapse).
+    "resident_solve": ("ops/pallas_resident.py", "group_factors"),
+    "resident_apply": ("ops/pallas_resident.py", "apply_group"),
     # Differentiable-solver hot regions (svd_jacobi_tpu.grad): the
     # safeguarded F-matrix construction and the full/sigma-only
     # cotangent recombinations — the backward-pass cost a training-loop
@@ -549,6 +586,8 @@ SCOPE_PHASES = {
     "rotations": "sweep.rotations",
     "pair_solve": "sweep.rotations",
     "block_solve": "sweep.rotations",
+    "resident_solve": "sweep.rotations",
+    "resident_apply": "sweep.apply",
     "apply": "sweep.apply",
     "apply_exchange": "sweep.apply",
     "exchange": "sweep.exchange",

@@ -195,12 +195,17 @@ def sweep_costs(m: int, n: int, *, block_size: Optional[int] = None,
                 dtype: str = "float32", pair_solver: str = "pallas",
                 accumulate_v: bool = True, sweeps: float = 1.0,
                 gram_dtype: Optional[str] = None,
+                rounds_resident: Optional[int] = None,
                 convention: str = "algorithm") -> Dict[str, PhaseCost]:
     """Costs of ``sweeps`` full sweeps on an m x n working matrix.
 
     ``pair_solver`` picks the rotation-solve term: "pallas" (scalar
     kernel), "gram-eigh"/"hybrid" (batched eigh + NS polish),
-    "block_rotation" (eigh-accumulated factors applied as rank-2b GEMMs).
+    "block_rotation" (eigh-accumulated factors applied as rank-2b GEMMs),
+    "resident" (the VMEM-resident grouped-round lane — per-round factors
+    solved against a CARRIED Gram and applied to the panel stacks once
+    per group of ``rounds_resident`` rounds, so the dominant apply/
+    exchange traffic amortizes ~1/R; see the phase notes inline).
     ``gram_dtype`` models the mixed_store regime (bf16 Gram panels while
     applies stay in the store dtype). Under ``convention="xla"`` the trip
     count collapses to one round (scan/while bodies counted once) and
@@ -212,7 +217,56 @@ def sweep_costs(m: int, n: int, *, block_size: Optional[int] = None,
     n_pad, k, rounds = _pad_geometry(n, b)
     xla = convention == "xla"
     trips = 1.0 if xla else float(sweeps) * rounds
+    per_sweep = 1.0 if xla else float(sweeps)     # once-per-sweep terms
     w = 2 * b                                     # pair width
+    apply_rows = m + (n_pad if accumulate_v else 0)
+
+    if pair_solver == "resident":
+        # The resident lane (ops/pallas_resident.py) restructures the
+        # sweep's data flow, so its byte model is NOT the generic one:
+        #   gram — ONE full n_pad x n_pad bootstrap Gram per sweep
+        #     (2 m n_pad^2 flops, one m x n_pad pass + the Gram write),
+        #     then per ROUND the carried-Gram advance G <- J^T G J (two
+        #     block-diagonal w-wide GEMMs, 4 n_pad w^2 k flops each
+        #     round) reading+writing the n_pad^2 carry. No per-round
+        #     panel re-streaming: the diagonal 2b x 2b subproblems are
+        #     EXTRACTED from the carry.
+        #   rotations — same eigh-accumulated factor solve as the
+        #     block_rotation lane, per round.
+        #   apply — identical FLOPs (R quadrant GEMMs per visit == one
+        #     per round) but the panel stacks are loaded/stored once per
+        #     GROUP of R rounds: bytes divide by R. This is the traffic
+        #     collapse the lane exists for.
+        #   exchange — FREE: inside a group the exchange is slot
+        #     renaming at kernel trace time; at group boundaries the
+        #     permutation rides the apply write-out and the static Gram
+        #     reordering. Zero modeled bytes.
+        r = max(1, int(rounds_resident if rounds_resident else 4))
+        # Under the xla convention the sweep WHILE body still counts
+        # once, but the resident lane's group/round loops inside it are
+        # Python-unrolled (group boundaries and the tournament
+        # permutation are static), so every per-round term appears
+        # ``rounds`` times in the counted-once body — unlike the other
+        # lanes, whose round loop is a lax loop the census sees once.
+        rtrips = float(rounds) if xla else trips
+        gram_flops = (per_sweep * 2.0 * m * n_pad * n_pad
+                      + rtrips * 4.0 * n_pad * w * w * k)
+        gram_bytes = (per_sweep * (m * n_pad + n_pad * n_pad) * gs
+                      + rtrips * 2.0 * n_pad * n_pad * gs)
+        eigh_term = 0.0 if xla else EIGH_FLOPS_PER_N3 * w ** 3
+        rot_flops = rtrips * k * (eigh_term + _NS_FLOPS_PER_N3 * w ** 3)
+        rot_bytes = rtrips * k * 3.0 * w * w * ds
+        apply_flops = rtrips * 8.0 * apply_rows * b * b * k
+        apply_bytes = rtrips * 2.0 * apply_rows * n_pad * ds / r
+        exch_bytes = 0.0
+        return {
+            "sweep.gram": PhaseCost("sweep.gram", gram_flops, gram_bytes),
+            "sweep.rotations": PhaseCost("sweep.rotations", rot_flops,
+                                         rot_bytes),
+            "sweep.apply": PhaseCost("sweep.apply", apply_flops,
+                                     apply_bytes),
+            "sweep.exchange": PhaseCost("sweep.exchange", 0.0, exch_bytes),
+        }
 
     # Gram: k pairs of (m x 2b) panels -> (2b x 2b) Gram blocks.
     gram_flops = trips * 8.0 * m * b * b * k
@@ -236,7 +290,6 @@ def sweep_costs(m: int, n: int, *, block_size: Optional[int] = None,
     # accumulated, onto the V stack (n_pad rows). The block_rotation
     # bulk's one-GEMM-per-pair apply has the same count — that lane's
     # win is arithmetic intensity, not fewer flops.
-    apply_rows = m + (n_pad if accumulate_v else 0)
     apply_flops = trips * 8.0 * apply_rows * b * b * k
     apply_bytes = trips * 2.0 * apply_rows * n_pad * ds
 
@@ -353,6 +406,7 @@ def solve_costs(m: int, n: int, *, block_size: Optional[int] = None,
                 compute_u: bool = True, compute_v: bool = True,
                 mixed_store: bool = False, top_k: Optional[int] = None,
                 oversample: int = 8, power_iters: int = 0,
+                rounds_resident: Optional[int] = None,
                 convention: str = "algorithm") -> Dict[str, PhaseCost]:
     """Full-solve cost by phase, the attribution join table.
 
@@ -396,12 +450,14 @@ def solve_costs(m: int, n: int, *, block_size: Optional[int] = None,
                          dtype=dtype, pair_solver=bulk_solver,
                          accumulate_v=accumulate_v, sweeps=bulk_sweeps,
                          gram_dtype="bfloat16" if mixed_store else None,
+                         rounds_resident=rounds_resident,
                          convention=convention))
     if polish_sweeps > 0 or bulk_sweeps == 0:
         _acc(sweep_costs(sweep_m, sweep_n, block_size=block_size,
                          dtype=dtype,
                          pair_solver="pallas" if pair_solver in
-                         ("pallas", "block_rotation") else pair_solver,
+                         ("pallas", "block_rotation", "resident")
+                         else pair_solver,
                          accumulate_v=accumulate_v,
                          sweeps=max(polish_sweeps, 1.0),
                          convention=convention))
@@ -439,10 +495,12 @@ def entry_flops(kind: str, m: int, n: int, *, block_size: int,
     """
     kw = dict(block_size=block_size, dtype=dtype, convention=convention)
 
-    def stage(pair_solver, *, gram_dtype=None, mm=n, accumulate_v=True):
+    def stage(pair_solver, *, gram_dtype=None, mm=n, accumulate_v=True,
+              rounds_resident=None):
         return sum(c.flops for c in sweep_costs(
             mm, n, pair_solver=pair_solver, gram_dtype=gram_dtype,
-            accumulate_v=accumulate_v, **kw).values())
+            accumulate_v=accumulate_v, rounds_resident=rounds_resident,
+            **kw).values())
 
     def fin(**over):
         fkw = dict(m=m, n=n, dtype=dtype, preconditioned=True,
@@ -472,6 +530,12 @@ def entry_flops(kind: str, m: int, n: int, *, block_size: int,
         per = pre + stage("pallas") + fin()
     elif kind == "pallas_block_rotation":
         per = pre + stage("block_rotation") + stage("pallas") + fin()
+    elif kind == "pallas_resident":
+        # Resident bulk loop (grouped rounds against the carried Gram)
+        # + the shared pallas polish loop, like the block lane's two
+        # phases. R only moves BYTES, not flops, so the counted-once
+        # "xla" loop body is R-independent.
+        per = pre + stage("resident") + stage("pallas") + fin()
     elif kind == "padded_hybrid":
         # Padded XLA lane: no QR precondition — sweeps run on the full
         # m-row stacks; bulk gram-eigh loop + polish qr-svd loop.

@@ -46,6 +46,22 @@ import numpy as np
 HI = jax.lax.Precision.HIGHEST
 
 
+class VmemBudgetError(ValueError):
+    """A Pallas lane's per-grid-step working set exceeds the scoped-VMEM
+    budget for the requested geometry.
+
+    Subclasses ValueError so pre-existing handlers keep working, but
+    carries enough structure (``lane``, ``fallback``) for the serve
+    dispatch to treat it as a RETRYABLE capability miss — route the
+    request down the escalation ladder onto ``fallback`` instead of
+    failing the request with ERROR."""
+
+    def __init__(self, message: str, *, lane: str, fallback: str):
+        super().__init__(message)
+        self.lane = lane
+        self.fallback = fallback
+
+
 def _perm_maps(k: int, exchange: bool, batch: int = 1):
     """(pair_t, top_half_t, pair_b, top_half_b) for output slots i in [0, k).
 
@@ -244,11 +260,14 @@ def apply_exchange(top, bot, q, *, exchange: bool = True,
     mc = _pick_chunk(m, b, 6,
                      _gram_fixed_bytes(b) if with_gram else None)
     if mc == 0:
-        raise ValueError(
-            f"no usable VMEM row chunk for apply_exchange at (m, b) = "
-            f"({m}, {b}) with_gram={with_gram} — the per-step footprint "
-            f"exceeds the scoped-VMEM budget; gate callers on "
-            f"pallas_apply.supported()")
+        raise VmemBudgetError(
+            f"no usable VMEM row chunk for the 'pallas_apply."
+            f"apply_exchange' kernel lane at (m, b) = ({m}, {b}) "
+            f"with_gram={with_gram} — the per-step footprint exceeds the "
+            f"scoped-VMEM budget; gate callers on "
+            f"pallas_apply.supported() or fall back to "
+            f"pair_solver='block_rotation'",
+            lane="pallas_apply.apply_exchange", fallback="block_rotation")
     pair_t, top_half_t, pair_b, top_half_b = _perm_maps(k, exchange, batch)
     # Per-output-slot (2b, b) strips of q, gathered OUTSIDE the kernel
     # (q is (k, 2b, 2b) — tiny next to the stacks).

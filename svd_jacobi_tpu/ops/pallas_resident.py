@@ -1,0 +1,625 @@
+"""VMEM-resident multi-round sweep megakernel (pair_solver="resident").
+
+The blocked-rotation lane (`rounds.block_round_fused`) already collapsed a
+tournament round to eigh + ONE fused apply/exchange/gram kernel per stack
+— but every round still makes a full HBM pass over the (k, m, b) panel
+stacks, so one sweep re-streams the matrix ~2k-1 times (PROFILE items
+8/29; BENCH_r04 sits at 1.7% MFU because of exactly this). This module is
+the residency point of that design (cuSOLVER-gesvdj / Brent-Luk blocked
+Jacobi taken to its TPU conclusion): solve R consecutive rounds' 2b x 2b
+subproblems AGAINST A CARRIED SMALL-SIDE GRAM, then make ONE panel pass
+that applies all R rounds' factors while the working set stays in VMEM.
+
+How a group of R rounds runs:
+
+  1. ``group_factors`` — n^2-scale, zero panel reads: the full pair-major
+     Gram carry G (n_pad x n_pad, bootstrapped once per sweep as X^T X)
+     yields each round's paired-diagonal 2b x 2b panels; the round's skip
+     statistic and `block_rotate.accumulate` factor come from those, the
+     skip gate folds to an identity factor (the exchange still happens,
+     matching `block_round_fused`'s skip branch exactly), and G advances
+     by G <- J^T G J plus the tournament block permutation. Factors never
+     round-trip through a panel pass.
+  2. ``apply_group`` — the single panel pass. On compiled TPU backends a
+     Pallas megakernel grids over row chunks ONLY: the full 2k-block
+     pair axis of the chunk plus all R factor stacks are resident in
+     VMEM, the R rounds' rank-2b applies run back to back on the MXU
+     (Mosaic's grid pipelining double-buffers the next row chunk's HBM
+     loads behind them), and the tournament exchange is a SLOT REMAP of
+     VMEM values — pure renaming at trace time, zero data movement.
+     Elsewhere an XLA twin applies the composed group transform as one
+     GEMM (R >= k_per, the FLOP-optimal regime) or R iterated jnp rounds
+     (R < k_per — same values as the kernel, used by the equivalence
+     tests).
+
+HBM traffic per sweep drops from ~(2k-1) full passes over the stacks to
+ceil((2k-1)/R) passes plus one Gram bootstrap pass — the R-fold
+reduction `obs.costmodel.sweep_costs(pair_solver="resident")` models and
+PERF001's byte acceptance checks. R == 1 (or k_per == 1) delegates to
+`rounds.sweep_block` verbatim: the resident lane at R=1 IS the
+blocked-rotation round chain, bitwise.
+
+Accuracy contract: this is a BULK phase. The loop statistic derives from
+the carried G (f32-HIGHEST updates, re-bootstrapped from the true panels
+every sweep, so carry drift is bounded by one sweep's rounds); the
+endgame always belongs to the unchanged pallas rel-criterion polish,
+which re-measures from the real panels — sigma exactness, U
+orthonormality and v_orth_live are inherited from that handoff, exactly
+as on the block_rotation lane.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import block_rotate as br
+from . import pallas_apply as pa
+from . import rounds
+from ..obs import metrics
+from ..obs.scopes import scope
+from ..parallel import schedule as sched
+
+HI = jax.lax.Precision.HIGHEST
+
+# Default residency depth when neither SVDConfig.rounds_resident nor a
+# tuning-table row pins it: 4 rounds per panel pass quarters the sweep's
+# panel traffic while the factor stacks (R * k * (2b)^2 f32) stay small
+# next to the row-chunk working set at lane-sized b.
+DEFAULT_ROUNDS = 4
+
+
+# --------------------------------------------------------------------------
+# Static VMEM-footprint model. Unlike pallas_apply's per-exchange kernel
+# (13 MiB scoped budget), the resident kernel's whole point is to spend
+# VMEM: the R rounds' factor stacks live in a single constant-index-map
+# buffer (NOT double-buffered) while only the in/out row chunks pipeline.
+# Budget = half the v5-lite 128 MiB VMEM, leaving the other half for
+# Mosaic's own double-buffering of the four io chunk pairs, semaphores,
+# and compiler scratch.
+# --------------------------------------------------------------------------
+
+VMEM_STEP_BUDGET = (128 << 20) // 2
+
+
+def step_bytes(mc: int, k: int, b: int, r: int, itemsize: int = 4) -> int:
+    """Per-grid-step VMEM bytes of the megakernel: top+bot in and out row
+    chunks (double-buffered by the pipeline) plus the R resident factor
+    stacks (single-buffered — their index map is constant across the
+    grid)."""
+    xio = 4 * k * mc * b * itemsize          # top+bot, in + out
+    return 2 * xio + r * k * (2 * b) * (2 * b) * 4
+
+
+def _pick_chunk(m: int, k: int, b: int, r: int, itemsize: int = 4) -> int:
+    """Largest sublane-aligned divisor of m whose grid step fits the
+    scoped-VMEM budget (the same divisor discipline as
+    `pallas_apply._pick_chunk`). 0 if none is usable."""
+    best = 0
+    for c in range(8, m + 1, 8):
+        if m % c:
+            continue
+        if step_bytes(c, k, b, r, itemsize) > VMEM_STEP_BUDGET:
+            break
+        best = c
+    return best
+
+
+def supported(m: int, b: int, k: int, r: int) -> bool:
+    """Whether the compiled megakernel can take this geometry: lane-sized
+    panels and a usable row chunk once the R factor stacks are resident."""
+    return b % 128 == 0 and _pick_chunk(m, k, b, r) >= 128
+
+
+def footprint(m: int, b: int, k: int, r: int, itemsize: int = 4) -> dict:
+    """Static VMEM-budget report row for one geometry (the analysis
+    pass's VMEM check renders these): the chosen chunk, its per-step
+    bytes, the budget, and whether the lane fits."""
+    mc = _pick_chunk(m, k, b, r, itemsize)
+    return {
+        "lane": "pallas_resident.apply_group",
+        "m": int(m), "b": int(b), "k": int(k), "r": int(r),
+        "row_chunk": int(mc),
+        "step_bytes": int(step_bytes(max(mc, 8), k, b, r, itemsize)),
+        "budget_bytes": int(VMEM_STEP_BUDGET),
+        "fits": bool(mc > 0),
+    }
+
+
+# --------------------------------------------------------------------------
+# Pair-major layout helpers. Block-column order [t_0, b_0, t_1, b_1, ...]
+# so pair i's 2b x 2b Gram panel is the i-th diagonal block of G.
+# --------------------------------------------------------------------------
+
+def _pair_major_perm(kp: int) -> np.ndarray:
+    """Old pair-major b-block position of each NEW position under one
+    tournament rotation — derived by running the proven index simulation
+    (`schedule.rotate_indices`) on position ids, so this table and the
+    data rotation (`schedule.rotate_blocks`) cannot disagree."""
+    top = 2 * np.arange(kp)
+    bot = 2 * np.arange(kp) + 1
+    ntop, nbot = sched.rotate_indices(top, bot)
+    return np.stack([ntop, nbot], axis=1).reshape(-1)
+
+
+def _to_pair_major(top, bot, batch: int = 1):
+    """(k, m, b) stacks -> pair-major matrix: (m, 2*k*b) when batch == 1,
+    else (batch, m, 2*k_per*b) per-member views."""
+    k, m, b = top.shape
+    x = jnp.stack([top, bot], axis=1).reshape(2 * k, m, b)
+    if batch == 1:
+        return x.transpose(1, 0, 2).reshape(m, 2 * k * b)
+    kp = k // batch
+    x = x.reshape(batch, 2 * kp, m, b)
+    return x.transpose(0, 2, 1, 3).reshape(batch, m, 2 * kp * b)
+
+
+def _from_pair_major(x, k: int, b: int, batch: int = 1):
+    """Inverse of `_to_pair_major`."""
+    if batch == 1:
+        m = x.shape[0]
+        pairs = x.reshape(m, 2 * k, b).transpose(1, 0, 2).reshape(k, 2, m, b)
+        return pairs[:, 0], pairs[:, 1]
+    m = x.shape[1]
+    kp = k // batch
+    pairs = x.reshape(batch, m, 2 * kp, b).transpose(0, 2, 1, 3)
+    pairs = pairs.reshape(batch, kp, 2, m, b)
+    return pairs[:, :, 0].reshape(k, m, b), pairs[:, :, 1].reshape(k, m, b)
+
+
+def _full_gram(top, bot, batch: int = 1):
+    """Pair-major full Gram of the padded working matrix, f32 HIGHEST —
+    the once-per-sweep bootstrap that pins the carry to the true panels.
+    Per-member (batch, n_p, n_p) on the batched lane (members are
+    independent matrices; their cross terms do not exist)."""
+    x = _to_pair_major(top, bot, batch)
+    x = x.astype(jnp.float32)
+    spec = "mi,mj->ij" if batch == 1 else "bmi,bmj->bij"
+    return jnp.einsum(spec, x, x, precision=HI,
+                      preferred_element_type=jnp.float32)
+
+
+def _extract_pairs(g, k: int, b: int, batch: int = 1):
+    """The k paired-diagonal (2b, 2b) panels of the pair-major carry."""
+    w = 2 * b
+    if batch == 1:
+        gb = g.reshape(k, w, k, w)
+        idx = jnp.arange(k)
+        return gb[idx, :, idx, :]
+    kp = k // batch
+
+    def one(gm):
+        gb = gm.reshape(kp, w, kp, w)
+        idx = jnp.arange(kp)
+        return gb[idx, :, idx, :]
+
+    return jax.vmap(one)(g).reshape(k, w, w)
+
+
+def _update_gram(g, q, k: int, b: int, batch: int = 1):
+    """Advance the carry one round: G <- J^T G J (J = block-diagonal of
+    the pair factors, in pair-major order) then the tournament block
+    permutation on both sides. All n^2-scale f32-HIGHEST contractions —
+    no panel touches."""
+    w = 2 * b
+    kp = k // batch
+    n_p = 2 * kp * b
+    # jnp.array, NOT jnp.asarray: asarray on a host constant lowers to a
+    # device_put, and this runs inside the fused sweep loop (JAXPR003).
+    perm = jnp.array(_pair_major_perm(kp))
+    if batch == 1:
+        gv = g.reshape(n_p, k, w)
+        gv = jnp.einsum("mkj,kji->mki", gv, q, precision=HI,
+                        preferred_element_type=jnp.float32)
+        g = gv.reshape(n_p, n_p)
+        gr = g.reshape(k, w, n_p)
+        gr = jnp.einsum("kjm,kji->kim", gr, q, precision=HI,
+                        preferred_element_type=jnp.float32)
+        g = gr.reshape(n_p, n_p)
+        g4 = g.reshape(2 * k, b, 2 * k, b)
+        g4 = jnp.take(jnp.take(g4, perm, axis=0), perm, axis=2)
+        return g4.reshape(n_p, n_p)
+    qm = q.reshape(batch, kp, w, w)
+    gv = g.reshape(batch, n_p, kp, w)
+    gv = jnp.einsum("Bmkj,Bkji->Bmki", gv, qm, precision=HI,
+                    preferred_element_type=jnp.float32)
+    g = gv.reshape(batch, n_p, n_p)
+    gr = g.reshape(batch, kp, w, n_p)
+    gr = jnp.einsum("Bkjm,Bkji->Bkim", gr, qm, precision=HI,
+                    preferred_element_type=jnp.float32)
+    g = gr.reshape(batch, n_p, n_p)
+    g4 = g.reshape(batch, 2 * kp, b, 2 * kp, b)
+    g4 = jnp.take(jnp.take(g4, perm, axis=1), perm, axis=3)
+    return g4.reshape(batch, n_p, n_p)
+
+
+# --------------------------------------------------------------------------
+# Group factor solve (n^2-scale; zero panel reads).
+# --------------------------------------------------------------------------
+
+def group_factors(g, dmax2, rtol, *, r: int, k: int, b: int,
+                  batch: int = 1, last: bool = False):
+    """(factors, g_out, stats, rotated) of the next ``r`` rounds.
+
+    ``factors`` is (r, k, 2b, 2b) f32 — round rr's per-pair orthogonal
+    transforms in THAT round's slot order (identity where the round-skip
+    gate fired: the panels still exchange, matching `block_round_fused`'s
+    skip branch, and an identity apply is bitwise-exact). ``stats`` is the
+    per-round masked ABS coupling ((r,) scalar rounds, (r, batch)
+    batched); ``rotated`` the int32 count of rounds whose gate fired.
+    ``last``: the final group before the next sweep's fresh bootstrap —
+    its last carry update would be dead work and is skipped."""
+    with scope("resident_solve"):
+        w = 2 * b
+        factors, stats = [], []
+        rotated = jnp.int32(0)
+        for rr in range(r):
+            gp = _extract_pairs(g, k, b, batch)
+            if batch > 1:
+                stat, skip = rounds.panel_stats(
+                    gp, dmax2, members=rounds._members(batch, k // batch),
+                    criterion="abs")
+                skip = rounds._skip_stat(skip)
+            else:
+                stat, skip = rounds.panel_stats(gp, dmax2, criterion="abs")
+            eye = jnp.broadcast_to(jnp.eye(w, dtype=jnp.float32),
+                                   (k, w, w))
+            q = jax.lax.cond(skip > rtol,
+                             lambda p: br.accumulate(p),
+                             lambda p: eye, gp)
+            factors.append(q)
+            stats.append(stat)
+            rotated = rotated + (skip > rtol).astype(jnp.int32)
+            if not (last and rr == r - 1):
+                g = _update_gram(g, q, k, b, batch)
+        return jnp.stack(factors), g, jnp.stack(stats), rotated
+
+
+# --------------------------------------------------------------------------
+# The panel pass: Pallas megakernel + XLA twins.
+# --------------------------------------------------------------------------
+
+def _kernel(top_ref, bot_ref, f_ref, out_t_ref, out_b_ref, *, k, b, r,
+            batch, x3):
+    """R rounds of rank-2b applies on one resident row chunk. The
+    tournament exchange between rounds is a SLOT REMAP of the VMEM values
+    (a trace-time renaming — zero moves, the megakernel's whole point);
+    the (2b, 2b) factor is consumed as four (b, b) quadrants so each mm
+    matches `pallas_apply._kernel`'s dot2 shapes exactly (the equivalence
+    tests pin the two kernels bitwise against each other)."""
+    f32 = jnp.float32
+    bf16 = jnp.bfloat16
+
+    def raw(x, wgt, prec):
+        return jax.lax.dot_general(x, wgt, (((1,), (0,)), ((), ())),
+                                   precision=prec,
+                                   preferred_element_type=f32)
+
+    def split(x):
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        hi = jax.lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000),
+                                          f32)
+        return hi.astype(bf16), (x - hi).astype(bf16)
+
+    if top_ref.dtype == bf16:
+        if x3:
+            def mm(x, wgt):
+                wh, wl = split(wgt)
+                return raw(x, wh, None) + raw(x, wl, None)
+        else:
+            mm = lambda x, wgt: raw(x, wgt.astype(bf16), None)
+    elif x3:
+        def mm(x, wgt):
+            xh, xl = split(x)
+            wh, wl = split(wgt)
+            return raw(xh, wh, None) + (raw(xl, wh, None)
+                                        + raw(xh, wl, None))
+    else:
+        mm = lambda x, wgt: raw(x.astype(f32), wgt, HI)
+
+    ts = [top_ref[i].astype(f32) for i in range(k)]
+    bs = [bot_ref[i].astype(f32) for i in range(k)]
+    kp = k // batch
+    for rr in range(r):
+        nts, nbs = [], []
+        for i in range(k):
+            q = f_ref[rr, i]
+            nts.append(mm(ts[i], q[:b, :b]) + mm(bs[i], q[b:, :b]))
+            nbs.append(mm(ts[i], q[:b, b:]) + mm(bs[i], q[b:, b:]))
+        ts, bs = [], []
+        for s in range(batch):
+            t_seg = nts[s * kp:(s + 1) * kp]
+            b_seg = nbs[s * kp:(s + 1) * kp]
+            if kp > 1:
+                t_seg, b_seg = ([t_seg[0], b_seg[0]] + t_seg[1:-1],
+                                b_seg[1:] + [t_seg[-1]])
+            ts += t_seg
+            bs += b_seg
+    for i in range(k):
+        out_t_ref[i] = ts[i].astype(out_t_ref.dtype)
+        out_b_ref[i] = bs[i].astype(out_b_ref.dtype)
+
+
+def _apply_group_kernel(top, bot, factors, *, x3=False, batch=1,
+                        interpret=False):
+    """The megakernel launch: grid over row chunks only — the whole pair
+    axis and every factor stack stay resident across the R in-kernel
+    rounds, and the pipeline prefetches the next chunk behind the MXU
+    work."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, m, b = top.shape
+    r = int(factors.shape[0])
+    mc = _pick_chunk(m, k, b, r, top.dtype.itemsize)
+    if mc == 0:
+        raise pa.VmemBudgetError(
+            f"no usable VMEM row chunk for the resident megakernel at "
+            f"(m, b, k, R) = ({m}, {b}, {k}, {r}) — the per-grid-step "
+            f"working set exceeds the scoped-VMEM budget "
+            f"({VMEM_STEP_BUDGET} bytes); lower rounds_resident or fall "
+            f"back to pair_solver='block_rotation'",
+            lane="pallas_resident.apply_group", fallback="block_rotation")
+    x_spec = pl.BlockSpec((k, mc, b), lambda mi: (0, mi, 0),
+                          memory_space=pltpu.VMEM)
+    f_spec = pl.BlockSpec((r, k, 2 * b, 2 * b), lambda mi: (0, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    out = jax.ShapeDtypeStruct((k, m, b), top.dtype)
+    return pl.pallas_call(
+        functools.partial(_kernel, k=k, b=b, r=r, batch=batch, x3=x3),
+        grid=(m // mc,),
+        in_specs=[x_spec, x_spec, f_spec],
+        out_specs=[x_spec, x_spec],
+        out_shape=[out, out],
+        interpret=interpret,
+    )(top, bot, factors.astype(jnp.float32))
+
+
+def _apply_group_rounds(top, bot, factors, *, x3=False, batch=1):
+    """XLA twin, iterated form: R jnp rounds of the same quadrant dot2 +
+    `rotate_blocks` exchange — value-equivalent to the kernel (the
+    interpret-mode tests pin it) and FLOP-optimal when R < k_per."""
+    b = top.shape[-1]
+    for rr in range(factors.shape[0]):
+        q = factors[rr]
+        nt = (rounds._einsum(top, q[:, :b, :b], "kmi,kij->kmj", x3=x3)
+              + rounds._einsum(bot, q[:, b:, :b], "kmi,kij->kmj", x3=x3))
+        nb = (rounds._einsum(top, q[:, :b, b:], "kmi,kij->kmj", x3=x3)
+              + rounds._einsum(bot, q[:, b:, b:], "kmi,kij->kmj", x3=x3))
+        top, bot = sched.rotate_blocks(nt.astype(top.dtype),
+                                       nb.astype(bot.dtype), batch)
+    return top, bot
+
+
+def compose_w(factors, k: int, b: int, batch: int = 1):
+    """The group's composed pair-major transform W (exchange permutations
+    folded in): X_out = X_pair_major @ W. One n^2 * 2b-scale contraction
+    per round — cheap next to the panel GEMM it amortizes."""
+    kp = k // batch
+    n_p = 2 * kp * b
+    w2 = 2 * b
+    perm = jnp.array(_pair_major_perm(kp))  # not asarray: see _update_gram
+    r = factors.shape[0]
+    if batch == 1:
+        wmat = jnp.eye(n_p, dtype=jnp.float32)
+        for rr in range(r):
+            wv = wmat.reshape(n_p, k, w2)
+            wv = jnp.einsum("mkj,kji->mki", wv, factors[rr], precision=HI,
+                            preferred_element_type=jnp.float32)
+            wmat = wv.reshape(n_p, 2 * k, b)
+            wmat = jnp.take(wmat, perm, axis=1).reshape(n_p, n_p)
+        return wmat
+    wmat = jnp.broadcast_to(jnp.eye(n_p, dtype=jnp.float32),
+                            (batch, n_p, n_p))
+    fm = factors.reshape(r, batch, kp, w2, w2)
+    for rr in range(r):
+        wv = wmat.reshape(batch, n_p, kp, w2)
+        wv = jnp.einsum("Bmkj,Bkji->Bmki", wv, fm[rr], precision=HI,
+                        preferred_element_type=jnp.float32)
+        wmat = wv.reshape(batch, n_p, 2 * kp, b)
+        wmat = jnp.take(wmat, perm, axis=2).reshape(batch, n_p, n_p)
+    return wmat
+
+
+def _apply_group_composed(top, bot, factors, *, x3=False, batch=1):
+    """XLA twin, composed form: ONE panel GEMM against `compose_w` —
+    FLOP-optimal when R >= k_per, and the big-GEMM shape BLAS/XLA:CPU
+    actually runs near peak (the measured source of the CPU lane win)."""
+    k, m, b = top.shape
+    wmat = compose_w(factors, k, b, batch)
+    x = _to_pair_major(top, bot, batch)
+    spec = "mi,ij->mj" if batch == 1 else "Bmi,Bij->Bmj"
+    xn = rounds._einsum(x, wmat, spec, x3=x3).astype(top.dtype)
+    return _from_pair_major(xn, k, b, batch)
+
+
+def apply_group(top, bot, factors, *, interpret=False, x3=False,
+                batch=1):
+    """(new_top, new_bot) after the group's R rounds of applies and
+    exchanges — the resident lane's one panel pass per R rounds."""
+    k, m, b = top.shape
+    r = int(factors.shape[0])
+    with scope("resident_apply"):
+        if not interpret and supported(m, b, k, r):
+            return _apply_group_kernel(top, bot, factors, x3=x3,
+                                       batch=batch)
+        if r >= k // batch:
+            return _apply_group_composed(top, bot, factors, x3=x3,
+                                         batch=batch)
+        return _apply_group_rounds(top, bot, factors, x3=x3, batch=batch)
+
+
+# --------------------------------------------------------------------------
+# Sweep + bulk iterate loops (the lane's drivers; mirror rounds.sweep_block
+# / iterate_block so the solver's stage machinery treats both lanes alike).
+# --------------------------------------------------------------------------
+
+def sweep_resident(top, bot, vtop, vbot, dmax2, rtol, *, r_rounds: int,
+                   interpret, apply_x3=False, telemetry=False, batch=1):
+    """One resident-lane sweep: the 2k_per - 1 tournament rounds run in
+    groups of ``r_rounds``, each group one `group_factors` + one
+    `apply_group` panel pass per stack. Returns
+    (top, bot, vtop, vbot, off[, rotated]) exactly like
+    `rounds.sweep_block`. ``r_rounds <= 1`` (or a single pair) IS the
+    blocked-rotation sweep — delegated verbatim, so R=1 is bitwise the
+    `block_round_fused` chain."""
+    k, m, b = top.shape
+    kp = k // batch
+    n_rounds = sched.num_rounds(2 * kp)
+    r = max(1, min(int(r_rounds), n_rounds))
+    if r <= 1 or kp == 1:
+        return rounds.sweep_block(top, bot, vtop, vbot, dmax2, rtol,
+                                  interpret=interpret, apply_x3=apply_x3,
+                                  telemetry=telemetry, batch=batch)
+    with_v = vtop is not None
+    with scope("gram"):
+        g0 = _full_gram(top, bot, batch)
+
+    def group(carry, r_g, last):
+        top, bot, vtop, vbot, g, mx = carry[:6]
+        factors, g, stats, rotated = group_factors(
+            g, dmax2, rtol, r=r_g, k=k, b=b, batch=batch, last=last)
+        top, bot = apply_group(top, bot, factors, interpret=interpret,
+                               x3=apply_x3, batch=batch)
+        if with_v:
+            vtop, vbot = apply_group(vtop, vbot, factors,
+                                     interpret=interpret, x3=apply_x3,
+                                     batch=batch)
+        mx = jnp.maximum(mx, jnp.max(stats, axis=0))
+        new = (top, bot, vtop, vbot, g, mx)
+        if telemetry:
+            new += (carry[6] + rotated,)
+        return new
+
+    if not with_v:
+        vtop = vbot = jnp.zeros((k, 0, b), top.dtype)
+    mx0 = (jnp.zeros((batch,), jnp.float32) if batch > 1
+           else jnp.zeros((), jnp.float32))
+    carry = (top, bot, vtop, vbot, g0, mx0)
+    if telemetry:
+        carry += (jnp.int32(0),)
+    n_full, rem = divmod(n_rounds, r)
+    # Equal-R groups ride one scan body (bounded trace size at any k);
+    # the final group — the remainder, or the last full group when R
+    # divides the round count — runs unrolled with the dead carry update
+    # elided (the next sweep re-bootstraps G from the panels).
+    n_scan, tail = (n_full, rem) if rem else (n_full - 1, r)
+    if n_scan > 0:
+        carry, _ = jax.lax.scan(lambda c, _: (group(c, r, False), None),
+                                carry, None, length=n_scan)
+    carry = group(carry, tail, True)
+    top, bot, vtop, vbot, _, off = carry[:6]
+    out = (top, bot, (vtop if with_v else None),
+           (vbot if with_v else None), off)
+    return out + (carry[6],) if telemetry else out
+
+
+def iterate_resident(top, bot, vtop, vbot, *, r_rounds, abs_tol,
+                     max_sweeps, interpret, apply_x3=False,
+                     stall_detection=True, start_sweeps=0, telemetry=False,
+                     stage="resident_bulk", nonfinite0=None,
+                     chaos_nan_sweep=None):
+    """`lax.while_loop` of `sweep_resident`s against the ABS criterion —
+    the resident BULK phase (`rounds.iterate_block` semantics verbatim:
+    stall gate 4*abs_tol / shrink 0.75, nonfinite rides the dmax2/off
+    reductions, ``chaos_nan_sweep`` is the fault-injection hook). Returns
+    (top, bot, vtop, vbot, off, sweeps, nonfinite)."""
+    from ..resilience import chaos as _chaos
+    with_v = vtop is not None
+    k = top.shape[0]
+    if vtop is None:
+        vtop = vbot = jnp.zeros((k, 0, top.shape[2]), top.dtype)
+
+    def cond(st):
+        _, _, _, _, off, prev_off, sweeps, nonfinite = st
+        return rounds.should_continue(
+            off, prev_off, sweeps, tol=abs_tol, max_sweeps=max_sweeps,
+            stall_detection=stall_detection, stall_gate=4.0 * abs_tol,
+            stall_shrink=0.75, nonfinite=nonfinite)
+
+    def body(st):
+        top, bot, vtop, vbot, prev_off, _, sweeps, nonfinite = st
+        if chaos_nan_sweep is not None:
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
+        dmax2 = rounds._global_dmax2(top, bot)
+        out = sweep_resident(
+            top, bot, vtop if with_v else None, vbot if with_v else None,
+            dmax2, abs_tol, r_rounds=r_rounds, interpret=interpret,
+            apply_x3=apply_x3, telemetry=telemetry)
+        top, bot, nvt, nvb, off = out[:5]
+        nonfinite = nonfinite | ~jnp.isfinite(dmax2) | ~jnp.isfinite(off)
+        if telemetry:
+            metrics.emit("sweep",
+                         meta={"path": "resident", "stage": stage},
+                         sweep=sweeps + 1, off_rel=off,
+                         rounds_rotated=out[5])
+        if not with_v:
+            nvt, nvb = st[2], st[3]
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1, nonfinite)
+
+    inf = jnp.float32(jnp.inf)
+    nf0 = (jnp.zeros((), jnp.bool_) if nonfinite0 is None
+           else jnp.asarray(nonfinite0, jnp.bool_))
+    state = (top, bot, vtop, vbot, inf, inf,
+             jnp.asarray(start_sweeps, jnp.int32), nf0)
+    top, bot, vtop, vbot, off, _, sweeps, nonfinite = jax.lax.while_loop(
+        cond, body, state)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off, sweeps, nonfinite)
+
+
+def iterate_resident_batched(top, bot, vtop, vbot, *, batch, r_rounds,
+                             abs_tol, max_sweeps, interpret, apply_x3=False,
+                             stall_detection=True, chaos_nan_sweep=None):
+    """Batched resident bulk loop (`rounds.iterate_block_batched`
+    semantics verbatim: per-member go-mask freezing, per-member health).
+    Returns (top, bot, vtop, vbot, off (batch,), sweeps scalar,
+    msweeps (batch,), nonfinite (batch,))."""
+    from ..resilience import chaos as _chaos
+    with_v = vtop is not None
+    kb = top.shape[0]
+    if vtop is None:
+        vtop = vbot = jnp.zeros((kb, 0, top.shape[2]), top.dtype)
+
+    def go_mask(off, prev_off, sweeps, nonfinite):
+        return rounds.should_continue(
+            off, prev_off, sweeps, tol=abs_tol, max_sweeps=max_sweeps,
+            stall_detection=stall_detection, stall_gate=4.0 * abs_tol,
+            stall_shrink=0.75, nonfinite=nonfinite)
+
+    def cond(st):
+        _, _, _, _, off, prev_off, sweeps, _, nonfinite = st
+        return jnp.any(go_mask(off, prev_off, sweeps, nonfinite))
+
+    def body(st):
+        top, bot, vtop, vbot, off, prev_off, sweeps, msweeps, nonfinite = st
+        go = go_mask(off, prev_off, sweeps, nonfinite)
+        if chaos_nan_sweep is not None:
+            top = _chaos.poison(top, sweeps, chaos_nan_sweep)
+        dmax2 = rounds._global_dmax2(top, bot, batch=batch)
+        out = sweep_resident(top, bot, vtop if with_v else None,
+                             vbot if with_v else None, dmax2, abs_tol,
+                             r_rounds=r_rounds, interpret=interpret,
+                             apply_x3=apply_x3, batch=batch)
+        top, bot, nvt, nvb, off_new = out[:5]
+        nf_new = ~jnp.isfinite(dmax2) | ~jnp.isfinite(off_new)
+        nonfinite = nonfinite | (go & nf_new)
+        prev_off = jnp.where(go, off, prev_off)
+        off = jnp.where(go, off_new, off)
+        msweeps = msweeps + go.astype(jnp.int32)
+        if not with_v:
+            nvt, nvb = st[2], st[3]
+        return (top, bot, nvt, nvb, off, prev_off, sweeps + 1, msweeps,
+                nonfinite)
+
+    inf = jnp.full((batch,), jnp.inf, jnp.float32)
+    state = (top, bot, vtop, vbot, inf, inf, jnp.int32(0),
+             jnp.zeros((batch,), jnp.int32),
+             jnp.zeros((batch,), jnp.bool_))
+    (top, bot, vtop, vbot, off, _, sweeps, msweeps,
+     nonfinite) = jax.lax.while_loop(cond, body, state)
+    return (top, bot, (vtop if with_v else None),
+            (vbot if with_v else None), off, sweeps, msweeps, nonfinite)

@@ -90,6 +90,16 @@ def jit_entries() -> Dict[str, object]:
         "solver._sweep_step_block_jit": solver._sweep_step_block_jit,
         "solver._sweep_step_block_batched_jit":
             solver._sweep_step_block_batched_jit,
+        # VMEM-resident lane (pair_solver="resident"): fused entries +
+        # the host-stepped bulk-sweep twins (the polish stage reuses the
+        # pallas sweep/finish entries below, like the block lane).
+        "solver._svd_resident": solver._svd_resident,
+        "solver._svd_resident_donated": solver._svd_resident_donated,
+        "solver._svd_resident_batched": solver._svd_resident_batched,
+        "solver._sweep_step_resident_jit":
+            solver._sweep_step_resident_jit,
+        "solver._sweep_step_resident_batched_jit":
+            solver._sweep_step_resident_batched_jit,
         "sharded._svd_sharded_jit": sharded._svd_sharded_jit,
         # Host-stepped serving entries (SweepStepper).
         "solver._precondition_qr_jit": solver._precondition_qr_jit,

@@ -2154,6 +2154,7 @@ class SVDService:
                             lane=lane.index)
 
     def _serve_one(self, lane: Lane, req: Request) -> None:
+        from ..ops.pallas_apply import VmemBudgetError
         from ..resilience import chaos
         from ..solver import SolveStatus
         t_pop = time.monotonic()
@@ -2226,8 +2227,33 @@ class SVDService:
                 if path == "ladder":
                     r = self._solve_ladder(lane, req, cu, cv)
                 else:
-                    r = self._solve_base(lane, req, cu, cv,
-                                         sigma_capture=cap)
+                    try:
+                        r = self._solve_base(lane, req, cu, cv,
+                                             sigma_capture=cap)
+                    except VmemBudgetError as ve:
+                        # A Pallas lane's per-grid-step working set
+                        # over-ran its scoped-VMEM budget (geometry the
+                        # VMEM001 analysis check exists to catch before
+                        # it ships). A planning failure, not a backend
+                        # fault: re-dispatch through the escalation
+                        # ladder's unfused solve instead of erroring the
+                        # request.
+                        path = "ladder"
+                        self._bump("vmem_escalations")
+                        if self.metrics is not None:
+                            self.metrics.inc(
+                                "svdj_vmem_escalations_total",
+                                lane=lane.index,
+                                help="VMEM-budget ladder escalations")
+                            self._span(req.id, "vmem_escalate",
+                                       lane=lane.index,
+                                       vmem_lane=getattr(ve, "lane", ""),
+                                       fallback=getattr(ve, "fallback",
+                                                        ""))
+                        print(f"svdj-serve: {ve} — escalating "
+                              f"request {req.id} to the ladder",
+                              file=sys.stderr)
+                        r = self._solve_ladder(lane, req, cu, cv)
                 status = r.status_enum()
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
@@ -2290,6 +2316,7 @@ class SVDService:
         path, so members dispatch sequentially through it. The breaker
         records ONE outcome per batched dispatch (all non-cancelled
         members OK)."""
+        from ..ops.pallas_apply import VmemBudgetError
         from ..solver import SolveStatus
         t_pop = time.monotonic()
         live = []
@@ -2370,6 +2397,25 @@ class SVDService:
                 r = self._solve_batched(lane, live, bucket, tier, cu, cv,
                                         deadline, should_cancel,
                                         sigma_capture=cap)
+            except VmemBudgetError as ve:
+                # Over-budget kernel geometry (see _serve_one): a
+                # planning failure, not a backend fault — the breaker
+                # records nothing. Members re-dispatch sequentially;
+                # each single dispatch escalates itself to the ladder
+                # if the unbatched geometry over-runs too.
+                self._bump("vmem_escalations")
+                if self.metrics is not None:
+                    self.metrics.inc("svdj_vmem_escalations_total",
+                                     lane=lane.index,
+                                     help="VMEM-budget ladder escalations")
+                print(f"svdj-serve: {ve} — re-dispatching batch "
+                      f"{batch_id} members sequentially",
+                      file=sys.stderr)
+                with self._lock:
+                    lane.in_flight = []
+                for req in live:
+                    self._serve_one(lane, req)
+                return
             except Exception as e:
                 error = f"{type(e).__name__}: {e}"
             solve_time = time.monotonic() - t0

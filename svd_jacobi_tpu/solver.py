@@ -34,6 +34,7 @@ from .obs import metrics
 from .obs.scopes import scope
 from .ops import blockwise, rounds
 from .ops import pallas_blocks as pb
+from .ops import pallas_resident as _resident
 from .ops import sketch as _sketch
 from .parallel import schedule as sched
 from .resilience import chaos as _chaos
@@ -151,14 +152,17 @@ def _plan(n: int, n_devices: int, config: SVDConfig, m: Optional[int] = None,
     return b, k
 
 
-# The device-kernel solver lanes: both run the blockified sweep machinery
+# The device-kernel solver lanes: all run the blockified sweep machinery
 # of ops/rounds.py with f32 rotation math (f64 routes to qr-svd) and
 # terminate on the rel statistic. "pallas" generates rotations with the
 # latency-bound Pallas step kernels every round; "block_rotation" solves
 # each round's full 2b x 2b Gram subproblem on-chip (ops/block_rotate —
 # accumulate into one factor J, apply as one rank-2b matmul per pair) as
-# an abs-statistic bulk phase and polishes with the pallas kernels.
-_KERNEL_METHODS = ("pallas", "block_rotation")
+# an abs-statistic bulk phase and polishes with the pallas kernels;
+# "resident" (ops/pallas_resident) runs that same bulk against a carried
+# full Gram so R consecutive rounds' factors apply in ONE VMEM-resident
+# panel pass, then polishes with the same pallas endgame.
+_KERNEL_METHODS = ("pallas", "block_rotation", "resident")
 
 
 def _resolve_mixed_store(config: SVDConfig, n: int, m: int, dtype) -> str:
@@ -182,6 +186,26 @@ def _resolve_mixed_store(config: SVDConfig, n: int, m: int, dtype) -> str:
 # resolving structure and starts re-perturbing what the polish must then
 # undo (14 total sweeps at 1x vs 11 at 10x; 4.40 s vs 2.71 s).
 _BLOCK_BULK_TOL_FACTOR = 10.0
+
+
+def _resolve_rounds_resident(config: SVDConfig, n: int, m: int, dtype,
+                             n_rounds: int) -> int:
+    """The ONE validate-and-resolve of the resident lane's residency depth
+    R (rounds per VMEM-resident panel pass), shared by the fused planners
+    and the steppers so every dispatch surface of a bucket runs the same
+    group structure: explicit `SVDConfig.rounds_resident` wins, else the
+    tuning table's row, else the lane default; clamped to the sweep's
+    round count (a deeper residency than one sweep has rounds is just the
+    whole sweep)."""
+    r = config.rounds_resident
+    if r is None:
+        r = _tuned(n, m, dtype).rounds_resident
+    if r is None:
+        r = _resident.DEFAULT_ROUNDS
+    r = int(r)
+    if r < 1:
+        raise ValueError(f"rounds_resident must be >= 1, got {r}")
+    return max(1, min(r, int(n_rounds)))
 
 
 def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
@@ -238,8 +262,8 @@ def _resolve_options(a, config: SVDConfig, compute_uv: bool = True):
         raise ValueError(f"pair_solver={method!r} computes rotations in "
                          "float32; use 'qr-svd' (the auto choice) for "
                          "float64 inputs")
-    if method not in ("pallas", "block_rotation", "qr-svd", "gram-eigh",
-                      "hybrid"):
+    if method not in ("pallas", "block_rotation", "resident", "qr-svd",
+                      "gram-eigh", "hybrid"):
         raise ValueError(f"unknown pair solver method: {method!r}")
     criterion = config.criterion
     if criterion == "auto":
@@ -1250,6 +1274,176 @@ _svd_block_rotation_batched = partial(
     _svd_block_rotation_batched_impl)
 
 
+_RESIDENT_STATIC = (
+    "n", "compute_u", "compute_v", "full_u", "nblocks", "n_pad", "tol",
+    "max_sweeps", "r_rounds", "precondition", "polish", "apply_x3",
+    "interpret", "stall_detection", "refine", "telemetry",
+    "chaos_nan_sweep")
+
+
+def _svd_resident_impl(a, *, n, compute_u, compute_v, full_u, nblocks,
+                       n_pad, tol, max_sweeps, r_rounds, precondition,
+                       polish, apply_x3=False, interpret=False,
+                       stall_detection=True, refine=False,
+                       telemetry=False, chaos_nan_sweep=None):
+    """The VMEM-resident megakernel solve (pair_solver="resident"),
+    m >= n: `_svd_block_rotation_impl`'s exact two-phase structure with
+    the bulk swapped for `ops.pallas_resident.iterate_resident` — every
+    group of ``r_rounds`` tournament rounds solves its 2b x 2b
+    subproblems against the carried full Gram (n^2-scale, zero panel
+    reads) and applies all R factor stacks in ONE panel pass (the Pallas
+    megakernel on compiled TPU backends, the composed-GEMM / iterated
+    XLA twin elsewhere). The polish phase, preconditioning and
+    postprocessing bookkeeping are bitwise the block_rotation lane's —
+    the accuracy contract (sigma exact, U orthonormal, v_orth_live
+    clean) is inherited from the same unchanged pallas endgame."""
+    m = a.shape[0]
+    dtype = a.dtype
+    if precondition:
+        q1, _, order, work = _precondition_qr(a)
+        accumulate = compute_u       # rotations -> U
+        want_cols = compute_v        # normalized columns -> V
+    else:
+        q1 = order = None
+        work = a
+        accumulate = compute_v
+        want_cols = compute_u
+
+    top, bot = _blockify(work, n_pad, nblocks)
+    if accumulate:
+        vtop, vbot = _blockify(jnp.eye(n_pad, dtype=dtype), n_pad, nblocks)
+    else:
+        vtop = vbot = None
+
+    top, bot, vtop, vbot, bulk_off, bulk_sweeps, bulk_nf = \
+        _resident.iterate_resident(
+            top, bot, vtop, vbot, r_rounds=r_rounds,
+            abs_tol=_BLOCK_BULK_TOL_FACTOR * _abs_phase_tol(dtype),
+            max_sweeps=max_sweeps, interpret=interpret, apply_x3=apply_x3,
+            stall_detection=stall_detection, telemetry=telemetry,
+            chaos_nan_sweep=chaos_nan_sweep)
+    if telemetry:
+        metrics.emit("stage", meta={"stage": "resident_bulk"},
+                     sweeps=bulk_sweeps, off_rel=bulk_off)
+    top, bot, vtop, vbot, off_rel, sweeps, nonfinite = rounds.iterate(
+        top, bot, vtop, vbot, tol=tol, max_sweeps=max_sweeps,
+        interpret=interpret, polish=polish, bulk_bf16=False,
+        stall_detection=stall_detection, start_sweeps=bulk_sweeps,
+        telemetry=telemetry, stage="polish", nonfinite0=bulk_nf,
+        chaos_nan_sweep=chaos_nan_sweep)
+    # Bulk budget-exhaustion: report the bulk statistic if the polish
+    # never ran (cf. the block_rotation lane's identical carry handling).
+    off_rel = jnp.where(sweeps > bulk_sweeps, off_rel, bulk_off)
+    status = _status_word(off_rel, sweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
+
+    a_work = _deblockify(top, bot)
+    v_work = _deblockify(vtop, vbot)[:n, :] if accumulate else None
+    cols, s, rot = _postprocess(a_work, v_work, n, compute_u=want_cols,
+                                full_u=False, dtype=dtype)
+    if refine:
+        cols, s, rot = _refine_from_work(work, cols, s, rot)
+    if precondition:
+        u, v = _recombine_precondition(
+            cols, rot, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=full_u, dtype=dtype, q1=q1, order=order)
+        return u, s, v, sweeps, off_rel, status
+    u = cols
+    if compute_u and full_u and m > n and u is not None:
+        u = _complete_orthonormal(u, n, dtype)
+    return u, s, rot, sweeps, off_rel, status
+
+
+_svd_resident = partial(jax.jit, static_argnames=_RESIDENT_STATIC)(
+    _svd_resident_impl)
+# Input-donating twin, mirroring _svd_block_rotation_donated.
+_svd_resident_donated = partial(
+    jax.jit, static_argnames=_RESIDENT_STATIC,
+    donate_argnums=(0,))(_svd_resident_impl)
+
+
+_RESIDENT_BATCHED_STATIC = (
+    "n", "compute_u", "compute_v", "nblocks", "n_pad", "tol", "max_sweeps",
+    "r_rounds", "precondition", "polish", "apply_x3", "interpret",
+    "stall_detection", "refine", "chaos_nan_sweep")
+
+
+def _svd_resident_batched_impl(a, *, n, compute_u, compute_v, nblocks,
+                               n_pad, tol, max_sweeps, r_rounds,
+                               precondition, polish, apply_x3=False,
+                               interpret=False, stall_detection=True,
+                               refine=False, chaos_nan_sweep=None):
+    """Batched resident solve: `_svd_block_rotation_batched_impl` with
+    the bulk swapped for `pallas_resident.iterate_resident_batched` —
+    per-member Gram carries (one matrix's couplings never enter a
+    neighbor's factors), the same per-member freezing/health words, the
+    same kernel polish continuing the per-member counters."""
+    batch, m = a.shape[0], a.shape[1]
+    dtype = a.dtype
+    if precondition:
+        q1, _, order, work = jax.vmap(_precondition_qr)(a)
+        accumulate = compute_u
+        want_cols = compute_v
+    else:
+        q1 = order = None
+        work = a
+        accumulate = compute_v
+        want_cols = compute_u
+
+    top, bot = map(_stack_members,
+                   _blockify_batched(work, n_pad, nblocks))
+    if accumulate:
+        eye = jnp.broadcast_to(jnp.eye(n_pad, dtype=dtype),
+                               (batch, n_pad, n_pad))
+        vtop, vbot = map(_stack_members,
+                         _blockify_batched(eye, n_pad, nblocks))
+    else:
+        vtop = vbot = None
+
+    (top, bot, vtop, vbot, bulk_off, bulk_sweeps, bulk_msweeps,
+     bulk_nf) = _resident.iterate_resident_batched(
+        top, bot, vtop, vbot, batch=batch, r_rounds=r_rounds,
+        abs_tol=_BLOCK_BULK_TOL_FACTOR * _abs_phase_tol(dtype),
+        max_sweeps=max_sweeps, interpret=interpret, apply_x3=apply_x3,
+        stall_detection=stall_detection, chaos_nan_sweep=chaos_nan_sweep)
+    top, bot, vtop, vbot, off, msweeps, nonfinite = rounds.iterate_batched(
+        top, bot, vtop, vbot, batch=batch, tol=tol, max_sweeps=max_sweeps,
+        interpret=interpret, polish=polish,
+        stall_detection=stall_detection, start_sweeps=bulk_sweeps,
+        msweeps0=bulk_msweeps, nonfinite0=bulk_nf,
+        chaos_nan_sweep=chaos_nan_sweep)
+    # Members whose polish never swept (total budget exhausted in bulk)
+    # report the bulk statistic, cf. the single-solve carry handling.
+    off = jnp.where(msweeps > bulk_msweeps, off, bulk_off)
+    status = _status_word(off, msweeps, nonfinite, tol=tol,
+                          max_sweeps=max_sweeps)
+
+    a_work = _deblockify_batched(top, bot, batch)
+    v_work = (_deblockify_batched(vtop, vbot, batch)[:, :n, :]
+              if accumulate else None)
+
+    def post_one(aw, vw, wk):
+        cols, s, rot = _postprocess(aw, vw, n, compute_u=want_cols,
+                                    full_u=False, dtype=dtype)
+        if refine:
+            cols, s, rot = _refine_from_work(wk, cols, s, rot)
+        return cols, s, rot
+
+    cols, s, rot = jax.vmap(post_one)(a_work, v_work, work)
+    if precondition:
+        u, v = jax.vmap(lambda c, r, qq, oo: _recombine_precondition(
+            c, r, m=m, n=n, compute_u=compute_u, compute_v=compute_v,
+            full_u=False, dtype=dtype, q1=qq, order=oo))(cols, rot, q1,
+                                                         order)
+        return u, s, v, msweeps, off, status
+    return cols, s, rot, msweeps, off, status
+
+
+_svd_resident_batched = partial(
+    jax.jit, static_argnames=_RESIDENT_BATCHED_STATIC)(
+    _svd_resident_batched_impl)
+
+
 def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
                 compute_v: bool = True, full_matrices: bool = False):
     """Resolve the fused jitted entry point a (input, config) pair
@@ -1270,7 +1464,7 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
     if config.precondition not in ("auto", "on", "off", "double"):
         raise ValueError(f"unknown precondition mode: {config.precondition!r}")
 
-    if method == "block_rotation":
+    if method in ("block_rotation", "resident"):
         if b % 2:
             # The polish phase's self kernel splits blocks in half.
             b += 1
@@ -1279,25 +1473,23 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
         if config.precondition == "double":
             raise ValueError(
                 "precondition='double' is a pallas-lane fused mode; the "
-                "block_rotation lane supports 'auto'/'on'/'off'")
+                f"{method} lane supports 'auto'/'on'/'off'")
         if config.mixed_bulk or config.bulk_bf16:
             raise ValueError(
                 "mixed_bulk/bulk_bf16 are pallas-lane bulk regimes; the "
-                "block_rotation lane runs its own eigh-accumulated bulk "
+                f"{method} lane runs its own eigh-accumulated bulk "
                 "(its panel matmuls honor mixed_store instead)")
         precondition = (_tuned(n, m, a.dtype).precondition == "on"
                         if config.precondition == "auto"
                         else config.precondition == "on")
-        # The mixed-store gate composes with the blocked-rotation lane
-        # through its bulk-phase panel GEMMs: a bf16 storage verdict
+        # The mixed-store gate composes with the blocked-rotation lanes
+        # through their bulk-phase panel GEMMs: a bf16 storage verdict
         # (table row or explicit) runs them as bf16x3 split products
         # (~eps_bf16^2 error, absorbed by the abs-phase contract — the
         # f32 polish re-converges from the applied state).
         mixed_store = _resolve_mixed_store(config, n, m, a.dtype)
         refine = (config.sigma_refine if config.sigma_refine is not None
                   else (compute_u or compute_v))
-        solve = (_svd_block_rotation_donated if config.donate_input
-                 else _svd_block_rotation)
         kwargs = dict(
             n=n, compute_u=compute_u, compute_v=compute_v,
             full_u=full_matrices, nblocks=2 * k, n_pad=n_pad, tol=tol,
@@ -1309,6 +1501,14 @@ def _plan_entry(a, config: SVDConfig, *, compute_u: bool = True,
             stall_detection=bool(config.stall_detection),
             refine=bool(refine), telemetry=bool(metrics.enabled()),
             chaos_nan_sweep=_chaos.consume_nan_sweep())
+        if method == "resident":
+            kwargs["r_rounds"] = _resolve_rounds_resident(
+                config, n, m, a.dtype, 2 * k - 1)
+            solve = (_svd_resident_donated if config.donate_input
+                     else _svd_resident)
+            return "resident", solve, a, kwargs
+        solve = (_svd_block_rotation_donated if config.donate_input
+                 else _svd_block_rotation)
         return "block_rotation", solve, a, kwargs
 
     if method == "pallas":
@@ -1442,6 +1642,12 @@ def _plan_entry_batched(a, config: SVDConfig, *, compute_u: bool = True,
                 _resolve_mixed_store(config, n, m, a.dtype) != "f32")
             return ("block_rotation_batched", _svd_block_rotation_batched,
                     a, kwargs)
+        if method == "resident":
+            kwargs["apply_x3"] = (
+                _resolve_mixed_store(config, n, m, a.dtype) != "f32")
+            kwargs["r_rounds"] = _resolve_rounds_resident(
+                config, n, m, a.dtype, 2 * k - 1)
+            return "resident_batched", _svd_resident_batched, a, kwargs
         return "pallas_batched", _svd_pallas_batched, a, kwargs
     if config.precondition in ("on", "double") or config.mixed_bulk:
         bad = ("mixed_bulk=True" if config.mixed_bulk
@@ -2096,21 +2302,23 @@ class _SweepControlMixin:
     def _phase(self):
         """(method, criterion, tol) for the next sweep, per current stage.
 
-        Two methods run as host-visible bulk+polish stages: "hybrid"
+        Three methods run as host-visible bulk+polish stages: "hybrid"
         (gram-eigh/abs bulk, qr-svd/rel polish — the XLA lane) and
-        "block_rotation" (eigh-accumulated block rounds against the abs
-        statistic, pallas-kernel polish — the MXU lane). Both share the
-        abs-criterion stall/tolerance machinery for the bulk stage."""
+        "block_rotation"/"resident" (eigh-accumulated block rounds
+        against the abs statistic — per-round Gram panels vs the
+        VMEM-resident group carry — with the pallas-kernel polish; the
+        MXU lanes). All share the abs-criterion stall/tolerance
+        machinery for the bulk stage."""
         if self._stage == "bulk":
-            if self.method == "block_rotation":
-                # The block lane's measured bulk exit (see
+            if self.method in ("block_rotation", "resident"):
+                # The block lanes' measured bulk exit (see
                 # `_BLOCK_BULK_TOL_FACTOR`): past ~10x the abs floor the
                 # eigh factors' backward error re-perturbs structure.
-                return ("block_rotation", "abs",
+                return (self.method, "abs",
                         _BLOCK_BULK_TOL_FACTOR * self.abs_tol)
             return "gram-eigh", "abs", self.abs_tol
         if self._stage == "polish":
-            if self.method == "block_rotation":
+            if self.method in ("block_rotation", "resident"):
                 return "pallas", self.criterion, self.tol
             return "qr-svd", self.criterion, self.tol
         return self.method, self.criterion, self.tol
@@ -2202,12 +2410,12 @@ class SweepStepper(_SweepControlMixin):
                 else config.precondition == "on")
             self._accumulate = (compute_u if self._precondition
                                 else compute_v)
-            # The block lane's bulk GEMMs honor the resolved mixed-store
+            # The block lanes' bulk GEMMs honor the resolved mixed-store
             # gate exactly like the fused planner (the stepper IS the
             # serving dispatch — fused and served solves of one bucket
             # must run the same arithmetic).
             self._apply_x3 = (
-                self.method == "block_rotation"
+                self.method in ("block_rotation", "resident")
                 and _resolve_mixed_store(config, n, m, a.dtype) != "f32")
             self._pc = None          # lazy (q1, order, work) cache
         else:
@@ -2217,12 +2425,19 @@ class SweepStepper(_SweepControlMixin):
              self.criterion) = _resolve_xla_options(a, config,
                                                     compute_uv=compute_u)
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
+        # Residency depth of the resident lane's bulk sweeps — resolved
+        # exactly like the fused planner so served and fused solves of
+        # one bucket run the same group structure.
+        self._r_rounds = (_resolve_rounds_resident(
+            config, n, m, a.dtype, self.nblocks - 1)
+            if self.method == "resident" else None)
         self.abs_tol = _abs_phase_tol(a.dtype)
         self._prev_off = float("inf")
-        # Hybrid and block_rotation run as two host-visible stages:
+        # Hybrid and the block lanes run as two host-visible stages:
         # "bulk" (abs statistic) then "polish" (rel criterion) — see
         # `_SweepControlMixin._phase`. Other methods have one stage.
-        self._stage = ("bulk" if self.method in ("hybrid", "block_rotation")
+        self._stage = ("bulk" if self.method in ("hybrid", "block_rotation",
+                                                 "resident")
                        else "single")
         self._just_switched = False
         self._input_digest = None
@@ -2388,6 +2603,18 @@ class SweepStepper(_SweepControlMixin):
                     state.top, state.bot, state.vtop, state.vbot,
                     jnp.float32(_BLOCK_BULK_TOL_FACTOR * self.abs_tol),
                     with_v=self._accumulate, apply_x3=self._apply_x3,
+                    interpret=not pb.supported())
+                return SweepState(top, bot, vtop, vbot, off,
+                                  state.sweeps + 1)
+            if method == "resident":
+                # The resident bulk stage: grouped rounds against the
+                # carried Gram, one VMEM-resident panel pass per group;
+                # polish falls through to the pallas step below.
+                top, bot, vtop, vbot, off = _sweep_step_resident_jit(
+                    state.top, state.bot, state.vtop, state.vbot,
+                    jnp.float32(_BLOCK_BULK_TOL_FACTOR * self.abs_tol),
+                    r_rounds=self._r_rounds, with_v=self._accumulate,
+                    apply_x3=self._apply_x3,
                     interpret=not pb.supported())
                 return SweepState(top, bot, vtop, vbot, off,
                                   state.sweeps + 1)
@@ -2596,6 +2823,17 @@ class SweepStepper(_SweepControlMixin):
                     dict(with_v=self._accumulate,
                          apply_x3=self._apply_x3,
                          interpret=not pb.supported())))
+            if self.method == "resident":
+                # The resident lane's bulk stage entry (the polish
+                # stage's pallas entry follows below).
+                entries.append((
+                    "solver._sweep_step_resident_jit",
+                    _sweep_step_resident_jit,
+                    (top_s, bot_s, vtop_s, vbot_s, f32s),
+                    dict(r_rounds=self._r_rounds,
+                         with_v=self._accumulate,
+                         apply_x3=self._apply_x3,
+                         interpret=not pb.supported())))
             entries.append((
                 "solver._sweep_step_pallas_jit", _sweep_step_pallas_jit,
                 (top_s, bot_s, vtop_s, vbot_s, f32s),
@@ -2791,6 +3029,27 @@ def _sweep_step_block_jit(top, bot, vtop, vbot, rtol, *, with_v, apply_x3,
     return top, bot, vtop, vbot, off
 
 
+@partial(jax.jit, static_argnames=("r_rounds", "with_v", "apply_x3",
+                                   "interpret"))
+def _sweep_step_resident_jit(top, bot, vtop, vbot, rtol, *, r_rounds, with_v,
+                             apply_x3, interpret):
+    """One VMEM-resident BULK sweep for the host-stepped API
+    (`SweepStepper` with pair_solver="resident", stage "bulk"): the same
+    `ops.pallas_resident.sweep_resident` the fused solver loops, with the
+    per-sweep dmax2 deflation scale recomputed here. ``r_rounds`` is the
+    residency depth R (rounds applied per VMEM visit); ``rtol`` /
+    ``apply_x3`` as in `_sweep_step_block_jit`. The polish stage runs
+    `_sweep_step_pallas_jit` unchanged."""
+    dmax2 = _global_dmax2(top, bot)
+    top, bot, nvt, nvb, off = _resident.sweep_resident(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        dmax2, rtol, r_rounds=r_rounds, interpret=interpret,
+        apply_x3=apply_x3)
+    if with_v:
+        vtop, vbot = nvt, nvb
+    return top, bot, vtop, vbot, off
+
+
 def _finish_pallas_one(top, bot, vtop, vbot, work, q1, order, *, n,
                        compute_u, compute_v, full_u, precondition, refine):
     """Kernel-path postprocessing + recombination (+ sigma refinement) for
@@ -2869,6 +3128,25 @@ def _sweep_step_block_batched_jit(top, bot, vtop, vbot, rtol, *, batch,
     top, bot, nvt, nvb, off = rounds.sweep_block(
         top, bot, vtop if with_v else None, vbot if with_v else None,
         dmax2, rtol, interpret=interpret, apply_x3=apply_x3, batch=batch)
+    if with_v:
+        vtop, vbot = nvt, nvb
+    return top, bot, vtop, vbot, off
+
+
+@partial(jax.jit, static_argnames=("batch", "r_rounds", "with_v", "apply_x3",
+                                   "interpret"))
+def _sweep_step_resident_batched_jit(top, bot, vtop, vbot, rtol, *, batch,
+                                     r_rounds, with_v, apply_x3, interpret):
+    """One VMEM-resident bulk sweep of a stacked (B*k, m, b) batch
+    (`BatchedSweepStepper` stage "bulk"): `pallas_resident.sweep_resident`
+    with the block-diagonal batched schedule; per-member (B,) dmax2/off
+    vectors on the ABS statistic. ``r_rounds`` / ``apply_x3``: see
+    `_sweep_step_resident_jit`."""
+    dmax2 = _global_dmax2(top, bot, batch=batch)
+    top, bot, nvt, nvb, off = _resident.sweep_resident(
+        top, bot, vtop if with_v else None, vbot if with_v else None,
+        dmax2, rtol, r_rounds=r_rounds, interpret=interpret,
+        apply_x3=apply_x3, batch=batch)
     if with_v:
         vtop, vbot = nvt, nvb
     return top, bot, vtop, vbot, off
@@ -3027,7 +3305,7 @@ class BatchedSweepStepper(_SweepControlMixin):
             # Resolved mixed-store gate for the block lane's bulk GEMMs
             # (cf. SweepStepper.__init__).
             self._apply_x3 = (
-                self.method == "block_rotation"
+                self.method in ("block_rotation", "resident")
                 and _resolve_mixed_store(config, n, m, a.dtype) != "f32")
             self._pc = None
         else:
@@ -3035,8 +3313,12 @@ class BatchedSweepStepper(_SweepControlMixin):
              self.criterion) = _resolve_xla_options(a[0], config,
                                                     compute_uv=compute_u)
         self.nblocks, self.n_pad = 2 * k, 2 * k * b
+        self._r_rounds = (
+            _resolve_rounds_resident(config, n, m, a.dtype, self.nblocks - 1)
+            if self.method == "resident" else None)
         self.abs_tol = _abs_phase_tol(a.dtype)
-        self._stage = ("bulk" if self.method in ("hybrid", "block_rotation")
+        self._stage = ("bulk" if self.method in ("hybrid", "block_rotation",
+                                                 "resident")
                        else "single")
         self._just_switched = False
         # Per-member host bookkeeping: stop reason (None = live), frozen
@@ -3111,6 +3393,13 @@ class BatchedSweepStepper(_SweepControlMixin):
                 jnp.float32(_BLOCK_BULK_TOL_FACTOR * self.abs_tol),
                 batch=self.batch, with_v=self._accumulate,
                 apply_x3=self._apply_x3, interpret=not pb.supported())
+        elif self._kernel_path and method == "resident":
+            top, bot, vtop, vbot, off = _sweep_step_resident_batched_jit(
+                state.top, state.bot, state.vtop, state.vbot,
+                jnp.float32(_BLOCK_BULK_TOL_FACTOR * self.abs_tol),
+                batch=self.batch, r_rounds=self._r_rounds,
+                with_v=self._accumulate, apply_x3=self._apply_x3,
+                interpret=not pb.supported())
         elif self._kernel_path:
             top, bot, vtop, vbot, off = _sweep_step_pallas_batched_jit(
                 state.top, state.bot, state.vtop, state.vbot,
@@ -3351,6 +3640,17 @@ class BatchedSweepStepper(_SweepControlMixin):
                     _sweep_step_block_batched_jit,
                     (top_s, bot_s, vtop_s, vbot_s, f32s),
                     dict(batch=self.batch, with_v=self._accumulate,
+                         apply_x3=self._apply_x3,
+                         interpret=not pb.supported())))
+            if self.method == "resident":
+                # Bulk-stage sweep entry of the resident lane (the
+                # polish stage's pallas entry follows).
+                entries.append((
+                    "solver._sweep_step_resident_batched_jit",
+                    _sweep_step_resident_batched_jit,
+                    (top_s, bot_s, vtop_s, vbot_s, f32s),
+                    dict(batch=self.batch, r_rounds=self._r_rounds,
+                         with_v=self._accumulate,
                          apply_x3=self._apply_x3,
                          interpret=not pb.supported())))
             entries.append((

@@ -85,7 +85,7 @@ def _build_config(base, knobs: Dict[str, object]):
     import dataclasses as _dc
     updates = {}
     for k in ("block_size", "mixed_store", "pair_solver", "precondition",
-              "criterion"):
+              "criterion", "rounds_resident"):
         if k in knobs:
             updates[k] = knobs[k]
     if updates.get("pair_solver", "auto") not in ("auto", "pallas",
@@ -171,7 +171,8 @@ def _axes(n: int, dtype: str, baseline: Dict[str, object],
     # block_rotation shares the kernel lane's capability window (f32-only
     # rotations, min(m, n) >= 64 to block usefully).
     solver_axis = (["qr-svd"] if f64
-                   else (["pallas", "block_rotation", "hybrid", "qr-svd"]
+                   else (["pallas", "block_rotation", "resident", "hybrid",
+                          "qr-svd"]
                          if n >= 64 else ["hybrid", "qr-svd"]))
     axes = [
         ("block_size", block_axis),
@@ -179,6 +180,11 @@ def _axes(n: int, dtype: str, baseline: Dict[str, object],
     ]
     if pallas_routed:
         axes.append(("precondition", ["on", "off"]))
+        # Residency depth of the resident lane (rounds per VMEM panel
+        # pass). Swept AFTER pair_solver so it prices against a resident
+        # incumbent; the search loop skips it when the incumbent routed
+        # elsewhere (the knob is dead there — identical programs).
+        axes.append(("rounds_resident", [2, 4, 8]))
     return [(k, [v for v in vs if v != baseline.get(k)]) for k, vs in axes]
 
 
@@ -295,6 +301,11 @@ def search_shape(m: int, n: int, dtype: str, *, reps: int, budget_s: float,
     incumbent_time = baseline.time_s
     points: List[Point] = []
     for knob, values in _axes(n, dt.name, baseline_knobs, smoke):
+        if (knob == "rounds_resident"
+                and incumbent_knobs.get("pair_solver") != "resident"):
+            _log("tune:   rounds_resident skipped (incumbent solver is "
+                 f"{incumbent_knobs.get('pair_solver')!r}, not resident)")
+            continue
         for value in values:
             cand = dict(incumbent_knobs)
             cand[knob] = value

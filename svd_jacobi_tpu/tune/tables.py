@@ -55,7 +55,7 @@ SCHEMA_VERSION = 1
 # than f64, null = 8*eps of the accumulation dtype).
 KNOBS = ("block_size", "mixed_store", "pair_solver", "precondition",
          "criterion", "batch_tiers", "oversample", "power_iters",
-         "tsqr_chunk", "grad_degenerate_rtol")
+         "tsqr_chunk", "grad_degenerate_rtol", "rounds_resident")
 
 # The sketch-knob subset, used by the TUNE001 coverage rule: a declared
 # top-k serve bucket must get these from a MEASURED (non-generic) row.
@@ -82,8 +82,8 @@ K_CLASSES = ("none", "small", "medium", "large")
 _MATCH_KEYS = ("n_class", "aspect", "dtype", "backend", "device_kind",
                "k_class")
 _VALID_MIXED_STORE = ("f32", "bf16", "bf16g")
-_VALID_PAIR_SOLVER = ("pallas", "block_rotation", "qr-svd", "gram-eigh",
-                      "hybrid")
+_VALID_PAIR_SOLVER = ("pallas", "block_rotation", "resident", "qr-svd",
+                      "gram-eigh", "hybrid")
 # "double" (dgejsv's second QR) is deliberately NOT a table value: it is
 # a fused-single-solve-only mode the stepper/batched/mesh lanes cannot
 # run, so a row pinning it would make the fused and served solves of the
@@ -197,6 +197,10 @@ GENERIC_KNOBS: Dict[str, object] = {
     # accumulation dtype at solve time — the dtype-derived floor; the
     # shipped table pins per-dtype rows on top).
     "grad_degenerate_rtol": None,
+    # Residency depth R of the "resident" lane (None = the lane's
+    # builtin ops.pallas_resident.DEFAULT_ROUNDS; solve-time clamped to
+    # the sweep's 2k-1 rounds).
+    "rounds_resident": None,
 }
 
 
@@ -226,6 +230,7 @@ class Resolved(NamedTuple):
     power_iters: int
     tsqr_chunk: Optional[int]
     grad_degenerate_rtol: Optional[float]
+    rounds_resident: Optional[int]
     generic_only: bool
     sketch_generic_only: bool
     source: str
@@ -290,6 +295,11 @@ def _validate_row(row: dict, where: str, errors: List[str]) -> None:
                            or isinstance(gr, bool) or not gr > 0):
         errors.append(f"{where}.knobs.grad_degenerate_rtol: expected null "
                       f"or a number > 0, got {gr!r}")
+    rr = knobs.get("rounds_resident", None)
+    if rr is not None and (not isinstance(rr, int) or isinstance(rr, bool)
+                           or rr < 1):
+        errors.append(f"{where}.knobs.rounds_resident: expected null or "
+                      f"int >= 1, got {rr!r}")
     tiers = knobs.get("batch_tiers")
     if tiers is not None and (
             not isinstance(tiers, (list, tuple)) or not tiers
@@ -431,6 +441,7 @@ class TuningTable:
         bs = knobs["block_size"]
         tc = knobs["tsqr_chunk"]
         gr = knobs["grad_degenerate_rtol"]
+        rr = knobs["rounds_resident"]
         return Resolved(
             block_size=int(bs) if bs is not None
             else heuristic_block_size(int(n)),
@@ -443,6 +454,7 @@ class TuningTable:
             power_iters=int(knobs["power_iters"]),
             tsqr_chunk=None if tc is None else int(tc),
             grad_degenerate_rtol=None if gr is None else float(gr),
+            rounds_resident=None if rr is None else int(rr),
             generic_only=generic_only,
             sketch_generic_only=sketch_generic_only,
             source=f"{self.table_id}:{','.join(contributors) or 'builtin'}",

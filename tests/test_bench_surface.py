@@ -61,6 +61,38 @@ def test_bench_fused_gen_stepped_conflict():
     assert "incompatible" in (p.stderr + p.stdout)
 
 
+def test_bench_check_row_gate_passes(tmp_path):
+    """--check-row replays a saved row through the --check-against perf
+    gate without solving (no backend, no compile — tier-1 fast): a value
+    at/above the fitted band of the checked-in history exits 0."""
+    row = tmp_path / "row.json"
+    row.write_text(json.dumps(
+        {"metric": "svd_2048x2048_float32_gflops", "value": 999.0,
+         "unit": "GFLOP/s"}))
+    p = _run(f"--check-row={row}", "--check-against=BENCH_r04.json")
+    assert p.returncode == 0, p.stderr[-500:]
+    assert "pass svd_2048x2048_float32_gflops" in p.stderr
+    assert "best prior" in p.stderr
+
+
+def test_bench_check_row_gate_fails_on_regression(tmp_path):
+    row = tmp_path / "row.json"
+    row.write_text(json.dumps(
+        {"metric": "svd_2048x2048_float32_gflops", "value": 0.0001,
+         "unit": "GFLOP/s"}))
+    p = _run(f"--check-row={row}", "--check-against=BENCH_r04.json")
+    assert p.returncode == 4, (p.returncode, p.stderr[-500:])
+    assert "FAIL" in p.stderr
+
+
+def test_bench_check_row_requires_check_against(tmp_path):
+    row = tmp_path / "row.json"
+    row.write_text("{}")
+    p = _run(f"--check-row={row}")
+    assert p.returncode != 0
+    assert "check-against" in (p.stderr + p.stdout)
+
+
 @_row
 def test_bench_donate_stepped_row():
     """The 30208^2 recipe's flag combination, exercised end-to-end at toy

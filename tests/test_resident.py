@@ -1,0 +1,515 @@
+"""The VMEM-resident grouped-round lane (pair_solver="resident").
+
+Covers the PR's acceptance surface: the megakernel (interpret mode) is
+BITWISE the iterated-jnp twin given the same factors, R=1 delegates
+verbatim to the blocked-rotation sweep, the lane's sigma/U/V match the
+pallas lane and the f64 oracle on gap/flat/decaying spectra through the
+fused, stepped and batched surfaces, chaos NaN mid-residency decodes
+NONFINITE (with batched member isolation), the five new jits keep the
+once-per-bucket compile contract (RETRACE001) and ride the AOT ledger
+two ways (AOT001 + seeded unbudgeted fixture), the lowered fused entry
+carries zero collectives, the cost model's resident byte claim holds
+(<= 1/2 of block_rotation per sweep at 2048^2 f32 R>=4), the static
+VMEM-budget check is clean with a firing over-budget fixture, and the
+over-budget runtime error names the lane and the knob to turn.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import svd_jacobi_tpu as sj
+from svd_jacobi_tpu import SVDConfig, solver
+from svd_jacobi_tpu.ops import pallas_resident as pr
+from svd_jacobi_tpu.ops import rounds
+from svd_jacobi_tpu.parallel import schedule as sched
+from svd_jacobi_tpu.resilience import chaos
+
+CFG = SVDConfig(pair_solver="resident", block_size=16)
+
+# Redundant-coverage depth rides the slow lane: every demoted case has
+# a tier-1 twin asserting the same contract on a cheaper surface (the
+# tier-1 suite must stay inside the 870 s ROADMAP budget).
+_deep = pytest.mark.slow
+
+
+def _spectrum_matrix(n, spec, seed=7, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    if spec == "gap":
+        sv = np.concatenate([np.ones(4) * 100.0, np.ones(n - 4)])
+    elif spec == "flat":
+        sv = np.ones(n)
+    else:  # decaying
+        sv = np.exp(-np.arange(n) / (n / 8))
+    qa, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    qb, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return jnp.asarray((qa * sv) @ qb.T, dtype)
+
+
+def _stacks(k, m, b, seed, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    top = jnp.asarray(rng.standard_normal((k, m, b)), dtype)
+    bot = jnp.asarray(rng.standard_normal((k, m, b)), dtype)
+    return top, bot
+
+
+def _factors(k, m, b, r, seed):
+    """Orthogonal (r, k, 2b, 2b) factor stacks via group_factors on a
+    real Gram — the factors the lane would actually apply."""
+    top, bot = _stacks(k, m, b, seed)
+    g = pr._full_gram(top, bot)
+    dmax2 = rounds._global_dmax2(top, bot)
+    f, _, _, _ = pr.group_factors(g, dmax2, jnp.float32(0.0), r=r, k=k, b=b)
+    return top, bot, f
+
+
+class TestKernelEquivalence:
+    @pytest.mark.parametrize("r", [2, 4])
+    def test_megakernel_bitwise_vs_iterated_twin(self, r):
+        """Given the SAME factor stacks, the interpret-mode megakernel's
+        R fused rounds (slot-remap exchange) equal the iterated jnp twin
+        (quadrant dot2 + rotate_blocks) BITWISE — the exchange really is
+        pure renaming and each mm has the twin's exact shape."""
+        k, m, b = 4, 48, 8
+        top, bot, f = _factors(k, m, b, r, seed=3)
+        kt, kb = pr._apply_group_kernel(top, bot, f, interpret=True)
+        tt, tb = pr._apply_group_rounds(top, bot, f)
+        np.testing.assert_array_equal(np.asarray(kt), np.asarray(tt))
+        np.testing.assert_array_equal(np.asarray(kb), np.asarray(tb))
+
+    @_deep
+    def test_megakernel_bitwise_batched(self):
+        """Batched (per-member tournament) slot remap: still bitwise."""
+        batch, kp, m, b = 2, 3, 40, 8
+        k = batch * kp
+        top, bot = _stacks(k, m, b, seed=5)
+        g = pr._full_gram(top, bot, batch)
+        dmax2 = rounds._global_dmax2(top, bot, batch=batch)
+        f, _, _, _ = pr.group_factors(g, dmax2, jnp.float32(0.0), r=2,
+                                      k=k, b=b, batch=batch)
+        kt, kb = pr._apply_group_kernel(top, bot, f, batch=batch,
+                                        interpret=True)
+        tt, tb = pr._apply_group_rounds(top, bot, f, batch=batch)
+        np.testing.assert_array_equal(np.asarray(kt), np.asarray(tt))
+        np.testing.assert_array_equal(np.asarray(kb), np.asarray(tb))
+
+    def test_composed_twin_matches_iterated(self):
+        """The composed-W twin (one GEMM) matches the iterated rounds to
+        f32 contraction accuracy (not bitwise: different add order)."""
+        k, m, b = 3, 32, 8
+        top, bot, f = _factors(k, m, b, 4, seed=7)
+        ct, cb = pr._apply_group_composed(top, bot, f)
+        tt, tb = pr._apply_group_rounds(top, bot, f)
+        scale = float(jnp.max(jnp.abs(top))) + float(jnp.max(jnp.abs(bot)))
+        np.testing.assert_allclose(np.asarray(ct), np.asarray(tt),
+                                   rtol=0, atol=3e-5 * scale)
+        np.testing.assert_allclose(np.asarray(cb), np.asarray(tb),
+                                   rtol=0, atol=3e-5 * scale)
+
+    def test_exchange_matches_schedule(self):
+        """Identity factors make the group pass a PURE exchange chain:
+        R rounds of the slot remap must equal R `schedule.rotate_blocks`
+        tournament rotations, bitwise."""
+        k, m, b, r = 4, 24, 8, 3
+        top, bot = _stacks(k, m, b, seed=11)
+        eye = jnp.broadcast_to(jnp.eye(2 * b, dtype=jnp.float32),
+                               (r, k, 2 * b, 2 * b))
+        kt, kb = pr._apply_group_kernel(top, bot, eye, interpret=True)
+        et, eb = top, bot
+        for _ in range(r):
+            et, eb = sched.rotate_blocks(et, eb)
+        np.testing.assert_array_equal(np.asarray(kt), np.asarray(et))
+        np.testing.assert_array_equal(np.asarray(kb), np.asarray(eb))
+
+    def test_r1_delegates_to_block_sweep_bitwise(self):
+        """sweep_resident at R=1 IS rounds.sweep_block, bitwise — the
+        delegation is literal, not re-derived."""
+        k, m, b = 3, 48, 8
+        top, bot = _stacks(k, m, b, seed=13)
+        dmax2 = rounds._global_dmax2(top, bot)
+        rtol = jnp.float32(1e-6)
+        rt, rb_, _, _, roff = pr.sweep_resident(
+            top, bot, None, None, dmax2, rtol, r_rounds=1, interpret=True)
+        st, sb, _, _, soff = rounds.sweep_block(
+            top, bot, None, None, dmax2, rtol, interpret=True)
+        np.testing.assert_array_equal(np.asarray(rt), np.asarray(st))
+        np.testing.assert_array_equal(np.asarray(rb_), np.asarray(sb))
+        assert float(roff) == float(soff)
+
+    def test_gram_carry_matches_fresh_bootstrap(self):
+        """After one group the carried G equals a fresh X^T X of the
+        group's output panels to f32 contraction accuracy — the carry
+        advance (J^T G J + permutation) tracks the real panels."""
+        k, m, b, r = 4, 48, 8, 2
+        top, bot = _stacks(k, m, b, seed=17)
+        g = pr._full_gram(top, bot)
+        dmax2 = rounds._global_dmax2(top, bot)
+        f, g_out, _, _ = pr.group_factors(g, dmax2, jnp.float32(0.0),
+                                          r=r, k=k, b=b)
+        nt, nb = pr._apply_group_rounds(top, bot, f)
+        g_ref = pr._full_gram(nt, nb)
+        scale = float(jnp.max(jnp.abs(g_ref)))
+        np.testing.assert_allclose(np.asarray(g_out), np.asarray(g_ref),
+                                   rtol=0, atol=2e-5 * scale)
+
+
+class TestLaneAccuracy:
+    @pytest.mark.parametrize("spec", ["gap", "flat", "decaying"])
+    def test_matches_pallas_and_oracle(self, spec):
+        """sigma/U/V of the resident lane match the pallas lane and the
+        f64 oracle on gap/flat/decaying spectra (f32 input)."""
+        n = 96
+        a = _spectrum_matrix(n, spec)
+        r = sj.svd(a, config=CFG)
+        assert r.status_enum().name in ("OK", "STAGNATED")
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        serr = np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / s_ref[0]
+        assert serr < 2e-6
+        u, s, v = (np.asarray(r.u, np.float64), np.asarray(r.s, np.float64),
+                   np.asarray(r.v, np.float64))
+        res = np.linalg.norm(np.asarray(a, np.float64) - (u * s) @ v.T)
+        assert res / np.linalg.norm(a) < 5e-6
+        assert np.max(np.abs(u.T @ u - np.eye(n))) < 5e-5
+        assert np.max(np.abs(v.T @ v - np.eye(n))) < 5e-5
+        rp = sj.svd(a, config=SVDConfig(pair_solver="pallas", block_size=16))
+        np.testing.assert_allclose(np.asarray(r.s), np.asarray(rp.s),
+                                   rtol=1e-5, atol=1e-5 * float(s_ref[0]))
+
+    @_deep
+    @pytest.mark.parametrize("rr", [2, 5])
+    def test_rounds_resident_knob_respected(self, rr):
+        """Explicit rounds_resident values (including one clamped to the
+        sweep's round count) converge to the same spectrum."""
+        n = 96
+        a = _spectrum_matrix(n, "decaying", seed=23)
+        cfg = SVDConfig(pair_solver="resident", block_size=16,
+                        rounds_resident=rr)
+        r = sj.svd(a, config=cfg)
+        assert r.status_enum().name in ("OK", "STAGNATED")
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / \
+            s_ref[0] < 2e-6
+
+    def test_rounds_resident_invalid_rejected(self):
+        a = jnp.zeros((96, 96), jnp.float32)
+        with pytest.raises(ValueError, match="rounds_resident"):
+            sj.svd(a, config=SVDConfig(pair_solver="resident",
+                                       block_size=16, rounds_resident=0))
+
+    @_deep
+    def test_wide_input_transposes(self):
+        rng = np.random.default_rng(5)
+        a = jnp.asarray(rng.standard_normal((64, 96)), jnp.float32)
+        r = sj.svd(a, config=CFG)
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        assert np.max(np.abs(np.asarray(r.s, np.float64) - s_ref)) / \
+            s_ref[0] < 2e-6
+        assert r.u.shape == (64, 64) and r.v.shape == (96, 64)
+
+    def test_batched_matches_oracle_and_isolates_nan_member(self):
+        """The batched lane: per-member sigmas match the oracle; a
+        chaos-poisoned member decodes NONFINITE with OK neighbors."""
+        rng = np.random.default_rng(9)
+        stack = jnp.stack([jnp.asarray(rng.standard_normal((64, 64)),
+                                       jnp.float32) for _ in range(3)])
+        cfg = SVDConfig(pair_solver="resident", block_size=16)
+        r = solver.svd_batched(stack, config=cfg)
+        for i in range(3):
+            assert int(r.status[i]) == int(solver.SolveStatus.OK)
+            s_ref = np.linalg.svd(np.asarray(stack[i], np.float64),
+                                  compute_uv=False)
+            assert np.max(np.abs(np.asarray(r.s[i], np.float64) - s_ref)) \
+                / s_ref[0] < 2e-6
+        with chaos.nan_at_sweep(1):
+            rn = solver.svd_batched(stack, config=cfg)
+        assert int(rn.status[0]) == int(solver.SolveStatus.NONFINITE)
+        assert int(rn.status[1]) == int(solver.SolveStatus.OK)
+        assert int(rn.status[2]) == int(solver.SolveStatus.OK)
+
+    @_deep
+    def test_chaos_nan_mid_residency_decodes_nonfinite(self):
+        """NaN injected mid-solve (inside the resident bulk loop, where
+        the carried Gram could otherwise launder it) decodes NONFINITE."""
+        rng = np.random.default_rng(11)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        with chaos.nan_at_sweep(1):
+            r = sj.svd(a, config=CFG)
+        assert r.status_enum() is solver.SolveStatus.NONFINITE
+
+
+class TestSteppers:
+    def test_stepper_matches_fused(self):
+        rng = np.random.default_rng(13)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        rf = sj.svd(a, config=CFG)
+        st = solver.SweepStepper(a, config=CFG)
+        assert st._kernel_path and st.method == "resident"
+        assert st.phase_info().stage == "bulk"
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        rs = st.finish(state)
+        assert rs.status_enum().name == "OK"
+        np.testing.assert_allclose(np.asarray(rs.s), np.asarray(rf.s),
+                                   rtol=1e-5, atol=1e-4)
+
+    @_deep
+    def test_batched_stepper_matches_fused(self):
+        rng = np.random.default_rng(15)
+        stack = jnp.stack([jnp.asarray(rng.standard_normal((64, 64)),
+                                       jnp.float32) for _ in range(2)])
+        cfg = SVDConfig(pair_solver="resident", block_size=16)
+        rf = solver.svd_batched(stack, config=cfg)
+        bst = solver.BatchedSweepStepper(stack, config=cfg)
+        assert bst.method == "resident"
+        state = bst.init()
+        while bst.should_continue(state):
+            state = bst.step(state)
+        rb = bst.finish(state)
+        for i in range(2):
+            assert int(rb.status[i]) == int(solver.SolveStatus.OK)
+            np.testing.assert_allclose(np.asarray(rb.s[i]),
+                                       np.asarray(rf.s[i]),
+                                       rtol=1e-5, atol=1e-4)
+
+    @_deep
+    def test_sigma_promote_flow(self):
+        rng = np.random.default_rng(17)
+        a = jnp.asarray(rng.standard_normal((96, 64)), jnp.float32)
+        st = solver.SweepStepper(a, config=CFG)
+        state = st.init()
+        while st.should_continue(state):
+            state = st.step(state)
+        full = st.finish(state)
+        sig, payload = st.sigma_finish(state)
+        assert payload["promotable"]
+        np.testing.assert_allclose(np.asarray(sig.s), np.asarray(full.s),
+                                   rtol=1e-4, atol=1e-4)
+        promoted = solver.finish_from_payload(payload)
+        np.testing.assert_allclose(np.asarray(promoted.s),
+                                   np.asarray(full.s), rtol=0, atol=0)
+
+    def test_aot_entries_cover_both_stages(self):
+        """The stepped surfaces declare the resident BULK jit plus the
+        unchanged pallas POLISH jit — the bulk->polish handoff is
+        AOT-warmable end to end."""
+        rng = np.random.default_rng(19)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float32)
+        st = solver.SweepStepper(a, config=CFG)
+        names = [n for n, _, _, _ in st.aot_entries()]
+        assert "solver._sweep_step_resident_jit" in names
+        assert "solver._sweep_step_pallas_jit" in names
+        stack = jnp.stack([a, a])
+        bst = solver.BatchedSweepStepper(stack, config=CFG)
+        bnames = [n for n, _, _, _ in bst.aot_entries()]
+        assert "solver._sweep_step_resident_batched_jit" in bnames
+        assert "solver._sweep_step_pallas_batched_jit" in bnames
+
+
+class TestVmemBudget:
+    def test_footprint_fields_and_monotonicity(self):
+        fp = pr.footprint(2048, 128, 8, 4)
+        assert fp["lane"] == "pallas_resident.apply_group"
+        assert fp["fits"] and fp["row_chunk"] >= 128
+        assert fp["step_bytes"] <= fp["budget_bytes"]
+        # Deeper residency monotonically grows the resident set.
+        assert (pr.footprint(2048, 128, 8, 8)["step_bytes"]
+                > fp["step_bytes"])
+
+    def test_over_budget_raises_named_error(self, monkeypatch):
+        """The runtime guard: an over-budget geometry raises
+        VmemBudgetError naming the lane, the offending geometry and the
+        knob to turn — not a Mosaic compile error."""
+        from svd_jacobi_tpu.ops.pallas_apply import VmemBudgetError
+        monkeypatch.setattr(pr, "VMEM_STEP_BUDGET", 1024)
+        top, bot, f = _factors(2, 24, 8, 2, seed=29)
+        with pytest.raises(VmemBudgetError) as ei:
+            pr._apply_group_kernel(top, bot, f, interpret=True)
+        msg = str(ei.value)
+        assert "(m, b, k, R) = (24, 8, 2, 2)" in msg
+        assert "rounds_resident" in msg
+        assert ei.value.lane == "pallas_resident.apply_group"
+        assert ei.value.fallback == "block_rotation"
+
+    def test_vmem_check_clean_and_fixture_fires(self):
+        """VMEM001: every shipped geometry (serve buckets + the table's
+        TPU resident rows) fits its footprint model; the seeded
+        over-budget fixture MUST fire."""
+        from svd_jacobi_tpu.analysis import perf_checks
+        findings, rows = perf_checks.check_vmem_budget()
+        assert findings == []
+        # The shipped TPU resident rows are evaluated (not just buckets).
+        resident_rows = [r for r in rows
+                         if r["lane"] == "pallas_resident.apply_group"]
+        assert resident_rows and all(r["fits"] for r in resident_rows)
+        assert all(r["envelope_n"] >= r["n"] for r in resident_rows)
+        fixture_findings, frows = perf_checks.check_vmem_budget(
+            fixture_oversize=True)
+        assert any(f.code == "VMEM001"
+                   and f.where.startswith("fixture_oversize")
+                   for f in fixture_findings)
+        assert any(r["source"] == "fixture_oversize" and not r["fits"]
+                   for r in frows)
+
+    def test_supported_gate_consistent_with_pick_chunk(self):
+        assert pr.supported(2048, 128, 8, 4)
+        assert not pr.supported(2048, 120, 8, 4)      # lane alignment
+        assert not pr.supported(2048, 128, 8, 10_000)  # over budget
+
+
+@pytest.mark.serve
+class TestServeEscalation:
+    def test_vmem_budget_error_routes_to_ladder(self, monkeypatch):
+        """A VmemBudgetError out of the base dispatch re-routes the
+        request down the escalation ladder (path="ladder", status OK)
+        instead of erroring it — and does not trip the breaker."""
+        from svd_jacobi_tpu.ops.pallas_apply import VmemBudgetError
+        from svd_jacobi_tpu.serve import service as service_mod
+        from svd_jacobi_tpu.serve import (BreakerState, ServeConfig,
+                                          SVDService)
+        from svd_jacobi_tpu.solver import SolveStatus
+        from svd_jacobi_tpu.utils import matgen
+
+        calls = {"n": 0}
+
+        def boom(self, lane, req, cu, cv, **kw):
+            calls["n"] += 1
+            raise VmemBudgetError(
+                "no usable VMEM row chunk for the resident megakernel at "
+                "(m, b, k, R) = (32, 8, 2, 4); lower rounds_resident",
+                lane="pallas_resident.apply_group",
+                fallback="block_rotation")
+
+        monkeypatch.setattr(service_mod.SVDService, "_solve_base", boom)
+        cfg = ServeConfig(buckets=((32, 32, "float64"),),
+                          solver=SVDConfig(block_size=4),
+                          max_queue_depth=8)
+        a = matgen.random_dense(32, 32, seed=77, dtype=jnp.float64)
+        with SVDService(cfg) as svc:
+            res = svc.submit(a).result(timeout=180.0)
+            health = svc.healthz()
+        assert calls["n"] == 1
+        assert res.status is SolveStatus.OK
+        assert res.path == "ladder"
+        s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+        np.testing.assert_allclose(np.asarray(res.s), s_ref,
+                                   rtol=1e-10, atol=1e-12)
+        # Planning failure, not a backend fault: breaker stays closed,
+        # and the escalation is counted for the flight recorder.
+        assert health["breaker"] == BreakerState.CLOSED.value
+        assert health["stats"]["vmem_escalations"] == 1
+
+
+class TestAnalysisLedger:
+    def test_retrace_once_per_problem(self):
+        """Once-per-bucket compiles for the fused resident jit: two
+        shapes, two solves each — repeats are pure cache hits."""
+        from svd_jacobi_tpu.analysis.recompile_guard import RecompileGuard
+        rng = np.random.default_rng(27)
+        mats = {n: jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+                for n in (48, 64)}
+        cfg = SVDConfig(pair_solver="resident", block_size=8, max_sweeps=8)
+        with RecompileGuard() as guard:
+            guard.expect("solver._svd_resident", problems=2)
+            for n, a in mats.items():
+                jax.block_until_ready(sj.svd(a, config=cfg).s)
+                jax.block_until_ready(sj.svd(a, config=cfg).s)
+        assert guard.check() == []
+        traces = guard.new_traces()
+        assert traces["solver._svd_resident"] == 2
+
+    def test_aot001_bijection_and_seeded_unbudgeted_entry(self):
+        """All five new jits ride the registry/budget bijection; dropping
+        one budget fires AOT001 naming it (the seeded fixture)."""
+        from svd_jacobi_tpu import config as _config
+        from svd_jacobi_tpu.analysis import aot_checks
+        from svd_jacobi_tpu.serve import registry
+        entries = registry.jit_entries()
+        new = ("solver._svd_resident", "solver._svd_resident_donated",
+               "solver._svd_resident_batched",
+               "solver._sweep_step_resident_jit",
+               "solver._sweep_step_resident_batched_jit")
+        for name in new:
+            assert name in entries
+            assert name in _config.RETRACE_BUDGETS
+        assert aot_checks.check_budget_coverage() == []
+        budgets = {k: v for k, v in _config.RETRACE_BUDGETS.items()
+                   if k != "solver._svd_resident"}
+        findings = aot_checks.check_budget_coverage(budgets=budgets)
+        assert [f.code for f in findings] == ["AOT001"]
+        assert findings[0].where == "solver._svd_resident"
+
+    def test_zero_collective_hlo_budget(self):
+        """COLLECTIVE_BUDGET["pallas_resident"]: the lowered fused entry
+        carries no collectives of any kind."""
+        from svd_jacobi_tpu.analysis import entries, hlo_checks
+        probes = {p.name: p
+                  for p in entries.single_device_probes(include_f64=False)}
+        assert "pallas_resident" in probes
+        assert probes["pallas_resident"].entry_id == "solver._svd_resident"
+        assert hlo_checks.check_collective_budget(
+            probes["pallas_resident"]) == []
+
+    def test_tune_axis_and_table_validity(self):
+        """rounds_resident is a validated table knob, the shipped table
+        routes the TPU v5-lite medium/large square f32 classes onto the
+        lane (R=4 medium, R=2 large — the VMEM envelope), CPU routing is
+        untouched, and the search axis exists exactly where the kernel
+        lane does."""
+        from svd_jacobi_tpu.tune import search, tables
+        t = tables.TuningTable.from_payload({
+            "schema_version": tables.SCHEMA_VERSION,
+            "table_id": "t", "rows": [
+                {"match": {"n_class": "medium"},
+                 "knobs": {"pair_solver": "resident",
+                           "rounds_resident": 4}}],
+        }, verify_hash=False)
+        res = t.resolve(2048, dtype="float32", backend="cpu",
+                        device_kind="cpu")
+        assert res.pair_solver == "resident" and res.rounds_resident == 4
+        with pytest.raises(tables.TableError, match="rounds_resident"):
+            tables.TuningTable.from_payload({
+                "schema_version": tables.SCHEMA_VERSION,
+                "table_id": "bad", "rows": [
+                    {"match": {}, "knobs": {"rounds_resident": 0}}],
+            }, verify_hash=False)
+        shipped = tables.load_table(tables.shipped_table_path())
+        med = shipped.resolve(2048, dtype="float32", backend="tpu",
+                              device_kind="tpu-v5-lite")
+        assert med.pair_solver == "resident" and med.rounds_resident == 4
+        large = shipped.resolve(8192, dtype="float32", backend="tpu",
+                                device_kind="tpu-v5-lite")
+        assert large.pair_solver == "resident"
+        assert large.rounds_resident == 2
+        assert pr.footprint(8192, large.block_size,
+                            8192 // (2 * large.block_size), 2)["fits"]
+        cpu_med = shipped.resolve(2048, dtype="float32", backend="cpu",
+                                  device_kind="cpu")
+        assert cpu_med.pair_solver == "block_rotation"
+        assert cpu_med.rounds_resident is None
+        axes = dict(search._axes(512, "float32", {}, smoke=False))
+        assert "resident" in axes["pair_solver"]
+        assert set(axes["rounds_resident"]) == {2, 4, 8}
+        axes_f64 = dict(search._axes(512, "float64", {}, smoke=False))
+        assert "resident" not in axes_f64["pair_solver"]
+        assert "rounds_resident" not in axes_f64
+
+    def test_costmodel_resident_halves_sweep_bytes(self):
+        """The acceptance byte claim: at 2048^2 f32 lane geometry the
+        resident lane's modeled HBM bytes per sweep are <= 1/2 of
+        block_rotation's at R>=4 — and monotonically shrink with R."""
+        from svd_jacobi_tpu.obs import costmodel
+
+        def sweep_bytes(solver_name, rr=None):
+            phases = costmodel.sweep_costs(
+                2048, 2048, block_size=128, pair_solver=solver_name,
+                sweeps=1.0, rounds_resident=rr)
+            return sum(c.hbm_bytes for c in phases.values())
+
+        base = sweep_bytes("block_rotation")
+        r4 = sweep_bytes("resident", 4)
+        r8 = sweep_bytes("resident", 8)
+        assert r4 <= 0.5 * base
+        assert r8 < r4 < sweep_bytes("resident", 2) < base

@@ -255,14 +255,22 @@ class TestShippedTableVerdicts:
                                **cpu).pair_solver == "block_rotation"
         assert shipped.resolve(4096, m=4096, dtype="float32",
                                **cpu).pair_solver == "block_rotation"
-        # Narrow verdict: tall aspect, the small class, and every TPU
-        # class stay on the measured pallas default.
+        # Narrow verdict: tall aspect and the small class stay on the
+        # measured pallas default.
         assert shipped.resolve(2048, m=65536, dtype="float32",
                                **cpu).pair_solver == "pallas"
         assert shipped.resolve(512, m=512, dtype="float32",
                                **cpu).pair_solver == "pallas"
-        assert shipped.resolve(2048, m=2048, dtype="float32",
-                               **self.V5E).pair_solver == "pallas"
+        # r05: the TPU v5-lite medium/large square f32 classes route to
+        # the VMEM-resident grouped-round lane (R=4 medium; R=2 large —
+        # the largest residency whose factor stacks fit the scoped VMEM
+        # budget at b=256, per ops.pallas_resident.footprint).
+        med = shipped.resolve(2048, m=2048, dtype="float32", **self.V5E)
+        assert med.pair_solver == "resident"
+        assert med.rounds_resident == 4
+        large = shipped.resolve(8192, m=8192, dtype="float32", **self.V5E)
+        assert large.pair_solver == "resident"
+        assert large.rounds_resident == 2
 
     def test_solver_consumes_shipped_verdicts(self):
         """End-to-end: `_plan_entry` on a (spoofed-large) problem takes
